@@ -1,0 +1,14 @@
+"""spark_text_clustering_tpu — a TPU-native text-clustering framework.
+
+A from-scratch JAX/XLA/pjit re-design of the capabilities of
+borisfoko/Spark-Text-Clustering (see SURVEY.md): host-side text
+preprocessing, device-side TF-IDF, online-VB and EM LDA topic models sharded
+over a ("data", "model") TPU mesh, scoring with human-readable reports, and
+single-artifact checkpointing.
+"""
+
+from .config import Params
+
+__version__ = "0.1.0"
+
+__all__ = ["Params", "__version__"]

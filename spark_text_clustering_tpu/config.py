@@ -1,0 +1,90 @@
+"""Hyperparameter / run configuration.
+
+The reference's entire config surface is the ``Params`` case class
+(reference: TextClustering/src/main/scala/Params.scala:1-11) plus hardcoded
+driver constants (LDATraining.scala:6-13).  We keep the exact field set of
+``Params`` as the core hyperparameter surface and add what the reference
+lacks: JSON round-tripping and CLI overrides (SURVEY.md §5 "Config / flag
+system").
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from dataclasses import dataclass, field
+from typing import Optional
+
+
+@dataclass
+class Params:
+    """LDA training hyperparameters.
+
+    Field-for-field equivalent of the reference's ``Params`` case class
+    (Params.scala:1-11); defaults match the reference's defaults.
+
+    ``-1`` sentinels for the concentrations mean "auto":
+      * EM:      alpha = 50/k + 1,  eta = 1.1   (observed in saved metadata:
+                 docConcentration=[11.0]*5, topicConcentration=1.1 for k=5)
+      * online:  alpha = eta = 1/k
+    (SURVEY.md §2.2 "LDA facade".)
+    """
+
+    input: str = ""
+    k: int = 5
+    max_iterations: int = 50
+    doc_concentration: float = -1.0
+    topic_concentration: float = -1.0
+    vocab_size: int = 2_900_000
+    stop_word_text: Optional[str] = None
+    algorithm: str = "em"  # "em" | "online" | "nmf"
+    checkpoint_dir: Optional[str] = None
+    checkpoint_interval: int = 10
+
+    # --- capability upgrades over the reference (not in Params.scala) ---
+    # Online-VB knobs; MLlib hardcodes these (SURVEY.md §3.3): tau0=1024,
+    # kappa=0.51, gammaShape=100; miniBatchFraction default 0.05 + 1/N is
+    # applied at run time when batch_size is None (LDAClustering.scala:43).
+    tau0: float = 1024.0
+    kappa: float = 0.51
+    gamma_shape: float = 100.0
+    batch_size: Optional[int] = None
+    seed: int = 0
+    # IDF behavior (LDAClustering.scala:177,184-187)
+    min_doc_freq: int = 2
+    idf_floor: float = 0.0001
+    # Device/runtime
+    data_shards: Optional[int] = None   # None -> all devices on the "data" axis
+    model_shards: int = 1               # vocab-axis sharding of beta [k, V]
+
+    def resolved_alpha(self) -> float:
+        if self.doc_concentration > 0:
+            return float(self.doc_concentration)
+        if self.algorithm == "em":
+            return 50.0 / self.k + 1.0
+        return 1.0 / self.k
+
+    def resolved_eta(self) -> float:
+        if self.topic_concentration > 0:
+            return float(self.topic_concentration)
+        if self.algorithm == "em":
+            return 1.1
+        return 1.0 / self.k
+
+    def mini_batch_fraction(self, corpus_size: int) -> float:
+        """MLlib's ``miniBatchFraction = 0.05 + 1/corpusSize``
+        (LDAClustering.scala:43)."""
+        return 0.05 + 1.0 / max(1, corpus_size)
+
+    # --- serialization -------------------------------------------------
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self), indent=2, sort_keys=True)
+
+    @classmethod
+    def from_json(cls, s: str) -> "Params":
+        raw = json.loads(s)
+        known = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in raw.items() if k in known})
+
+    def replace(self, **kw) -> "Params":
+        return dataclasses.replace(self, **kw)

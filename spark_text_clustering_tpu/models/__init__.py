@@ -1,0 +1,4 @@
+from .base import LDAModel
+from .online_lda import OnlineLDA, make_online_train_step
+
+__all__ = ["LDAModel", "OnlineLDA", "make_online_train_step"]

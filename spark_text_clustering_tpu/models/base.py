@@ -1,0 +1,170 @@
+"""LDA model API — the capability surface of MLlib's
+``LocalLDAModel``/``DistributedLDAModel`` as exercised by the reference
+(SURVEY.md §2.2): ``describeTopics(n)``, ``topicDistribution``,
+``logLikelihood``/``logPerplexity``, ``save``/``load``, ``k``, ``vocabSize``.
+
+One model class serves both optimizers: EM's topic-word counts and online
+VB's lambda are both a [k, V] nonnegative matrix whose rows, normalized, are
+the topics.  The vocabulary is folded INTO the model (fixing the reference's
+fragile out-of-band sidecar, SURVEY.md §5 "Checkpoint / resume").
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.lda_math import (
+    approx_bound,
+    dirichlet_expectation,
+    infer_gamma,
+    init_gamma,
+    topic_inference,
+)
+from ..ops.sparse import DocTermBatch, batch_from_rows
+
+__all__ = ["LDAModel"]
+
+
+@dataclass
+class LDAModel:
+    """Topic model: ``lam`` [k, V] topic-word pseudo-counts, vocabulary, and
+    hyperparameters."""
+
+    lam: np.ndarray                    # [k, V] float32
+    vocab: List[str]
+    alpha: np.ndarray                  # [k] docConcentration
+    eta: float                         # topicConcentration
+    gamma_shape: float = 100.0
+    iteration_times: List[float] = field(default_factory=list)
+    algorithm: str = "online"
+    step: int = 0
+
+    # ---- shape accessors (MLlib: model.k, model.vocabSize) -------------
+    @property
+    def k(self) -> int:
+        return int(self.lam.shape[0])
+
+    @property
+    def vocab_size(self) -> int:
+        return int(self.lam.shape[1])
+
+    # ---- topics --------------------------------------------------------
+    def topics_matrix(self) -> np.ndarray:
+        """Row-normalized topic-term distributions [k, V] (MLlib's
+        ``topicsMatrix`` is column-major V x k; we keep [k, V])."""
+        lam = np.asarray(self.lam, np.float64)
+        return lam / lam.sum(axis=1, keepdims=True)
+
+    def describe_topics(
+        self, max_terms_per_topic: int = 10
+    ) -> List[List[Tuple[int, float]]]:
+        """Per-topic top-n (term_id, weight), weights normalized by topic
+        totals — ``describeTopics`` (LDAClustering.scala:81-92,
+        LDALoader.scala:66-69)."""
+        mat = self.topics_matrix()
+        out = []
+        for row in mat:
+            top = np.argsort(-row, kind="stable")[:max_terms_per_topic]
+            out.append([(int(i), float(row[i])) for i in top])
+        return out
+
+    def describe_topics_terms(
+        self, max_terms_per_topic: int = 10
+    ) -> List[List[Tuple[str, float]]]:
+        """Same, resolved through the vocabulary (the print loops at
+        LDAClustering.scala:85-92)."""
+        return [
+            [(self.vocab[i], w) for i, w in topic]
+            for topic in self.describe_topics(max_terms_per_topic)
+        ]
+
+    # ---- inference -----------------------------------------------------
+    def _exp_elog_beta(self) -> jnp.ndarray:
+        return jnp.exp(dirichlet_expectation(jnp.asarray(self.lam)))
+
+    def topic_distribution(
+        self,
+        docs: Union[DocTermBatch, Sequence[Tuple[np.ndarray, np.ndarray]]],
+        max_inner: int = 100,
+        tol: float = 1e-3,
+        seed: Optional[int] = None,
+    ) -> np.ndarray:
+        """Per-doc posterior topic mixture [B, k]
+        (``LocalLDAModel.topicDistribution``, LDALoader.scala:108).
+
+        ``seed=None`` uses the deterministic all-ones gamma init; the
+        reference's scoring is reproducible to ~1e-6 across runs regardless
+        of its random init (SURVEY.md §4), i.e. the fixed point dominates.
+        """
+        batch = (
+            docs
+            if isinstance(docs, DocTermBatch)
+            else batch_from_rows(list(docs))
+        )
+        key = None if seed is None else jax.random.PRNGKey(seed)
+        gamma0 = init_gamma(key, batch.num_docs, self.k, self.gamma_shape)
+        dist = topic_inference(
+            batch,
+            self._exp_elog_beta(),
+            jnp.asarray(self.alpha, jnp.float32),
+            gamma0,
+            max_inner=max_inner,
+            tol=tol,
+        )
+        return np.asarray(dist)
+
+    # ---- evaluation ----------------------------------------------------
+    def log_likelihood(
+        self,
+        docs: Union[DocTermBatch, Sequence[Tuple[np.ndarray, np.ndarray]]],
+        seed: Optional[int] = None,
+    ) -> float:
+        """Variational lower bound on log p(docs) (``logLikelihood``,
+        LDAClustering.scala:73-78 prints bound / corpusSize)."""
+        batch = (
+            docs
+            if isinstance(docs, DocTermBatch)
+            else batch_from_rows(list(docs))
+        )
+        key = None if seed is None else jax.random.PRNGKey(seed)
+        gamma0 = init_gamma(key, batch.num_docs, self.k, self.gamma_shape)
+        alpha = jnp.asarray(self.alpha, jnp.float32)
+        gamma = infer_gamma(batch, self._exp_elog_beta(), alpha, gamma0)
+        n_docs = float(np.asarray((batch.token_weights.sum(-1) > 0).sum()))
+        bound = approx_bound(
+            batch,
+            gamma,
+            jnp.asarray(self.lam),
+            alpha,
+            float(self.eta),
+            corpus_size=n_docs,
+            batch_docs=n_docs,
+        )
+        return float(bound)
+
+    def log_perplexity(self, docs) -> float:
+        """-bound / total token mass (MLlib ``logPerplexity``)."""
+        batch = (
+            docs
+            if isinstance(docs, DocTermBatch)
+            else batch_from_rows(list(docs))
+        )
+        tokens = float(np.asarray(batch.token_weights.sum()))
+        return -self.log_likelihood(batch) / max(tokens, 1.0)
+
+    # ---- persistence (delegates; see models/persistence.py) ------------
+    def save(self, path: str) -> None:
+        from .persistence import save_model
+
+        save_model(self, path)
+
+    @classmethod
+    def load(cls, path: str) -> "LDAModel":
+        from .persistence import load_model
+
+        return load_model(path)

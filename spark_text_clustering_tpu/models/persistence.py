@@ -1,0 +1,137 @@
+"""Model persistence: ONE self-contained artifact directory.
+
+The reference splits a model across a Parquet graph dump + JSON metadata +
+an out-of-band comma-joined vocabulary sidecar (SURVEY.md §3.5) — lose the
+sidecar and the model is unusable (LDALoader.scala:43).  We fold everything
+into a single directory (SURVEY.md §5 "Checkpoint / resume"):
+
+    <path>/
+      meta.json     — k, vocab_size, alpha, eta, gamma_shape, step,
+                      algorithm, iteration_times, format version
+      arrays.npz    — lam [k, V] float32 (+ alpha)
+      vocab.txt     — one term per line (utf-8)
+
+``save_train_state``/``load_train_state`` additionally persist the optimizer
+step for mid-training resume — the capability the reference's RDD
+checkpointing (intra-run lineage cuts only) does not provide.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+FORMAT_VERSION = 1
+
+__all__ = [
+    "save_model",
+    "load_model",
+    "save_train_state",
+    "load_train_state",
+    "model_dir_name",
+    "latest_model_dir",
+]
+
+
+def model_dir_name(lang: str, base: str = "models") -> str:
+    """Reference naming scheme ``LdaModel_<lang>_<epochMillis>``
+    (LDAClustering.scala:67-70)."""
+    return os.path.join(base, f"LdaModel_{lang}_{int(time.time() * 1000)}")
+
+
+def latest_model_dir(base: str, lang: str) -> Optional[str]:
+    """Newest saved model for a language — the reference takes the LAST
+    entry of an UNSORTED listFiles (LDALoader.scala:25-37), which is
+    filesystem-order dependent; we sort by the embedded timestamp so
+    'latest' actually means newest."""
+    if not os.path.isdir(base):
+        return None
+    prefix = f"LdaModel_{lang}_"
+    cands = [d for d in os.listdir(base) if d.startswith(prefix)]
+
+    def ts(d: str) -> int:
+        try:
+            return int(d.rsplit("_", 1)[-1])
+        except ValueError:
+            return -1
+
+    if not cands:
+        return None
+    return os.path.join(base, max(cands, key=ts))
+
+
+def save_model(model, path: str) -> None:
+    from .base import LDAModel  # local import to avoid cycle
+
+    assert isinstance(model, LDAModel)
+    os.makedirs(path, exist_ok=True)
+    meta = {
+        "format_version": FORMAT_VERSION,
+        "class": "spark_text_clustering_tpu.models.LDAModel",
+        "k": model.k,
+        "vocab_size": model.vocab_size,
+        "eta": float(model.eta),
+        "gamma_shape": float(model.gamma_shape),
+        "algorithm": model.algorithm,
+        "step": int(model.step),
+        "iteration_times": [float(t) for t in model.iteration_times],
+    }
+    with open(os.path.join(path, "meta.json"), "w") as f:
+        json.dump(meta, f, indent=2)
+    np.savez(
+        os.path.join(path, "arrays.npz"),
+        lam=np.asarray(model.lam, np.float32),
+        alpha=np.asarray(model.alpha, np.float32),
+    )
+    with open(os.path.join(path, "vocab.txt"), "w", encoding="utf-8") as f:
+        f.write("\n".join(model.vocab))
+
+
+def save_train_state(path: str, lam: np.ndarray, step: int) -> None:
+    """Mid-training checkpoint (lambda + optimizer step), written atomically
+    (tmp + rename) so a crash mid-write never corrupts the resume point.
+    The sampling/init streams are re-derived from (seed, iteration) at
+    resume, so no RNG state needs persisting."""
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    tmp = path + ".tmp.npz"
+    np.savez(tmp, lam=np.asarray(lam, np.float32), step=np.int64(step))
+    os.replace(tmp, path)
+
+
+def load_train_state(path: str) -> Tuple[np.ndarray, int]:
+    with np.load(path) as z:
+        return z["lam"], int(z["step"])
+
+
+def load_model(path: str):
+    from .base import LDAModel
+
+    with open(os.path.join(path, "meta.json")) as f:
+        meta = json.load(f)
+    if meta.get("format_version", 0) > FORMAT_VERSION:
+        raise ValueError(
+            f"checkpoint format {meta['format_version']} newer than "
+            f"supported {FORMAT_VERSION}"
+        )
+    arrays = np.load(os.path.join(path, "arrays.npz"))
+    with open(os.path.join(path, "vocab.txt"), encoding="utf-8") as f:
+        vocab = f.read().split("\n")
+    model = LDAModel(
+        lam=arrays["lam"],
+        vocab=vocab,
+        alpha=arrays["alpha"],
+        eta=float(meta["eta"]),
+        gamma_shape=float(meta.get("gamma_shape", 100.0)),
+        iteration_times=list(meta.get("iteration_times", [])),
+        algorithm=meta.get("algorithm", "online"),
+        step=int(meta.get("step", 0)),
+    )
+    if model.vocab_size != len(vocab):
+        raise ValueError(
+            f"vocab length {len(vocab)} != lam vocab axis {model.vocab_size}"
+        )
+    return model

@@ -1,0 +1,36 @@
+from .lda_math import (
+    approx_bound,
+    dirichlet_expectation,
+    e_step,
+    infer_gamma,
+    init_gamma,
+    init_lambda,
+    topic_inference,
+)
+from .sparse import DocTermBatch, batch_from_rows, bucket_by_length, next_pow2
+from .tfidf import (
+    doc_freq,
+    hashing_tf_ids,
+    idf_from_df,
+    idf_transform,
+    murmur3_32,
+)
+
+__all__ = [
+    "approx_bound",
+    "dirichlet_expectation",
+    "e_step",
+    "infer_gamma",
+    "init_gamma",
+    "init_lambda",
+    "topic_inference",
+    "DocTermBatch",
+    "batch_from_rows",
+    "bucket_by_length",
+    "next_pow2",
+    "doc_freq",
+    "hashing_tf_ids",
+    "idf_from_df",
+    "idf_transform",
+    "murmur3_32",
+]

@@ -1,0 +1,223 @@
+"""Core LDA variational math (single-shard, pure JAX).
+
+This re-owns the loop that the reference delegates to MLlib's
+``OnlineLDAOptimizer`` / ``LocalLDAModel.topicDistribution``
+(SURVEY.md §2.2, §3.3): Hoffman-style online variational Bayes.
+
+Design notes (TPU-first):
+  * The per-document E-step is batched over a ``DocTermBatch`` [B, L] — one
+    ``lax.while_loop`` iterates ALL docs' gamma simultaneously; converged
+    docs keep iterating at their fixed point (cheaper than masking on TPU,
+    and bitwise-stable since the update is a contraction at the optimum).
+  * The only gather is ``expElogbeta[:, ids]`` -> [B, L, k], hoisted out of
+    the loop; each inner iteration is two batched matvecs that XLA maps onto
+    the MXU.
+  * Sufficient statistics are ONE scatter-add (``segment_sum`` style) over
+    the flattened batch — the device analogue of MLlib's ``treeAggregate``;
+    cross-chip reduction (``psum``) happens in ``parallel.train_step``.
+  * Padding slots (weight 0) contribute exactly 0 everywhere.
+
+Semantics preserved from MLlib (metadata-confirmed): gamma init ~
+Gamma(shape=100, scale=1/100), inner convergence mean|Δgamma| < 1e-3,
+max 100 inner iterations.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.scipy.special import digamma, gammaln
+
+from .sparse import DocTermBatch
+
+__all__ = [
+    "dirichlet_expectation",
+    "init_lambda",
+    "init_gamma",
+    "e_step",
+    "infer_gamma",
+    "topic_inference",
+    "approx_bound",
+]
+
+_PHI_EPS = 1e-100
+
+
+def dirichlet_expectation(alpha: jnp.ndarray) -> jnp.ndarray:
+    """E[log X] for X ~ Dir(alpha), rows are distributions:
+    psi(alpha) - psi(sum(alpha, -1))."""
+    return digamma(alpha) - digamma(alpha.sum(axis=-1, keepdims=True))
+
+
+def init_lambda(
+    key: jax.Array, k: int, vocab_size: int, gamma_shape: float = 100.0
+) -> jnp.ndarray:
+    """lambda ~ Gamma(gammaShape, 1/gammaShape), shape [k, V] — MLlib's init
+    (gammaShape=100 persisted in the reference's model metadata)."""
+    return (
+        jax.random.gamma(key, gamma_shape, (k, vocab_size), jnp.float32)
+        / gamma_shape
+    )
+
+
+def init_gamma(
+    key: Optional[jax.Array], n_docs: int, k: int, gamma_shape: float = 100.0
+) -> jnp.ndarray:
+    if key is None:
+        return jnp.ones((n_docs, k), jnp.float32)
+    return (
+        jax.random.gamma(key, gamma_shape, (n_docs, k), jnp.float32)
+        / gamma_shape
+    )
+
+
+class EStepResult(NamedTuple):
+    gamma: jnp.ndarray        # [B, k] variational doc-topic posteriors
+    sstats: jnp.ndarray       # [k, V] raw sufficient stats (NOT yet * expElogbeta)
+    iters: jnp.ndarray        # scalar int32 — inner iterations actually run
+
+
+def _gamma_fixed_point(
+    eb: jnp.ndarray,        # [B, L, k] gathered exp(E[log beta])
+    cts: jnp.ndarray,       # [B, L]
+    alpha: jnp.ndarray,
+    gamma0: jnp.ndarray,    # [B, k]
+    max_inner: int,
+    tol: float,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """The shared inner gamma iteration (Hoffman eq. 2-4; MLlib
+    ``variationalTopicInference``): iterate all docs' gamma until the worst
+    per-doc mean|Δgamma| < tol or max_inner."""
+
+    def body(carry):
+        gamma, _, it = carry
+        exp_etheta = jnp.exp(dirichlet_expectation(gamma))     # [B, k]
+        phinorm = jnp.einsum("blk,bk->bl", eb, exp_etheta) + _PHI_EPS
+        gamma_new = alpha + exp_etheta * jnp.einsum(
+            "blk,bl->bk", eb, cts / phinorm
+        )
+        meanchange = jnp.abs(gamma_new - gamma).mean(axis=-1)  # [B]
+        return gamma_new, meanchange.max(), it + 1
+
+    def cond(carry):
+        _, worst, it = carry
+        return jnp.logical_and(it < max_inner, worst >= tol)
+
+    gamma, _, iters = lax.while_loop(
+        cond, body, (gamma0, jnp.float32(jnp.inf), jnp.int32(0))
+    )
+    return gamma, iters
+
+
+@partial(jax.jit, static_argnames=("max_inner", "vocab_size"))
+def e_step(
+    batch: DocTermBatch,
+    exp_elog_beta: jnp.ndarray,   # [k, V]
+    alpha: jnp.ndarray,           # [k] or scalar
+    gamma0: jnp.ndarray,          # [B, k]
+    vocab_size: int,
+    max_inner: int = 100,
+    tol: float = 1e-3,
+) -> EStepResult:
+    """Batched per-document variational E-step: gamma fixed point plus the
+    sufficient-statistics scatter-add (SURVEY.md §3.3)."""
+    ids, cts = batch.token_ids, batch.token_weights           # [B, L]
+    # Hoisted gather: per-doc slice of exp(E[log beta]) — [B, L, k].
+    eb = jnp.moveaxis(exp_elog_beta, 0, -1)[ids]              # [B, L, k]
+    gamma, iters = _gamma_fixed_point(eb, cts, alpha, gamma0, max_inner, tol)
+
+    # Final responsibilities -> sufficient statistics in ONE scatter-add.
+    exp_etheta = jnp.exp(dirichlet_expectation(gamma))         # [B, k]
+    phinorm = jnp.einsum("blk,bk->bl", eb, exp_etheta) + _PHI_EPS
+    ratio = cts / phinorm                                      # [B, L]
+    vals = ratio[..., None] * exp_etheta[:, None, :]           # [B, L, k]
+    sstats_vt = (
+        jnp.zeros((vocab_size, exp_etheta.shape[-1]), jnp.float32)
+        .at[ids.reshape(-1)]
+        .add(vals.reshape(-1, exp_etheta.shape[-1]))
+    )                                                          # [V, k]
+    return EStepResult(gamma, sstats_vt.T, iters)
+
+
+@partial(jax.jit, static_argnames=("max_inner",))
+def infer_gamma(
+    batch: DocTermBatch,
+    exp_elog_beta: jnp.ndarray,
+    alpha: jnp.ndarray,
+    gamma0: jnp.ndarray,
+    max_inner: int = 100,
+    tol: float = 1e-3,
+) -> jnp.ndarray:
+    """Gamma-only inference (no sufficient statistics) — the cheap path for
+    scoring and ELBO evaluation."""
+    eb = jnp.moveaxis(exp_elog_beta, 0, -1)[batch.token_ids]
+    gamma, _ = _gamma_fixed_point(
+        eb, batch.token_weights, alpha, gamma0, max_inner, tol
+    )
+    return gamma
+
+
+@partial(jax.jit, static_argnames=("max_inner",))
+def topic_inference(
+    batch: DocTermBatch,
+    exp_elog_beta: jnp.ndarray,
+    alpha: jnp.ndarray,
+    gamma0: jnp.ndarray,
+    max_inner: int = 100,
+    tol: float = 1e-3,
+) -> jnp.ndarray:
+    """``LocalLDAModel.topicDistribution`` equivalent (LDALoader.scala:108):
+    E-step with fixed topics, returns normalized gamma [B, k].  Empty docs
+    (all-zero weights) get the uniform distribution, matching MLlib."""
+    cts = batch.token_weights
+    eb = jnp.moveaxis(exp_elog_beta, 0, -1)[batch.token_ids]
+    gamma, _ = _gamma_fixed_point(eb, cts, alpha, gamma0, max_inner, tol)
+    nonempty = cts.sum(axis=-1, keepdims=True) > 0
+    k = gamma.shape[-1]
+    dist = gamma / gamma.sum(axis=-1, keepdims=True)
+    return jnp.where(nonempty, dist, jnp.full_like(dist, 1.0 / k))
+
+
+@partial(jax.jit, static_argnames=())
+def approx_bound(
+    batch: DocTermBatch,
+    gamma: jnp.ndarray,          # [B, k]
+    lam: jnp.ndarray,            # [k, V]
+    alpha: jnp.ndarray,          # [k] or scalar broadcast
+    eta: float,
+    corpus_size: float,
+    batch_docs: float,
+) -> jnp.ndarray:
+    """Hoffman's variational lower bound (ELBO) on log p(docs) — the basis of
+    ``LocalLDAModel.logLikelihood``/``logPerplexity``.  Document terms are
+    scaled by corpus_size/batch_docs; the topic term is counted once."""
+    ids, cts = batch.token_ids, batch.token_weights
+    k = gamma.shape[-1]
+    elog_theta = dirichlet_expectation(gamma)                  # [B, k]
+    elog_beta = dirichlet_expectation(lam)                     # [k, V]
+    eb = jnp.moveaxis(elog_beta, 0, -1)[ids]                   # [B, L, k]
+
+    # E[log p(docs | theta, beta)]: per token, logsumexp over topics.
+    lse = jax.nn.logsumexp(eb + elog_theta[:, None, :], axis=-1)  # [B, L]
+    score = (cts * lse).sum()
+
+    # E[log p(theta | alpha) - log q(theta | gamma)]
+    alpha_v = jnp.broadcast_to(jnp.asarray(alpha, jnp.float32), (k,))
+    score += ((alpha_v - gamma) * elog_theta).sum()
+    score += (gammaln(gamma) - gammaln(alpha_v)).sum()
+    score += (
+        gammaln(alpha_v.sum()) - gammaln(gamma.sum(axis=-1))
+    ).sum()
+
+    score = score * (corpus_size / jnp.maximum(batch_docs, 1.0))
+
+    # E[log p(beta | eta) - log q(beta | lambda)]
+    v = lam.shape[-1]
+    score += ((eta - lam) * elog_beta).sum()
+    score += (gammaln(lam) - gammaln(eta)).sum()
+    score += (gammaln(eta * v) - gammaln(lam.sum(axis=-1))).sum()
+    return score

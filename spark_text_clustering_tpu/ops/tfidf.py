@@ -1,0 +1,126 @@
+"""TF / HashingTF / IDF on device.
+
+IDF semantics are MLlib's as exercised by the reference
+(LDAClustering.scala:174-192 and SURVEY.md §2.2 "IDF"):
+
+    idf(t) = log((m + 1) / (df(t) + 1)),  forced to 0 when df(t) < min_doc_freq
+    reference then patches idf == 0 -> 0.0001 so low-DF terms keep tiny mass
+    (the 0.0001 edge weights visible in the saved models' tokenCounts)
+
+The distributed fit is ONE reduction over doc-sharded df counts — Spark's
+aggregate becomes a ``psum`` over the "data" mesh axis (done by the caller in
+``parallel``; this module is single-shard pure math).
+
+HashingTF (a north-star addition, BASELINE.json) uses Spark-compatible
+MurmurHash3 x86_32 with seed 42 over UTF-8 bytes, so hashed features line up
+with a Spark HashingTF run.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Iterable, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .sparse import DocTermBatch
+
+__all__ = [
+    "doc_freq",
+    "idf_from_df",
+    "idf_transform",
+    "murmur3_32",
+    "hashing_tf_ids",
+]
+
+
+def doc_freq(batch: DocTermBatch, vocab_size: int) -> jnp.ndarray:
+    """df[t] = number of docs containing term t (one scatter-add)."""
+    present = (batch.token_weights > 0).astype(jnp.float32)
+    return (
+        jnp.zeros((vocab_size,), jnp.float32)
+        .at[batch.token_ids.reshape(-1)]
+        .add(present.reshape(-1))
+    )
+
+
+def idf_from_df(
+    df: jnp.ndarray, num_docs: int, min_doc_freq: int = 2
+) -> jnp.ndarray:
+    """MLlib IDF(minDocFreq) fit: log((m+1)/(df+1)), 0 below the df cutoff."""
+    idf = jnp.log((num_docs + 1.0) / (df + 1.0))
+    return jnp.where(df >= min_doc_freq, idf, 0.0)
+
+
+def idf_transform(
+    batch: DocTermBatch, idf: jnp.ndarray, idf_floor: float = 0.0001
+) -> DocTermBatch:
+    """tf * idf per active term, with the reference's 0-idf -> ``idf_floor``
+    patch (LDAClustering.scala:180-192).  Set ``idf_floor=0`` to disable.
+    Padding (weight 0) stays 0."""
+    per_token_idf = idf[batch.token_ids]
+    if idf_floor:
+        per_token_idf = jnp.where(per_token_idf == 0.0, idf_floor, per_token_idf)
+    return DocTermBatch(batch.token_ids, batch.token_weights * per_token_idf)
+
+
+# --------------------------------------------------------------------------
+# HashingTF: Spark-compatible MurmurHash3 x86_32 (seed 42) over UTF-8 bytes.
+# String hashing is host work; the resulting ids feed the same DocTermBatch
+# path as the exact vocab.
+# --------------------------------------------------------------------------
+def murmur3_32(data: bytes, seed: int = 42) -> int:
+    c1, c2 = 0xCC9E2D51, 0x1B873593
+    h = seed & 0xFFFFFFFF
+    n = len(data)
+    rounded = n - (n % 4)
+    for i in range(0, rounded, 4):
+        k = struct.unpack_from("<I", data, i)[0]
+        k = (k * c1) & 0xFFFFFFFF
+        k = ((k << 15) | (k >> 17)) & 0xFFFFFFFF
+        k = (k * c2) & 0xFFFFFFFF
+        h ^= k
+        h = ((h << 13) | (h >> 19)) & 0xFFFFFFFF
+        h = (h * 5 + 0xE6546B64) & 0xFFFFFFFF
+    k = 0
+    tail = n % 4
+    if tail >= 3:
+        k ^= data[rounded + 2] << 16
+    if tail >= 2:
+        k ^= data[rounded + 1] << 8
+    if tail >= 1:
+        k ^= data[rounded]
+        k = (k * c1) & 0xFFFFFFFF
+        k = ((k << 15) | (k >> 17)) & 0xFFFFFFFF
+        k = (k * c2) & 0xFFFFFFFF
+        h ^= k
+    h ^= n
+    h ^= h >> 16
+    h = (h * 0x85EBCA6B) & 0xFFFFFFFF
+    h ^= h >> 13
+    h = (h * 0xC2B2AE35) & 0xFFFFFFFF
+    h ^= h >> 16
+    return h
+
+
+def hashing_tf_ids(
+    tokens: Sequence[str], num_features: int = 1 << 18
+) -> Tuple[np.ndarray, np.ndarray]:
+    """One document's (sorted ids, counts) under the hashing trick —
+    drop-in replacement for exact-vocab ``count_vector`` that needs no
+    vocabulary pass (SURVEY.md §7 hard part 4)."""
+    from collections import Counter
+
+    from ..utils.vocab import counter_to_sparse
+
+    def bucket(t: str) -> int:
+        h = murmur3_32(t.encode("utf-8"))
+        # Spark interprets the hash as SIGNED int32 then takes a
+        # non-negative mod; identical for power-of-two num_features but not
+        # otherwise.
+        signed = h - (1 << 32) if h >= (1 << 31) else h
+        return signed % num_features
+
+    return counter_to_sparse(Counter(bucket(t) for t in tokens))

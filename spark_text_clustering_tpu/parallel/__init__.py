@@ -1,0 +1,31 @@
+from .collectives import (
+    all_gather_model,
+    data_shard_batch,
+    psum_data,
+    psum_model,
+    scatter_model,
+)
+from .mesh import (
+    DATA_AXIS,
+    MODEL_AXIS,
+    data_sharding,
+    initialize_distributed,
+    make_mesh,
+    model_sharding,
+    replicated,
+)
+
+__all__ = [
+    "all_gather_model",
+    "data_shard_batch",
+    "psum_data",
+    "psum_model",
+    "scatter_model",
+    "DATA_AXIS",
+    "MODEL_AXIS",
+    "data_sharding",
+    "initialize_distributed",
+    "make_mesh",
+    "model_sharding",
+    "replicated",
+]

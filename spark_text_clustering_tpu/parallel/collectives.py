@@ -1,0 +1,73 @@
+"""Collective helpers: the TPU-native replacements for Spark's communication
+patterns (SURVEY.md §2.5):
+
+  Spark pattern                         ->  here
+  ---------------------------------------------------------------
+  treeAggregate (Online-LDA suff stats) ->  ``psum`` over "data"
+  broadcast (vocab map, lambda/minibatch)-> replication via sharding specs
+  shuffle reduceByKey (word counts)     ->  scatter-add + ``psum``
+  collect to driver                     ->  device->host of a small array
+
+These are thin wrappers used inside ``shard_map``-ped train steps so the
+model code reads algorithmically.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from .mesh import DATA_AXIS, MODEL_AXIS
+
+__all__ = [
+    "psum_data",
+    "psum_model",
+    "all_gather_model",
+    "scatter_model",
+    "data_shard_batch",
+]
+
+
+def psum_data(x):
+    """Reduce across document shards — Spark's treeAggregate
+    (SURVEY.md §3.3: 'the pair that becomes device_put + jax.lax.psum')."""
+    return lax.psum(x, DATA_AXIS)
+
+
+def psum_model(x):
+    return lax.psum(x, MODEL_AXIS)
+
+
+def all_gather_model(x, axis: int = -1):
+    """Materialize the full vocab axis from model shards (lambda [k, V/s] ->
+    [k, V]).  Used before the E-step gather; the scaling path for k x V
+    beyond HBM replaces this with one-hot matmuls (SURVEY.md §7 hard part 5)."""
+    return lax.all_gather(x, MODEL_AXIS, axis=axis, tiled=True)
+
+
+def scatter_model(x, axis: int = -1):
+    """Slice a full-vocab array back down to this device's model shard."""
+    idx = lax.axis_index(MODEL_AXIS)
+    size = lax.axis_size(MODEL_AXIS)
+    shard = x.shape[axis] // size
+    return lax.dynamic_slice_in_dim(x, idx * shard, shard, axis=axis)
+
+
+def data_shard_batch(mesh: Mesh, batch):
+    """Place a DocTermBatch with docs sharded over "data" (pads the doc axis
+    up to a multiple of the data-axis size first)."""
+    from ..ops.sparse import DocTermBatch  # local import to avoid cycle
+
+    n_data = mesh.shape[DATA_AXIS]
+    b = batch.num_docs
+    padded = batch.pad_rows_to(((b + n_data - 1) // n_data) * n_data)
+    spec = jax.sharding.NamedSharding(mesh, P(DATA_AXIS, None))
+    return DocTermBatch(
+        jax.device_put(padded.token_ids, spec),
+        jax.device_put(padded.token_weights, spec),
+    )

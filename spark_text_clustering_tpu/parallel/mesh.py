@@ -1,0 +1,77 @@
+"""Device mesh construction.
+
+The reference's distributed runtime is Spark executors + netty shuffle
+(SURVEY.md §2.5); ours is a ``jax.sharding.Mesh`` with two named axes:
+
+  * ``"data"``  — documents are sharded here (Spark's RDD partitions).
+  * ``"model"`` — the topic-word matrix lambda [k, V] is sharded over V here
+                  (Spark's GraphX term-vertex partitioning, §2.5 "Model
+                  parallelism"); 1 for small vocabularies.
+
+Collectives ride ICI within a slice; across hosts, ``initialize_distributed``
+brings up DCN via ``jax.distributed`` (the NCCL/MPI-free TPU analogue of
+Spark's cluster manager).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["make_mesh", "data_sharding", "model_sharding", "replicated",
+           "initialize_distributed", "DATA_AXIS", "MODEL_AXIS"]
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+
+def make_mesh(
+    data_shards: Optional[int] = None,
+    model_shards: int = 1,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if data_shards is None:
+        if n % model_shards:
+            raise ValueError(f"{n} devices not divisible by model_shards={model_shards}")
+        data_shards = n // model_shards
+    if data_shards * model_shards != n:
+        raise ValueError(
+            f"mesh {data_shards}x{model_shards} != {n} devices"
+        )
+    arr = np.asarray(devices).reshape(data_shards, model_shards)
+    return Mesh(arr, (DATA_AXIS, MODEL_AXIS))
+
+
+def data_sharding(mesh: Mesh, ndim: int = 2) -> NamedSharding:
+    """Shard leading (doc) axis over "data"; replicate the rest."""
+    return NamedSharding(mesh, P(DATA_AXIS, *([None] * (ndim - 1))))
+
+
+def model_sharding(mesh: Mesh, ndim: int = 2) -> NamedSharding:
+    """Shard trailing (vocab) axis over "model"; replicate the rest."""
+    return NamedSharding(mesh, P(*([None] * (ndim - 1)), MODEL_AXIS))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def initialize_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Multi-host bring-up over DCN (SURVEY.md §2.5 "Communication backend").
+    No-op when single-process args are absent."""
+    if coordinator_address is None:
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )

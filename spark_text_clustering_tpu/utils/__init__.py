@@ -1,0 +1,30 @@
+from .readers import Document, list_books, read_stop_word_file, read_text_dir
+from .textproc import (
+    filter_special_characters,
+    lemmatize_text,
+    parse_stop_words,
+    preprocess_document,
+    simple_tokenize,
+    stem,
+)
+from .timing import IterationTimer, PhaseTimer
+from .vocab import build_vocab, count_terms, count_vector, count_vectors
+
+__all__ = [
+    "Document",
+    "list_books",
+    "read_stop_word_file",
+    "read_text_dir",
+    "filter_special_characters",
+    "lemmatize_text",
+    "parse_stop_words",
+    "preprocess_document",
+    "simple_tokenize",
+    "stem",
+    "IterationTimer",
+    "PhaseTimer",
+    "build_vocab",
+    "count_terms",
+    "count_vector",
+    "count_vectors",
+]

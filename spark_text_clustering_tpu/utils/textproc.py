@@ -1,0 +1,254 @@
+"""Host-side text preprocessing.
+
+Tokenization/lemmatization/stemming is CPU string work — it never belonged on
+an accelerator — so this layer is pure Python, matching the observable
+semantics of the reference's JVM NLP stack (SURVEY.md §2.1/§2.3):
+
+  * cleaner           — regex of LDAClustering.scala:283-284
+  * lemmatizer        — CoreNLP ``morphology.lemma(word, tag)`` equivalent
+                        (LDAClustering.scala:293-309), incl. the "keep only
+                        lemmas with length > 3" filter and the per-sentence
+                        word-dedup quirk (``(words zip tags).toMap``).
+                        CoreNLP is not bit-reproducible in Python; we use a
+                        deterministic rule lemmatizer (SURVEY.md §7 hard part 6).
+  * tokenizer         — OpenNLP ``SimpleTokenizer`` equivalent: maximal runs
+                        of a single character class (LDAClustering.scala:133-135)
+  * Porter stemmer    — OpenNLP ``PorterStemmer`` equivalent via NLTK's
+                        original-algorithm mode, case-preserved
+                        (vocab evidence: "Holm", "veri", "littl")
+  * stop words        — comma-split, case-sensitive, applied PRE-stemming
+                        (LDAClustering.scala:125-137)
+"""
+
+from __future__ import annotations
+
+import re
+from functools import lru_cache
+from typing import Iterable, List, Sequence
+
+from nltk.stem import PorterStemmer
+
+__all__ = [
+    "filter_special_characters",
+    "lemmatize_text",
+    "simple_tokenize",
+    "stem",
+    "parse_stop_words",
+    "preprocess_document",
+]
+
+# --------------------------------------------------------------------------
+# Cleaning (LDAClustering.scala:283-284): the reference replaces this char
+# class with a space.
+# --------------------------------------------------------------------------
+_SPECIAL_RE = re.compile(r"[»«!@#$%^&*()_+\-−,”\"’';:.`?]")
+
+
+def filter_special_characters(text: str) -> str:
+    return _SPECIAL_RE.sub(" ", text)
+
+
+# --------------------------------------------------------------------------
+# Tokenization. OpenNLP SimpleTokenizer emits maximal runs of one character
+# class: alphabetic, numeric, whitespace (separator), other (each punct char
+# class run).  (LDAClustering.scala:7,133-135.)
+# --------------------------------------------------------------------------
+_TOKEN_RE = re.compile(r"[^\W\d_]+|\d+|[^\w\s]+", re.UNICODE)
+
+
+def simple_tokenize(text: str) -> List[str]:
+    return _TOKEN_RE.findall(text)
+
+
+# --------------------------------------------------------------------------
+# Porter stemming. OpenNLP's PorterStemmer is the classic Porter algorithm
+# and preserves case of the leading letter ("Holmes" -> "Holm"); NLTK's
+# ORIGINAL_ALGORITHM mode with to_lowercase disabled matches that behavior.
+# --------------------------------------------------------------------------
+_STEMMER = PorterStemmer(mode="ORIGINAL_ALGORITHM")
+
+
+@lru_cache(maxsize=1 << 18)
+def stem(token: str) -> str:
+    return _STEMMER.stem(token, to_lowercase=False)
+
+
+# --------------------------------------------------------------------------
+# Stop words: a single comma-separated line (resources/stopWords_EN.txt); the
+# reference flat-splits every input line on ',' (LDAClustering.scala:125-129)
+# and filters case-sensitively BEFORE stemming (:132-137).
+# --------------------------------------------------------------------------
+def parse_stop_words(text_or_lines) -> frozenset:
+    if isinstance(text_or_lines, str):
+        lines: Iterable[str] = text_or_lines.splitlines() or [text_or_lines]
+    else:
+        lines = text_or_lines
+    out = set()
+    for line in lines:
+        for w in line.split(","):
+            w = w.strip()
+            if w:
+                out.add(w)
+    return frozenset(out)
+
+
+# --------------------------------------------------------------------------
+# Lemmatization. CoreNLP-equivalent behavior (LDAClustering.scala:293-309):
+# sentence split, per-word lemma, keep only lemmas with len > 3, join with
+# spaces.  The reference builds ``(words zip tags).toMap`` per sentence,
+# which DEDUPS repeated words within a sentence (and scrambles order); we
+# reproduce the dedup (it defines the observed document counts) but keep
+# first-occurrence order for determinism.
+# --------------------------------------------------------------------------
+_SENT_SPLIT_RE = re.compile(r"(?<=[.!?])\s+")
+_WORD_RE = re.compile(r"[^\W\d_]+(?:['’][^\W\d_]+)?", re.UNICODE)
+
+# Small irregular-form table (most frequent English irregulars; CoreNLP's
+# Morphology resolves these via its finite-state lexicon).
+_IRREGULAR = {
+    "was": "be", "were": "be", "been": "be", "is": "be", "are": "be",
+    "am": "be", "has": "have", "had": "have", "having": "have",
+    "did": "do", "does": "do", "done": "do",
+    "went": "go", "gone": "go", "goes": "go",
+    "said": "say", "says": "say", "saw": "see", "seen": "see",
+    "made": "make", "came": "come", "taken": "take", "took": "take",
+    "given": "give", "gave": "give", "got": "get", "gotten": "get",
+    "knew": "know", "known": "know", "thought": "think", "told": "tell",
+    "found": "find", "left": "leave", "felt": "feel", "kept": "keep",
+    "held": "hold", "brought": "bring", "stood": "stand", "sat": "sit",
+    "spoke": "speak", "spoken": "speak", "heard": "hear", "meant": "mean",
+    "men": "man", "women": "woman", "children": "child", "feet": "foot",
+    "teeth": "tooth", "mice": "mouse", "people": "person", "wives": "wife",
+    "lives": "life", "leaves": "leaf", "selves": "self", "eyes": "eye",
+    "better": "good", "best": "good", "worse": "bad", "worst": "bad",
+}
+
+_VOWELS = set("aeiou")
+
+
+def _strip_double(stem_: str) -> str:
+    """running -> runn -> run (undo consonant doubling)."""
+    if (
+        len(stem_) >= 2
+        and stem_[-1] == stem_[-2]
+        and stem_[-1] not in _VOWELS
+        and stem_[-1] not in "ls"  # fall/fell, miss keep doubles
+    ):
+        return stem_[:-1]
+    return stem_
+
+
+def _needs_e(stem_: str) -> bool:
+    """making -> mak -> make: restore silent e after C{v}C[^aeiouwxy]."""
+    if len(stem_) < 3:
+        return False
+    c1, v, c2 = stem_[-3], stem_[-2], stem_[-1]
+    return (
+        c2 not in _VOWELS
+        and c2 not in "wxy"
+        and v in _VOWELS
+        and c1 not in _VOWELS
+        and not any(ch in _VOWELS for ch in stem_[:-3][-1:])
+    )
+
+
+def lemma(word: str) -> str:
+    """Deterministic rule lemmatizer approximating CoreNLP's
+    ``morphology.lemma``.  Case is preserved for non-suffix characters
+    (proper nouns stay capitalized, as in the reference's vocab)."""
+    low = word.lower()
+    if low in _IRREGULAR:
+        out = _IRREGULAR[low]
+        return word[0] + out[1:] if word[0].isupper() and len(out) > 1 else out
+
+    # plural / 3rd-person -s
+    if low.endswith("ies") and len(low) > 4:
+        return word[:-3] + "y"
+    if low.endswith("sses") or low.endswith("shes") or low.endswith("ches") or low.endswith("xes") or low.endswith("zes"):
+        return word[:-2]
+    if low.endswith("s") and not low.endswith("ss") and not low.endswith("us") and not low.endswith("is") and len(low) > 3:
+        return word[:-1]
+    # -ing
+    if low.endswith("ing") and len(low) > 5:
+        stem_ = word[:-3]
+        if not any(ch in _VOWELS for ch in stem_.lower()):
+            return word  # "sing", "thing"-like stems with no vowel left
+        stripped = _strip_double(stem_)
+        if stripped != stem_:
+            return stripped
+        if _needs_e(stem_.lower()):
+            return stem_ + "e"
+        return stem_
+    # -ed
+    if low.endswith("ied") and len(low) > 4:
+        return word[:-3] + "y"
+    if low.endswith("ed") and len(low) > 4:
+        stem_ = word[:-2]
+        if not any(ch in _VOWELS for ch in stem_.lower()):
+            return word
+        stripped = _strip_double(stem_)
+        if stripped != stem_:
+            return stripped
+        if _needs_e(stem_.lower()):
+            return stem_ + "e"
+        return stem_
+    return word
+
+
+def lemmatize_text(
+    text: str,
+    min_len_exclusive: int = 3,
+    dedup_within_sentence: bool = True,
+) -> str:
+    """CoreNLP ``getLemmaText`` equivalent (LDAClustering.scala:293-309):
+    sentence split -> per-word lemma -> keep lemmas with
+    ``len > min_len_exclusive`` -> join with spaces.
+
+    ``dedup_within_sentence=True`` reproduces the reference's
+    ``(words zip tags).toMap`` quirk (repeated words within one sentence are
+    counted once); disable for exact-count vectorization.
+    """
+    pieces: List[str] = []
+    for sentence in _SENT_SPLIT_RE.split(text):
+        words = _WORD_RE.findall(sentence)
+        if dedup_within_sentence:
+            seen = set()
+            uniq = []
+            for w in words:
+                if w not in seen:
+                    seen.add(w)
+                    uniq.append(w)
+            words = uniq
+        for w in words:
+            lm = lemma(w)
+            if len(lm) > min_len_exclusive:
+                pieces.append(lm)
+    return " ".join(pieces)
+
+
+# --------------------------------------------------------------------------
+# Full per-document pipeline (the map side of BuildTFIDFVector steps 1-5,
+# LDAClustering.scala:113-139): lemmatize -> clean -> tokenize ->
+# stop-filter (len>=1, case-sensitive, pre-stemming) -> Porter stem.
+# --------------------------------------------------------------------------
+def preprocess_document(
+    text: str,
+    stop_words: frozenset = frozenset(),
+    lemmatize: bool = True,
+    min_lemma_len_exclusive: int = 3,
+    dedup_within_sentence: bool = True,
+) -> List[str]:
+    if lemmatize:
+        text = lemmatize_text(
+            text,
+            min_len_exclusive=min_lemma_len_exclusive,
+            dedup_within_sentence=dedup_within_sentence,
+        )
+    text = filter_special_characters(text)
+    out: List[str] = []
+    for tok in simple_tokenize(text):
+        if len(tok) >= 1 and tok not in stop_words:
+            s = stem(tok)
+            if s:
+                out.append(s)
+    return out

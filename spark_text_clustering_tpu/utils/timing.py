@@ -1,0 +1,50 @@
+"""Phase timing / iteration times.
+
+The reference's only observability is ``System.nanoTime`` around
+preprocessing and training (LDAClustering.scala:22-34,58-64) plus MLlib's
+per-iteration wall times persisted into model metadata (``iterationTimes``).
+We keep both: a ``PhaseTimer`` for coarse phases and per-iteration times
+recorded by the optimizers and persisted in checkpoints (SURVEY.md §5
+"Tracing / profiling").
+"""
+
+from __future__ import annotations
+
+import time
+from contextlib import contextmanager
+from typing import Dict, List
+
+
+class PhaseTimer:
+    def __init__(self) -> None:
+        self.phases: Dict[str, float] = {}
+
+    @contextmanager
+    def phase(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.phases[name] = self.phases.get(name, 0.0) + (
+                time.perf_counter() - t0
+            )
+
+    def summary(self) -> str:
+        return "\n".join(f"{k}: {v:.3f}s" for k, v in self.phases.items())
+
+
+class IterationTimer:
+    """Collects per-iteration wall seconds, like MLlib's ``iterationTimes``
+    metadata field."""
+
+    def __init__(self) -> None:
+        self.times: List[float] = []
+        self._t0 = None
+
+    def start(self) -> None:
+        self._t0 = time.perf_counter()
+
+    def stop(self) -> None:
+        if self._t0 is not None:
+            self.times.append(time.perf_counter() - self._t0)
+            self._t0 = None

@@ -1,0 +1,90 @@
+"""Vocabulary construction and host-side count vectorization.
+
+Reference semantics (BuildTFIDFVector steps 6-8, LDAClustering.scala:144-167):
+corpus-wide word counts (flatMap + reduceByKey), vocabulary = top ``vocab_size``
+terms by DESCENDING corpus frequency, vocabulary index = frequency rank, then
+per-document sparse count vectors over that vocab with sorted indices.
+
+Spark's ``sortBy(desc).take(V)`` breaks frequency ties nondeterministically
+(partition order); we break ties by term (ascending) for reproducibility —
+a documented divergence.  ``count_terms`` accepts any iterable of token
+lists and Counter addition is associative, so sharded counting reduces to
+``sum(map(count_terms, shards), Counter())``.
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+from typing import Dict, Iterable, List, Sequence, Tuple
+
+import numpy as np
+
+__all__ = [
+    "count_terms",
+    "build_vocab",
+    "counter_to_sparse",
+    "count_vector",
+    "count_vectors",
+]
+
+
+def counter_to_sparse(c: Counter) -> Tuple[np.ndarray, np.ndarray]:
+    """{id: count} -> (sorted int32 ids, float32 counts)."""
+    if not c:
+        return (np.zeros(0, np.int32), np.zeros(0, np.float32))
+    ids = np.fromiter(sorted(c.keys()), dtype=np.int32, count=len(c))
+    vals = np.asarray([c[int(i)] for i in ids], dtype=np.float32)
+    return ids, vals
+
+
+def count_terms(docs_tokens: Iterable[Sequence[str]]) -> Counter:
+    """Corpus-wide term occurrence counts (LDAClustering.scala:144-147)."""
+    c: Counter = Counter()
+    for toks in docs_tokens:
+        c.update(toks)
+    return c
+
+
+def build_vocab(
+    term_counts: Counter,
+    vocab_size: int,
+) -> Tuple[List[str], Dict[str, int]]:
+    """Top-``vocab_size`` terms by descending count; index = rank
+    (LDAClustering.scala:148-151).  Ties broken by term ascending
+    (deterministic; Spark's take() is partition-order dependent)."""
+    ranked = sorted(term_counts.items(), key=lambda kv: (-kv[1], kv[0]))
+    vocab = [t for t, _ in ranked[:vocab_size]]
+    return vocab, {t: i for i, t in enumerate(vocab)}
+
+
+def count_vector(
+    tokens: Sequence[str],
+    term_to_id: Dict[str, int],
+) -> Tuple[np.ndarray, np.ndarray]:
+    """One document's sparse count vector over the vocab: (sorted ids, counts)
+    — the ``Vectors.sparse`` build of LDAClustering.scala:154-167.  Tokens
+    outside the vocab are dropped."""
+    c: Counter = Counter()
+    for t in tokens:
+        i = term_to_id.get(t)
+        if i is not None:
+            c[i] += 1
+    return counter_to_sparse(c)
+
+
+def count_vectors(
+    docs_tokens: Sequence[Sequence[str]],
+    term_to_id: Dict[str, int],
+    drop_empty: bool = True,
+) -> Tuple[List[Tuple[np.ndarray, np.ndarray]], List[int]]:
+    """Vectorize a corpus; returns (list of (ids, counts), kept original
+    indices).  Empty documents are dropped as in the reference
+    (LDAClustering.scala:139 filters empty token lists)."""
+    out, kept = [], []
+    for j, toks in enumerate(docs_tokens):
+        ids, vals = count_vector(toks, term_to_id)
+        if len(ids) == 0 and drop_empty:
+            continue
+        out.append((ids, vals))
+        kept.append(j)
+    return out, kept

@@ -1,0 +1,57 @@
+"""Test harness: force an 8-device virtual CPU platform BEFORE jax imports —
+the TPU-world analogue of a fake Spark cluster (SURVEY.md §4)."""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+# The sandbox pins JAX_PLATFORMS=axon (one real TPU); route tests to the
+# 8-device virtual CPU platform instead.
+CPU_DEVICES = jax.devices("cpu")
+jax.config.update("jax_default_device", CPU_DEVICES[0])
+
+REFERENCE_RESOURCES = "/root/reference/TextClustering/src/main/resources"
+
+
+@pytest.fixture(scope="session")
+def eight_devices():
+    assert len(CPU_DEVICES) == 8
+    return CPU_DEVICES
+
+
+@pytest.fixture(scope="session")
+def tiny_corpus_rows():
+    """A tiny deterministic synthetic corpus with two obvious topics."""
+    rng = np.random.default_rng(0)
+    v = 50
+    rows = []
+    for d in range(24):
+        topic = d % 2
+        terms = rng.choice(
+            np.arange(0, 25) if topic == 0 else np.arange(25, 50),
+            size=12,
+            replace=False,
+        )
+        counts = rng.integers(1, 6, size=terms.size)
+        order = np.argsort(terms)
+        rows.append(
+            (terms[order].astype(np.int32), counts[order].astype(np.float32))
+        )
+    vocab = [f"term{i}" for i in range(v)]
+    return rows, vocab
+
+
+@pytest.fixture(scope="session")
+def reference_resources():
+    if not os.path.isdir(REFERENCE_RESOURCES):
+        pytest.skip("reference resources not mounted")
+    return REFERENCE_RESOURCES

@@ -1,0 +1,84 @@
+"""Online VB LDA: convergence + sharding-consistency tests on the 8-device
+virtual CPU mesh (SURVEY.md §4 multi-device strategy)."""
+
+import jax
+import numpy as np
+import pytest
+
+from spark_text_clustering_tpu.config import Params
+from spark_text_clustering_tpu.models import LDAModel, OnlineLDA
+from spark_text_clustering_tpu.parallel import make_mesh
+
+
+def _fit(rows, vocab, **kw):
+    defaults = dict(
+        k=2,
+        algorithm="online",
+        max_iterations=40,
+        batch_size=24,
+        seed=3,
+    )
+    defaults.update(kw)
+    data_shards = defaults.pop("data_shards", None)
+    model_shards = defaults.get("model_shards", 1)
+    cpu = jax.devices("cpu")
+    if data_shards is None:
+        data_shards = len(cpu) // model_shards
+    p = Params(**defaults)
+    mesh = make_mesh(
+        data_shards=data_shards,
+        model_shards=model_shards,
+        devices=cpu[: data_shards * model_shards],
+    )
+    return OnlineLDA(p, mesh=mesh).fit(rows, vocab)
+
+
+class TestOnlineLDA:
+    def test_recovers_two_topics(self, tiny_corpus_rows):
+        rows, vocab = tiny_corpus_rows
+        model = _fit(rows, vocab)
+        assert isinstance(model, LDAModel)
+        topics = model.topics_matrix()
+        # topic mass should split on the 0-24 / 25-49 vocab halves
+        lo = topics[:, :25].sum(axis=1)
+        assert (lo > 0.9).any() and (lo < 0.1).any()
+
+    def test_topic_distribution_separates_docs(self, tiny_corpus_rows):
+        rows, vocab = tiny_corpus_rows
+        model = _fit(rows, vocab)
+        dist = model.topic_distribution(rows)
+        top = dist.argmax(axis=1)
+        even, odd = top[0::2], top[1::2]
+        assert (even == even[0]).all()
+        assert (odd == odd[0]).all()
+        assert even[0] != odd[0]
+
+    def test_perplexity_better_than_random(self, tiny_corpus_rows):
+        rows, vocab = tiny_corpus_rows
+        model = _fit(rows, vocab)
+        rand = LDAModel(
+            lam=np.abs(np.random.default_rng(0).normal(size=model.lam.shape))
+            .astype(np.float32)
+            + 0.5,
+            vocab=vocab,
+            alpha=model.alpha,
+            eta=model.eta,
+        )
+        assert model.log_perplexity(rows) < rand.log_perplexity(rows)
+
+    def test_model_sharding_consistent(self, tiny_corpus_rows):
+        rows, vocab = tiny_corpus_rows
+        m1 = _fit(rows, vocab, model_shards=1, data_shards=4)
+        m2 = _fit(rows, vocab, model_shards=2, data_shards=4)
+        np.testing.assert_allclose(m1.lam, m2.lam, rtol=2e-3, atol=1e-3)
+
+    def test_data_sharding_consistent(self, tiny_corpus_rows):
+        rows, vocab = tiny_corpus_rows
+        m1 = _fit(rows, vocab, data_shards=1)
+        m8 = _fit(rows, vocab, data_shards=8)
+        np.testing.assert_allclose(m1.lam, m8.lam, rtol=2e-3, atol=1e-3)
+
+    def test_iteration_times_recorded(self, tiny_corpus_rows):
+        rows, vocab = tiny_corpus_rows
+        model = _fit(rows, vocab, max_iterations=5)
+        assert len(model.iteration_times) == 5

@@ -1,0 +1,138 @@
+"""Tests for device ops: sparse batches, IDF, hashing, LDA math."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from spark_text_clustering_tpu.ops import (
+    DocTermBatch,
+    batch_from_rows,
+    bucket_by_length,
+    doc_freq,
+    e_step,
+    idf_from_df,
+    idf_transform,
+    init_gamma,
+    murmur3_32,
+    next_pow2,
+    topic_inference,
+)
+from spark_text_clustering_tpu.ops.lda_math import dirichlet_expectation
+
+
+def rows3():
+    return [
+        (np.array([0, 2], np.int32), np.array([1.0, 3.0], np.float32)),
+        (np.array([1], np.int32), np.array([2.0], np.float32)),
+        (np.array([0, 1, 3], np.int32), np.array([1, 1, 1], np.float32)),
+    ]
+
+
+class TestSparse:
+    def test_pad_shapes(self):
+        b = batch_from_rows(rows3())
+        assert b.token_ids.shape == (3, 8)  # min_row_len
+        assert float(b.doc_lengths()[0]) == 4.0
+        assert int(b.nnz_per_doc()[2]) == 3
+
+    def test_next_pow2(self):
+        assert [next_pow2(i) for i in (1, 2, 3, 9)] == [1, 2, 4, 16]
+
+    def test_bucketing(self):
+        rows = rows3() + [
+            (np.arange(20, dtype=np.int32), np.ones(20, np.float32))
+        ]
+        buckets = bucket_by_length(rows)
+        assert set(buckets) == {8, 32}
+        _, idxs = buckets[32]
+        assert idxs == [3]
+
+    def test_pad_rows(self):
+        b = batch_from_rows(rows3()).pad_rows_to(8)
+        assert b.num_docs == 8
+        assert float(b.token_weights[3:].sum()) == 0.0
+
+
+class TestIDF:
+    def test_mllib_formula(self):
+        # idf = log((m+1)/(df+1)), 0 below minDocFreq (SURVEY.md §2.2)
+        b = batch_from_rows(rows3())
+        df = doc_freq(b, vocab_size=5)
+        assert df.tolist() == [2, 2, 1, 1, 0]
+        idf = idf_from_df(df, num_docs=3, min_doc_freq=2)
+        assert float(idf[0]) == pytest.approx(np.log(4 / 3))
+        assert float(idf[2]) == 0.0  # df=1 < minDocFreq
+
+    def test_floor_patch(self):
+        # the reference's 0.0001 patch (LDAClustering.scala:184-187)
+        b = batch_from_rows(rows3())
+        idf = idf_from_df(doc_freq(b, 5), 3, 2)
+        out = idf_transform(b, idf, idf_floor=0.0001)
+        # doc 0 term 2 had idf 0 -> weight 3 * 0.0001
+        assert float(out.token_weights[0, 1]) == pytest.approx(3e-4)
+        # padding stays zero
+        assert float(out.token_weights[1, 1:].sum()) == 0.0
+
+
+class TestMurmur:
+    def test_known_vectors(self):
+        # MurmurHash3 x86_32 reference vectors (seed 0)
+        assert murmur3_32(b"", seed=0) == 0
+        assert murmur3_32(b"hello", seed=0) == 0x248BFA47
+        assert murmur3_32(b"hello, world", seed=0) == 0x149BBB7F
+
+    def test_spark_seed_stability(self):
+        h1 = murmur3_32("topic".encode(), seed=42)
+        assert 0 <= h1 < 1 << 32
+        assert h1 == murmur3_32("topic".encode(), seed=42)
+
+
+class TestLDAMath:
+    def test_dirichlet_expectation_matches_numpy(self):
+        from scipy.special import digamma as np_digamma  # type: ignore
+
+        x = np.abs(np.random.default_rng(0).normal(size=(4, 7))) + 0.1
+        got = np.asarray(dirichlet_expectation(jnp.asarray(x)))
+        want = np_digamma(x) - np_digamma(x.sum(-1, keepdims=True))
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    def test_e_step_sstats_mass(self):
+        # sum of raw sstats * expElogbeta over (k, V) == total token mass
+        # only if phi sums to 1... here: weighted responsibilities conserve
+        # each token's count: sum_k phi_k = 1 per token.
+        rows = rows3()
+        b = batch_from_rows(rows)
+        k, v = 3, 5
+        lam = jnp.abs(jax.random.normal(jax.random.PRNGKey(0), (k, v))) + 0.5
+        eb = jnp.exp(dirichlet_expectation(lam))
+        gamma0 = init_gamma(None, b.num_docs, k)
+        res = e_step(b, eb, jnp.full((k,), 0.5), gamma0, vocab_size=v)
+        # phi-weighted counts: (sstats * eb) col-sums == term occurrence mass
+        mass = np.asarray((res.sstats * eb).sum(axis=0))
+        want = np.zeros(v)
+        for ids, wts in rows:
+            for i, w in zip(ids, wts):
+                want[i] += w
+        np.testing.assert_allclose(mass, want, rtol=1e-4)
+
+    def test_topic_inference_normalized_and_empty_uniform(self):
+        rows = rows3() + [(np.zeros(0, np.int32), np.zeros(0, np.float32))]
+        b = batch_from_rows(rows)
+        k, v = 4, 5
+        lam = jnp.abs(jax.random.normal(jax.random.PRNGKey(1), (k, v))) + 0.5
+        eb = jnp.exp(dirichlet_expectation(lam))
+        gamma0 = init_gamma(None, b.num_docs, k)
+        dist = topic_inference(b, eb, jnp.full((k,), 0.25), gamma0)
+        np.testing.assert_allclose(np.asarray(dist).sum(-1), 1.0, rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(dist)[3], 0.25, rtol=1e-5)
+
+    def test_inference_deterministic(self):
+        b = batch_from_rows(rows3())
+        k, v = 3, 5
+        lam = jnp.abs(jax.random.normal(jax.random.PRNGKey(2), (k, v))) + 0.5
+        eb = jnp.exp(dirichlet_expectation(lam))
+        g0 = init_gamma(None, b.num_docs, k)
+        d1 = topic_inference(b, eb, jnp.full((k,), 0.5), g0)
+        d2 = topic_inference(b, eb, jnp.full((k,), 0.5), g0)
+        np.testing.assert_array_equal(np.asarray(d1), np.asarray(d2))

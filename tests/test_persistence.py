@@ -1,0 +1,107 @@
+"""Checkpoint round-trip tests (SURVEY.md §5: vocab folded INTO the model,
+unlike the reference's fragile sidecar)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from spark_text_clustering_tpu.models import LDAModel
+from spark_text_clustering_tpu.models.persistence import (
+    latest_model_dir,
+    model_dir_name,
+)
+
+
+def _model(k=3, v=7):
+    rng = np.random.default_rng(0)
+    return LDAModel(
+        lam=np.abs(rng.normal(size=(k, v))).astype(np.float32) + 0.1,
+        vocab=[f"t{i}" for i in range(v)],
+        alpha=np.full((k,), 0.5, np.float32),
+        eta=0.3,
+        iteration_times=[0.1, 0.2],
+        algorithm="online",
+        step=2,
+    )
+
+
+class TestPersistence:
+    def test_roundtrip(self, tmp_path):
+        m = _model()
+        p = str(tmp_path / "model")
+        m.save(p)
+        m2 = LDAModel.load(p)
+        np.testing.assert_array_equal(m.lam, m2.lam)
+        np.testing.assert_array_equal(m.alpha, m2.alpha)
+        assert m2.vocab == m.vocab
+        assert m2.eta == m.eta
+        assert m2.step == 2
+        assert m2.iteration_times == [pytest.approx(0.1), pytest.approx(0.2)]
+
+    def test_roundtrip_inference_identical(self, tmp_path):
+        m = _model()
+        rows = [
+            (np.array([0, 2], np.int32), np.array([2.0, 1.0], np.float32))
+        ]
+        p = str(tmp_path / "model")
+        m.save(p)
+        m2 = LDAModel.load(p)
+        np.testing.assert_array_equal(
+            m.topic_distribution(rows), m2.topic_distribution(rows)
+        )
+
+    def test_unicode_vocab(self, tmp_path):
+        m = _model()
+        m.vocab[0] = "café"
+        m.vocab[1] = "Holm"
+        p = str(tmp_path / "m")
+        m.save(p)
+        assert LDAModel.load(p).vocab[:2] == ["café", "Holm"]
+
+    def test_latest_model_dir_by_timestamp(self, tmp_path):
+        # the reference takes .last of an UNSORTED listFiles
+        # (LDALoader.scala:25-37); we pick by embedded timestamp
+        base = str(tmp_path)
+        for ts in (1591049082850, 1602586875372, 159):
+            os.makedirs(os.path.join(base, f"LdaModel_EN_{ts}"))
+        os.makedirs(os.path.join(base, "LdaModel_GE_9999999999999"))
+        got = latest_model_dir(base, "EN")
+        assert got.endswith("LdaModel_EN_1602586875372")
+        assert latest_model_dir(base, "FR") is None
+
+    def test_model_dir_name_scheme(self, tmp_path):
+        name = model_dir_name("EN", base=str(tmp_path))
+        assert os.path.basename(name).startswith("LdaModel_EN_")
+
+
+class TestTrainResume:
+    def test_resume_matches_uninterrupted(self, tmp_path, tiny_corpus_rows):
+        import jax
+
+        from spark_text_clustering_tpu.config import Params
+        from spark_text_clustering_tpu.models import OnlineLDA
+        from spark_text_clustering_tpu.parallel import make_mesh
+
+        rows, vocab = tiny_corpus_rows
+        cpu = jax.devices("cpu")
+        mesh = make_mesh(data_shards=4, model_shards=1, devices=cpu[:4])
+        common = dict(k=2, algorithm="online", batch_size=8, seed=7,
+                      checkpoint_interval=3)
+
+        # uninterrupted 6-iteration run
+        m_full = OnlineLDA(
+            Params(max_iterations=6, **common), mesh=mesh
+        ).fit(rows, vocab)
+
+        # interrupted: 3 iters with checkpointing, then resume to 6
+        ck = str(tmp_path / "ck")
+        OnlineLDA(
+            Params(max_iterations=3, checkpoint_dir=ck, **common), mesh=mesh
+        ).fit(rows, vocab)
+        assert os.path.exists(os.path.join(ck, "train_state.npz"))
+        m_resumed = OnlineLDA(
+            Params(max_iterations=6, checkpoint_dir=ck, **common), mesh=mesh
+        ).fit(rows, vocab)
+
+        np.testing.assert_allclose(m_full.lam, m_resumed.lam, rtol=1e-6)

@@ -1,0 +1,133 @@
+"""Unit tests for the host text pipeline (SURVEY.md §4: the pure-function
+pyramid the reference lacks)."""
+
+import numpy as np
+import pytest
+
+from spark_text_clustering_tpu.utils import (
+    filter_special_characters,
+    lemmatize_text,
+    parse_stop_words,
+    preprocess_document,
+    simple_tokenize,
+    stem,
+)
+from spark_text_clustering_tpu.utils.textproc import lemma
+from spark_text_clustering_tpu.utils.vocab import (
+    build_vocab,
+    count_terms,
+    count_vector,
+)
+
+
+class TestClean:
+    def test_special_chars_to_space(self):
+        # char class of LDAClustering.scala:283-284
+        assert filter_special_characters("a,b.c!d?e") == "a b c d e"
+        assert filter_special_characters("x»y«z") == "x y z"
+        assert filter_special_characters("it’s ‘fine‘")[:4] == "it s"
+
+    def test_keeps_word_chars(self):
+        assert filter_special_characters("hello world") == "hello world"
+
+
+class TestTokenize:
+    def test_alpha_runs(self):
+        assert simple_tokenize("hello world") == ["hello", "world"]
+
+    def test_class_switches(self):
+        # SimpleTokenizer: maximal runs of one char class
+        assert simple_tokenize("abc123def") == ["abc", "123", "def"]
+
+    def test_unicode_letters(self):
+        assert simple_tokenize("café naïve") == ["café", "naïve"]
+
+
+class TestStem:
+    def test_porter_classics(self):
+        # evidence from the saved vocab sidecar: veri, littl, Holm, befor
+        assert stem("very") == "veri"
+        assert stem("little") == "littl"
+        assert stem("before") == "befor"
+
+    def test_case_preserved(self):
+        # OpenNLP PorterStemmer keeps case: "Holmes" -> "Holm" in the vocab
+        assert stem("Holmes") == "Holm"
+        assert stem("Watson")[0] == "W"
+
+
+class TestStopWords:
+    def test_comma_single_line(self):
+        sw = parse_stop_words("a,able,about")
+        assert sw == frozenset({"a", "able", "about"})
+
+    def test_multiline_flat_split(self):
+        sw = parse_stop_words(["a,b", "c,d"])
+        assert sw == frozenset("abcd")
+
+
+class TestLemma:
+    def test_plural(self):
+        assert lemma("houses") == "house"
+        assert lemma("stories") == "story"
+
+    def test_irregular(self):
+        assert lemma("went") == "go"
+        assert lemma("children") == "child"
+
+    def test_been_lemmatizes_to_be_and_is_filtered(self):
+        # CoreNLP: "been" -> "be" (len 2), dropped by the len>3 filter
+        assert lemma("been") == "be"
+        assert "be" not in lemmatize_text("it has been raining").split()
+
+    def test_ing_ed(self):
+        assert lemma("running") == "run"
+        assert lemma("making") == "make"
+        assert lemma("walked") == "walk"
+
+    def test_min_len_filter(self):
+        # LDAClustering.scala:300-304: lemmas with len <= 3 dropped
+        out = lemmatize_text("the cat sat on a large mat today")
+        assert "cat" not in out.split()
+        assert "large" in out.split()
+
+    def test_sentence_dedup_quirk(self):
+        # (words zip tags).toMap dedups repeated words per sentence
+        out = lemmatize_text("tiger tiger burning bright", dedup_within_sentence=True)
+        assert out.split().count("tiger") == 1
+        out2 = lemmatize_text(
+            "tiger tiger burning bright", dedup_within_sentence=False
+        )
+        assert out2.split().count("tiger") == 2
+
+
+class TestPreprocess:
+    def test_stopword_before_stemming(self):
+        # stop filter is case-sensitive and PRE-stemming
+        # (LDAClustering.scala:132-137)
+        toks = preprocess_document(
+            "wonderful wonderful things", stop_words=frozenset({"wonderful"}),
+            lemmatize=False,
+        )
+        assert "wonder" not in toks  # stopped before stemming
+        assert "thing" in toks
+
+
+class TestVocab:
+    def test_frequency_rank_order(self):
+        # vocab index = frequency rank (LDAClustering.scala:148-151)
+        counts = count_terms([["b", "a", "a"], ["a", "c", "b"]])
+        vocab, t2i = build_vocab(counts, vocab_size=10)
+        assert vocab[0] == "a" and t2i["a"] == 0
+        assert set(vocab) == {"a", "b", "c"}
+
+    def test_vocab_size_cap(self):
+        counts = count_terms([["a", "b", "c", "d"]])
+        vocab, _ = build_vocab(counts, vocab_size=2)
+        assert len(vocab) == 2
+
+    def test_count_vector_sorted_and_oov_dropped(self):
+        _, t2i = build_vocab(count_terms([["a", "b", "c"]]), 3)
+        ids, vals = count_vector(["c", "a", "zzz", "a"], t2i)
+        assert ids.tolist() == sorted(ids.tolist())
+        assert vals.sum() == 3  # zzz dropped
