@@ -1,0 +1,221 @@
+"""Command-line drivers: ``train`` and ``score``.
+
+The reference has NO CLI — both drivers are ``object ... extends App`` with
+constants edited in source (LDATraining.scala:5-22, LDALoader.scala:11-215);
+this module exposes the same two flows as real subcommands, with the
+reference's hardcoded values as defaults.
+
+    python -m spark_text_clustering_tpu.cli train --books <dir> \
+        --stop-words <file> --lang EN --algorithm em --k 5
+    python -m spark_text_clustering_tpu.cli score --books <dir> \
+        --lang EN --models-dir <dir> --output-dir <dir>
+
+Language -> books-directory routing mirrors LDALoader.scala:46-56.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+from typing import List, Optional
+
+import numpy as np
+
+from .config import Params
+from .pipeline import (
+    IDF,
+    LDA,
+    CountVectorizer,
+    Pipeline,
+    TextPreprocessor,
+)
+from .models.base import LDAModel
+from .models.persistence import latest_model_dir, model_dir_name
+from .utils.readers import read_stop_word_file, read_text_dir
+from .utils.report import format_scoring_report, write_scoring_report
+from .utils.textproc import parse_stop_words
+from .utils.timing import PhaseTimer
+
+# LDALoader.scala:46-56 routing
+LANG_DIRS = {
+    "EN": "English",
+    "GE": "German",
+    "FR": "French",
+    "IT": "Italian",
+    "RU": "Russian",
+    "SP": "Spanish",
+    "UKR": "Ukrainian",
+    "DU": "Dutch",
+}
+
+
+def _load_stop_words(path: Optional[str]) -> frozenset:
+    if not path:
+        return frozenset()
+    return parse_stop_words(read_stop_word_file(path))
+
+
+def cmd_train(args: argparse.Namespace) -> int:
+    timer = PhaseTimer()
+    sw = _load_stop_words(args.stop_words)
+    with timer.phase("read"):
+        docs = list(read_text_dir(args.books, include_all=args.include_all))
+    texts = [d.text for d in docs]
+
+    params = Params(
+        input=args.books,
+        k=args.k,
+        max_iterations=args.max_iterations,
+        doc_concentration=args.doc_concentration,
+        topic_concentration=args.topic_concentration,
+        vocab_size=args.vocab_size,
+        algorithm=args.algorithm,
+        checkpoint_dir=args.checkpoint_dir,
+        checkpoint_interval=args.checkpoint_interval,
+        seed=args.seed,
+        data_shards=args.data_shards,
+        model_shards=args.model_shards,
+    )
+
+    stages: List[object] = [
+        TextPreprocessor(stop_words=sw, lemmatize=not args.no_lemmatize),
+        CountVectorizer(vocab_size=params.vocab_size),
+    ]
+    if not args.no_tfidf:
+        # the reference trains LDA on TF-IDF pseudo-counts
+        # (LDAClustering.scala:180-192)
+        stages.append(IDF(min_doc_freq=params.min_doc_freq,
+                          idf_floor=params.idf_floor))
+    stages.append(LDA(params))
+
+    with timer.phase("preprocess+vectorize+train"):
+        fitted = Pipeline(stages).fit(
+            {"texts": texts}
+        )
+
+    lda_stage = fitted.stages[-1]
+    model: LDAModel = lda_stage.model
+
+    # corpus summary (LDAClustering.scala:28-34 prints)
+    print("Training corpus summary:")
+    print(f"\t Trained on {len(texts)} documents")
+    print(f"\t Vocabulary size: {model.vocab_size} terms")
+    print(f"\t Topics: {model.k}; algorithm: {params.algorithm}")
+    print(f"\t Preprocessing+training time: "
+          f"{timer.phases['preprocess+vectorize+train']:.1f}s "
+          f"(mean iter {np.mean(model.iteration_times):.3f}s)")
+    # avg log-likelihood, the reference's single quality metric
+    # (LDAClustering.scala:73-78, EM only); divided by the corpus actually
+    # trained on (nonempty docs), matching corpus.count()
+    if lda_stage.log_likelihood is not None and lda_stage.corpus_size:
+        print(f"The average log likelihood of the training data: "
+              f"{lda_stage.log_likelihood / lda_stage.corpus_size}")
+
+    # top-10 terms per topic (LDAClustering.scala:81-92)
+    print(f"{model.k} topics:")
+    for i, topic in enumerate(model.describe_topics_terms(10)):
+        print(f"TOPIC {i}")
+        for term, w in topic:
+            print(f"{term}\t{w}")
+        print()
+
+    out_dir = model_dir_name(args.lang, base=args.models_dir)
+    model.save(out_dir)
+    print(f"model saved to {out_dir}")
+    return 0
+
+
+def cmd_score(args: argparse.Namespace) -> int:
+    model_path = args.model or latest_model_dir(args.models_dir, args.lang)
+    if model_path is None:
+        print(f"no model for lang {args.lang} under {args.models_dir}",
+              file=sys.stderr)
+        return 2
+    model = LDAModel.load(model_path)
+    print(f"loaded model {model_path}: k={model.k}, V={model.vocab_size}")
+
+    books_dir = args.books
+    if books_dir is None and args.books_root:
+        books_dir = os.path.join(args.books_root, LANG_DIRS[args.lang])
+    if books_dir is None:
+        print("score requires --books or --books-root", file=sys.stderr)
+        return 2
+    sw = _load_stop_words(args.stop_words)
+
+    docs = list(read_text_dir(books_dir, include_all=args.include_all))
+    # BuildCountVector semantics: count vectors over the TRAINED vocab, no
+    # IDF (LDALoader.scala:83-106)
+    pre = TextPreprocessor(stop_words=sw, lemmatize=not args.no_lemmatize)
+    from .pipeline import CountVectorizerModel
+
+    cv = CountVectorizerModel(model.vocab)
+    ds = cv.transform(pre.transform({"texts": [d.text for d in docs]}))
+    rows = ds["rows"]
+    dist = model.topic_distribution(rows)
+
+    text = format_scoring_report(
+        model,
+        [d.path for d in docs],
+        dist,
+        rows,
+    )
+    path = write_scoring_report(text, args.output_dir, args.lang)
+    # console tally like LDALoader.scala:142-149
+    tallies = np.bincount(dist.argmax(1), minlength=model.k)
+    for t, c in enumerate(tallies):
+        print(f"topic {t}: {c} books")
+    print(f"report written to {path}")
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(prog="spark_text_clustering_tpu")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    tr = sub.add_parser("train", help="train an LDA topic model on a book dir")
+    tr.add_argument("--books", required=True)
+    tr.add_argument("--stop-words", default=None)
+    tr.add_argument("--lang", default="EN", choices=sorted(LANG_DIRS))
+    tr.add_argument("--k", type=int, default=5)
+    tr.add_argument("--max-iterations", type=int, default=50)
+    tr.add_argument("--doc-concentration", type=float, default=-1)
+    tr.add_argument("--topic-concentration", type=float, default=-1)
+    tr.add_argument("--vocab-size", type=int, default=2_900_000)
+    tr.add_argument("--algorithm", default="em", choices=["em", "online"])
+    tr.add_argument("--checkpoint-dir", default=None)
+    tr.add_argument("--checkpoint-interval", type=int, default=10)
+    tr.add_argument("--seed", type=int, default=0)
+    tr.add_argument("--data-shards", type=int, default=None)
+    tr.add_argument("--model-shards", type=int, default=1)
+    tr.add_argument("--models-dir", default="models")
+    tr.add_argument("--no-tfidf", action="store_true",
+                    help="train on raw counts instead of TF-IDF pseudo-counts")
+    tr.add_argument("--no-lemmatize", action="store_true")
+    tr.add_argument("--include-all", action="store_true",
+                    help="ingest non-.txt files too (reference behavior)")
+    tr.set_defaults(fn=cmd_train)
+
+    sc = sub.add_parser("score", help="score books against a saved model")
+    sc.add_argument("--books", default=None)
+    sc.add_argument("--books-root", default=None,
+                    help="root containing per-language dirs (LDALoader routing)")
+    sc.add_argument("--lang", default="EN", choices=sorted(LANG_DIRS))
+    sc.add_argument("--stop-words", default=None)
+    sc.add_argument("--models-dir", default="models")
+    sc.add_argument("--model", default=None, help="explicit model dir")
+    sc.add_argument("--output-dir", default="TestOutput")
+    sc.add_argument("--no-lemmatize", action="store_true")
+    sc.add_argument("--include-all", action="store_true")
+    sc.set_defaults(fn=cmd_score)
+    return ap
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

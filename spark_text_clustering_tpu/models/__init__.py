@@ -1,4 +1,12 @@
 from .base import LDAModel
+from .em_lda import EMLDA, em_log_likelihood, make_em_train_step
 from .online_lda import OnlineLDA, make_online_train_step
 
-__all__ = ["LDAModel", "OnlineLDA", "make_online_train_step"]
+__all__ = [
+    "LDAModel",
+    "EMLDA",
+    "em_log_likelihood",
+    "make_em_train_step",
+    "OnlineLDA",
+    "make_online_train_step",
+]

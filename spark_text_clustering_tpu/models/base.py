@@ -84,8 +84,15 @@ class LDAModel:
         ]
 
     # ---- inference -----------------------------------------------------
+    _LAM_FLOOR = 1e-30  # jax digamma(0) is NaN (Breeze returns -inf); EM
+    #                     counts can underflow to exact 0 — floor keeps the
+    #                     limit semantics: exp(digamma(1e-30)) == 0.
+
+    def _safe_lam(self) -> jnp.ndarray:
+        return jnp.maximum(jnp.asarray(self.lam, jnp.float32), self._LAM_FLOOR)
+
     def _exp_elog_beta(self) -> jnp.ndarray:
-        return jnp.exp(dirichlet_expectation(jnp.asarray(self.lam)))
+        return jnp.exp(dirichlet_expectation(self._safe_lam()))
 
     def topic_distribution(
         self,
@@ -139,7 +146,7 @@ class LDAModel:
         bound = approx_bound(
             batch,
             gamma,
-            jnp.asarray(self.lam),
+            self._safe_lam(),
             alpha,
             float(self.eta),
             corpus_size=n_docs,

@@ -1,0 +1,298 @@
+"""EM LDA — the reference's default training path, TPU-reformulated.
+
+MLlib's ``EMLDAOptimizer`` (invoked at LDAClustering.scala:41,61) runs
+collapsed MAP-EM on a bipartite doc<->term GraphX graph: vertices hold k-dim
+topic-count vectors, edges hold the doc's term weight, and each iteration
+recomputes a per-edge topic posterior then aggregates edge-weighted
+posteriors back into vertex counts + a global k-vector of topic totals
+(SURVEY.md §2.2 "EMLDAOptimizer").
+
+We drop the graph entirely (SURVEY.md §7 layer 7): the edge set IS our
+padded ``DocTermBatch`` [B, L], so one EM iteration is
+
+    phi[b, l, k]  ∝  (N_wk[ids] + eta - 1) * (N_dk + alpha - 1)
+                     / (N_k + V*eta - V)          # MLlib's computePTopic
+    N_dk'  = sum_l  w * phi                        # per-doc reduce
+    N_wk'  = scatter-add_l  w * phi                # one segment-sum
+    N_k'   = sum_V N_wk'
+
+— two einsums and a scatter-add, mapped over the mesh: docs (and their N_dk)
+sharded over "data", the term-topic matrix N_wk sharded over "model", the
+N_wk aggregation reduced with ``psum`` over "data" (the graph's
+aggregateMessages + shuffle collapses into one collective).
+
+All counts are fractional: the reference feeds TF-IDF pseudo-counts, not
+integers (SURVEY.md §2.1 BuildTFIDFVector note), and this module preserves
+that convention.
+"""
+
+from __future__ import annotations
+
+import os
+from functools import partial
+from typing import Callable, List, NamedTuple, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..config import Params
+from ..ops.sparse import DocTermBatch, batch_from_rows, next_pow2
+from ..parallel.collectives import (
+    all_gather_model,
+    data_shard_batch,
+    psum_data,
+    scatter_model,
+)
+from ..parallel.mesh import DATA_AXIS, MODEL_AXIS, make_mesh, model_sharding
+from ..utils.timing import IterationTimer
+from .base import LDAModel
+from .persistence import load_train_state, save_train_state
+
+__all__ = ["EMLDA", "make_em_train_step", "em_log_likelihood"]
+
+
+class EMState(NamedTuple):
+    n_wk: jnp.ndarray   # [k, V/model_shards] term-topic counts (beta params)
+    n_dk: jnp.ndarray   # [B_total/data_shards ... sharded over data] doc-topic
+    step: jnp.ndarray
+
+
+def make_em_train_step(
+    mesh: Mesh, *, alpha: float, eta: float, vocab_size: int
+) -> Callable[[EMState, DocTermBatch], EMState]:
+    """One full-corpus EM iteration (the body of the reference's 50x hot
+    loop, LDAClustering.scala:61).  ``vocab_size`` is the TRUE V (not the
+    shard-padded width) so the smoothing denominator — and therefore the
+    trained counts — are identical across mesh topologies."""
+    v = vocab_size
+
+    def _step(n_wk_shard, n_dk, step, ids, wts):
+        n_wk = all_gather_model(n_wk_shard, axis=-1)           # [k, V_pad]
+        n_k = n_wk.sum(axis=-1)                                # [k]
+
+        # MLlib computePTopic: (N_wk + eta - 1)(N_dk + alpha - 1)/(N_k + V*eta - V)
+        term_f = jnp.moveaxis(n_wk, 0, -1)[ids] + (eta - 1.0)  # [B, L, k]
+        doc_f = n_dk + (alpha - 1.0)                           # [B, k]
+        denom = n_k + (eta * v - v)                            # [k]
+        phi = term_f * (doc_f / denom)[:, None, :]             # [B, L, k]
+        phi = phi / (phi.sum(-1, keepdims=True) + 1e-30)
+        wphi = wts[..., None] * phi                            # [B, L, k]
+
+        n_dk_new = wphi.sum(axis=1)                            # [B, k]
+        k = n_dk.shape[-1]
+        n_wk_new = (
+            jnp.zeros((n_wk.shape[-1], k), jnp.float32)
+            .at[ids.reshape(-1)]
+            .add(wphi.reshape(-1, k))
+        ).T                                                    # [k, V_pad]
+        n_wk_new = psum_data(n_wk_new)                         # graph shuffle -> psum
+        return scatter_model(n_wk_new, axis=-1), n_dk_new, step + 1
+
+    sharded = jax.shard_map(
+        _step,
+        mesh=mesh,
+        in_specs=(
+            P(None, MODEL_AXIS),     # n_wk shard
+            P(DATA_AXIS, None),      # n_dk
+            P(),                     # step
+            P(DATA_AXIS, None),      # ids
+            P(DATA_AXIS, None),      # wts
+        ),
+        out_specs=(P(None, MODEL_AXIS), P(DATA_AXIS, None), P()),
+        # n_wk is data-replicated by construction (psum over "data"); the
+        # static VMA checker can't see that through the model-axis slice.
+        check_vma=False,
+    )
+
+    @jax.jit
+    def train_step(state: EMState, batch: DocTermBatch) -> EMState:
+        n_wk, n_dk, step = sharded(
+            state.n_wk, state.n_dk, state.step,
+            batch.token_ids, batch.token_weights,
+        )
+        return EMState(n_wk, n_dk, step)
+
+    return train_step
+
+
+@partial(jax.jit, static_argnames=("vocab_size",))
+def em_log_likelihood(
+    batch: DocTermBatch,
+    n_wk: jnp.ndarray,    # [k, V] (may be shard-padded; pass true vocab_size)
+    n_dk: jnp.ndarray,    # [B, k]
+    alpha: float,
+    eta: float,
+    vocab_size: Optional[int] = None,
+) -> jnp.ndarray:
+    """``DistributedLDAModel.logLikelihood`` semantics (printed as
+    bound/corpusSize at LDAClustering.scala:73-78): log P(tokens | MAP
+    estimates), token log-lik = w * log sum_k phi_wk theta_dk with the same
+    smoothed estimates EM iterates on."""
+    ids, wts = batch.token_ids, batch.token_weights
+    v = vocab_size if vocab_size is not None else n_wk.shape[-1]
+    n_k = n_wk.sum(axis=-1)
+    phi_w = (jnp.moveaxis(n_wk, 0, -1)[ids] + (eta - 1.0)) / (
+        n_k + (eta * v - v)
+    )                                                          # [B, L, k]
+    theta = (n_dk + (alpha - 1.0)) / (
+        n_dk.sum(-1, keepdims=True) + n_dk.shape[-1] * (alpha - 1.0)
+    )                                                          # [B, k]
+    tok = jnp.einsum("blk,bk->bl", phi_w, theta)               # [B, L]
+    return (wts * jnp.log(jnp.where(tok > 0, tok, 1.0))).sum()
+
+
+class EMLDA:
+    """Estimator for the EM path: ``fit(rows, vocab) -> LDAModel`` with
+    EM auto-priors alpha = 50/k + 1, eta = 1.1 (metadata-confirmed,
+    SURVEY.md §2.2)."""
+
+    def __init__(self, params: Params, mesh: Optional[Mesh] = None) -> None:
+        if params.algorithm != "em":
+            params = params.replace(algorithm="em")
+        self.params = params
+        # MLlib's EM path requires concentrations > 1 (or -1 = auto): the
+        # MAP update subtracts 1 and would produce negative pseudo-counts.
+        for name, val in (
+            ("doc_concentration", params.doc_concentration),
+            ("topic_concentration", params.topic_concentration),
+        ):
+            if val != -1 and val <= 1.0:
+                raise ValueError(
+                    f"EM requires {name} > 1 (or -1 for auto); got {val}"
+                )
+        self.mesh = mesh if mesh is not None else make_mesh(
+            data_shards=params.data_shards, model_shards=params.model_shards
+        )
+        self.last_log_likelihood: Optional[float] = None
+        self._step_fn = None  # jit cache survives repeat fits (bench warmup)
+
+    def _init_state(self, batch: DocTermBatch, k: int, v_pad: int, seed: int):
+        """Soft random edge assignments aggregated into counts — the dense
+        analogue of MLlib's random vertex gamma init — sampled PER DATA
+        SHARD inside shard_map so init memory scales like the train step
+        (the dense [B, L, k] sample never materializes unsharded)."""
+
+        def _init(ids, wts):
+            # Per-DOC keys from the global doc index: the same doc draws the
+            # same init regardless of mesh topology (sharding-invariant
+            # results), while the dense [B, L, k] sample stays shard-local.
+            base = jax.random.PRNGKey(seed)
+            b_local, row_len = ids.shape
+            d0 = jax.lax.axis_index(DATA_AXIS) * b_local
+            keys = jax.vmap(lambda i: jax.random.fold_in(base, i))(
+                d0 + jnp.arange(b_local)
+            )
+            phi0 = jax.vmap(
+                lambda kk: jax.random.dirichlet(kk, jnp.ones((k,)), (row_len,))
+            )(keys)
+            wphi0 = wts[..., None] * phi0
+            n_dk = wphi0.sum(axis=1)
+            n_wk = (
+                jnp.zeros((v_pad, k), jnp.float32)
+                .at[ids.reshape(-1)]
+                .add(wphi0.reshape(-1, k))
+            ).T
+            n_wk = psum_data(n_wk)
+            return scatter_model(n_wk, axis=-1), n_dk
+
+        return jax.jit(
+            jax.shard_map(
+                _init,
+                mesh=self.mesh,
+                in_specs=(P(DATA_AXIS, None), P(DATA_AXIS, None)),
+                out_specs=(P(None, MODEL_AXIS), P(DATA_AXIS, None)),
+                check_vma=False,
+            )
+        )(batch.token_ids, batch.token_weights)
+
+    def fit(
+        self,
+        rows: Sequence[Tuple[np.ndarray, np.ndarray]],
+        vocab: List[str],
+        verbose: bool = False,
+        max_iterations: Optional[int] = None,
+    ) -> LDAModel:
+        p = self.params
+        n_iters = p.max_iterations if max_iterations is None else max_iterations
+        k, n, v = p.k, len(rows), len(vocab)
+        alpha = p.resolved_alpha()
+        eta = p.resolved_eta()
+
+        v_pad = ((v + p.model_shards - 1) // p.model_shards) * p.model_shards
+        max_nnz = max((len(i) for i, _ in rows), default=1)
+        row_len = max(8, next_pow2(max_nnz))
+        batch = batch_from_rows(rows, row_len=row_len)
+        batch = data_shard_batch(self.mesh, batch)   # pads B to shard multiple
+        b_pad = batch.num_docs
+
+        n_wk, n_dk = self._init_state(batch, k, v_pad, p.seed)
+        state = EMState(n_wk, n_dk, jnp.int32(0))
+
+        ckpt_path = (
+            os.path.join(p.checkpoint_dir, "em_state.npz")
+            if p.checkpoint_dir
+            else None
+        )
+        start_it = 0
+        if ckpt_path and os.path.exists(ckpt_path):
+            st = load_train_state(ckpt_path)
+            start_it = st["step"]
+            if st["n_wk"].shape != (k, v_pad) or st["n_dk"].shape != (b_pad, k):
+                raise ValueError(
+                    f"checkpoint shapes n_wk{st['n_wk'].shape}/"
+                    f"n_dk{st['n_dk'].shape} do not match this run "
+                    f"({(k, v_pad)}/{(b_pad, k)}) — topology or params differ"
+                )
+            state = EMState(
+                jax.device_put(jnp.asarray(st["n_wk"]),
+                               model_sharding(self.mesh)),
+                jax.device_put(jnp.asarray(st["n_dk"]),
+                               NamedSharding(self.mesh, P(DATA_AXIS, None))),
+                jnp.int32(start_it),
+            )
+
+        if self._step_fn is None:
+            self._step_fn = make_em_train_step(
+                self.mesh, alpha=alpha, eta=eta, vocab_size=v
+            )
+        step_fn = self._step_fn
+        timer = IterationTimer()
+        for it in range(start_it, n_iters):
+            timer.start()
+            state = step_fn(state, batch)
+            state.n_wk.block_until_ready()
+            timer.stop()
+            if verbose:
+                print(f"EM iter {it}: {timer.times[-1]:.3f}s")
+            if ckpt_path and (it + 1) % p.checkpoint_interval == 0:
+                save_train_state(
+                    ckpt_path, it + 1,
+                    n_wk=np.asarray(jax.device_get(state.n_wk)),
+                    n_dk=np.asarray(jax.device_get(state.n_dk)),
+                )
+
+        n_wk_full = np.asarray(jax.device_get(state.n_wk))
+        n_wk_np = n_wk_full[:, :v]
+        n_dk_full = np.asarray(jax.device_get(state.n_dk))
+        self.last_log_likelihood = float(
+            em_log_likelihood(
+                batch,
+                jnp.asarray(n_wk_full),
+                jnp.asarray(n_dk_full),
+                alpha,
+                eta,
+                vocab_size=v,
+            )
+        )
+        return LDAModel(
+            lam=n_wk_np,
+            vocab=list(vocab),
+            alpha=np.full((k,), alpha, np.float32),
+            eta=float(eta),
+            gamma_shape=p.gamma_shape,
+            iteration_times=list(timer.times),
+            algorithm="em",
+            step=int(state.step),
+        )

@@ -182,7 +182,8 @@ class OnlineLDA:
         start_it = 0
         base_key = jax.random.PRNGKey(p.seed)
         if ckpt_path and os.path.exists(ckpt_path):
-            lam_np, start_it = load_train_state(ckpt_path)
+            st = load_train_state(ckpt_path)
+            lam_np, start_it = st["lam"], st["step"]
             if lam_np.shape != (k, v_pad):
                 raise ValueError(
                     f"checkpoint lam {lam_np.shape} != expected {(k, v_pad)}"
@@ -226,7 +227,8 @@ class OnlineLDA:
                 print(f"iter {it}: {timer.times[-1]:.3f}s")
             if ckpt_path and (it + 1) % p.checkpoint_interval == 0:
                 save_train_state(
-                    ckpt_path, np.asarray(jax.device_get(state.lam)), it + 1
+                    ckpt_path, it + 1,
+                    lam=np.asarray(jax.device_get(state.lam)),
                 )
 
         lam = np.asarray(jax.device_get(state.lam))[:, :v]

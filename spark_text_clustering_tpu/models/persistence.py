@@ -91,20 +91,28 @@ def save_model(model, path: str) -> None:
         f.write("\n".join(model.vocab))
 
 
-def save_train_state(path: str, lam: np.ndarray, step: int) -> None:
-    """Mid-training checkpoint (lambda + optimizer step), written atomically
-    (tmp + rename) so a crash mid-write never corrupts the resume point.
-    The sampling/init streams are re-derived from (seed, iteration) at
-    resume, so no RNG state needs persisting."""
+def save_train_state(path: str, step: int, **arrays: np.ndarray) -> None:
+    """Mid-training checkpoint (named state arrays + optimizer step), written
+    atomically (tmp + rename) so a crash mid-write never corrupts the resume
+    point.  The sampling/init streams are re-derived from (seed, iteration)
+    at resume, so no RNG state needs persisting."""
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     tmp = path + ".tmp.npz"
-    np.savez(tmp, lam=np.asarray(lam, np.float32), step=np.int64(step))
+    np.savez(
+        tmp,
+        step=np.int64(step),
+        **{k: np.asarray(v, np.float32) for k, v in arrays.items()},
+    )
     os.replace(tmp, path)
 
 
-def load_train_state(path: str) -> Tuple[np.ndarray, int]:
+def load_train_state(path: str) -> dict:
+    """Returns {'step': int, <array name>: np.ndarray, ...}."""
+    out = {}
     with np.load(path) as z:
-        return z["lam"], int(z["step"])
+        for k in z.files:
+            out[k] = int(z[k]) if k == "step" else z[k]
+    return out
 
 
 def load_model(path: str):

@@ -44,7 +44,10 @@ __all__ = [
     "approx_bound",
 ]
 
-_PHI_EPS = 1e-100
+# Hoffman's reference uses 1e-100, which UNDERFLOWS TO ZERO in float32 and
+# lets phinorm hit exact 0 (inf * 0 = NaN downstream) when a term's
+# exp(E[log beta]) underflows in every topic.  1e-30 is float32-normal.
+_PHI_EPS = 1e-30
 
 
 def dirichlet_expectation(alpha: jnp.ndarray) -> jnp.ndarray:

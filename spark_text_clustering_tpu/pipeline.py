@@ -1,0 +1,246 @@
+"""Composable Estimator/Transformer pipeline.
+
+The north star (BASELINE.json) frames the workload as an ml.Pipeline of
+``HashingTF -> IDF -> LDA`` stages with ``fit``/``transform``; the reference
+instead has two copy-paste featurizer functions (``BuildTFIDFVector`` /
+``BuildCountVector``, LDAClustering.scala:105-275).  This module replaces
+both with one composable pipeline: the scoring path is the training path
+minus the IDF stage, by construction rather than by duplication.
+
+Stages operate on a plain dict dataset with conventional keys:
+
+    texts   : List[str]            raw documents
+    tokens  : List[List[str]]      preprocessed token lists
+    rows    : List[(ids, weights)] sparse doc-term rows
+    vocab   : List[str]            vocabulary (absent for HashingTF)
+    model   : LDAModel             after an LDA stage
+    topic_distribution : np.ndarray [n, k]
+
+Host stages (preprocess, vocab) are pure Python; device stages (IDF, LDA)
+run on the mesh.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .config import Params
+from .ops.sparse import batch_from_rows
+from .ops.tfidf import doc_freq, hashing_tf_ids, idf_from_df, idf_transform
+from .utils.textproc import preprocess_document
+from .utils.vocab import build_vocab, count_terms, count_vectors
+
+__all__ = [
+    "Transformer",
+    "Estimator",
+    "TextPreprocessor",
+    "CountVectorizer",
+    "HashingTF",
+    "IDF",
+    "IDFModel",
+    "LDA",
+    "Pipeline",
+    "PipelineModel",
+]
+
+
+class Transformer:
+    def transform(self, ds: Dict) -> Dict:
+        raise NotImplementedError
+
+
+class Estimator:
+    def fit(self, ds: Dict) -> Transformer:
+        raise NotImplementedError
+
+
+# ---------------------------------------------------------------------------
+class TextPreprocessor(Transformer):
+    """texts -> tokens (clean + lemmatize + tokenize + stop-filter + stem;
+    the map side of BuildTFIDFVector steps 1-5)."""
+
+    def __init__(
+        self,
+        stop_words: frozenset = frozenset(),
+        lemmatize: bool = True,
+        dedup_within_sentence: bool = True,
+    ) -> None:
+        self.stop_words = stop_words
+        self.lemmatize = lemmatize
+        self.dedup = dedup_within_sentence
+
+    def transform(self, ds: Dict) -> Dict:
+        out = dict(ds)
+        out["tokens"] = [
+            preprocess_document(
+                t,
+                stop_words=self.stop_words,
+                lemmatize=self.lemmatize,
+                dedup_within_sentence=self.dedup,
+            )
+            for t in ds["texts"]
+        ]
+        return out
+
+
+class CountVectorizerModel(Transformer):
+    def __init__(self, vocab: List[str]):
+        self.vocab = vocab
+        self._t2i = {t: i for i, t in enumerate(vocab)}
+
+    def transform(self, ds: Dict) -> Dict:
+        out = dict(ds)
+        rows, kept = count_vectors(ds["tokens"], self._t2i, drop_empty=False)
+        out["rows"] = rows
+        out["vocab"] = self.vocab
+        return out
+
+
+class CountVectorizer(Estimator):
+    """Frequency-ranked exact vocabulary (LDAClustering.scala:144-167)."""
+
+    def __init__(self, vocab_size: int = 2_900_000):
+        self.vocab_size = vocab_size
+
+    def fit(self, ds: Dict) -> CountVectorizerModel:
+        vocab, _ = build_vocab(count_terms(ds["tokens"]), self.vocab_size)
+        return CountVectorizerModel(vocab)
+
+
+class HashingTF(Transformer):
+    """Vocabulary-free featurization (murmur3 mod num_features) — the
+    north-star stage that sidesteps the distributed vocab build."""
+
+    def __init__(self, num_features: int = 1 << 18):
+        self.num_features = num_features
+
+    def transform(self, ds: Dict) -> Dict:
+        out = dict(ds)
+        out["rows"] = [
+            hashing_tf_ids(toks, self.num_features) for toks in ds["tokens"]
+        ]
+        out["vocab"] = None
+        out["num_features"] = self.num_features
+        return out
+
+
+class IDFModel(Transformer):
+    def __init__(self, idf: np.ndarray, idf_floor: float):
+        self.idf = idf
+        self.idf_floor = idf_floor
+
+    def transform(self, ds: Dict) -> Dict:
+        import jax.numpy as jnp
+
+        out = dict(ds)
+        rows = ds["rows"]
+        if not rows:
+            return out
+        batch = batch_from_rows(rows)
+        weighted = idf_transform(
+            batch, jnp.asarray(self.idf), idf_floor=self.idf_floor
+        )
+        w = np.asarray(weighted.token_weights)
+        ids = np.asarray(batch.token_ids)
+        nnz = np.asarray((batch.token_weights > 0).sum(axis=1))
+        out["rows"] = [
+            (ids[r, : nnz[r]].copy(), w[r, : nnz[r]].copy())
+            for r in range(len(rows))
+        ]
+        return out
+
+
+class IDF(Estimator):
+    """MLlib IDF(minDocFreq=2) with the reference's 0.0001 floor
+    (LDAClustering.scala:174-192)."""
+
+    def __init__(self, min_doc_freq: int = 2, idf_floor: float = 0.0001):
+        self.min_doc_freq = min_doc_freq
+        self.idf_floor = idf_floor
+
+    def fit(self, ds: Dict) -> IDFModel:
+        rows = ds["rows"]
+        v = (
+            len(ds["vocab"])
+            if ds.get("vocab") is not None
+            else ds["num_features"]
+        )
+        batch = batch_from_rows(rows)
+        # MLlib: m = number of vectors in the RDD, empties included
+        idf = idf_from_df(doc_freq(batch, v), len(rows), self.min_doc_freq)
+        return IDFModel(np.asarray(idf), self.idf_floor)
+
+
+class LDAModelTransformer(Transformer):
+    def __init__(
+        self,
+        model,
+        log_likelihood: Optional[float] = None,
+        corpus_size: Optional[int] = None,
+    ):
+        self.model = model
+        self.log_likelihood = log_likelihood  # EM training logLik, if any
+        self.corpus_size = corpus_size        # nonempty docs actually trained on
+
+    def transform(self, ds: Dict) -> Dict:
+        out = dict(ds)
+        out["model"] = self.model
+        out["topic_distribution"] = self.model.topic_distribution(ds["rows"])
+        return out
+
+
+class LDA(Estimator):
+    """Dispatches to the EM or online optimizer by ``params.algorithm`` —
+    the LDA facade of LDAClustering.scala:37-61."""
+
+    def __init__(self, params: Params, mesh=None):
+        self.params = params
+        self.mesh = mesh
+
+    def fit(self, ds: Dict) -> LDAModelTransformer:
+        from .models.em_lda import EMLDA
+        from .models.online_lda import OnlineLDA
+
+        rows = ds["rows"]
+        vocab = ds.get("vocab")
+        if vocab is None:
+            vocab = [f"h{i}" for i in range(ds["num_features"])]
+        nonempty = [(i, w) for i, w in rows if len(i) > 0]
+        cls = EMLDA if self.params.algorithm == "em" else OnlineLDA
+        opt = cls(self.params, mesh=self.mesh)
+        model = opt.fit(nonempty, vocab)
+        return LDAModelTransformer(
+            model,
+            log_likelihood=getattr(opt, "last_log_likelihood", None),
+            corpus_size=len(nonempty),
+        )
+
+
+# ---------------------------------------------------------------------------
+class PipelineModel(Transformer):
+    def __init__(self, stages: Sequence[Transformer]):
+        self.stages = list(stages)
+
+    def transform(self, ds: Dict) -> Dict:
+        for s in self.stages:
+            ds = s.transform(ds)
+        return ds
+
+
+class Pipeline(Estimator):
+    """Fit estimators in sequence, passing transformed data downstream."""
+
+    def __init__(self, stages: Sequence[object]):
+        self.stages = list(stages)
+
+    def fit(self, ds: Dict) -> PipelineModel:
+        fitted: List[Transformer] = []
+        last = len(self.stages) - 1
+        for i, s in enumerate(self.stages):
+            t = s.fit(ds) if isinstance(s, Estimator) else s
+            if i != last:  # the final model's transform output is unused here
+                ds = t.transform(ds)
+            fitted.append(t)
+        return PipelineModel(fitted)

@@ -1,4 +1,5 @@
 from .readers import Document, list_books, read_stop_word_file, read_text_dir
+from .report import format_scoring_report, java_double_str, write_scoring_report
 from .textproc import (
     filter_special_characters,
     lemmatize_text,
@@ -11,6 +12,9 @@ from .timing import IterationTimer, PhaseTimer
 from .vocab import build_vocab, count_terms, count_vector, count_vectors
 
 __all__ = [
+    "format_scoring_report",
+    "java_double_str",
+    "write_scoring_report",
     "Document",
     "list_books",
     "read_stop_word_file",

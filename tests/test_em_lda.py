@@ -1,0 +1,84 @@
+"""EM LDA tests: convergence, likelihood monotonic-ish improvement,
+sharding consistency, and agreement with the online path on topic recovery."""
+
+import jax
+import numpy as np
+import pytest
+
+from spark_text_clustering_tpu.config import Params
+from spark_text_clustering_tpu.models import EMLDA, LDAModel
+from spark_text_clustering_tpu.parallel import make_mesh
+
+
+def _fit(rows, vocab, return_opt=False, **kw):
+    defaults = dict(k=2, algorithm="em", max_iterations=30, seed=5)
+    defaults.update(kw)
+    data_shards = defaults.pop("data_shards", None)
+    model_shards = defaults.get("model_shards", 1)
+    cpu = jax.devices("cpu")
+    if data_shards is None:
+        data_shards = len(cpu) // model_shards
+    mesh = make_mesh(
+        data_shards=data_shards,
+        model_shards=model_shards,
+        devices=cpu[: data_shards * model_shards],
+    )
+    opt = EMLDA(Params(**defaults), mesh=mesh)
+    model = opt.fit(rows, vocab)
+    return (model, opt) if return_opt else model
+
+
+class TestEMLDA:
+    def test_em_autopriors(self):
+        p = Params(k=5, algorithm="em")
+        # metadata-confirmed: alpha = 50/k + 1 = 11, eta = 1.1
+        assert p.resolved_alpha() == pytest.approx(11.0)
+        assert p.resolved_eta() == pytest.approx(1.1)
+
+    def test_em_rejects_concentrations_below_one(self):
+        # MLlib EM requires > 1 (or -1 auto): MAP update subtracts 1
+        with pytest.raises(ValueError, match="doc_concentration"):
+            EMLDA(Params(k=2, algorithm="em", doc_concentration=0.5))
+        with pytest.raises(ValueError, match="topic_concentration"):
+            EMLDA(Params(k=2, algorithm="em", topic_concentration=1.0))
+
+    def test_recovers_two_topics(self, tiny_corpus_rows):
+        rows, vocab = tiny_corpus_rows
+        model = _fit(rows, vocab)
+        topics = model.topics_matrix()
+        lo = topics[:, :25].sum(axis=1)
+        assert (lo > 0.85).any() and (lo < 0.15).any()
+        assert model.algorithm == "em"
+
+    def test_log_likelihood_improves_with_iterations(self, tiny_corpus_rows):
+        rows, vocab = tiny_corpus_rows
+        _, opt3 = _fit(rows, vocab, max_iterations=2, return_opt=True)
+        _, opt30 = _fit(rows, vocab, max_iterations=30, return_opt=True)
+        assert opt30.last_log_likelihood > opt3.last_log_likelihood
+
+    def test_counts_conserve_token_mass(self, tiny_corpus_rows):
+        rows, vocab = tiny_corpus_rows
+        model = _fit(rows, vocab)
+        total = sum(float(w.sum()) for _, w in rows)
+        assert model.lam.sum() == pytest.approx(total, rel=1e-4)
+
+    def test_sharding_consistent(self, tiny_corpus_rows):
+        rows, vocab = tiny_corpus_rows
+        m1 = _fit(rows, vocab, data_shards=1)
+        m2 = _fit(rows, vocab, data_shards=4, model_shards=2)
+        np.testing.assert_allclose(m1.lam, m2.lam, rtol=2e-3, atol=1e-3)
+
+    def test_scoring_works_on_em_model(self, tiny_corpus_rows):
+        rows, vocab = tiny_corpus_rows
+        model = _fit(rows, vocab)
+        dist = model.topic_distribution(rows)
+        np.testing.assert_allclose(dist.sum(-1), 1.0, rtol=1e-5)
+        top = dist.argmax(1)
+        assert (top[0::2] == top[0]).all() and top[0] != top[1]
+
+    def test_fractional_weights_accepted(self, tiny_corpus_rows):
+        # the reference trains EM on TF-IDF pseudo-counts, not integers
+        rows, vocab = tiny_corpus_rows
+        frac = [(i, w * 0.37) for i, w in rows]
+        model = _fit(frac, vocab)
+        assert np.isfinite(model.lam).all()

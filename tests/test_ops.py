@@ -127,6 +127,22 @@ class TestLDAMath:
         np.testing.assert_allclose(np.asarray(dist).sum(-1), 1.0, rtol=1e-5)
         np.testing.assert_allclose(np.asarray(dist)[3], 0.25, rtol=1e-5)
 
+    def test_no_nan_when_term_underflows_all_topics(self):
+        # regression: a term whose lam is tiny in EVERY topic makes
+        # exp(E[log beta]) underflow to 0 across k; phinorm must stay > 0
+        # in float32 (the 1e-100 guard of float64 implementations is 0 here)
+        k, v = 3, 6
+        lam = np.full((k, v), 100.0, np.float32)
+        lam[:, 5] = 1e-7  # rare TF-IDF-floor term
+        eb = jnp.exp(dirichlet_expectation(jnp.asarray(lam)))
+        assert float(eb[:, 5].max()) == 0.0  # genuinely underflows
+        rows = [(np.array([0, 5], np.int32), np.array([3.0, 2.0], np.float32))]
+        b = batch_from_rows(rows)
+        dist = topic_inference(
+            b, eb, jnp.full((k,), 0.5), init_gamma(None, 1, k)
+        )
+        assert np.isfinite(np.asarray(dist)).all()
+
     def test_inference_deterministic(self):
         b = batch_from_rows(rows3())
         k, v = 3, 5

@@ -1,0 +1,154 @@
+"""Pipeline/report/CLI tests — the end-to-end layer the reference exercised
+only by hand (SURVEY.md §4)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from spark_text_clustering_tpu.config import Params
+from spark_text_clustering_tpu.pipeline import (
+    IDF,
+    LDA,
+    CountVectorizer,
+    HashingTF,
+    Pipeline,
+    TextPreprocessor,
+)
+from spark_text_clustering_tpu.utils.report import (
+    format_scoring_report,
+    java_double_str,
+)
+
+TEXTS = [
+    "The detective investigated the mysterious crime scene carefully today.",
+    "Detectives solve crimes; the detective found crucial evidence yesterday.",
+    "The spaceship landed on the distant planet with astronauts aboard.",
+    "Astronauts explored planets; the spaceship orbited the red planet.",
+] * 3
+
+
+class TestPipeline:
+    def test_count_pipeline_end_to_end(self):
+        pipe = Pipeline([
+            TextPreprocessor(),
+            CountVectorizer(vocab_size=500),
+            IDF(min_doc_freq=2),
+            LDA(Params(k=2, algorithm="online", max_iterations=15,
+                       batch_size=12, seed=0)),
+        ])
+        fitted = pipe.fit({"texts": TEXTS})
+        ds = fitted.transform({"texts": TEXTS[:4]})
+        assert ds["topic_distribution"].shape == (4, 2)
+        np.testing.assert_allclose(
+            ds["topic_distribution"].sum(1), 1.0, rtol=1e-5
+        )
+
+    def test_hashing_pipeline(self):
+        pipe = Pipeline([
+            TextPreprocessor(),
+            HashingTF(num_features=1 << 12),
+            IDF(min_doc_freq=1),
+            LDA(Params(k=2, algorithm="online", max_iterations=10,
+                       batch_size=12, seed=0)),
+        ])
+        fitted = pipe.fit({"texts": TEXTS})
+        ds = fitted.transform({"texts": TEXTS[:2]})
+        assert ds["topic_distribution"].shape == (2, 2)
+
+    def test_em_pipeline_exposes_log_likelihood(self):
+        pipe = Pipeline([
+            TextPreprocessor(),
+            CountVectorizer(vocab_size=500),
+            LDA(Params(k=2, algorithm="em", max_iterations=10, seed=0)),
+        ])
+        fitted = pipe.fit({"texts": TEXTS})
+        assert fitted.stages[-1].log_likelihood is not None
+        assert fitted.stages[-1].log_likelihood < 0
+
+    def test_scoring_path_is_training_path_minus_idf(self):
+        # the reference's BuildCountVector == BuildTFIDFVector minus IDF;
+        # here that's by construction: same stages, drop IDF
+        pre = TextPreprocessor()
+        cv = CountVectorizer(vocab_size=500).fit(pre.transform({"texts": TEXTS}))
+        ds = cv.transform(pre.transform({"texts": TEXTS[:2]}))
+        assert all(w.dtype == np.float32 for _, w in ds["rows"])
+        assert all((w == np.round(w)).all() for _, w in ds["rows"])  # raw counts
+
+
+class TestJavaDoubleStr:
+    def test_decimal_range(self):
+        assert java_double_str(0.35421591206190234) == "0.35421591206190234"
+        assert java_double_str(0.0) == "0.0"
+
+    def test_scientific_below_1e_minus_3(self):
+        assert java_double_str(8.448894766995838e-4) == "8.448894766995838E-4"
+
+    def test_large(self):
+        assert java_double_str(1.5e8).endswith("E8")
+
+
+class TestReport:
+    def test_report_structure(self, tiny_corpus_rows):
+        rows, vocab = tiny_corpus_rows
+        from spark_text_clustering_tpu.models import LDAModel
+
+        rng = np.random.default_rng(0)
+        model = LDAModel(
+            lam=np.abs(rng.normal(size=(3, len(vocab)))).astype(np.float32) + 0.1,
+            vocab=vocab,
+            alpha=np.full((3,), 0.5, np.float32),
+            eta=0.3,
+        )
+        dist = model.topic_distribution(rows[:4])
+        text = format_scoring_report(
+            model,
+            [f"/x/Book {i}, Vol - Author.txt" for i in range(4)],
+            dist,
+            rows[:4],
+        )
+        assert "LDA Model: 3 Topics" in text
+        assert "Book's number: 3" in text
+        assert "Book 0? Vol - Author.txt" in text  # ',' -> '?' escape
+        assert "Main topic of the book" in text
+        assert text.count("Topics Nr. \t|\t Distribution") == 4
+
+
+class TestCLI:
+    def test_train_then_score_roundtrip(self, tmp_path):
+        from spark_text_clustering_tpu.cli import main
+
+        books = tmp_path / "books"
+        books.mkdir()
+        for i, t in enumerate(TEXTS):
+            (books / f"book{i:02d}.txt").write_text(t * 5)
+        models = str(tmp_path / "models")
+        out = str(tmp_path / "TestOutput")
+
+        rc = main([
+            "train", "--books", str(books), "--lang", "EN", "--k", "2",
+            "--algorithm", "online", "--max-iterations", "10",
+            "--models-dir", models, "--vocab-size", "1000",
+        ])
+        assert rc == 0
+        assert any(d.startswith("LdaModel_EN_") for d in os.listdir(models))
+
+        rc = main([
+            "score", "--books", str(books), "--lang", "EN",
+            "--models-dir", models, "--output-dir", out,
+        ])
+        assert rc == 0
+        results = os.listdir(out)
+        assert len(results) == 1 and results[0].startswith("Result_EN_")
+        content = (tmp_path / "TestOutput" / results[0]).read_text()
+        assert "LDA Model: 2 Topics" in content
+        assert content.count("Book's number:") == len(TEXTS)
+
+    def test_score_without_model_errors_cleanly(self, tmp_path):
+        from spark_text_clustering_tpu.cli import main
+
+        rc = main([
+            "score", "--books", str(tmp_path), "--lang", "FR",
+            "--models-dir", str(tmp_path), "--output-dir", str(tmp_path),
+        ])
+        assert rc == 2
