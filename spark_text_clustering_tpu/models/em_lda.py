@@ -38,7 +38,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..config import Params
-from ..ops.sparse import DocTermBatch, batch_from_rows, next_pow2
+from ..ops.sparse import DocTermBatch, batch_from_rows
 from ..parallel.collectives import (
     all_gather_model,
     data_shard_batch,
@@ -166,7 +166,11 @@ class EMLDA:
             data_shards=params.data_shards, model_shards=params.model_shards
         )
         self.last_log_likelihood: Optional[float] = None
-        self._step_fn = None  # jit cache survives repeat fits (bench warmup)
+        # jit cache keyed by vocab size (the only per-fit value baked into
+        # the step closure) so it survives repeat fits (bench warmup) but
+        # never leaks across fits with different vocabularies
+        self._step_fn = None
+        self._step_fn_vocab = None
 
     def _init_state(self, batch: DocTermBatch, k: int, v_pad: int, seed: int):
         """Soft random edge assignments aggregated into counts — the dense
@@ -221,14 +225,9 @@ class EMLDA:
         eta = p.resolved_eta()
 
         v_pad = ((v + p.model_shards - 1) // p.model_shards) * p.model_shards
-        max_nnz = max((len(i) for i, _ in rows), default=1)
-        row_len = max(8, next_pow2(max_nnz))
-        batch = batch_from_rows(rows, row_len=row_len)
+        batch = batch_from_rows(rows)
         batch = data_shard_batch(self.mesh, batch)   # pads B to shard multiple
         b_pad = batch.num_docs
-
-        n_wk, n_dk = self._init_state(batch, k, v_pad, p.seed)
-        state = EMState(n_wk, n_dk, jnp.int32(0))
 
         ckpt_path = (
             os.path.join(p.checkpoint_dir, "em_state.npz")
@@ -252,11 +251,15 @@ class EMLDA:
                                NamedSharding(self.mesh, P(DATA_AXIS, None))),
                 jnp.int32(start_it),
             )
+        else:
+            n_wk, n_dk = self._init_state(batch, k, v_pad, p.seed)
+            state = EMState(n_wk, n_dk, jnp.int32(0))
 
-        if self._step_fn is None:
+        if self._step_fn is None or self._step_fn_vocab != v:
             self._step_fn = make_em_train_step(
                 self.mesh, alpha=alpha, eta=eta, vocab_size=v
             )
+            self._step_fn_vocab = v
         step_fn = self._step_fn
         timer = IterationTimer()
         for it in range(start_it, n_iters):

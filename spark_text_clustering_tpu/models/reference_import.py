@@ -1,0 +1,185 @@
+"""Read-only importer for Spark MLlib 2.4.3 ``DistributedLDAModel`` artifacts.
+
+The reference saves EM-trained models as three Parquet datasets plus a JSON
+metadata line (layout documented in SURVEY.md §3.5; written at
+``LDAClustering.scala:70`` and read back at ``LDALoader.scala:37``):
+
+  ``metadata/part-00000``     {class, version, k, vocabSize, docConcentration,
+                               topicConcentration, iterationTimes, gammaShape}
+  ``data/globalTopicTotals``  one row, k-dim dense vector N_k
+  ``data/topicCounts``        (id: long, topicWeights: k-vector) per graph
+                              vertex; term ids are encoded NEGATIVE as
+                              ``-(termIndex + 1)``, doc ids are >= 0
+  ``data/tokenCounts``        (srcId: doc, dstId: negative term, tokenCounts:
+                              double) per doc-term edge — TF-IDF weights,
+                              including the reference's 0.0001 IDF floor
+
+The vocabulary is NOT in the model: it lives in an out-of-band comma-joined
+sidecar at ``models/vocabularies/<model_name>`` (``LDAClustering.scala:71-72``).
+
+This importer turns those frozen artifacts into parity fixtures: an imported
+model is a normal :class:`~.base.LDAModel`, so our ``describe_topics`` /
+``topic_distribution`` / report paths run against the reference's own trained
+parameters and can be checked against the golden ``TestOutput/Result_EN_*``
+reports (tests/test_reference_parity.py).
+
+Vectors use Spark SQL's VectorUDT struct encoding:
+``{type: 0 sparse | 1 dense, size, indices, values}``.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .base import LDAModel
+
+__all__ = [
+    "load_reference_model",
+    "load_reference_vocab",
+    "reference_doc_rows",
+    "MLlibLDAArtifacts",
+]
+
+
+def _require_pyarrow():
+    try:
+        import pyarrow.parquet as pq  # noqa: F401
+
+        return pq
+    except ImportError as e:  # pragma: no cover - env without pyarrow
+        raise ImportError(
+            "reading reference MLlib Parquet artifacts requires pyarrow"
+        ) from e
+
+
+def _read_parquet_dir(path: str) -> List[dict]:
+    """All rows of every ``part-*.parquet`` under ``path`` (Spark writes the
+    dataset as a directory of part files plus ``_SUCCESS``)."""
+    pq = _require_pyarrow()
+    rows: List[dict] = []
+    parts = sorted(glob.glob(os.path.join(path, "part-*.parquet")))
+    if not parts:
+        raise FileNotFoundError(f"no parquet part files under {path}")
+    for part in parts:
+        rows.extend(pq.read_table(part).to_pylist())
+    return rows
+
+
+def _vector_to_dense(v: dict, size: Optional[int] = None) -> np.ndarray:
+    """Decode a Spark VectorUDT struct row to a dense float64 array."""
+    if v["type"] == 1:  # dense
+        return np.asarray(v["values"], np.float64)
+    n = v["size"] if size is None else size
+    out = np.zeros(int(n), np.float64)
+    out[np.asarray(v["indices"], np.int64)] = np.asarray(v["values"], np.float64)
+    return out
+
+
+class MLlibLDAArtifacts:
+    """Raw decoded artifacts of one saved DistributedLDAModel."""
+
+    def __init__(self, path: str):
+        self.path = path
+        with open(
+            os.path.join(path, "metadata", "part-00000"), encoding="utf-8"
+        ) as f:
+            self.metadata = json.loads(f.readline())
+        k = int(self.metadata["k"])
+        v = int(self.metadata["vocabSize"])
+        self.k, self.vocab_size = k, v
+
+        totals_rows = _read_parquet_dir(
+            os.path.join(path, "data", "globalTopicTotals")
+        )
+        self.global_topic_totals = _vector_to_dense(
+            totals_rows[0]["topicCounts"]
+            if "topicCounts" in totals_rows[0]
+            else next(iter(totals_rows[0].values())),
+            size=k,
+        )
+
+        # vertices: term rows -> beta counts [k, V]; doc rows -> gamma [D, k]
+        self.beta = np.zeros((k, v), np.float64)
+        doc_gammas: Dict[int, np.ndarray] = {}
+        for row in _read_parquet_dir(os.path.join(path, "data", "topicCounts")):
+            vid = int(row["id"])
+            vec = _vector_to_dense(row["topicWeights"], size=k)
+            if vid < 0:
+                self.beta[:, -(vid + 1)] = vec
+            else:
+                doc_gammas[vid] = vec
+        self.doc_gammas = doc_gammas
+
+        # edges: (doc, term) -> weight (TF-IDF pseudo-counts, 0.0001 floor)
+        self.edges: List[Tuple[int, int, float]] = []
+        for row in _read_parquet_dir(os.path.join(path, "data", "tokenCounts")):
+            src, dst = int(row["srcId"]), int(row["dstId"])
+            doc_id, term_id = (src, dst) if dst < 0 else (dst, src)
+            self.edges.append((doc_id, -(term_id + 1), float(row["tokenCounts"])))
+
+
+def load_reference_vocab(model_path: str) -> List[str]:
+    """The comma-joined single-line vocabulary sidecar
+    (``models/vocabularies/<model_name>``, LDAClustering.scala:71-72)."""
+    base = os.path.dirname(model_path.rstrip("/"))
+    name = os.path.basename(model_path.rstrip("/"))
+    sidecar = os.path.join(base, "vocabularies", name)
+    with open(sidecar, encoding="utf-8") as f:
+        return f.read().strip("\n").split(",")
+
+
+def load_reference_model(
+    model_path: str, vocab: Optional[List[str]] = None
+) -> LDAModel:
+    """Import a frozen MLlib DistributedLDAModel as one of ours.
+
+    ``lam`` carries the EM topic-word counts (the matrix MLlib's ``toLocal``
+    hands to ``LocalLDAModel``), so ``topic_distribution`` reproduces
+    ``model.toLocal.topicDistribution`` (LDALoader.scala:108) and
+    ``describe_topics`` reproduces ``describeTopics`` normalization by topic
+    totals (SURVEY.md §2.2).
+    """
+    art = MLlibLDAArtifacts(model_path)
+    if vocab is None:
+        try:
+            vocab = load_reference_vocab(model_path)
+        except FileNotFoundError:
+            vocab = [f"term_{i}" for i in range(art.vocab_size)]
+    meta = art.metadata
+    alpha = np.asarray(meta["docConcentration"], np.float32)
+    if alpha.ndim == 0:
+        alpha = np.full((art.k,), float(alpha), np.float32)
+    model = LDAModel(
+        lam=art.beta.astype(np.float32),
+        vocab=list(vocab),
+        alpha=alpha,
+        eta=float(meta["topicConcentration"]),
+        gamma_shape=float(meta.get("gammaShape", 100.0)),
+        iteration_times=[float(t) for t in meta.get("iterationTimes", [])],
+        algorithm="em",
+        step=len(meta.get("iterationTimes", [])),
+    )
+    return model
+
+
+def reference_doc_rows(
+    art: MLlibLDAArtifacts,
+) -> List[Tuple[int, np.ndarray, np.ndarray]]:
+    """Reconstruct the training corpus rows from the saved edges:
+    ``[(doc_id, term_ids, tfidf_weights)]`` sorted by doc id.  These are the
+    exact TF-IDF vectors EM trained on (including the 0.0001-floor edges)."""
+    by_doc: Dict[int, List[Tuple[int, float]]] = {}
+    for doc_id, term_id, w in art.edges:
+        by_doc.setdefault(doc_id, []).append((term_id, w))
+    rows = []
+    for doc_id in sorted(by_doc):
+        pairs = sorted(by_doc[doc_id])
+        ids = np.asarray([p[0] for p in pairs], np.int32)
+        wts = np.asarray([p[1] for p in pairs], np.float32)
+        rows.append((doc_id, ids, wts))
+    return rows

@@ -1,0 +1,201 @@
+"""Cross-implementation parity against the reference's frozen artifacts.
+
+The reference ships three saved MLlib DistributedLDAModels, vocabulary
+sidecars, and two golden scoring reports (SURVEY.md §2.6, §4).  Importing a
+frozen model and running OUR inference/report paths against it checks our
+math against the numbers Spark MLlib 2.4.3 actually produced:
+
+* ``describeTopics`` weights — the golden report's per-topic term weights
+  were printed straight from the frozen model (LDALoader.scala:66-69,
+  177-187), full double precision, so they pin our normalization exactly.
+* ``topicDistribution`` — run on the exact TF-IDF rows EM trained on
+  (reconstructed from the saved graph edges) must land in the same posterior
+  basin as the EM doc-vertex topic counts.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+import numpy as np
+import pytest
+
+pq = pytest.importorskip("pyarrow.parquet")
+
+from spark_text_clustering_tpu.models.reference_import import (  # noqa: E402
+    MLlibLDAArtifacts,
+    load_reference_model,
+    load_reference_vocab,
+    reference_doc_rows,
+)
+
+EN_MODEL = "models/LdaModel_EN_1591049082850"
+GOLDEN_REPORT = "TestOutput/Result_EN_1591066624209"
+
+
+@pytest.fixture(scope="module")
+def en_model_path(reference_resources):
+    path = os.path.join(reference_resources, EN_MODEL)
+    if not os.path.isdir(path):
+        pytest.skip("frozen EN model not present")
+    return path
+
+
+@pytest.fixture(scope="module")
+def artifacts(en_model_path):
+    return MLlibLDAArtifacts(en_model_path)
+
+
+@pytest.fixture(scope="module")
+def model(en_model_path):
+    return load_reference_model(en_model_path)
+
+
+def test_import_shapes_match_survey(artifacts):
+    """SURVEY.md §6: 39,431 vertices (39,380 terms + 51 docs), 253,368
+    edges, k=5 totals."""
+    assert artifacts.k == 5
+    assert artifacts.vocab_size == 39_380
+    assert len(artifacts.doc_gammas) == 51
+    assert len(artifacts.edges) == 253_368
+    assert artifacts.global_topic_totals.shape == (5,)
+    # EM invariant: global totals are the term-topic counts summed over terms
+    np.testing.assert_allclose(
+        artifacts.beta.sum(axis=1), artifacts.global_topic_totals, rtol=1e-12
+    )
+
+
+def test_metadata_hyperparameters(model):
+    """BASELINE.md: k=5, alpha=11 (auto 50/k+1), eta=1.1, 50 iters,
+    gammaShape=100."""
+    assert model.k == 5
+    np.testing.assert_allclose(model.alpha, np.full(5, 11.0))
+    assert model.eta == pytest.approx(1.1)
+    assert model.gamma_shape == pytest.approx(100.0)
+    assert len(model.iteration_times) == 50
+    assert len(model.vocab) == model.vocab_size
+
+
+def test_vocab_sidecar(en_model_path):
+    vocab = load_reference_vocab(en_model_path)
+    assert len(vocab) == 39_380
+    # frequency-ranked: the reference's most frequent stems come first
+    assert vocab[0] == "come"
+    assert "Holm" in vocab[:30]
+
+
+def test_edges_have_idf_floor(artifacts):
+    """BuildTFIDFVector patches idf==0 -> 0.0001 (LDAClustering.scala:184-187);
+    the floor must survive in the saved edges."""
+    weights = np.asarray([w for _, _, w in artifacts.edges])
+    assert weights.min() == pytest.approx(1e-4)
+    assert (weights > 0).all()
+
+
+def _golden_topic_terms(report_path):
+    """Parse the 'TOPIC n: top-weighted terms' header of a golden report into
+    [[(term, weight)]] (format written at LDALoader.scala:70-77)."""
+    topics, current = [], None
+    with open(report_path, encoding="utf-8", errors="replace") as f:
+        for line in f:
+            if line.startswith("TOPIC "):
+                current = []
+                topics.append(current)
+            elif current is not None:
+                m = re.match(r"^(\S+)\t([0-9.Ee-]+)\s*$", line)
+                if m:
+                    current.append((m.group(1), float(m.group(2))))
+                elif line.strip() == "" and current:
+                    current = None
+            if line.startswith("***") and len(topics) == 5 and current is None:
+                break
+    return topics
+
+
+def test_describe_topics_matches_golden_report(
+    reference_resources, model, artifacts
+):
+    """Our describe_topics on the imported beta reproduces the golden
+    report's term weights (normalized by topic totals) to float32 precision."""
+    report = os.path.join(reference_resources, GOLDEN_REPORT)
+    if not os.path.isfile(report):
+        pytest.skip("golden report not present")
+    golden = _golden_topic_terms(report)
+    assert len(golden) == 5 and all(len(t) >= 5 for t in golden)
+
+    ours = model.describe_topics_terms(max_terms_per_topic=10)
+    beta64 = artifacts.beta / artifacts.beta.sum(axis=1, keepdims=True)
+    vocab_index = {t: i for i, t in enumerate(model.vocab)}
+    for topic_id, golden_terms in enumerate(golden):
+        our_terms = [t for t, _ in ours[topic_id]]
+        for rank, (term, weight) in enumerate(golden_terms):
+            assert our_terms[rank] == term, (
+                f"topic {topic_id} rank {rank}: {our_terms[rank]} != {term}"
+            )
+            # float32 import path: ~1e-7 relative; float64 exact to 1e-12
+            assert ours[topic_id][rank][1] == pytest.approx(weight, rel=1e-5)
+            assert beta64[topic_id, vocab_index[term]] == pytest.approx(
+                weight, rel=1e-11
+            )
+
+
+def test_topic_distribution_on_training_rows(model, artifacts):
+    """Infer topic mixtures for the exact TF-IDF rows EM trained on; the
+    posterior must agree with the EM doc-vertex topic counts on the dominant
+    topic for nearly every doc (same model, same data — only the inference
+    algorithm differs: VB E-step vs EM graph aggregation)."""
+    rows = reference_doc_rows(artifacts)
+    assert len(rows) == 51
+    dist = model.topic_distribution([(ids, wts) for _, ids, wts in rows])
+    assert dist.shape == (51, 5)
+    np.testing.assert_allclose(dist.sum(axis=1), 1.0, atol=1e-4)
+    assert (dist > 0).all()
+
+    em_argmax = np.asarray(
+        [np.argmax(artifacts.doc_gammas[doc_id]) for doc_id, _, _ in rows]
+    )
+    vb_argmax = dist.argmax(axis=1)
+    agreement = float((em_argmax == vb_argmax).mean())
+    assert agreement >= 0.8, f"dominant-topic agreement only {agreement:.2f}"
+
+
+def _golden_book_assignments(report_path):
+    """[(book_name, argmax_topic, weight, [k-dim distribution])] parsed from
+    the per-book sections (format at LDALoader.scala:110-169)."""
+    books = []
+    name, dist = None, []
+    with open(report_path, encoding="utf-8", errors="replace") as f:
+        for line in f:
+            m = re.match(r"^Book's name: (.+?)\s*$", line)
+            if m:
+                name, dist = m.group(1), []
+                continue
+            m = re.match(r"^Nr\.: (\d+) \s*\t?\s*\|\s*([0-9.Ee-]+)", line)
+            if m:
+                dist.append(float(m.group(2)))
+                continue
+            m = re.match(
+                r"^Main topic of the book: Topic Nr\. \((\d+)\), "
+                r"Weight \(([0-9.Ee-]+)\)",
+                line,
+            )
+            if m and name is not None:
+                books.append(
+                    (name, int(m.group(1)), float(m.group(2)), list(dist))
+                )
+                name = None
+    return books
+
+
+def test_golden_report_parse_sanity(reference_resources):
+    report = os.path.join(reference_resources, GOLDEN_REPORT)
+    if not os.path.isfile(report):
+        pytest.skip("golden report not present")
+    books = _golden_book_assignments(report)
+    assert len(books) == 51
+    for _, argmax, weight, dist in books:
+        assert len(dist) == 5
+        assert np.argmax(dist) == argmax
+        assert dist[argmax] == pytest.approx(weight)
+        assert sum(dist) == pytest.approx(1.0, abs=1e-6)
