@@ -32,7 +32,7 @@ from .pipeline import (
     TextPreprocessor,
 )
 from .models.base import LDAModel
-from .models.persistence import latest_model_dir, model_dir_name
+from .models.persistence import latest_model_dir, load_model, model_dir_name
 from .utils.readers import read_stop_word_file, read_text_dir
 from .utils.report import format_scoring_report, write_scoring_report
 from .utils.textproc import parse_stop_words
@@ -133,7 +133,9 @@ def cmd_score(args: argparse.Namespace) -> int:
         print(f"no model for lang {args.lang} under {args.models_dir}",
               file=sys.stderr)
         return 2
-    model = LDAModel.load(model_path)
+    # Generic loader: scoring works with whichever estimator trained the
+    # artifact (LDA or NMF) — both expose topic_distribution/describe_topics.
+    model = load_model(model_path)
     print(f"loaded model {model_path}: k={model.k}, V={model.vocab_size}")
 
     books_dir = args.books
@@ -183,7 +185,9 @@ def build_parser() -> argparse.ArgumentParser:
     tr.add_argument("--doc-concentration", type=float, default=-1)
     tr.add_argument("--topic-concentration", type=float, default=-1)
     tr.add_argument("--vocab-size", type=int, default=2_900_000)
-    tr.add_argument("--algorithm", default="em", choices=["em", "online"])
+    tr.add_argument(
+        "--algorithm", default="em", choices=["em", "online", "nmf"]
+    )
     tr.add_argument("--checkpoint-dir", default=None)
     tr.add_argument("--checkpoint-interval", type=int, default=10)
     tr.add_argument("--seed", type=int, default=0)

@@ -1,5 +1,6 @@
 from .base import LDAModel
 from .em_lda import EMLDA, em_log_likelihood, make_em_train_step
+from .nmf import NMF, NMFModel, make_nmf_train_step
 from .online_lda import OnlineLDA, make_online_train_step
 
 __all__ = [
@@ -7,6 +8,9 @@ __all__ = [
     "EMLDA",
     "em_log_likelihood",
     "make_em_train_step",
+    "NMF",
+    "NMFModel",
+    "make_nmf_train_step",
     "OnlineLDA",
     "make_online_train_step",
 ]

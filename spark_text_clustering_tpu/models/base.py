@@ -174,4 +174,10 @@ class LDAModel:
     def load(cls, path: str) -> "LDAModel":
         from .persistence import load_model
 
-        return load_model(path)
+        model = load_model(path)
+        if not isinstance(model, cls):
+            raise TypeError(
+                f"{path} holds a {type(model).__name__}; use "
+                f"persistence.load_model for estimator-agnostic loading"
+            )
+        return model

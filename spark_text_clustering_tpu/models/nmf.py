@@ -1,0 +1,312 @@
+"""Sparse non-negative matrix factorization on the TF-IDF TPU path.
+
+The north-star "estimator swap" config (BASELINE.md): reuse the exact
+featurization the LDA estimators consume (a sparse ``DocTermBatch`` of
+TF-IDF rows) but factor X ~= W @ H with multiplicative updates
+(Lee & Seung, Frobenius objective) instead of fitting a topic posterior.
+The reference has no NMF — this is a capability the framework adds on top
+of the shared pipeline, which is why it lives behind the same
+Estimator/Transformer surface as ``LDA`` (pipeline.py).
+
+TPU mapping (same mesh contract as online_lda.py):
+
+  * W [B, k]   — doc factors, sharded over "data" (each chip owns its docs'
+                 rows, like Spark's RDD partitions).
+  * H [k, V]   — topic factors, V-sharded over "model" (the lambda layout).
+  * X          — the padded sparse batch, doc-sharded over "data".
+
+Per iteration, both multiplicative updates reduce to gathers + one
+scatter-add + tiny [k, k] matmuls:
+
+  W <- W * (X H^T) / (W (H H^T))      X H^T: gather H columns at token ids
+  H <- H * (W^T X) / ((W^T W) H)      W^T X: scatter-add, psum over "data"
+                                      W^T W: [k, k] psum over "data"
+
+No driver round-trips; the only cross-chip traffic is two small psums and
+the H all-gather (which disappears when model_shards=1).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Callable, List, NamedTuple, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..config import Params
+from ..ops.sparse import DocTermBatch, batch_from_rows
+from ..parallel.collectives import (
+    all_gather_model,
+    data_shard_batch,
+    psum_data,
+    scatter_model,
+)
+from ..parallel.mesh import DATA_AXIS, MODEL_AXIS, make_mesh, model_sharding
+from ..utils.timing import IterationTimer
+
+__all__ = ["NMF", "NMFModel", "make_nmf_train_step", "frobenius_loss"]
+
+_EPS = 1e-9  # multiplicative-update guard; keeps factors strictly >= 0
+
+
+class NMFTrainState(NamedTuple):
+    w: jnp.ndarray  # [B, k] doc-sharded over "data"
+    h: jnp.ndarray  # [k, V/model_shards] per device along "model"
+
+
+def _gather_h(h: jnp.ndarray, ids: jnp.ndarray) -> jnp.ndarray:
+    """h [k, V] gathered at token ids -> [B, L, k] (the E-step gather)."""
+    return jnp.moveaxis(h, 0, -1)[ids]
+
+
+def make_nmf_train_step(
+    mesh: Mesh, *, vocab_size: int
+) -> Callable[[NMFTrainState, DocTermBatch], NMFTrainState]:
+    """Build the jitted, shard_mapped multiplicative-update step.
+
+    ``batch`` must be doc-sharded over "data"; H is V-sharded over "model".
+    Pad docs (all weights 0) have X H^T == 0, so their W rows decay to 0 and
+    contribute nothing to W^T X / W^T W — padding is numerically inert.
+    """
+
+    def _step(w, h_shard, ids, wts):
+        h = all_gather_model(h_shard, axis=-1)                 # [k, V]
+
+        # --- W update (local to each data shard) -----------------------
+        hg = _gather_h(h, ids)                                 # [B, L, k]
+        xht = jnp.einsum("blk,bl->bk", hg, wts)                # [B, k]
+        hht = h @ h.T                                          # [k, k]
+        w = w * xht / (w @ hht + _EPS)
+
+        # --- H update (psum the doc-side reductions) -------------------
+        wtw = psum_data(w.T @ w)                               # [k, k]
+        vals = wts[..., None] * w[:, None, :]                  # [B, L, k]
+        wtx_vt = (
+            jnp.zeros((vocab_size, w.shape[-1]), jnp.float32)
+            .at[ids.reshape(-1)]
+            .add(vals.reshape(-1, w.shape[-1]))
+        )                                                      # [V, k]
+        wtx = psum_data(wtx_vt.T)                              # [k, V]
+        h = h * wtx / (wtw @ h + _EPS)
+        return w, scatter_model(h, axis=-1)
+
+    sharded = jax.shard_map(
+        _step,
+        mesh=mesh,
+        in_specs=(
+            P(DATA_AXIS, None),       # w
+            P(None, MODEL_AXIS),      # h shard
+            P(DATA_AXIS, None),       # token_ids
+            P(DATA_AXIS, None),       # token_weights
+        ),
+        out_specs=(P(DATA_AXIS, None), P(None, MODEL_AXIS)),
+        check_vma=False,
+    )
+
+    @jax.jit
+    def train_step(state: NMFTrainState, batch: DocTermBatch) -> NMFTrainState:
+        w, h = sharded(state.w, state.h, batch.token_ids, batch.token_weights)
+        return NMFTrainState(w, h)
+
+    return train_step
+
+
+@partial(jax.jit, static_argnames=())
+def frobenius_loss(
+    batch: DocTermBatch, w: jnp.ndarray, h: jnp.ndarray
+) -> jnp.ndarray:
+    """||X - W H||_F^2 without densifying X:
+    ||X||^2 - 2 sum_nz x * (W H) + tr((W^T W)(H H^T))."""
+    ids, wts = batch.token_ids, batch.token_weights
+    hg = _gather_h(h, ids)                                     # [B, L, k]
+    wh_at_nz = jnp.einsum("blk,bk->bl", hg, w)                 # [B, L]
+    cross = (wts * wh_at_nz).sum()
+    x2 = (wts**2).sum()
+    wh2 = ((w.T @ w) * (h @ h.T)).sum()
+    return x2 - 2.0 * cross + wh2
+
+
+@partial(jax.jit, static_argnames=("n_iter",))
+def _solve_w(
+    batch: DocTermBatch, h: jnp.ndarray, w0: jnp.ndarray, n_iter: int = 100
+) -> jnp.ndarray:
+    """Fixed-H W solve (the transform path): iterate only the W update."""
+    ids, wts = batch.token_ids, batch.token_weights
+    hg = _gather_h(h, ids)                                     # [B, L, k]
+    xht = jnp.einsum("blk,bl->bk", hg, wts)                    # [B, k]
+    hht = h @ h.T
+
+    def body(_, w):
+        return w * xht / (w @ hht + _EPS)
+
+    return jax.lax.fori_loop(0, n_iter, body, w0)
+
+
+# ---------------------------------------------------------------------------
+@dataclass
+class NMFModel:
+    """Fitted factorization: ``h`` [k, V] topic-term factors + vocabulary.
+
+    The topic-facing API mirrors LDAModel (describe_topics, transform) so
+    pipelines can swap estimators without downstream changes — the
+    north-star "estimator swap" capability."""
+
+    h: np.ndarray                      # [k, V] float32
+    vocab: List[str]
+    loss: float = float("nan")         # final Frobenius objective
+    iteration_times: List[float] = field(default_factory=list)
+    step: int = 0
+
+    @property
+    def k(self) -> int:
+        return int(self.h.shape[0])
+
+    @property
+    def vocab_size(self) -> int:
+        return int(self.h.shape[1])
+
+    def topics_matrix(self) -> np.ndarray:
+        """Row-normalized topic-term distributions [k, V]."""
+        h = np.asarray(self.h, np.float64)
+        return h / np.maximum(h.sum(axis=1, keepdims=True), _EPS)
+
+    def describe_topics(
+        self, max_terms_per_topic: int = 10
+    ) -> List[List[Tuple[int, float]]]:
+        mat = self.topics_matrix()
+        out = []
+        for row in mat:
+            top = np.argsort(-row, kind="stable")[:max_terms_per_topic]
+            out.append([(int(i), float(row[i])) for i in top])
+        return out
+
+    def describe_topics_terms(
+        self, max_terms_per_topic: int = 10
+    ) -> List[List[Tuple[str, float]]]:
+        return [
+            [(self.vocab[i], w) for i, w in topic]
+            for topic in self.describe_topics(max_terms_per_topic)
+        ]
+
+    def transform(
+        self,
+        docs: Union[DocTermBatch, Sequence[Tuple[np.ndarray, np.ndarray]]],
+        n_iter: int = 100,
+    ) -> np.ndarray:
+        """Doc factors W [B, k] for new docs with H fixed."""
+        batch = (
+            docs
+            if isinstance(docs, DocTermBatch)
+            else batch_from_rows(list(docs))
+        )
+        w0 = jnp.full((batch.num_docs, self.k), 1.0 / self.k, jnp.float32)
+        w = _solve_w(batch, jnp.asarray(self.h, jnp.float32), w0, n_iter)
+        return np.asarray(w)
+
+    def topic_distribution(self, docs, n_iter: int = 100) -> np.ndarray:
+        """Row-normalized W — the LDAModel.topic_distribution analogue, so
+        scoring/report code is estimator-agnostic.  Empty docs get uniform."""
+        w = self.transform(docs, n_iter=n_iter)
+        totals = w.sum(axis=1, keepdims=True)
+        uniform = np.full_like(w, 1.0 / self.k)
+        return np.where(totals > 0, w / np.maximum(totals, _EPS), uniform)
+
+    # ---- persistence ---------------------------------------------------
+    def save(self, path: str) -> None:
+        from .persistence import save_nmf_model
+
+        save_nmf_model(self, path)
+
+    @classmethod
+    def load(cls, path: str) -> "NMFModel":
+        from .persistence import load_model
+
+        model = load_model(path)
+        if not isinstance(model, cls):
+            raise TypeError(f"{path} holds a {type(model).__name__}")
+        return model
+
+
+# ---------------------------------------------------------------------------
+class NMF:
+    """Estimator: ``fit(rows, vocab) -> NMFModel`` on the shared mesh.
+
+    Uses ``params.k``/``max_iterations``/``seed`` from the same Params
+    surface as the LDA estimators (Params.scala:1-11 equivalent)."""
+
+    def __init__(self, params: Params, mesh: Optional[Mesh] = None) -> None:
+        self.params = params
+        self.mesh = mesh if mesh is not None else make_mesh(
+            data_shards=params.data_shards, model_shards=params.model_shards
+        )
+        self.last_loss: Optional[float] = None
+        # Per-instance step cache (the EMLDA pattern): repeat fits on the
+        # same vocab size skip shard_map construction + XLA retrace.
+        self._step_fn = None
+        self._step_fn_vocab: Optional[int] = None
+
+    def fit(
+        self,
+        rows: Sequence[Tuple[np.ndarray, np.ndarray]],
+        vocab: List[str],
+        verbose: bool = False,
+    ) -> NMFModel:
+        p = self.params
+        k, v = p.k, len(vocab)
+        n_model = self.mesh.shape[MODEL_AXIS]
+        v_pad = ((v + n_model - 1) // n_model) * n_model
+
+        n_true = len(rows)
+        batch = batch_from_rows(list(rows))
+        batch = data_shard_batch(self.mesh, batch)
+        b = batch.num_docs
+
+        # Scaled-uniform init: E[(W H)_ij] == mean(X) at iteration 0, the
+        # standard scheme that keeps early updates well-conditioned.  Scale
+        # and H's vocab extent use the UNPADDED n_true/v so the init (and
+        # hence the trajectory) is mesh-shape independent: pad columns of H
+        # start at 0 and multiplicative updates keep them there.
+        mean_x = float(np.asarray(batch.token_weights.sum())) / max(
+            n_true * v, 1
+        )
+        scale = np.sqrt(max(mean_x, _EPS) / k)
+        kw, kh = jax.random.split(jax.random.PRNGKey(p.seed))
+        w = scale * (
+            0.5 + jax.random.uniform(kw, (n_true, k), jnp.float32)
+        )
+        w = jnp.pad(w, ((0, b - n_true), (0, 0)))  # pad docs: W rows stay 0
+        h = scale * (
+            0.5 + jax.random.uniform(kh, (k, v), jnp.float32)
+        )
+        h = jnp.pad(h, ((0, 0), (0, v_pad - v)))
+        w = jax.device_put(w, NamedSharding(self.mesh, P(DATA_AXIS, None)))
+        h = jax.device_put(h, model_sharding(self.mesh))
+        state = NMFTrainState(w, h)
+
+        if self._step_fn is None or self._step_fn_vocab != v_pad:
+            self._step_fn = make_nmf_train_step(self.mesh, vocab_size=v_pad)
+            self._step_fn_vocab = v_pad
+        step_fn = self._step_fn
+        timer = IterationTimer()
+        for it in range(p.max_iterations):
+            timer.start()
+            state = step_fn(state, batch)
+            state.h.block_until_ready()
+            timer.stop()
+            if verbose:
+                print(f"nmf iter {it}: {timer.times[-1]:.3f}s")
+
+        loss = float(frobenius_loss(batch, state.w, state.h))
+        self.last_loss = loss
+        h_np = np.asarray(jax.device_get(state.h))[:, :v]
+        return NMFModel(
+            h=h_np,
+            vocab=list(vocab),
+            loss=loss,
+            iteration_times=list(timer.times),
+            step=p.max_iterations,
+        )

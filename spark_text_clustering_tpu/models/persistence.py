@@ -29,6 +29,7 @@ FORMAT_VERSION = 1
 
 __all__ = [
     "save_model",
+    "save_nmf_model",
     "load_model",
     "save_train_state",
     "load_train_state",
@@ -64,31 +65,61 @@ def latest_model_dir(base: str, lang: str) -> Optional[str]:
     return os.path.join(base, max(cands, key=ts))
 
 
-def save_model(model, path: str) -> None:
-    from .base import LDAModel  # local import to avoid cycle
-
-    assert isinstance(model, LDAModel)
+def _write_artifact(path: str, meta: dict, arrays: dict, vocab) -> None:
+    """The single artifact layout (meta.json + arrays.npz + vocab.txt)."""
     os.makedirs(path, exist_ok=True)
-    meta = {
-        "format_version": FORMAT_VERSION,
-        "class": "spark_text_clustering_tpu.models.LDAModel",
-        "k": model.k,
-        "vocab_size": model.vocab_size,
-        "eta": float(model.eta),
-        "gamma_shape": float(model.gamma_shape),
-        "algorithm": model.algorithm,
-        "step": int(model.step),
-        "iteration_times": [float(t) for t in model.iteration_times],
-    }
     with open(os.path.join(path, "meta.json"), "w") as f:
-        json.dump(meta, f, indent=2)
+        json.dump({"format_version": FORMAT_VERSION, **meta}, f, indent=2)
     np.savez(
         os.path.join(path, "arrays.npz"),
-        lam=np.asarray(model.lam, np.float32),
-        alpha=np.asarray(model.alpha, np.float32),
+        **{k: np.asarray(v, np.float32) for k, v in arrays.items()},
     )
     with open(os.path.join(path, "vocab.txt"), "w", encoding="utf-8") as f:
-        f.write("\n".join(model.vocab))
+        f.write("\n".join(vocab))
+
+
+def save_model(model, path: str) -> None:
+    """Persist any framework model (dispatches on type — callers that got
+    their model from an estimator-swapped pipeline need not care which)."""
+    from .base import LDAModel  # local imports to avoid cycles
+    from .nmf import NMFModel
+
+    if isinstance(model, NMFModel):
+        save_nmf_model(model, path)
+        return
+    if not isinstance(model, LDAModel):
+        raise TypeError(f"cannot save a {type(model).__name__}")
+    _write_artifact(
+        path,
+        meta={
+            "class": "spark_text_clustering_tpu.models.LDAModel",
+            "k": model.k,
+            "vocab_size": model.vocab_size,
+            "eta": float(model.eta),
+            "gamma_shape": float(model.gamma_shape),
+            "algorithm": model.algorithm,
+            "step": int(model.step),
+            "iteration_times": [float(t) for t in model.iteration_times],
+        },
+        arrays={"lam": model.lam, "alpha": model.alpha},
+        vocab=model.vocab,
+    )
+
+
+def save_nmf_model(model, path: str) -> None:
+    _write_artifact(
+        path,
+        meta={
+            "class": "spark_text_clustering_tpu.models.NMFModel",
+            "k": model.k,
+            "vocab_size": model.vocab_size,
+            "loss": float(model.loss),
+            "step": int(model.step),
+            "iteration_times": [float(t) for t in model.iteration_times],
+        },
+        arrays={"h": model.h},
+        vocab=model.vocab,
+    )
 
 
 def save_train_state(path: str, step: int, **arrays: np.ndarray) -> None:
@@ -128,6 +159,21 @@ def load_model(path: str):
     arrays = np.load(os.path.join(path, "arrays.npz"))
     with open(os.path.join(path, "vocab.txt"), encoding="utf-8") as f:
         vocab = f.read().split("\n")
+    if meta.get("class", "").endswith("NMFModel"):
+        from .nmf import NMFModel
+
+        model = NMFModel(
+            h=arrays["h"],
+            vocab=vocab,
+            loss=float(meta.get("loss", float("nan"))),
+            iteration_times=list(meta.get("iteration_times", [])),
+            step=int(meta.get("step", 0)),
+        )
+        if model.vocab_size != len(vocab):
+            raise ValueError(
+                f"vocab length {len(vocab)} != h vocab axis {model.vocab_size}"
+            )
+        return model
     model = LDAModel(
         lam=arrays["lam"],
         vocab=vocab,

@@ -41,6 +41,7 @@ __all__ = [
     "IDF",
     "IDFModel",
     "LDA",
+    "NMFEstimator",
     "Pipeline",
     "PipelineModel",
 ]
@@ -192,8 +193,10 @@ class LDAModelTransformer(Transformer):
 
 
 class LDA(Estimator):
-    """Dispatches to the EM or online optimizer by ``params.algorithm`` —
-    the LDA facade of LDAClustering.scala:37-61."""
+    """Dispatches to the EM, online, or NMF optimizer by
+    ``params.algorithm`` — the LDA facade of LDAClustering.scala:37-61,
+    widened with the north-star "estimator swap" (sparse NMF on the same
+    featurization)."""
 
     def __init__(self, params: Params, mesh=None):
         self.params = params
@@ -201,6 +204,7 @@ class LDA(Estimator):
 
     def fit(self, ds: Dict) -> LDAModelTransformer:
         from .models.em_lda import EMLDA
+        from .models.nmf import NMF
         from .models.online_lda import OnlineLDA
 
         rows = ds["rows"]
@@ -208,7 +212,14 @@ class LDA(Estimator):
         if vocab is None:
             vocab = [f"h{i}" for i in range(ds["num_features"])]
         nonempty = [(i, w) for i, w in rows if len(i) > 0]
-        cls = EMLDA if self.params.algorithm == "em" else OnlineLDA
+        optimizers = {"em": EMLDA, "online": OnlineLDA, "nmf": NMF}
+        try:
+            cls = optimizers[self.params.algorithm]
+        except KeyError:
+            raise ValueError(
+                f"unknown algorithm {self.params.algorithm!r}; "
+                f"expected one of {sorted(optimizers)}"
+            ) from None
         opt = cls(self.params, mesh=self.mesh)
         model = opt.fit(nonempty, vocab)
         return LDAModelTransformer(
@@ -216,6 +227,16 @@ class LDA(Estimator):
             log_likelihood=getattr(opt, "last_log_likelihood", None),
             corpus_size=len(nonempty),
         )
+
+
+class NMFEstimator(LDA):
+    """Drop-in estimator swap (north-star config: "sparse NMF reusing the
+    TF-IDF TPU path"): the LDA facade pinned to ``algorithm="nmf"``, so
+    report/scoring code downstream cannot tell which factorizer produced
+    the topics."""
+
+    def __init__(self, params: Params, mesh=None):
+        super().__init__(params.replace(algorithm="nmf"), mesh=mesh)
 
 
 # ---------------------------------------------------------------------------
