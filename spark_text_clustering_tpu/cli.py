@@ -148,13 +148,13 @@ def cmd_score(args: argparse.Namespace) -> int:
 
     docs = list(read_text_dir(books_dir, include_all=args.include_all))
     # BuildCountVector semantics: count vectors over the TRAINED vocab, no
-    # IDF (LDALoader.scala:83-106)
+    # IDF (LDALoader.scala:83-106); hash-trained models hash instead of
+    # looking up (their vocab is the synthetic h0..hN)
     pre = TextPreprocessor(stop_words=sw, lemmatize=not args.no_lemmatize)
-    from .pipeline import CountVectorizerModel
+    from .pipeline import make_vectorizer
 
-    cv = CountVectorizerModel(model.vocab)
-    ds = cv.transform(pre.transform({"texts": [d.text for d in docs]}))
-    rows = ds["rows"]
+    ds = pre.transform({"texts": [d.text for d in docs]})
+    rows = make_vectorizer(model.vocab)(ds["tokens"])
     dist = model.topic_distribution(rows)
 
     text = format_scoring_report(
@@ -170,6 +170,120 @@ def cmd_score(args: argparse.Namespace) -> int:
         print(f"topic {t}: {c} books")
     print(f"report written to {path}")
     return 0
+
+
+def cmd_stream_score(args: argparse.Namespace) -> int:
+    """Watch a directory and score arriving books incrementally (the
+    LDALoader flow as a micro-batch stream; north-star "streaming" row)."""
+    from .streaming import FileStreamSource, StreamingScorer
+
+    model_path = args.model or latest_model_dir(args.models_dir, args.lang)
+    if model_path is None:
+        print(f"no model for lang {args.lang} under {args.models_dir}",
+              file=sys.stderr)
+        return 2
+    model = load_model(model_path)
+    print(f"loaded model {model_path}: k={model.k}, V={model.vocab_size}")
+
+    src = FileStreamSource(
+        args.watch_dir,
+        include_all=args.include_all,
+        max_files_per_trigger=args.max_files_per_trigger,
+        min_file_age_s=args.min_file_age,
+    )
+    scorer = StreamingScorer(
+        model,
+        stop_words=_load_stop_words(args.stop_words),
+        lemmatize=not args.no_lemmatize,
+        batch_capacity=args.batch_capacity,
+    )
+    for mb in src.stream(
+        poll_interval=args.poll_interval, idle_timeout=args.idle_timeout
+    ):
+        for sd in scorer.process(mb):
+            print(f"[batch {mb.batch_id}] "
+                  f"{os.path.basename(sd.name)} -> topic {sd.topic}")
+    for t, c in enumerate(scorer.tallies):
+        print(f"topic {t}: {c} books")
+    if scorer.results:
+        path = scorer.write_report(args.output_dir, args.lang)
+        print(f"report written to {path}")
+    return 0
+
+
+def cmd_stream_train(args: argparse.Namespace) -> int:
+    """Continuous online-VB training over a watched directory; saves the
+    final model like ``train`` does."""
+    from .streaming import FileStreamSource, StreamingOnlineLDA
+
+    params = Params(
+        input=args.watch_dir,
+        k=args.k,
+        algorithm="online",
+        checkpoint_dir=args.checkpoint_dir,
+        seed=args.seed,
+        data_shards=args.data_shards,
+        model_shards=args.model_shards,
+    )
+    vocab = None
+    num_features = args.hash_features
+    if args.vocab_from_model:
+        vocab = load_model(args.vocab_from_model).vocab
+        num_features = None
+
+    trainer = StreamingOnlineLDA(
+        params,
+        vocab=vocab,
+        num_features=num_features,
+        stop_words=_load_stop_words(args.stop_words),
+        lemmatize=not args.no_lemmatize,
+        batch_capacity=args.batch_capacity,
+        corpus_size_hint=args.corpus_size_hint,
+        checkpoint_every=args.checkpoint_interval,
+    )
+    src = FileStreamSource(
+        args.watch_dir,
+        include_all=args.include_all,
+        max_files_per_trigger=args.max_files_per_trigger,
+        min_file_age_s=args.min_file_age,
+        # resume must not re-ingest (and double-train on) consumed files
+        state_path=(
+            os.path.join(args.checkpoint_dir, "seen_files.txt")
+            if args.checkpoint_dir
+            else None
+        ),
+    )
+    trainer.run(
+        src, poll_interval=args.poll_interval, idle_timeout=args.idle_timeout
+    )
+    print(f"stream ended: {trainer.docs_seen} docs / "
+          f"{trainer.batches_seen} micro-batches")
+    model = trainer.model()
+    for i, topic in enumerate(model.describe_topics_terms(10)):
+        print(f"TOPIC {i}: " + ", ".join(t for t, _ in topic))
+    out_dir = model_dir_name(args.lang, base=args.models_dir)
+    model.save(out_dir)
+    print(f"model saved to {out_dir}")
+    return 0
+
+
+def _add_stream_args(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--watch-dir", required=True,
+                   help="directory to watch for arriving .txt files")
+    p.add_argument("--poll-interval", type=float, default=1.0)
+    p.add_argument("--idle-timeout", type=float, default=30.0,
+                   help="stop after this many idle seconds (streaming jobs "
+                        "run until the source dries up)")
+    p.add_argument("--max-files-per-trigger", type=int, default=None)
+    p.add_argument("--min-file-age", type=float, default=0.0,
+                   help="seconds a file's mtime must settle before pickup "
+                        "(use when producers don't rename atomically)")
+    p.add_argument("--batch-capacity", type=int, default=8,
+                   help="device batch rows per trigger (static shape)")
+    p.add_argument("--stop-words", default=None)
+    p.add_argument("--lang", default="EN", choices=sorted(LANG_DIRS))
+    p.add_argument("--no-lemmatize", action="store_true")
+    p.add_argument("--include-all", action="store_true")
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -213,6 +327,35 @@ def build_parser() -> argparse.ArgumentParser:
     sc.add_argument("--no-lemmatize", action="store_true")
     sc.add_argument("--include-all", action="store_true")
     sc.set_defaults(fn=cmd_score)
+
+    ss = sub.add_parser(
+        "stream-score",
+        help="watch a directory, score arriving books incrementally",
+    )
+    _add_stream_args(ss)
+    ss.add_argument("--models-dir", default="models")
+    ss.add_argument("--model", default=None, help="explicit model dir")
+    ss.add_argument("--output-dir", default="TestOutput")
+    ss.set_defaults(fn=cmd_stream_score)
+
+    st = sub.add_parser(
+        "stream-train",
+        help="continuous online-VB LDA over a watched directory",
+    )
+    _add_stream_args(st)
+    st.add_argument("--k", type=int, default=5)
+    st.add_argument("--hash-features", type=int, default=1 << 18,
+                    help="HashingTF buckets (streams have no vocab pass)")
+    st.add_argument("--vocab-from-model", default=None,
+                    help="reuse a saved model's vocabulary instead of hashing")
+    st.add_argument("--corpus-size-hint", type=int, default=None)
+    st.add_argument("--checkpoint-dir", default=None)
+    st.add_argument("--checkpoint-interval", type=int, default=10)
+    st.add_argument("--seed", type=int, default=0)
+    st.add_argument("--data-shards", type=int, default=None)
+    st.add_argument("--model-shards", type=int, default=1)
+    st.add_argument("--models-dir", default="models")
+    st.set_defaults(fn=cmd_stream_train)
     return ap
 
 

@@ -58,10 +58,10 @@ def make_online_train_step(
     eta: float,
     tau0: float,
     kappa: float,
-    corpus_size: int,
+    corpus_size: Optional[int] = None,
     max_inner: int = 100,
     tol: float = 1e-3,
-) -> Callable[[TrainState, DocTermBatch, jnp.ndarray], TrainState]:
+) -> Callable[..., TrainState]:
     """Build the jitted, shard_mapped train step.
 
     Returned fn: (state, batch, gamma0) -> new state.  ``batch`` must be
@@ -69,10 +69,15 @@ def make_online_train_step(
     V-sharded over "model".  Empty pad docs contribute zero sufficient
     statistics, and the effective batch size (nonempty docs, summed over
     shards) is computed on device so padding never biases the M-step scale.
+
+    ``corpus_size=None`` returns a step taking the corpus size as a FOURTH
+    dynamic argument ``(state, batch, gamma0, corpus_size)`` — used by the
+    streaming trainer, where the corpus grows as micro-batches arrive and a
+    static D would force a recompile per batch.
     """
     alpha_arr = jnp.asarray(alpha, jnp.float32)
 
-    def _step(lam_shard, step, ids, wts, gamma0):
+    def _step(lam_shard, step, ids, wts, gamma0, corpus_sz):
         batch = DocTermBatch(ids, wts)
         lam = all_gather_model(lam_shard, axis=-1)          # [k, V]
         vocab_size = lam.shape[-1]
@@ -88,7 +93,7 @@ def make_online_train_step(
 
         # M-step (Hoffman): lambda_hat = eta + (D/|B|) * sstats ∘ expElogbeta
         rho = (tau0 + step.astype(jnp.float32) + 1.0) ** (-kappa)
-        lam_hat = eta + (corpus_size / jnp.maximum(batch_docs, 1.0)) * (
+        lam_hat = eta + (corpus_sz / jnp.maximum(batch_docs, 1.0)) * (
             sstats * exp_elog_beta
         )
         lam_new = (1.0 - rho) * lam + rho * lam_hat
@@ -103,17 +108,38 @@ def make_online_train_step(
             P(DATA_AXIS, None),       # token_ids
             P(DATA_AXIS, None),       # token_weights
             P(DATA_AXIS, None),       # gamma0
+            P(),                      # corpus size (replicated scalar)
         ),
         out_specs=(P(None, MODEL_AXIS), P()),
         check_vma=False,
     )
+
+    if corpus_size is None:
+
+        @jax.jit
+        def train_step_dyn(
+            state: TrainState,
+            batch: DocTermBatch,
+            gamma0: jnp.ndarray,
+            corpus_sz: jnp.ndarray,
+        ) -> TrainState:
+            lam, step = sharded(
+                state.lam, state.step, batch.token_ids, batch.token_weights,
+                gamma0, jnp.asarray(corpus_sz, jnp.float32),
+            )
+            return TrainState(lam, step)
+
+        return train_step_dyn
+
+    cs = jnp.float32(corpus_size)
 
     @jax.jit
     def train_step(
         state: TrainState, batch: DocTermBatch, gamma0: jnp.ndarray
     ) -> TrainState:
         lam, step = sharded(
-            state.lam, state.step, batch.token_ids, batch.token_weights, gamma0
+            state.lam, state.step, batch.token_ids, batch.token_weights,
+            gamma0, cs,
         )
         return TrainState(lam, step)
 

@@ -132,7 +132,17 @@ def save_train_state(path: str, step: int, **arrays: np.ndarray) -> None:
     np.savez(
         tmp,
         step=np.int64(step),
-        **{k: np.asarray(v, np.float32) for k, v in arrays.items()},
+        # float arrays normalize to float32 (device dtype); integer state
+        # (counters like docs_seen) keeps its own dtype — float32 would
+        # silently lose precision past 2^24
+        **{
+            k: (
+                a
+                if np.issubdtype((a := np.asarray(v)).dtype, np.integer)
+                else a.astype(np.float32)
+            )
+            for k, v in arrays.items()
+        },
     )
     os.replace(tmp, path)
 

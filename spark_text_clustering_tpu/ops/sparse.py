@@ -23,7 +23,13 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["DocTermBatch", "batch_from_rows", "bucket_by_length", "next_pow2"]
+__all__ = [
+    "DocTermBatch",
+    "batch_from_rows",
+    "bucket_by_length",
+    "next_pow2",
+    "pad_rows",
+]
 
 
 @jax.tree_util.register_pytree_node_class
@@ -74,6 +80,20 @@ class DocTermBatch:
 
 def next_pow2(n: int) -> int:
     return 1 if n <= 1 else 1 << (int(n - 1).bit_length())
+
+
+_EMPTY_ROW = (np.zeros(0, np.int32), np.zeros(0, np.float32))
+
+
+def pad_rows(
+    rows: Sequence[Tuple[np.ndarray, np.ndarray]], capacity: int
+) -> List[Tuple[np.ndarray, np.ndarray]]:
+    """Pad a row list to ``capacity`` docs with empty rows (weight-0 docs are
+    numerically inert everywhere) — for pinning the batch dimension of a
+    streaming trigger or a sharded batch."""
+    if len(rows) > capacity:
+        raise ValueError(f"{len(rows)} rows > capacity {capacity}")
+    return list(rows) + [_EMPTY_ROW] * (capacity - len(rows))
 
 
 def batch_from_rows(

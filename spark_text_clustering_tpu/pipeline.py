@@ -33,6 +33,8 @@ from .utils.textproc import preprocess_document
 from .utils.vocab import build_vocab, count_terms, count_vectors
 
 __all__ = [
+    "is_hashed_vocab",
+    "make_vectorizer",
     "Transformer",
     "Estimator",
     "TextPreprocessor",
@@ -45,6 +47,34 @@ __all__ = [
     "Pipeline",
     "PipelineModel",
 ]
+
+
+def is_hashed_vocab(vocab: Sequence[str]) -> bool:
+    """True when a model's vocabulary is the synthetic ``h0..hN`` produced by
+    the HashingTF path (LDA.fit with no exact vocab).  Scoring such a model
+    must hash tokens, not look them up — a real frequency-ranked vocabulary
+    cannot match this pattern at every probed rank."""
+    n = len(vocab)
+    if n == 0:
+        return False
+    return all(vocab[i] == f"h{i}" for i in (0, n // 2, n - 1))
+
+
+def make_vectorizer(vocab: Sequence[str]):
+    """tokens -> sparse rows, dispatching on the vocabulary kind: exact
+    vocabularies get count-vector lookup (BuildCountVector semantics,
+    LDALoader.scala:83-106), hashed ``h0..hN`` vocabularies get murmur3
+    bucketing.  The single scoring-time vectorization policy for every call
+    site (batch CLI, streaming scorer, streaming trainer)."""
+    if is_hashed_vocab(vocab):
+        from .ops.tfidf import hashing_tf_ids
+
+        n = len(vocab)
+        return lambda tokens_lists: [
+            hashing_tf_ids(toks, n) for toks in tokens_lists
+        ]
+    cvm = CountVectorizerModel(list(vocab))
+    return lambda tokens_lists: cvm.transform({"tokens": tokens_lists})["rows"]
 
 
 class Transformer:

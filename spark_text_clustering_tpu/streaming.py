@@ -1,0 +1,556 @@
+"""Micro-batch streaming: sources, streaming scorer, streaming trainer.
+
+The reference is batch-only (both drivers are one-shot ``extends App`` mains,
+LDATraining.scala:5, LDALoader.scala:11); the north star (BASELINE.md
+"streaming" row) asks for a Structured-Streaming-style micro-batch LDA over
+a text stream.  TPU-native, a "stream" is a host-side source yielding
+micro-batches of documents with STATIC device shapes — each trigger packs
+its docs into a fixed ``[batch_capacity, row_len]`` ``DocTermBatch`` so
+every trigger hits the same compiled executable (no per-batch recompiles,
+the streaming analogue of Spark's reused physical plan).
+
+Three pieces:
+
+  * Sources — ``FileStreamSource`` (watch a directory for new files, the
+    analogue of Spark's file source: each ``poll()`` returns only files not
+    yet seen, up to ``max_files_per_trigger``) and ``MemoryStreamSource``
+    (enqueue docs programmatically, the ``MemoryStream`` testing analogue).
+  * ``StreamingScorer`` — scores each micro-batch against a trained model
+    (the LDALoader flow, LDALoader.scala:80-169, run incrementally),
+    accumulating per-topic tallies and report rows across triggers.
+  * ``StreamingOnlineLDA`` — continuous online-VB training: online LDA is
+    *natively* a streaming algorithm (Hoffman et al.), so each micro-batch
+    is one M-step with the running document count as the corpus-size
+    estimate (dynamic operand — no recompile as D grows).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from dataclasses import dataclass
+from typing import Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .config import Params
+from .ops.sparse import batch_from_rows, next_pow2, pad_rows
+from .pipeline import TextPreprocessor, is_hashed_vocab, make_vectorizer
+from .utils.report import format_scoring_report, write_scoring_report
+
+__all__ = [
+    "MicroBatch",
+    "FileStreamSource",
+    "MemoryStreamSource",
+    "ScoredDoc",
+    "StreamingScorer",
+    "StreamingOnlineLDA",
+]
+
+
+@dataclass
+class MicroBatch:
+    """One trigger's worth of raw documents."""
+
+    batch_id: int
+    names: List[str]       # display names / paths
+    texts: List[str]
+
+    def __len__(self) -> int:
+        return len(self.texts)
+
+
+# ---------------------------------------------------------------------------
+# Sources
+# ---------------------------------------------------------------------------
+class FileStreamSource:
+    """Directory-watching source: each ``poll()`` returns a micro-batch of
+    files that appeared since the last trigger (ordered by mtime then name,
+    capped at ``max_files_per_trigger``), or None when nothing new arrived.
+
+    The file-ingestion analogue of ``sc.wholeTextFiles``
+    (LDAClustering.scala:113) run incrementally.  Files are keyed by path;
+    a rewritten file (same path) is NOT re-emitted, matching Spark's file
+    source semantics.  Like Spark's source, producers are expected to drop
+    files ATOMICALLY (write elsewhere + rename into the watch dir) — a file
+    caught mid-write is read truncated and never re-read.  When atomic
+    renames can't be guaranteed, set ``min_file_age_s`` so a file is only
+    picked up once its mtime has settled for that long.
+    """
+
+    def __init__(
+        self,
+        directory: str,
+        *,
+        suffix: str = ".txt",
+        include_all: bool = False,
+        max_files_per_trigger: Optional[int] = None,
+        encoding: str = "utf-8",
+        min_file_age_s: float = 0.0,
+        state_path: Optional[str] = None,
+    ) -> None:
+        self.directory = directory
+        self.suffix = suffix
+        self.include_all = include_all
+        self.max_files = max_files_per_trigger
+        self.encoding = encoding
+        self.min_file_age_s = min_file_age_s
+        # Source progress (Spark's file-source "commit log"): with a
+        # state_path, consumed paths persist across restarts so a resumed
+        # stream-train never re-ingests (and double-trains) old files.
+        self.state_path = state_path
+        self._seen: set = set()
+        self._next_id = 0
+        if state_path and os.path.exists(state_path):
+            with open(state_path, "r", encoding="utf-8") as f:
+                self._seen = {line.rstrip("\n") for line in f if line.strip()}
+
+    def _commit(self, paths: List[str]) -> None:
+        if not self.state_path:
+            return
+        os.makedirs(os.path.dirname(self.state_path) or ".", exist_ok=True)
+        with open(self.state_path, "a", encoding="utf-8") as f:
+            for p in paths:
+                f.write(p + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+
+    def _list_new(self) -> List[str]:
+        try:
+            entries = os.listdir(self.directory)
+        except FileNotFoundError:
+            return []
+        out = []
+        for name in sorted(entries):
+            if not self.include_all and not name.endswith(self.suffix):
+                continue
+            p = os.path.join(self.directory, name)
+            if os.path.isfile(p) and p not in self._seen:
+                out.append(p)
+
+        def mtime_or_inf(p: str) -> float:
+            # a writer may unlink/rename between listdir and here; a vanished
+            # file must not kill a long-running stream
+            try:
+                return os.path.getmtime(p)
+            except OSError:
+                return float("inf")
+
+        if self.min_file_age_s > 0:
+            settled = time.time() - self.min_file_age_s
+            out = [p for p in out if mtime_or_inf(p) <= settled]
+        out.sort(key=lambda p: (mtime_or_inf(p), p))
+        return out
+
+    def poll(self) -> Optional[MicroBatch]:
+        new = self._list_new()
+        if not new:
+            return None
+        if self.max_files is not None:
+            new = new[: self.max_files]
+        names, texts = [], []
+        for p in new:
+            # unreadable/vanished files are skipped WITHOUT marking seen, so
+            # a transient failure retries next trigger instead of silently
+            # dropping the file from the stream forever
+            try:
+                with open(
+                    p, "r", encoding=self.encoding, errors="replace"
+                ) as f:
+                    texts.append(f.read())
+            except OSError:
+                continue
+            names.append(p)
+        if not names:
+            return None
+        for p in names:
+            self._seen.add(p)
+        self._commit(names)
+        mb = MicroBatch(self._next_id, names, texts)
+        self._next_id += 1
+        return mb
+
+    def stream(
+        self,
+        poll_interval: float = 1.0,
+        idle_timeout: Optional[float] = 30.0,
+    ) -> Iterator[MicroBatch]:
+        """Generator of micro-batches; stops after ``idle_timeout`` seconds
+        without new data (None = run forever)."""
+        last_data = time.monotonic()
+        while True:
+            mb = self.poll()
+            if mb is not None:
+                last_data = time.monotonic()
+                yield mb
+                continue
+            if (
+                idle_timeout is not None
+                and time.monotonic() - last_data >= idle_timeout
+            ):
+                return
+            time.sleep(poll_interval)
+
+
+class MemoryStreamSource:
+    """In-memory source for tests and programmatic feeds (the
+    ``MemoryStream`` analogue): ``add()`` enqueues docs, ``poll()`` drains
+    one micro-batch."""
+
+    def __init__(self, max_docs_per_trigger: Optional[int] = None) -> None:
+        self.max_docs = max_docs_per_trigger
+        self._queue: List[Tuple[str, str]] = []
+        self._next_id = 0
+        self._docs_added = 0    # monotonic: auto-names never collide
+
+    def add(self, texts: Sequence[str], names: Optional[Sequence[str]] = None):
+        if names is None:
+            names = [
+                f"doc-{self._docs_added + i}" for i in range(len(texts))
+            ]
+        self._docs_added += len(texts)
+        self._queue.extend(zip(names, texts))
+
+    def poll(self) -> Optional[MicroBatch]:
+        if not self._queue:
+            return None
+        n = len(self._queue) if self.max_docs is None else self.max_docs
+        take, self._queue = self._queue[:n], self._queue[n:]
+        mb = MicroBatch(
+            self._next_id, [n_ for n_, _ in take], [t for _, t in take]
+        )
+        self._next_id += 1
+        return mb
+
+
+def _vectorize_texts(pre: TextPreprocessor, rows_for, texts: Sequence[str]):
+    """The one preprocessing->rows path shared by scorer and trainer."""
+    return rows_for(pre.transform({"texts": list(texts)})["tokens"])
+
+
+def _vocab_fingerprint(vocab: Sequence[str]) -> int:
+    """Stable 32-bit fingerprint of a vocabulary, persisted with streaming
+    checkpoints: a resumed run whose vocab merely has the same SIZE would
+    otherwise silently map term columns to different terms."""
+    import zlib
+
+    h = 0
+    for t in vocab:
+        h = zlib.crc32(t.encode("utf-8"), h)
+    return h
+
+
+# ---------------------------------------------------------------------------
+# Streaming scorer
+# ---------------------------------------------------------------------------
+@dataclass
+class ScoredDoc:
+    name: str
+    topic: int
+    distribution: np.ndarray            # [k]
+    row: Tuple[np.ndarray, np.ndarray]  # (ids, weights) over the model vocab
+
+
+class StreamingScorer:
+    """Score micro-batches against a trained model, accumulating results.
+
+    Per trigger: preprocess on host, vectorize into the model's global
+    vocabulary (BuildCountVector semantics — raw counts, no IDF,
+    LDALoader.scala:83-106), run batched ``topicDistribution`` on device,
+    tally argmax topics (LDALoader.scala:131-149).  Device shapes are pinned
+    to ``[batch_capacity, row_len]`` so every trigger reuses one compiled
+    executable; oversized triggers are chunked.
+    """
+
+    def __init__(
+        self,
+        model,
+        *,
+        stop_words: frozenset = frozenset(),
+        lemmatize: bool = True,
+        batch_capacity: int = 8,
+        row_len: Optional[int] = None,
+    ) -> None:
+        self.model = model
+        self.pre = TextPreprocessor(stop_words=stop_words, lemmatize=lemmatize)
+        # make_vectorizer auto-dispatches: hash-trained models (synthetic
+        # h0..hN vocab) get murmur3 bucketing; exact vocabs get lookup.
+        self.hashed = is_hashed_vocab(model.vocab)
+        self._rows_for = make_vectorizer(model.vocab)
+        self.batch_capacity = batch_capacity
+        self.row_len = row_len          # lazily pinned on first trigger
+        self.tallies = np.zeros(model.k, np.int64)
+        self.results: List[ScoredDoc] = []
+        self.batches_seen = 0
+
+    def _vectorize(self, mb: MicroBatch):
+        return _vectorize_texts(self.pre, self._rows_for, mb.texts)
+
+    def process(self, mb: MicroBatch) -> List[ScoredDoc]:
+        rows = self._vectorize(mb)
+        if self.row_len is None:
+            max_nnz = max((len(i) for i, _ in rows), default=1)
+            self.row_len = max(8, next_pow2(max_nnz))
+        out: List[ScoredDoc] = []
+        for at in range(0, len(rows), self.batch_capacity):
+            chunk = rows[at : at + self.batch_capacity]
+            names = mb.names[at : at + self.batch_capacity]
+            # grow row_len only when a longer doc arrives (rare recompile)
+            max_nnz = max((len(i) for i, _ in chunk), default=1)
+            if max_nnz > self.row_len:
+                self.row_len = next_pow2(max_nnz)
+            batch = batch_from_rows(
+                pad_rows(chunk, self.batch_capacity), row_len=self.row_len
+            )
+            dist = self.model.topic_distribution(batch)[: len(chunk)]
+            for name, d, row in zip(names, dist, chunk):
+                sd = ScoredDoc(name, int(np.argmax(d)), np.asarray(d), row)
+                self.tallies[sd.topic] += 1
+                out.append(sd)
+        self.results.extend(out)
+        self.batches_seen += 1
+        return out
+
+    # -- terminal outputs ------------------------------------------------
+    def report(self) -> str:
+        """Full accumulated report in the golden Result_<lang>_* format."""
+        return format_scoring_report(
+            self.model,
+            [r.name for r in self.results],
+            np.stack([r.distribution for r in self.results])
+            if self.results
+            else np.zeros((0, self.model.k)),
+            [r.row for r in self.results],
+        )
+
+    def write_report(self, output_dir: str, lang: str) -> str:
+        return write_scoring_report(self.report(), output_dir, lang)
+
+
+# ---------------------------------------------------------------------------
+# Streaming trainer
+# ---------------------------------------------------------------------------
+class StreamingOnlineLDA:
+    """Continuous online-VB LDA over a micro-batch stream.
+
+    Online LDA's M-step ``lambda <- (1-rho_t) lambda + rho_t lambda_hat``
+    was designed for exactly this (SURVEY.md §3.3); here each arriving
+    micro-batch is one step.  The corpus size D in ``lambda_hat = eta +
+    (D/|B|) * sstats`` is the RUNNING count of documents seen (or
+    ``corpus_size_hint`` when the true stream size is known), passed as a
+    dynamic scalar so growth never recompiles.
+
+    The vocabulary must be fixed up front (a stream has no second pass):
+    either an explicit ``vocab`` (e.g. from a batch-trained model) or
+    hashing via ``num_features`` (HashingTF sidesteps the vocab build —
+    the north-star streaming+hashing combination).
+    """
+
+    def __init__(
+        self,
+        params: Params,
+        *,
+        vocab: Optional[List[str]] = None,
+        num_features: Optional[int] = None,
+        mesh=None,
+        stop_words: frozenset = frozenset(),
+        lemmatize: bool = True,
+        batch_capacity: int = 8,
+        row_len: int = 1024,
+        corpus_size_hint: Optional[int] = None,
+        checkpoint_every: Optional[int] = None,
+    ) -> None:
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from .models.online_lda import TrainState, make_online_train_step
+        from .ops.lda_math import init_lambda
+        from .parallel.mesh import DATA_AXIS, make_mesh, model_sharding
+
+        if (vocab is None) == (num_features is None):
+            raise ValueError("exactly one of vocab / num_features required")
+        if params.algorithm != "online":
+            params = params.replace(algorithm="online")
+        self.params = params
+        self.mesh = mesh if mesh is not None else make_mesh(
+            data_shards=params.data_shards, model_shards=params.model_shards
+        )
+        self._data_axis = DATA_AXIS
+        self._nsh = NamedSharding
+        self._pspec = P
+
+        self.pre = TextPreprocessor(stop_words=stop_words, lemmatize=lemmatize)
+        if vocab is not None:
+            self.vocab = list(vocab)
+            self.num_features = None
+        else:
+            self.num_features = num_features
+            self.vocab = [f"h{i}" for i in range(num_features)]
+        self._rows_for = make_vectorizer(self.vocab)
+
+        v = len(self.vocab)
+        ms = params.model_shards
+        self._v_pad = ((v + ms - 1) // ms) * ms
+        n_data = self.mesh.shape[DATA_AXIS]
+        self.batch_capacity = ((batch_capacity + n_data - 1) // n_data) * n_data
+        self.row_len = row_len
+        self.corpus_size_hint = corpus_size_hint
+        self.checkpoint_every = checkpoint_every
+        self.docs_seen = 0
+        self.batches_seen = 0
+
+        k = params.k
+        self._alpha = np.full((k,), params.resolved_alpha(), np.float32)
+        self._key = jax.random.PRNGKey(params.seed)
+        self._step_fn = make_online_train_step(
+            self.mesh,
+            alpha=self._alpha,
+            eta=params.resolved_eta(),
+            tau0=params.tau0,
+            kappa=params.kappa,
+            corpus_size=None,           # dynamic: running docs_seen
+        )
+
+        self._ckpt_path = (
+            os.path.join(params.checkpoint_dir, "stream_state.npz")
+            if params.checkpoint_dir
+            else None
+        )
+        if self._ckpt_path and os.path.exists(self._ckpt_path):
+            self._restore()             # resume: no throwaway fresh init
+        else:
+            lam0 = init_lambda(
+                jax.random.fold_in(self._key, 0xFFFF), k, self._v_pad,
+                params.gamma_shape,
+            )
+            lam0 = jax.device_put(lam0, model_sharding(self.mesh))
+            self.state = TrainState(lam0, jnp.int32(0))
+
+    # -- vectorization ---------------------------------------------------
+    def _vectorize(self, mb: MicroBatch):
+        return _vectorize_texts(self.pre, self._rows_for, mb.texts)
+
+    # -- the per-trigger update -----------------------------------------
+    def process(self, mb: MicroBatch) -> None:
+        rows = [(i, w) for i, w in self._vectorize(mb) if len(i) > 0]
+        if not rows:
+            return
+        self.docs_seen += len(rows)
+        for at in range(0, len(rows), self.batch_capacity):
+            self._update(rows[at : at + self.batch_capacity])
+        self.batches_seen += 1
+        if (
+            self._ckpt_path
+            and self.checkpoint_every
+            and self.batches_seen % self.checkpoint_every == 0
+        ):
+            self.checkpoint()
+
+    def _update(self, chunk) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        from .ops.lda_math import init_gamma
+        from .parallel.collectives import data_shard_batch
+
+        max_nnz = max(len(i) for i, _ in chunk)
+        if max_nnz > self.row_len:      # rare: grow + recompile
+            self.row_len = next_pow2(max_nnz)
+        batch = batch_from_rows(
+            pad_rows(chunk, self.batch_capacity), row_len=self.row_len
+        )
+        batch = data_shard_batch(self.mesh, batch)
+        step_i = int(self.state.step)
+        gamma0 = init_gamma(
+            jax.random.fold_in(self._key, step_i),
+            batch.num_docs,
+            self.params.k,
+            self.params.gamma_shape,
+        )
+        gamma0 = jax.device_put(
+            gamma0,
+            self._nsh(self.mesh, self._pspec(self._data_axis, None)),
+        )
+        d = float(max(self.docs_seen, self.corpus_size_hint or 0))
+        self.state = self._step_fn(
+            self.state, batch, gamma0, jnp.float32(d)
+        )
+
+    # -- lifecycle -------------------------------------------------------
+    def run(self, source, **stream_kw) -> "StreamingOnlineLDA":
+        """Drain a source (``poll``-able or iterable of MicroBatch)."""
+        if hasattr(source, "stream"):
+            it = source.stream(**stream_kw)
+        elif hasattr(source, "poll"):
+            def _drain():
+                while True:
+                    mb = source.poll()
+                    if mb is None:
+                        return
+                    yield mb
+            it = _drain()
+        else:
+            it = iter(source)
+        for mb in it:
+            self.process(mb)
+        return self
+
+    def checkpoint(self) -> None:
+        import jax
+
+        from .models.persistence import save_train_state
+
+        save_train_state(
+            self._ckpt_path,
+            int(self.state.step),
+            lam=np.asarray(jax.device_get(self.state.lam)),
+            docs_seen=np.int64(self.docs_seen),
+            batches_seen=np.int64(self.batches_seen),
+            vocab_fp=np.int64(_vocab_fingerprint(self.vocab)),
+        )
+
+    def _restore(self) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        from .models.online_lda import TrainState
+        from .models.persistence import load_train_state
+        from .parallel.mesh import model_sharding
+
+        st = load_train_state(self._ckpt_path)
+        lam = st["lam"]
+        if lam.shape != (self.params.k, self._v_pad):
+            raise ValueError(
+                f"checkpoint lam {lam.shape} != {(self.params.k, self._v_pad)}"
+            )
+        fp = int(st.get("vocab_fp", -1))
+        if fp not in (-1, _vocab_fingerprint(self.vocab)):
+            raise ValueError(
+                f"checkpoint {self._ckpt_path} was trained with a DIFFERENT "
+                f"vocabulary of the same size — term columns would misalign; "
+                f"use the original vocab/num_features or a fresh checkpoint dir"
+            )
+        self.state = TrainState(
+            jax.device_put(jnp.asarray(lam), model_sharding(self.mesh)),
+            jnp.int32(st["step"]),
+        )
+        self.docs_seen = int(st.get("docs_seen", 0))
+        self.batches_seen = int(st.get("batches_seen", 0))
+
+    def model(self):
+        """Snapshot the current topics as an ``LDAModel``."""
+        import jax
+
+        from .models.base import LDAModel
+
+        lam = np.asarray(jax.device_get(self.state.lam))[:, : len(self.vocab)]
+        return LDAModel(
+            lam=lam,
+            vocab=list(self.vocab),
+            alpha=self._alpha,
+            eta=float(self.params.resolved_eta()),
+            gamma_shape=self.params.gamma_shape,
+            algorithm="online",
+            step=int(self.state.step),
+        )

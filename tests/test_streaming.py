@@ -1,0 +1,504 @@
+"""Streaming micro-batch layer: sources, scorer, continuous trainer.
+
+North-star capability (BASELINE.md "streaming"): Structured-Streaming-style
+micro-batch LDA over a text stream.  The reference is batch-only
+(LDATraining.scala:5, LDALoader.scala:11), so these tests pin OUR semantics:
+file-source incremental discovery, streaming==batch scoring equivalence, and
+streaming online-VB training (one M-step per trigger, dynamic corpus size).
+"""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+from spark_text_clustering_tpu.config import Params
+from spark_text_clustering_tpu.models.base import LDAModel
+from spark_text_clustering_tpu.streaming import (
+    FileStreamSource,
+    MemoryStreamSource,
+    MicroBatch,
+    StreamingOnlineLDA,
+    StreamingScorer,
+)
+
+# Two clearly-separated topics so training outcomes are checkable.
+TOPIC_A_DOCS = [
+    "piano violin orchestra symphony concerto melody rhythm harmony",
+    "violin cello orchestra conductor symphony opera melody chord",
+    "piano sonata concerto orchestra harmony melody tempo forte",
+    "opera soprano orchestra violin symphony chorus melody aria",
+]
+TOPIC_B_DOCS = [
+    "electron proton neutron quantum particle physics energy atom",
+    "quantum photon particle electron wavelength physics momentum atom",
+    "neutron fission atom particle reactor physics energy proton",
+    "particle collider quantum proton electron physics boson atom",
+]
+
+
+def _mb(texts, bid=0, names=None):
+    names = names or [f"d{bid}-{i}" for i in range(len(texts))]
+    return MicroBatch(bid, names, texts)
+
+
+def _toy_model(k=2, seed=0):
+    """A tiny LDA model over the union vocabulary of the toy docs."""
+    from spark_text_clustering_tpu.pipeline import (
+        CountVectorizer,
+        TextPreprocessor,
+    )
+
+    pre = TextPreprocessor(stop_words=frozenset(), lemmatize=False)
+    ds = pre.transform({"texts": TOPIC_A_DOCS + TOPIC_B_DOCS})
+    cvm = CountVectorizer(vocab_size=1000).fit(ds)
+    vocab = cvm.vocab
+    rng = np.random.default_rng(seed)
+    lam = rng.gamma(100.0, 1.0 / 100.0, size=(k, len(vocab))).astype(
+        np.float32
+    )
+    return LDAModel(
+        lam=lam,
+        vocab=vocab,
+        alpha=np.full((k,), 1.0 / k, np.float32),
+        eta=1.0 / k,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Sources
+# ---------------------------------------------------------------------------
+class TestFileStreamSource:
+    def test_incremental_discovery(self, tmp_path):
+        d = tmp_path / "in"
+        d.mkdir()
+        (d / "a.txt").write_text("alpha doc")
+        (d / "b.txt").write_text("beta doc")
+        src = FileStreamSource(str(d))
+
+        mb = src.poll()
+        assert mb is not None and len(mb) == 2
+        assert sorted(os.path.basename(n) for n in mb.names) == [
+            "a.txt",
+            "b.txt",
+        ]
+        assert src.poll() is None  # nothing new
+
+        (d / "c.txt").write_text("gamma doc")
+        mb2 = src.poll()
+        assert [os.path.basename(n) for n in mb2.names] == ["c.txt"]
+        assert mb2.batch_id == mb.batch_id + 1
+        assert src.poll() is None
+
+    def test_max_files_per_trigger(self, tmp_path):
+        d = tmp_path / "in"
+        d.mkdir()
+        for i in range(5):
+            (d / f"f{i}.txt").write_text(f"doc {i}")
+        src = FileStreamSource(str(d), max_files_per_trigger=2)
+        sizes = []
+        while (mb := src.poll()) is not None:
+            sizes.append(len(mb))
+        assert sizes == [2, 2, 1]
+
+    def test_suffix_filter_and_include_all(self, tmp_path):
+        d = tmp_path / "in"
+        d.mkdir()
+        (d / "book.txt").write_text("text")
+        (d / "desktop.ini").write_text("junk")  # the reference's stray file
+        assert len(FileStreamSource(str(d)).poll()) == 1
+        assert len(FileStreamSource(str(d), include_all=True).poll()) == 2
+
+    def test_min_file_age_defers_fresh_files(self, tmp_path):
+        """Files younger than min_file_age_s are deferred (guards against
+        ingesting partially-written files when renames aren't atomic)."""
+        d = tmp_path / "in"
+        d.mkdir()
+        (d / "fresh.txt").write_text("still being written?")
+        src = FileStreamSource(str(d), min_file_age_s=60.0)
+        assert src.poll() is None
+        old = d / "settled.txt"
+        old.write_text("done")
+        past = time.time() - 120
+        os.utime(old, (past, past))
+        mb = src.poll()
+        assert [os.path.basename(n) for n in mb.names] == ["settled.txt"]
+
+    def test_stream_idle_timeout(self, tmp_path):
+        d = tmp_path / "in"
+        d.mkdir()
+        (d / "a.txt").write_text("doc")
+        src = FileStreamSource(str(d))
+        t0 = time.monotonic()
+        got = list(src.stream(poll_interval=0.01, idle_timeout=0.05))
+        assert len(got) == 1
+        assert time.monotonic() - t0 < 5.0
+
+
+class TestMemoryStreamSource:
+    def test_auto_names_never_collide(self):
+        src = MemoryStreamSource()
+        src.add(["a", "b", "c"])
+        first = src.poll().names
+        src.add(["d", "e"])
+        second = src.poll().names
+        assert first == ["doc-0", "doc-1", "doc-2"]
+        assert second == ["doc-3", "doc-4"]
+        assert len(set(first + second)) == 5
+
+    def test_drain_and_trigger_cap(self):
+        src = MemoryStreamSource(max_docs_per_trigger=3)
+        src.add(["t1", "t2", "t3", "t4"], names=list("abcd"))
+        mb1, mb2 = src.poll(), src.poll()
+        assert (len(mb1), len(mb2)) == (3, 1)
+        assert mb1.names == ["a", "b", "c"] and mb2.names == ["d"]
+        assert src.poll() is None
+
+
+# ---------------------------------------------------------------------------
+# Streaming scorer
+# ---------------------------------------------------------------------------
+class TestStreamingScorer:
+    def test_matches_batch_scoring(self):
+        """Streaming in 3 triggers == scoring everything at once."""
+        model = _toy_model()
+        texts = TOPIC_A_DOCS + TOPIC_B_DOCS
+
+        from spark_text_clustering_tpu.pipeline import (
+            CountVectorizerModel,
+            TextPreprocessor,
+        )
+
+        pre = TextPreprocessor(stop_words=frozenset(), lemmatize=True)
+        cv = CountVectorizerModel(model.vocab)
+        rows = cv.transform(pre.transform({"texts": texts}))["rows"]
+        batch_dist = model.topic_distribution(rows)
+
+        scorer = StreamingScorer(model, batch_capacity=4)
+        for bid, at in enumerate(range(0, len(texts), 3)):
+            scorer.process(_mb(texts[at : at + 3], bid))
+
+        got = np.stack([r.distribution for r in scorer.results])
+        # inner-loop convergence (tol=1e-3) stops on the WORST doc in a
+        # batch, so chunking changes iteration counts — agreement is at the
+        # tolerance scale, same as the reference's run-to-run ~1e-6..1e-3
+        np.testing.assert_allclose(got, batch_dist, atol=5e-3)
+        np.testing.assert_array_equal(
+            got.argmax(1), batch_dist.argmax(1)
+        )
+        assert scorer.tallies.sum() == len(texts)
+        np.testing.assert_array_equal(
+            scorer.tallies,
+            np.bincount(batch_dist.argmax(1), minlength=model.k),
+        )
+
+    def test_report_accumulates_and_writes(self, tmp_path):
+        model = _toy_model()
+        scorer = StreamingScorer(model, batch_capacity=4)
+        scorer.process(_mb(TOPIC_A_DOCS, 0))
+        scorer.process(_mb(TOPIC_B_DOCS, 1))
+        text = scorer.report()
+        assert f"LDA Model: {model.k} Topics" in text
+        assert text.count("Book's number:") == 8
+        path = scorer.write_report(str(tmp_path), "EN")
+        assert os.path.basename(path).startswith("Result_EN_")
+        assert open(path).read() == text
+
+    def test_hashed_model_scoring_hashes_tokens(self):
+        """A hash-trained model (synthetic h0..hN vocab) must be scored by
+        hashing, not vocab lookup — lookup yields all-empty rows and
+        prior-only (uniform) distributions for every doc."""
+        from spark_text_clustering_tpu.pipeline import is_hashed_vocab
+
+        nf = 256
+        rng = np.random.default_rng(3)
+        model = LDAModel(
+            lam=rng.gamma(1.0, 1.0, size=(2, nf)).astype(np.float32) + 0.01,
+            vocab=[f"h{i}" for i in range(nf)],
+            alpha=np.full((2,), 0.5, np.float32),
+            eta=0.5,
+        )
+        assert is_hashed_vocab(model.vocab)
+        assert not is_hashed_vocab(_toy_model().vocab)
+
+        scorer = StreamingScorer(model, lemmatize=False, batch_capacity=8)
+        assert scorer.hashed
+        out = scorer.process(_mb(TOPIC_A_DOCS + TOPIC_B_DOCS))
+        # rows must be non-empty (tokens hashed into buckets)...
+        assert all(len(sd.row[0]) > 0 for sd in out)
+        # ...and at least some distribution must differ from uniform prior
+        dists = np.stack([sd.distribution for sd in out])
+        assert np.abs(dists - 0.5).max() > 0.01
+
+    def test_row_len_growth_keeps_results(self):
+        """A later, much longer doc must not break or skew scoring."""
+        model = _toy_model()
+        scorer = StreamingScorer(model, batch_capacity=2)
+        scorer.process(_mb(TOPIC_A_DOCS[:2], 0))
+        long_doc = " ".join(TOPIC_A_DOCS + TOPIC_B_DOCS) * 3
+        out = scorer.process(_mb([long_doc], 1))
+        assert len(out) == 1
+        assert np.all(np.isfinite(out[0].distribution))
+        assert len(scorer.results) == 3
+
+
+# ---------------------------------------------------------------------------
+# Streaming trainer
+# ---------------------------------------------------------------------------
+class TestStreamingOnlineLDA:
+    def _params(self, **kw):
+        base = dict(k=2, algorithm="online", seed=0)
+        base.update(kw)
+        return Params(**base)
+
+    @staticmethod
+    def _mesh(data_shards=8, model_shards=1):
+        import jax
+
+        from spark_text_clustering_tpu.parallel.mesh import make_mesh
+
+        cpu = jax.devices("cpu")
+        return make_mesh(
+            data_shards=data_shards,
+            model_shards=model_shards,
+            devices=cpu[: data_shards * model_shards],
+        )
+
+    def test_requires_exactly_one_vocab_source(self):
+        with pytest.raises(ValueError):
+            StreamingOnlineLDA(self._params())
+        with pytest.raises(ValueError):
+            StreamingOnlineLDA(
+                self._params(), vocab=["a"], num_features=16
+            )
+
+    def test_trains_and_separates_topics(self):
+        model0 = _toy_model()  # borrow its vocab
+        trainer = StreamingOnlineLDA(
+            self._params(),
+            vocab=model0.vocab,
+            lemmatize=False,
+            batch_capacity=8,
+            row_len=32,
+            mesh=self._mesh(),
+        )
+        src = MemoryStreamSource(max_docs_per_trigger=4)
+        rng = np.random.default_rng(0)
+        for _ in range(30):
+            pick = rng.integers(0, 4, size=4)
+            src.add([TOPIC_A_DOCS[i] for i in pick])
+            src.add([TOPIC_B_DOCS[i] for i in pick])
+        trainer.run(src)
+        assert trainer.docs_seen == 30 * 8
+        assert trainer.batches_seen > 0
+
+        model = trainer.model()
+        assert (model.k, model.vocab_size) == (2, len(model0.vocab))
+        # the two topic rows should separate music terms from physics terms
+        dist = model.topic_distribution(
+            StreamingScorer(model, lemmatize=False)._vectorize(
+                _mb(TOPIC_A_DOCS + TOPIC_B_DOCS)
+            )
+        )
+        a_topics = set(dist[:4].argmax(1).tolist())
+        b_topics = set(dist[4:].argmax(1).tolist())
+        assert len(a_topics) == 1 and len(b_topics) == 1
+        assert a_topics != b_topics
+
+    def test_hashing_mode_no_vocab(self):
+        trainer = StreamingOnlineLDA(
+            self._params(),
+            num_features=256,
+            lemmatize=False,
+            batch_capacity=8,
+            row_len=32,
+            mesh=self._mesh(),
+        )
+        src = MemoryStreamSource()
+        src.add(TOPIC_A_DOCS + TOPIC_B_DOCS)
+        trainer.run(src)
+        lam = np.asarray(trainer.model().lam)
+        assert lam.shape == (2, 256)
+        assert np.isfinite(lam).all() and (lam > 0).all()
+
+    def test_dynamic_corpus_size_no_recompile(self):
+        """docs_seen growth must not trigger per-batch recompiles."""
+        import jax
+
+        trainer = StreamingOnlineLDA(
+            self._params(),
+            num_features=64,
+            lemmatize=False,
+            batch_capacity=8,
+            row_len=32,
+            mesh=self._mesh(),
+        )
+        trainer.process(_mb(TOPIC_A_DOCS + TOPIC_B_DOCS, 0))
+        with jax.log_compiles():
+            import logging
+
+            records = []
+            handler = logging.Handler()
+            handler.emit = lambda r: records.append(r)
+            logger = logging.getLogger("jax._src.dispatch")
+            logger.addHandler(handler)
+            try:
+                for b in range(1, 4):
+                    trainer.process(_mb(TOPIC_A_DOCS + TOPIC_B_DOCS, b))
+            finally:
+                logger.removeHandler(handler)
+        compile_msgs = [
+            r for r in records if "Compiling" in r.getMessage()
+        ]
+        assert not compile_msgs, [r.getMessage() for r in compile_msgs]
+
+    def test_checkpoint_resume(self, tmp_path):
+        ck = str(tmp_path / "ck")
+        os.makedirs(ck)
+        params = self._params(checkpoint_dir=ck)
+
+        t1 = StreamingOnlineLDA(
+            params, num_features=64, lemmatize=False,
+            batch_capacity=8, row_len=32, checkpoint_every=1,
+            mesh=self._mesh(),
+        )
+        t1.process(_mb(TOPIC_A_DOCS + TOPIC_B_DOCS, 0))
+        t1.process(_mb(TOPIC_B_DOCS + TOPIC_A_DOCS, 1))
+        lam1 = np.asarray(t1.model().lam)
+        step1, seen1 = int(t1.state.step), t1.docs_seen
+
+        # a fresh trainer with the same checkpoint dir resumes mid-stream
+        t2 = StreamingOnlineLDA(
+            params, num_features=64, lemmatize=False,
+            batch_capacity=8, row_len=32, checkpoint_every=1,
+            mesh=self._mesh(),
+        )
+        assert int(t2.state.step) == step1
+        assert t2.docs_seen == seen1
+        assert t2.batches_seen == t1.batches_seen  # checkpoint cadence resumes
+        np.testing.assert_allclose(np.asarray(t2.model().lam), lam1)
+
+        # and both continue identically given the same next batch
+        t1.process(_mb(TOPIC_A_DOCS, 2))
+        t2.process(_mb(TOPIC_A_DOCS, 2))
+        np.testing.assert_allclose(
+            np.asarray(t1.model().lam), np.asarray(t2.model().lam),
+            rtol=1e-6,
+        )
+
+        # resuming with a DIFFERENT same-size vocabulary must refuse: the
+        # checkpoint's term columns would silently misalign
+        with pytest.raises(ValueError, match="DIFFERENT"):
+            StreamingOnlineLDA(
+                params, vocab=[f"w{i}" for i in range(64)], lemmatize=False,
+                batch_capacity=8, row_len=32, mesh=self._mesh(),
+            )
+
+    def test_source_state_survives_restart(self, tmp_path):
+        """FileStreamSource with a state_path must not re-emit files already
+        consumed by a previous process (resume would double-train them)."""
+        d = tmp_path / "in"
+        d.mkdir()
+        state = str(tmp_path / "seen.txt")
+        (d / "a.txt").write_text("first wave")
+        src1 = FileStreamSource(str(d), state_path=state)
+        assert len(src1.poll()) == 1
+
+        (d / "b.txt").write_text("second wave")
+        src2 = FileStreamSource(str(d), state_path=state)  # "restart"
+        mb = src2.poll()
+        assert [os.path.basename(n) for n in mb.names] == ["b.txt"]
+        assert src2.poll() is None
+
+    def test_cli_stream_score_and_train(self, tmp_path):
+        """End-to-end smoke: stream-train on a watched dir, then
+        stream-score against the produced model."""
+        from spark_text_clustering_tpu.cli import main
+
+        watch = tmp_path / "incoming"
+        watch.mkdir()
+        for i, t in enumerate(TOPIC_A_DOCS + TOPIC_B_DOCS):
+            (watch / f"doc{i}.txt").write_text(t)
+        models = str(tmp_path / "models")
+        out = str(tmp_path / "out")
+
+        rc = main([
+            "stream-train", "--watch-dir", str(watch),
+            "--idle-timeout", "0", "--k", "2",
+            "--hash-features", "256", "--no-lemmatize",
+            "--models-dir", models, "--lang", "EN",
+        ])
+        assert rc == 0
+        assert os.listdir(models)
+
+        rc = main([
+            "stream-score", "--watch-dir", str(watch),
+            "--idle-timeout", "0", "--no-lemmatize",
+            "--models-dir", models, "--lang", "EN",
+            "--output-dir", out,
+        ])
+        assert rc == 0
+        (report,) = os.listdir(out)
+        assert report.startswith("Result_EN_")
+
+    def test_streaming_step_matches_batch_online_step(self, eight_devices):
+        """One streaming trigger == one OnlineLDA train step with the same
+        batch, gamma0, and corpus size (the dynamic-D refactor must be
+        numerically identical to the static-D path)."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from spark_text_clustering_tpu.models.online_lda import (
+            TrainState,
+            make_online_train_step,
+        )
+        from spark_text_clustering_tpu.ops.lda_math import (
+            init_gamma,
+            init_lambda,
+        )
+        from spark_text_clustering_tpu.ops.sparse import batch_from_rows
+        from spark_text_clustering_tpu.parallel.collectives import (
+            data_shard_batch,
+        )
+        from spark_text_clustering_tpu.parallel.mesh import (
+            DATA_AXIS,
+            make_mesh,
+            model_sharding,
+        )
+
+        mesh = make_mesh(
+            data_shards=4, model_shards=2, devices=eight_devices
+        )
+        k, v, n = 3, 64, 8
+        rng = np.random.default_rng(1)
+        rows = []
+        for _ in range(n):
+            ids = np.sort(
+                rng.choice(v, size=12, replace=False)
+            ).astype(np.int32)
+            rows.append((ids, rng.integers(1, 5, 12).astype(np.float32)))
+        batch = data_shard_batch(
+            mesh, batch_from_rows(rows, row_len=16)
+        )
+        lam0 = jax.device_put(
+            init_lambda(jax.random.PRNGKey(0), k, v), model_sharding(mesh)
+        )
+        gamma0 = jax.device_put(
+            init_gamma(jax.random.PRNGKey(1), n, k),
+            NamedSharding(mesh, P(DATA_AXIS, None)),
+        )
+        kw = dict(alpha=np.full((k,), 1.0 / k, np.float32), eta=1.0 / k,
+                  tau0=1024.0, kappa=0.51)
+
+        static = make_online_train_step(mesh, corpus_size=100, **kw)
+        dynamic = make_online_train_step(mesh, corpus_size=None, **kw)
+        s1 = static(TrainState(lam0, jnp.int32(0)), batch, gamma0)
+        s2 = dynamic(
+            TrainState(lam0, jnp.int32(0)), batch, gamma0, jnp.float32(100.0)
+        )
+        np.testing.assert_allclose(
+            np.asarray(s1.lam), np.asarray(s2.lam), rtol=1e-6
+        )
+        assert int(s1.step) == int(s2.step) == 1
