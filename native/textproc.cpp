@@ -1,0 +1,770 @@
+// Native host-side text preprocessing for spark_text_clustering_tpu.
+//
+// C++ port of utils/textproc.py — the map side of the reference's
+// BuildTFIDFVector (LDAClustering.scala:113-139): lemmatize (CoreNLP
+// getLemmaText equivalent, :293-309) -> clean (:283-284) -> tokenize
+// (OpenNLP SimpleTokenizer, :133-135) -> stop-filter -> Porter stem
+// (NLTK ORIGINAL_ALGORITHM mode, to_lowercase=False).
+//
+// The reference's preprocessing hot spot is CPU string work (SURVEY.md §3.2
+// "CPU hot spot"); this library is the native-runtime equivalent of the
+// JVM NLP stack, called from Python via ctypes (GIL released during calls,
+// so documents preprocess in parallel across host cores).
+//
+// Parity contract: given the same UTF-8 text, stc_preprocess must emit the
+// IDENTICAL token sequence as textproc.preprocess_document.  All string
+// logic therefore operates on Unicode code points (like Python str), never
+// raw bytes.  tests/test_native_textproc.py enforces this per-function and
+// end-to-end over multi-language corpus samples.
+
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <unordered_set>
+#include <vector>
+
+#include "unicode_tables.h"
+
+namespace {
+
+using std::string;
+using std::vector;
+using u32 = uint32_t;
+using U32s = vector<u32>;
+
+// ---------------------------------------------------------------------------
+// UTF-8 <-> code points
+// ---------------------------------------------------------------------------
+U32s decode_utf8(const char* s, size_t n) {
+  U32s out;
+  out.reserve(n);
+  size_t i = 0;
+  while (i < n) {
+    unsigned char c = (unsigned char)s[i];
+    u32 cp;
+    size_t len;
+    if (c < 0x80) {
+      cp = c;
+      len = 1;
+    } else if ((c >> 5) == 0x6) {
+      cp = c & 0x1F;
+      len = 2;
+    } else if ((c >> 4) == 0xE) {
+      cp = c & 0x0F;
+      len = 3;
+    } else if ((c >> 3) == 0x1E) {
+      cp = c & 0x07;
+      len = 4;
+    } else {  // invalid lead byte: emit replacement, resync
+      out.push_back(0xFFFD);
+      i += 1;
+      continue;
+    }
+    if (i + len > n) {
+      out.push_back(0xFFFD);
+      break;
+    }
+    bool ok = true;
+    for (size_t k = 1; k < len; ++k) {
+      unsigned char cc = (unsigned char)s[i + k];
+      if ((cc >> 6) != 0x2) {
+        ok = false;
+        break;
+      }
+      cp = (cp << 6) | (cc & 0x3F);
+    }
+    if (!ok) {
+      out.push_back(0xFFFD);
+      i += 1;
+      continue;
+    }
+    out.push_back(cp);
+    i += len;
+  }
+  return out;
+}
+
+void encode_utf8(u32 cp, string& out) {
+  if (cp < 0x80) {
+    out += (char)cp;
+  } else if (cp < 0x800) {
+    out += (char)(0xC0 | (cp >> 6));
+    out += (char)(0x80 | (cp & 0x3F));
+  } else if (cp < 0x10000) {
+    out += (char)(0xE0 | (cp >> 12));
+    out += (char)(0x80 | ((cp >> 6) & 0x3F));
+    out += (char)(0x80 | (cp & 0x3F));
+  } else {
+    out += (char)(0xF0 | (cp >> 18));
+    out += (char)(0x80 | ((cp >> 12) & 0x3F));
+    out += (char)(0x80 | ((cp >> 6) & 0x3F));
+    out += (char)(0x80 | (cp & 0x3F));
+  }
+}
+
+string encode_utf8(const U32s& cps) {
+  string out;
+  out.reserve(cps.size() * 2);
+  for (u32 cp : cps) encode_utf8(cp, out);
+  return out;
+}
+
+// ---------------------------------------------------------------------------
+// Character classes — binary search over tables GENERATED from CPython's
+// own re-module classification (native/gen_unicode_tables.py), so the
+// tokenizer splits text at exactly the same boundaries as the Python path
+// for every script, not just the corpus languages.
+// ---------------------------------------------------------------------------
+bool in_ranges(u32 c, const uint32_t (*ranges)[2], size_t n) {
+  size_t lo = 0, hi = n;
+  while (lo < hi) {
+    size_t mid = (lo + hi) / 2;
+    if (c < ranges[mid][0]) {
+      hi = mid;
+    } else if (c > ranges[mid][1]) {
+      lo = mid + 1;
+    } else {
+      return true;
+    }
+  }
+  return false;
+}
+
+// what [^\W\d_] matches (letters + numeric letters Nl/No)
+bool is_letter(u32 c) {
+  if (c < 0x80)
+    return (c >= 'a' && c <= 'z') || (c >= 'A' && c <= 'Z');
+  return in_ranges(c, kLetterRanges, kLetterRanges_len);
+}
+
+// what \d matches (Unicode decimal digits, category Nd)
+bool is_digit(u32 c) {
+  if (c < 0x80) return c >= '0' && c <= '9';
+  return in_ranges(c, kDigitRanges, kDigitRanges_len);
+}
+
+// what \s matches
+bool is_space(u32 c) {
+  if (c < 0x80)
+    return c == ' ' || (c >= 0x09 && c <= 0x0D) ||
+           (c >= 0x1C && c <= 0x1F);
+  return in_ranges(c, kSpaceRanges, kSpaceRanges_len);
+}
+
+// \w equivalent (letters | digits | underscore)
+bool is_word_char(u32 c) { return is_letter(c) || is_digit(c) || c == '_'; }
+
+u32 ascii_lower(u32 c) { return (c >= 'A' && c <= 'Z') ? c + 32 : c; }
+
+// ---------------------------------------------------------------------------
+// filter_special_characters (LDAClustering.scala:283-284): replace the char
+// class with a space.  Set matches textproc._SPECIAL_RE exactly:
+//   » « ! @ # $ % ^ & * ( ) _ + - − , ” " ’ ' ; : . ` ?
+// ---------------------------------------------------------------------------
+bool is_special(u32 c) {
+  switch (c) {
+    case 0xBB: case 0xAB:                     // » «
+    case '!': case '@': case '#': case '$': case '%': case '^': case '&':
+    case '*': case '(': case ')': case '_': case '+': case '-':
+    case 0x2212:                              // −
+    case ',': case 0x201D: case '"': case 0x2019: case '\'': case ';':
+    case ':': case '.': case '`': case '?':
+      return true;
+    default:
+      return false;
+  }
+}
+
+// ---------------------------------------------------------------------------
+// Porter stemmer — NLTK PorterStemmer(mode="ORIGINAL_ALGORITHM"),
+// stem(word, to_lowercase=False).  Operates on code points; vowel tests use
+// LOWERCASE ascii a/e/i/o/u only (so uppercase letters count as consonants,
+// exactly like the Python original running on a non-lowercased string).
+// ---------------------------------------------------------------------------
+struct Porter {
+  static bool is_vowel_char(u32 c) {
+    return c == 'a' || c == 'e' || c == 'i' || c == 'o' || c == 'u';
+  }
+
+  static bool is_consonant(const U32s& w, size_t i) {
+    if (is_vowel_char(w[i])) return false;
+    if (w[i] == 'y') {
+      bool negate = false;
+      while (i > 0 && w[i] == 'y') {
+        negate = !negate;
+        --i;
+      }
+      return (!is_vowel_char(w[i])) != negate;
+    }
+    return true;
+  }
+
+  static int measure(const U32s& stem) {
+    int m = 0;
+    bool prev_v = false;
+    for (size_t i = 0; i < stem.size(); ++i) {
+      bool v = !is_consonant(stem, i);
+      if (prev_v && !v) ++m;
+      prev_v = v;
+    }
+    return m;
+  }
+
+  static bool contains_vowel(const U32s& stem) {
+    for (size_t i = 0; i < stem.size(); ++i)
+      if (!is_consonant(stem, i)) return true;
+    return false;
+  }
+
+  static bool ends_double_consonant(const U32s& w) {
+    size_t n = w.size();
+    return n >= 2 && w[n - 1] == w[n - 2] && is_consonant(w, n - 1);
+  }
+
+  static bool ends_cvc(const U32s& w) {
+    size_t n = w.size();
+    return n >= 3 && is_consonant(w, n - 3) && !is_consonant(w, n - 2) &&
+           is_consonant(w, n - 1) && w[n - 1] != 'w' && w[n - 1] != 'x' &&
+           w[n - 1] != 'y';
+  }
+
+  static bool ends_with(const U32s& w, const char* suf) {
+    size_t m = strlen(suf);
+    if (w.size() < m) return false;
+    for (size_t i = 0; i < m; ++i)
+      if (w[w.size() - m + i] != (u32)(unsigned char)suf[i]) return false;
+    return true;
+  }
+
+  static U32s drop(const U32s& w, size_t m) {
+    return U32s(w.begin(), w.end() - (long)m);
+  }
+
+  static void append(U32s& w, const char* s) {
+    for (; *s; ++s) w.push_back((u32)(unsigned char)*s);
+  }
+
+  // one (suffix, replacement, condition) rule; returns true if the rule
+  // MATCHED (whether or not the condition passed — matching stops the scan,
+  // mirroring _apply_rule_list's early return on a failed condition)
+  enum Cond { NONE, M_GT_0, M_GT_1, M_GT_1_ST };
+  static bool try_rule(U32s& w, const char* suf, const char* rep, Cond cond) {
+    if (!ends_with(w, suf)) return false;
+    U32s stem = drop(w, strlen(suf));
+    bool ok;
+    switch (cond) {
+      case NONE: ok = true; break;
+      case M_GT_0: ok = measure(stem) > 0; break;
+      case M_GT_1: ok = measure(stem) > 1; break;
+      case M_GT_1_ST:
+        ok = measure(stem) > 1 && !stem.empty() &&
+             (stem.back() == 's' || stem.back() == 't');
+        break;
+    }
+    if (ok) {
+      append(stem, rep);
+      w = std::move(stem);
+    }
+    return true;  // matched; stop scanning further rules
+  }
+
+  static U32s step1a(U32s w) {
+    if (try_rule(w, "sses", "ss", NONE)) return w;
+    if (try_rule(w, "ies", "i", NONE)) return w;
+    if (try_rule(w, "ss", "ss", NONE)) return w;
+    if (try_rule(w, "s", "", NONE)) return w;
+    return w;
+  }
+
+  static U32s step1b(U32s w) {
+    if (ends_with(w, "eed")) {
+      U32s stem = drop(w, 3);
+      if (measure(stem) > 0) {
+        append(stem, "ee");
+        return stem;
+      }
+      return w;
+    }
+    U32s inter;
+    bool matched = false;
+    if (ends_with(w, "ed")) {
+      U32s s = drop(w, 2);
+      if (contains_vowel(s)) {
+        inter = std::move(s);
+        matched = true;
+      }
+    }
+    if (!matched && ends_with(w, "ing")) {
+      U32s s = drop(w, 3);
+      if (contains_vowel(s)) {
+        inter = std::move(s);
+        matched = true;
+      }
+    }
+    if (!matched) return w;
+
+    if (try_rule(inter, "at", "ate", NONE)) return inter;
+    if (try_rule(inter, "bl", "ble", NONE)) return inter;
+    if (try_rule(inter, "iz", "ize", NONE)) return inter;
+    if (ends_double_consonant(inter)) {
+      u32 last = inter.back();
+      if (last != 'l' && last != 's' && last != 'z') inter.pop_back();
+      return inter;  // rule matched either way — stop
+    }
+    if (measure(inter) == 1 && ends_cvc(inter)) {
+      inter.push_back('e');
+    }
+    return inter;
+  }
+
+  static U32s step1c(U32s w) {
+    // original condition: (*v*) Y -> I
+    if (ends_with(w, "y")) {
+      U32s stem = drop(w, 1);
+      if (contains_vowel(stem)) {
+        stem.push_back('i');
+        return stem;
+      }
+    }
+    return w;
+  }
+
+  static U32s step2(U32s w) {
+    // ORIGINAL_ALGORITHM rule list (abli variant, no alli-first, no
+    // fulli/logi)
+    if (try_rule(w, "ational", "ate", M_GT_0)) return w;
+    if (try_rule(w, "tional", "tion", M_GT_0)) return w;
+    if (try_rule(w, "enci", "ence", M_GT_0)) return w;
+    if (try_rule(w, "anci", "ance", M_GT_0)) return w;
+    if (try_rule(w, "izer", "ize", M_GT_0)) return w;
+    if (try_rule(w, "abli", "able", M_GT_0)) return w;
+    if (try_rule(w, "alli", "al", M_GT_0)) return w;
+    if (try_rule(w, "entli", "ent", M_GT_0)) return w;
+    if (try_rule(w, "eli", "e", M_GT_0)) return w;
+    if (try_rule(w, "ousli", "ous", M_GT_0)) return w;
+    if (try_rule(w, "ization", "ize", M_GT_0)) return w;
+    if (try_rule(w, "ation", "ate", M_GT_0)) return w;
+    if (try_rule(w, "ator", "ate", M_GT_0)) return w;
+    if (try_rule(w, "alism", "al", M_GT_0)) return w;
+    if (try_rule(w, "iveness", "ive", M_GT_0)) return w;
+    if (try_rule(w, "fulness", "ful", M_GT_0)) return w;
+    if (try_rule(w, "ousness", "ous", M_GT_0)) return w;
+    if (try_rule(w, "aliti", "al", M_GT_0)) return w;
+    if (try_rule(w, "iviti", "ive", M_GT_0)) return w;
+    if (try_rule(w, "biliti", "ble", M_GT_0)) return w;
+    return w;
+  }
+
+  static U32s step3(U32s w) {
+    if (try_rule(w, "icate", "ic", M_GT_0)) return w;
+    if (try_rule(w, "ative", "", M_GT_0)) return w;
+    if (try_rule(w, "alize", "al", M_GT_0)) return w;
+    if (try_rule(w, "iciti", "ic", M_GT_0)) return w;
+    if (try_rule(w, "ical", "ic", M_GT_0)) return w;
+    if (try_rule(w, "ful", "", M_GT_0)) return w;
+    if (try_rule(w, "ness", "", M_GT_0)) return w;
+    return w;
+  }
+
+  static U32s step4(U32s w) {
+    if (try_rule(w, "al", "", M_GT_1)) return w;
+    if (try_rule(w, "ance", "", M_GT_1)) return w;
+    if (try_rule(w, "ence", "", M_GT_1)) return w;
+    if (try_rule(w, "er", "", M_GT_1)) return w;
+    if (try_rule(w, "ic", "", M_GT_1)) return w;
+    if (try_rule(w, "able", "", M_GT_1)) return w;
+    if (try_rule(w, "ible", "", M_GT_1)) return w;
+    if (try_rule(w, "ant", "", M_GT_1)) return w;
+    if (try_rule(w, "ement", "", M_GT_1)) return w;
+    if (try_rule(w, "ment", "", M_GT_1)) return w;
+    if (try_rule(w, "ent", "", M_GT_1)) return w;
+    if (try_rule(w, "ion", "", M_GT_1_ST)) return w;
+    if (try_rule(w, "ou", "", M_GT_1)) return w;
+    if (try_rule(w, "ism", "", M_GT_1)) return w;
+    if (try_rule(w, "ate", "", M_GT_1)) return w;
+    if (try_rule(w, "iti", "", M_GT_1)) return w;
+    if (try_rule(w, "ous", "", M_GT_1)) return w;
+    if (try_rule(w, "ive", "", M_GT_1)) return w;
+    if (try_rule(w, "ize", "", M_GT_1)) return w;
+    return w;
+  }
+
+  static U32s step5a(U32s w) {
+    if (!w.empty() && w.back() == 'e') {
+      U32s stem = drop(w, 1);
+      int m = measure(stem);
+      if (m > 1) return stem;
+      if (m == 1 && !ends_cvc(stem)) return stem;
+    }
+    return w;
+  }
+
+  static U32s step5b(U32s w) {
+    if (ends_with(w, "ll") && measure(drop(w, 1)) > 1) {
+      w.pop_back();
+    }
+    return w;
+  }
+
+  static U32s stem(U32s w) {
+    w = step1a(std::move(w));
+    w = step1b(std::move(w));
+    w = step1c(std::move(w));
+    w = step2(std::move(w));
+    w = step3(std::move(w));
+    w = step4(std::move(w));
+    w = step5a(std::move(w));
+    w = step5b(std::move(w));
+    return w;
+  }
+};
+
+// ---------------------------------------------------------------------------
+// Rule lemmatizer — port of textproc.lemma() (CoreNLP morphology.lemma
+// approximation).  Irregular table and suffix rules are byte-identical.
+// ---------------------------------------------------------------------------
+struct IrregularEntry {
+  const char* from;
+  const char* to;
+};
+const IrregularEntry kIrregular[] = {
+    {"was", "be"},       {"were", "be"},     {"been", "be"},
+    {"is", "be"},        {"are", "be"},      {"am", "be"},
+    {"has", "have"},     {"had", "have"},    {"having", "have"},
+    {"did", "do"},       {"does", "do"},     {"done", "do"},
+    {"went", "go"},      {"gone", "go"},     {"goes", "go"},
+    {"said", "say"},     {"says", "say"},    {"saw", "see"},
+    {"seen", "see"},     {"made", "make"},   {"came", "come"},
+    {"taken", "take"},   {"took", "take"},   {"given", "give"},
+    {"gave", "give"},    {"got", "get"},     {"gotten", "get"},
+    {"knew", "know"},    {"known", "know"},  {"thought", "think"},
+    {"told", "tell"},    {"found", "find"},  {"left", "leave"},
+    {"felt", "feel"},    {"kept", "keep"},   {"held", "hold"},
+    {"brought", "bring"},{"stood", "stand"}, {"sat", "sit"},
+    {"spoke", "speak"},  {"spoken", "speak"},{"heard", "hear"},
+    {"meant", "mean"},   {"men", "man"},     {"women", "woman"},
+    {"children", "child"},{"feet", "foot"},  {"teeth", "tooth"},
+    {"mice", "mouse"},   {"people", "person"},{"wives", "wife"},
+    {"lives", "life"},   {"leaves", "leaf"}, {"selves", "self"},
+    {"eyes", "eye"},     {"better", "good"}, {"best", "good"},
+    {"worse", "bad"},    {"worst", "bad"},
+};
+
+const char* irregular_lookup(const string& low) {
+  for (auto& e : kIrregular)
+    if (low == e.from) return e.to;
+  return nullptr;
+}
+
+// Python's _strip_double compares RAW chars (`stem_[-1] not in "ls"` — an
+// uppercase 'L'/'S' would not match), so this mirrors the raw comparison.
+U32s strip_double_raw(const U32s& stem) {
+  size_t n = stem.size();
+  if (n >= 2 && stem[n - 1] == stem[n - 2] &&
+      !(stem[n - 1] == 'a' || stem[n - 1] == 'e' || stem[n - 1] == 'i' ||
+        stem[n - 1] == 'o' || stem[n - 1] == 'u') &&
+      stem[n - 1] != 'l' && stem[n - 1] != 's') {
+    return U32s(stem.begin(), stem.end() - 1);
+  }
+  return stem;
+}
+
+bool lower_is_vowel(u32 c) {
+  u32 l = ascii_lower(c);
+  return l == 'a' || l == 'e' || l == 'i' || l == 'o' || l == 'u';
+}
+
+// textproc._needs_e(stem_.lower()): called on the LOWERCASED stem.
+bool needs_e_lower(const U32s& low) {
+  size_t n = low.size();
+  if (n < 3) return false;
+  u32 c1 = low[n - 3], v = low[n - 2], c2 = low[n - 1];
+  bool cond = !lower_is_vowel(c2) && c2 != 'w' && c2 != 'x' && c2 != 'y' &&
+              lower_is_vowel(v) && !lower_is_vowel(c1);
+  if (!cond) return false;
+  // `not any(ch in _VOWELS for ch in stem_[:-3][-1:])`
+  if (n >= 4 && lower_is_vowel(low[n - 4])) return false;
+  return true;
+}
+
+bool any_vowel_lower(const U32s& w) {
+  for (u32 c : w)
+    if (lower_is_vowel(c)) return true;
+  return false;
+}
+
+U32s ascii_lower_all(const U32s& w) {
+  U32s out = w;
+  for (auto& c : out) c = ascii_lower(c);
+  return out;
+}
+
+bool ends_with_low(const U32s& low, const char* suf) {
+  return Porter::ends_with(low, suf);
+}
+
+U32s lemma(const U32s& word) {
+  U32s low = ascii_lower_all(word);
+  // irregular table: keys are pure-ASCII, so an ASCII-lower lookup matches
+  // Python's full .lower() for every word that can possibly hit the table
+  if (low.size() <= 8) {
+    bool all_ascii = true;
+    for (u32 c : low)
+      if (c >= 0x80) {
+        all_ascii = false;
+        break;
+      }
+    if (all_ascii) {
+      string lows;
+      for (u32 c : low) lows += (char)c;
+      if (const char* to = irregular_lookup(lows)) {
+        U32s out;
+        for (const char* p = to; *p; ++p) out.push_back((u32)(unsigned char)*p);
+        // word[0] + out[1:] if word[0].isupper() and len(out) > 1
+        if (word[0] >= 'A' && word[0] <= 'Z' && out.size() > 1) {
+          U32s cased;
+          cased.push_back(word[0]);
+          cased.insert(cased.end(), out.begin() + 1, out.end());
+          return cased;
+        }
+        return out;
+      }
+    }
+  }
+
+  size_t n = low.size();
+  // plural / 3rd-person -s
+  if (ends_with_low(low, "ies") && n > 4) {
+    U32s out(word.begin(), word.end() - 3);
+    out.push_back('y');
+    return out;
+  }
+  if (ends_with_low(low, "sses") || ends_with_low(low, "shes") ||
+      ends_with_low(low, "ches") || ends_with_low(low, "xes") ||
+      ends_with_low(low, "zes")) {
+    return U32s(word.begin(), word.end() - 2);
+  }
+  if (ends_with_low(low, "s") && !ends_with_low(low, "ss") &&
+      !ends_with_low(low, "us") && !ends_with_low(low, "is") && n > 3) {
+    return U32s(word.begin(), word.end() - 1);
+  }
+  // -ing
+  if (ends_with_low(low, "ing") && n > 5) {
+    U32s stem(word.begin(), word.end() - 3);
+    if (!any_vowel_lower(stem)) return word;
+    U32s stripped = strip_double_raw(stem);
+    if (stripped != stem) return stripped;
+    if (needs_e_lower(ascii_lower_all(stem))) {
+      U32s out = stem;
+      out.push_back('e');
+      return out;
+    }
+    return stem;
+  }
+  // -ed
+  if (ends_with_low(low, "ied") && n > 4) {
+    U32s out(word.begin(), word.end() - 3);
+    out.push_back('y');
+    return out;
+  }
+  if (ends_with_low(low, "ed") && n > 4) {
+    U32s stem(word.begin(), word.end() - 2);
+    if (!any_vowel_lower(stem)) return word;
+    U32s stripped = strip_double_raw(stem);
+    if (stripped != stem) return stripped;
+    if (needs_e_lower(ascii_lower_all(stem))) {
+      U32s out = stem;
+      out.push_back('e');
+      return out;
+    }
+    return stem;
+  }
+  return word;
+}
+
+// ---------------------------------------------------------------------------
+// lemmatize_text (textproc.lemmatize_text): sentence split on
+// (?<=[.!?])\s+, word regex [^\W\d_]+(?:['’][^\W\d_]+)?, optional
+// within-sentence dedup, lemma, keep len > min_len.
+// ---------------------------------------------------------------------------
+void words_of_sentence(const U32s& sent, vector<U32s>& out) {
+  size_t i = 0, n = sent.size();
+  while (i < n) {
+    if (!is_letter(sent[i]) || is_digit(sent[i]) || sent[i] == '_') {
+      ++i;
+      continue;
+    }
+    size_t j = i;
+    while (j < n && is_letter(sent[j]) && !is_digit(sent[j]) &&
+           sent[j] != '_')
+      ++j;
+    // optional ['’] + letters
+    if (j < n && (sent[j] == '\'' || sent[j] == 0x2019) && j + 1 < n &&
+        is_letter(sent[j + 1])) {
+      size_t k = j + 1;
+      while (k < n && is_letter(sent[k])) ++k;
+      out.emplace_back(sent.begin() + (long)i, sent.begin() + (long)k);
+      i = k;
+      continue;
+    }
+    out.emplace_back(sent.begin() + (long)i, sent.begin() + (long)j);
+    i = j;
+  }
+}
+
+U32s lemmatize_text(const U32s& text, int min_len_exclusive, bool dedup) {
+  U32s out;
+  size_t n = text.size();
+  size_t start = 0;
+  vector<std::pair<size_t, size_t>> sentences;
+  // split on (?<=[.!?])\s+  — boundary AFTER .!? at a whitespace run
+  for (size_t i = 0; i + 1 < n; ++i) {
+    u32 c = text[i];
+    if ((c == '.' || c == '!' || c == '?') && is_space(text[i + 1])) {
+      size_t j = i + 1;
+      while (j < n && is_space(text[j])) ++j;
+      sentences.emplace_back(start, i + 1);
+      start = j;
+      i = j - 1;
+    }
+  }
+  sentences.emplace_back(start, n);
+
+  std::unordered_set<string> seen;
+  vector<U32s> words;
+  for (auto& [s, e] : sentences) {
+    U32s sent(text.begin() + (long)s, text.begin() + (long)e);
+    words.clear();
+    words_of_sentence(sent, words);
+    seen.clear();
+    for (auto& w : words) {
+      if (dedup) {
+        string key = encode_utf8(w);
+        if (!seen.insert(std::move(key)).second) continue;
+      }
+      U32s lm = lemma(w);
+      if ((int)lm.size() > min_len_exclusive) {
+        if (!out.empty()) out.push_back(' ');
+        out.insert(out.end(), lm.begin(), lm.end());
+      }
+    }
+  }
+  return out;
+}
+
+// ---------------------------------------------------------------------------
+// simple_tokenize (textproc._TOKEN_RE): [^\W\d_]+ | \d+ | [^\w\s]+
+// ---------------------------------------------------------------------------
+void simple_tokenize(const U32s& text, vector<U32s>& out) {
+  size_t i = 0, n = text.size();
+  while (i < n) {
+    u32 c = text[i];
+    if (is_letter(c)) {  // [^\W\d_]+ : letters (not digit, not underscore)
+      size_t j = i;
+      while (j < n && is_letter(text[j])) ++j;
+      out.emplace_back(text.begin() + (long)i, text.begin() + (long)j);
+      i = j;
+    } else if (is_digit(c)) {  // \d+
+      size_t j = i;
+      while (j < n && is_digit(text[j])) ++j;
+      out.emplace_back(text.begin() + (long)i, text.begin() + (long)j);
+      i = j;
+    } else if (!is_space(c) && !is_word_char(c)) {  // [^\w\s]+
+      size_t j = i;
+      while (j < n && !is_space(text[j]) && !is_word_char(text[j])) ++j;
+      out.emplace_back(text.begin() + (long)i, text.begin() + (long)j);
+      i = j;
+    } else {
+      ++i;  // whitespace or underscore (matches nothing in the regex)
+    }
+  }
+}
+
+}  // namespace
+
+// ---------------------------------------------------------------------------
+// C API
+// ---------------------------------------------------------------------------
+extern "C" {
+
+// Full preprocess_document pipeline.  ``text_len`` is the byte length of
+// ``text`` — passed explicitly so documents containing embedded NUL bytes
+// (stray binary files ingested with include_all) are processed in full,
+// exactly like the Python path.  stop_words_nl: '\n'-joined UTF-8 stop
+// words (case-sensitive, applied pre-stemming).  Returns a malloc'd
+// '\n'-joined UTF-8 token buffer (empty string when no tokens); caller must
+// free with stc_free.  Thread-safe, no global state.
+char* stc_preprocess(const char* text, long text_len,
+                     const char* stop_words_nl,
+                     int lemmatize, int min_lemma_len_exclusive, int dedup,
+                     long* out_len) {
+  std::unordered_set<string> stops;
+  if (stop_words_nl && *stop_words_nl) {
+    const char* p = stop_words_nl;
+    while (*p) {
+      const char* q = strchr(p, '\n');
+      size_t len = q ? (size_t)(q - p) : strlen(p);
+      if (len) stops.emplace(p, len);
+      if (!q) break;
+      p = q + 1;
+    }
+  }
+
+  U32s cps = decode_utf8(text, (size_t)text_len);
+  if (lemmatize) {
+    cps = lemmatize_text(cps, min_lemma_len_exclusive, dedup != 0);
+  }
+  // filter_special_characters
+  for (auto& c : cps)
+    if (is_special(c)) c = ' ';
+
+  vector<U32s> toks;
+  simple_tokenize(cps, toks);
+
+  string out;
+  out.reserve(toks.size() * 8);
+  for (auto& t : toks) {
+    if (t.empty()) continue;
+    string raw = encode_utf8(t);
+    if (stops.count(raw)) continue;
+    U32s stemmed = Porter::stem(std::move(t));
+    if (stemmed.empty()) continue;
+    if (!out.empty()) out += '\n';
+    out += encode_utf8(stemmed);
+  }
+
+  // length returned out-of-band: punct-run tokens can contain NUL bytes
+  // (e.g. from binary junk files), which would truncate a strlen read
+  if (out_len) *out_len = (long)out.size();
+  char* buf = (char*)malloc(out.size() + 1);
+  memcpy(buf, out.data(), out.size());
+  buf[out.size()] = '\0';
+  return buf;
+}
+
+// Porter stem of one token (parity probe for tests).
+char* stc_stem(const char* token) {
+  U32s cps = decode_utf8(token, strlen(token));
+  string out = encode_utf8(Porter::stem(std::move(cps)));
+  char* buf = (char*)malloc(out.size() + 1);
+  memcpy(buf, out.data(), out.size());
+  buf[out.size()] = '\0';
+  return buf;
+}
+
+// Rule lemma of one word (parity probe for tests).
+char* stc_lemma(const char* word) {
+  U32s cps = decode_utf8(word, strlen(word));
+  string out = cps.empty() ? string() : encode_utf8(lemma(cps));
+  char* buf = (char*)malloc(out.size() + 1);
+  memcpy(buf, out.data(), out.size());
+  buf[out.size()] = '\0';
+  return buf;
+}
+
+void stc_free(char* p) { free(p); }
+
+int stc_abi_version() { return 2; }
+
+}  // extern "C"

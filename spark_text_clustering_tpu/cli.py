@@ -196,6 +196,8 @@ def cmd_stream_score(args: argparse.Namespace) -> int:
         stop_words=_load_stop_words(args.stop_words),
         lemmatize=not args.no_lemmatize,
         batch_capacity=args.batch_capacity,
+        # endless streams must not retain every doc's result in memory
+        keep_results=not args.no_report,
     )
     for mb in src.stream(
         poll_interval=args.poll_interval, idle_timeout=args.idle_timeout
@@ -205,7 +207,7 @@ def cmd_stream_score(args: argparse.Namespace) -> int:
                   f"{os.path.basename(sd.name)} -> topic {sd.topic}")
     for t, c in enumerate(scorer.tallies):
         print(f"topic {t}: {c} books")
-    if scorer.results:
+    if scorer.results and not args.no_report:
         path = scorer.write_report(args.output_dir, args.lang)
         print(f"report written to {path}")
     return 0
@@ -336,6 +338,10 @@ def build_parser() -> argparse.ArgumentParser:
     ss.add_argument("--models-dir", default="models")
     ss.add_argument("--model", default=None, help="explicit model dir")
     ss.add_argument("--output-dir", default="TestOutput")
+    ss.add_argument("--no-report", action="store_true",
+                    help="per-doc output only; don't accumulate results "
+                         "for a final report (constant memory for endless "
+                         "streams)")
     ss.set_defaults(fn=cmd_stream_score)
 
     st = sub.add_parser(

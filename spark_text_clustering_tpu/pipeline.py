@@ -90,29 +90,63 @@ class Estimator:
 # ---------------------------------------------------------------------------
 class TextPreprocessor(Transformer):
     """texts -> tokens (clean + lemmatize + tokenize + stop-filter + stem;
-    the map side of BuildTFIDFVector steps 1-5)."""
+    the map side of BuildTFIDFVector steps 1-5).
+
+    ``backend="auto"`` uses the native C++ library (native/textproc.cpp —
+    token-for-token parity with the Python path, preprocessed in parallel
+    across host cores) when it compiles/loads, else pure Python.  Force with
+    "native" or "python".
+    """
 
     def __init__(
         self,
         stop_words: frozenset = frozenset(),
         lemmatize: bool = True,
         dedup_within_sentence: bool = True,
+        backend: str = "auto",
     ) -> None:
+        if backend not in ("auto", "native", "python"):
+            raise ValueError(f"unknown backend {backend!r}")
         self.stop_words = stop_words
         self.lemmatize = lemmatize
         self.dedup = dedup_within_sentence
+        self.backend = backend
+
+    def _use_native(self) -> bool:
+        if self.backend == "python":
+            return False
+        from .utils.native import native_available
+
+        if self.backend == "native":
+            if not native_available():
+                raise RuntimeError(
+                    "backend='native' requested but the C++ textproc "
+                    "library failed to build/load"
+                )
+            return True
+        return native_available()
 
     def transform(self, ds: Dict) -> Dict:
         out = dict(ds)
-        out["tokens"] = [
-            preprocess_document(
-                t,
+        if self._use_native():
+            from .utils.native import preprocess_documents
+
+            out["tokens"] = preprocess_documents(
+                ds["texts"],
                 stop_words=self.stop_words,
                 lemmatize=self.lemmatize,
                 dedup_within_sentence=self.dedup,
             )
-            for t in ds["texts"]
-        ]
+        else:
+            out["tokens"] = [
+                preprocess_document(
+                    t,
+                    stop_words=self.stop_words,
+                    lemmatize=self.lemmatize,
+                    dedup_within_sentence=self.dedup,
+                )
+                for t in ds["texts"]
+            ]
         return out
 
 

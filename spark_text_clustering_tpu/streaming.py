@@ -98,22 +98,32 @@ class FileStreamSource:
         # Source progress (Spark's file-source "commit log"): with a
         # state_path, consumed paths persist across restarts so a resumed
         # stream-train never re-ingests (and double-trains) old files.
+        # poll() only STAGES paths (in-memory + _pending); the consumer
+        # calls commit() once the documents are durably accounted for (the
+        # trainer: right after its model checkpoint) — committing inside
+        # poll() would mark files seen that a crash then loses forever.
+        # Crash between checkpoint and commit() re-emits at most one
+        # checkpoint interval of files (at-least-once; benign for online VB)
+        # rather than dropping them (never-trained).
         self.state_path = state_path
         self._seen: set = set()
+        self._pending: List[str] = []
         self._next_id = 0
         if state_path and os.path.exists(state_path):
             with open(state_path, "r", encoding="utf-8") as f:
                 self._seen = {line.rstrip("\n") for line in f if line.strip()}
 
-    def _commit(self, paths: List[str]) -> None:
-        if not self.state_path:
+    def commit(self) -> None:
+        """Durably record every path staged since the last commit."""
+        if not self.state_path or not self._pending:
             return
         os.makedirs(os.path.dirname(self.state_path) or ".", exist_ok=True)
         with open(self.state_path, "a", encoding="utf-8") as f:
-            for p in paths:
+            for p in self._pending:
                 f.write(p + "\n")
             f.flush()
             os.fsync(f.fileno())
+        self._pending.clear()
 
     def _list_new(self) -> List[str]:
         try:
@@ -165,7 +175,7 @@ class FileStreamSource:
             return None
         for p in names:
             self._seen.add(p)
-        self._commit(names)
+        self._pending.extend(names)
         mb = MicroBatch(self._next_id, names, texts)
         self._next_id += 1
         return mb
@@ -270,6 +280,7 @@ class StreamingScorer:
         lemmatize: bool = True,
         batch_capacity: int = 8,
         row_len: Optional[int] = None,
+        keep_results: bool = True,
     ) -> None:
         self.model = model
         self.pre = TextPreprocessor(stop_words=stop_words, lemmatize=lemmatize)
@@ -280,6 +291,11 @@ class StreamingScorer:
         self.batch_capacity = batch_capacity
         self.row_len = row_len          # lazily pinned on first trigger
         self.tallies = np.zeros(model.k, np.int64)
+        # keep_results=False caps memory for endless streams: only the
+        # running tallies are retained, and report() covers nothing — each
+        # trigger's ScoredDocs are still returned from process() for the
+        # caller to stream out.
+        self.keep_results = keep_results
         self.results: List[ScoredDoc] = []
         self.batches_seen = 0
 
@@ -307,7 +323,8 @@ class StreamingScorer:
                 sd = ScoredDoc(name, int(np.argmax(d)), np.asarray(d), row)
                 self.tallies[sd.topic] += 1
                 out.append(sd)
-        self.results.extend(out)
+        if self.keep_results:
+            self.results.extend(out)
         self.batches_seen += 1
         return out
 
@@ -432,10 +449,13 @@ class StreamingOnlineLDA:
         return _vectorize_texts(self.pre, self._rows_for, mb.texts)
 
     # -- the per-trigger update -----------------------------------------
-    def process(self, mb: MicroBatch) -> None:
+    def process(self, mb: MicroBatch) -> bool:
+        """Train on one micro-batch.  Returns True when this call wrote a
+        model checkpoint — the caller's cue to commit source progress (see
+        FileStreamSource.commit)."""
         rows = [(i, w) for i, w in self._vectorize(mb) if len(i) > 0]
         if not rows:
-            return
+            return False
         self.docs_seen += len(rows)
         for at in range(0, len(rows), self.batch_capacity):
             self._update(rows[at : at + self.batch_capacity])
@@ -446,6 +466,8 @@ class StreamingOnlineLDA:
             and self.batches_seen % self.checkpoint_every == 0
         ):
             self.checkpoint()
+            return True
+        return False
 
     def _update(self, chunk) -> None:
         import jax
@@ -479,7 +501,9 @@ class StreamingOnlineLDA:
 
     # -- lifecycle -------------------------------------------------------
     def run(self, source, **stream_kw) -> "StreamingOnlineLDA":
-        """Drain a source (``poll``-able or iterable of MicroBatch)."""
+        """Drain a source (``poll``-able or iterable of MicroBatch),
+        committing source progress each time a model checkpoint lands and
+        once more (with a final checkpoint) at stream end."""
         if hasattr(source, "stream"):
             it = source.stream(**stream_kw)
         elif hasattr(source, "poll"):
@@ -492,8 +516,14 @@ class StreamingOnlineLDA:
             it = _drain()
         else:
             it = iter(source)
+        commit = getattr(source, "commit", None)
         for mb in it:
-            self.process(mb)
+            if self.process(mb) and commit is not None:
+                commit()
+        if self._ckpt_path:
+            self.checkpoint()
+        if commit is not None:
+            commit()
         return self
 
     def checkpoint(self) -> None:

@@ -1,0 +1,191 @@
+"""ctypes bindings for the native C++ preprocessing library.
+
+The reference runs its preprocessing hot spot — CoreNLP lemmatization +
+OpenNLP tokenize/stem, the dominant cost of BuildTFIDFVector (SURVEY.md §3.2
+"CPU hot spot") — on the JVM; ``native/textproc.cpp`` is our native-runtime
+equivalent.  This module compiles it on demand (g++, cached by source
+mtime), binds it via ctypes, and exposes a drop-in
+``preprocess_document_native`` matching ``textproc.preprocess_document``
+token-for-token (enforced by tests/test_native_textproc.py).
+
+ctypes releases the GIL for the duration of each call, so
+``preprocess_documents`` fans documents out over a thread pool and scales
+across host cores — the Spark-executor-parallelism analogue for the host
+side of the pipeline.
+
+Falls back cleanly: ``native_available()`` is False when no compiler exists
+or the build fails, and callers (TextPreprocessor) silently use the Python
+path.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import List, Optional, Sequence
+
+__all__ = [
+    "native_available",
+    "preprocess_document_native",
+    "preprocess_documents",
+    "stem_native",
+    "lemma_native",
+]
+
+_REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+_SRC = os.path.join(_REPO_ROOT, "native", "textproc.cpp")
+_LIB = os.path.join(_REPO_ROOT, "native", "libstc_textproc.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _build() -> bool:
+    """Compile the shared library when missing or stale; False on failure."""
+    if not os.path.exists(_SRC):
+        return False
+    deps = [_SRC, os.path.join(os.path.dirname(_SRC), "unicode_tables.h")]
+    src_mtime = max(os.path.getmtime(p) for p in deps if os.path.exists(p))
+    if os.path.exists(_LIB) and os.path.getmtime(_LIB) >= src_mtime:
+        return True
+    # per-process temp name: concurrent first builds (pytest workers, two
+    # CLI jobs) must not interleave writes into one .tmp and promote a
+    # corrupt .so whose fresh mtime then pins it forever
+    tmp = f"{_LIB}.tmp.{os.getpid()}"
+    try:
+        subprocess.run(
+            [
+                "g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+                "-o", tmp, _SRC,
+            ],
+            check=True,
+            capture_output=True,
+            timeout=300,
+        )
+        os.replace(tmp, _LIB)
+        return True
+    except (subprocess.SubprocessError, OSError):
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    with _lock:
+        if _tried:
+            return _lib
+        _tried = True
+        if not _build():
+            return None
+        try:
+            lib = ctypes.CDLL(_LIB)
+        except OSError:
+            return None
+        lib.stc_preprocess.restype = ctypes.c_void_p
+        lib.stc_preprocess.argtypes = [
+            ctypes.c_char_p, ctypes.c_long, ctypes.c_char_p,
+            ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_long),
+        ]
+        lib.stc_stem.restype = ctypes.c_void_p
+        lib.stc_stem.argtypes = [ctypes.c_char_p]
+        lib.stc_lemma.restype = ctypes.c_void_p
+        lib.stc_lemma.argtypes = [ctypes.c_char_p]
+        lib.stc_free.argtypes = [ctypes.c_void_p]
+        lib.stc_abi_version.restype = ctypes.c_int
+        if lib.stc_abi_version() != 2:
+            return None
+        _lib = lib
+        return _lib
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+def _take_string(lib: ctypes.CDLL, ptr: int) -> str:
+    try:
+        return ctypes.string_at(ptr).decode("utf-8")
+    finally:
+        lib.stc_free(ptr)
+
+
+def preprocess_document_native(
+    text: str,
+    stop_words: frozenset = frozenset(),
+    lemmatize: bool = True,
+    min_lemma_len_exclusive: int = 3,
+    dedup_within_sentence: bool = True,
+) -> List[str]:
+    """Native twin of ``textproc.preprocess_document`` (same signature,
+    same tokens)."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native textproc library unavailable")
+    raw = text.encode("utf-8")
+    sw = "\n".join(sorted(stop_words)).encode("utf-8")
+    out_len = ctypes.c_long()
+    ptr = lib.stc_preprocess(
+        raw,
+        len(raw),  # explicit length: embedded NUL bytes must not truncate
+        sw,
+        1 if lemmatize else 0,
+        min_lemma_len_exclusive,
+        1 if dedup_within_sentence else 0,
+        ctypes.byref(out_len),
+    )
+    try:
+        joined = ctypes.string_at(ptr, out_len.value).decode("utf-8")
+    finally:
+        lib.stc_free(ptr)
+    return joined.split("\n") if joined else []
+
+
+def preprocess_documents(
+    texts: Sequence[str],
+    stop_words: frozenset = frozenset(),
+    lemmatize: bool = True,
+    min_lemma_len_exclusive: int = 3,
+    dedup_within_sentence: bool = True,
+    max_workers: Optional[int] = None,
+) -> List[List[str]]:
+    """Preprocess a corpus in parallel across host cores (ctypes releases
+    the GIL, so threads give true parallelism)."""
+    if max_workers is None:
+        max_workers = min(32, os.cpu_count() or 1)
+    with ThreadPoolExecutor(max_workers=max_workers) as pool:
+        return list(
+            pool.map(
+                lambda t: preprocess_document_native(
+                    t,
+                    stop_words=stop_words,
+                    lemmatize=lemmatize,
+                    min_lemma_len_exclusive=min_lemma_len_exclusive,
+                    dedup_within_sentence=dedup_within_sentence,
+                ),
+                texts,
+            )
+        )
+
+
+def stem_native(token: str) -> str:
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native textproc library unavailable")
+    return _take_string(lib, lib.stc_stem(token.encode("utf-8")))
+
+
+def lemma_native(word: str) -> str:
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native textproc library unavailable")
+    return _take_string(lib, lib.stc_lemma(word.encode("utf-8")))

@@ -1,0 +1,157 @@
+"""Parity tests: native C++ preprocessing vs the pure-Python path.
+
+native/textproc.cpp must emit the IDENTICAL token sequence as
+utils/textproc.preprocess_document for any input — the native library is a
+performance backend, not a semantic variant.  Probes each layer (Porter
+stem, rule lemma, full pipeline) and the end-to-end corpus across all 8
+reference languages.
+"""
+
+import os
+
+import pytest
+
+from spark_text_clustering_tpu.utils import textproc
+from spark_text_clustering_tpu.utils.native import (
+    lemma_native,
+    native_available,
+    preprocess_document_native,
+    preprocess_documents,
+    stem_native,
+)
+
+pytestmark = pytest.mark.skipif(
+    not native_available(), reason="native textproc library unavailable"
+)
+
+STEM_WORDS = [
+    # Porter paper examples + ORIGINAL_ALGORITHM edge cases
+    "caresses", "ponies", "ties", "caress", "cats", "feed", "agreed",
+    "plastered", "bled", "motoring", "sing", "conflated", "troubled",
+    "sized", "hopping", "tanned", "falling", "hissing", "fizzed",
+    "failing", "filing", "happy", "sky", "relational", "conditional",
+    "rational", "valenci", "hesitanci", "digitizer", "conformabli",
+    "radicalli", "differentli", "vileli", "analogousli", "vietnamization",
+    "predication", "operator", "feudalism", "decisiveness", "hopefulness",
+    "callousness", "formaliti", "sensitiviti", "sensibiliti", "triplicate",
+    "formative", "formalize", "electriciti", "electrical", "hopeful",
+    "goodness", "revival", "allowance", "inference", "airliner",
+    "gyroscopic", "adjustable", "defensible", "irritant", "replacement",
+    "adjustment", "dependent", "adoption", "homologou", "communism",
+    "activate", "angulariti", "homologous", "effective", "bowdlerize",
+    "probate", "rate", "cease", "controll", "roll",
+    # case-preservation (vocab evidence: "Holm", "veri", "littl")
+    "Holmes", "Watson", "LADIES", "Was", "London", "I", "A",
+    # degenerate
+    "s", "ss", "a", "y", "yyyy", "ing", "ed", "eed",
+]
+
+LEMMA_WORDS = [
+    "was", "Was", "were", "children", "Women", "people", "lives",
+    "running", "making", "stopped", "cried", "ladies", "houses",
+    "churches", "foxes", "buzzes", "glasses", "bus", "analysis",
+    "thing", "sing", "bring", "falling", "fallen", "better", "worst",
+    "eyes", "Eyes", "cats", "miss", "kiss", "this", "его", "дома",
+]
+
+DOCS = [
+    "The Adventures of Sherlock Holmes. By Arthur Conan Doyle! "
+    "Running quickly, the dogs were happier than ever... weren't they?",
+    "Это русский текст про собак и кошек. Говорили они долго — и ушли!",
+    "Qu'est-ce que c'est? C'était magnifique... vraiment élégant.",
+    "Die Kinder spielten fröhlich im Garten; überall blühten Blumen.",
+    "Mixed 123 digits42and/slashes\\plus_underscores here.",
+    "",
+    "   \n\t  ",
+    "One-sentence no punctuation at all just words",
+    "repeat repeat repeat. repeat again repeat.",  # dedup quirk
+    # embedded NUL (stray binary junk with --include-all): everything after
+    # it must still be processed
+    "alpha beta gamma\x00delta epsilon zeta words",
+    # scripts beyond the corpus languages: Hebrew, Arabic, CJK, Hangul
+    "shalom שלום עולם here",
+    "مرحبا بالعالم hello",
+    "你好世界 mixed 漢字 text",
+    "안녕하세요 korean 한글 words",
+    # numeric letters (Nl — roman numerals) match [^\W\d_] in Python
+    "Chapter Ⅶ begins",
+]
+
+
+class TestPorterParity:
+    def test_stems_match_python(self):
+        for w in STEM_WORDS:
+            assert stem_native(w) == textproc.stem(w), w
+
+    def test_reference_vocab_spot_stems(self):
+        # stems frozen in the reference's saved vocabulary
+        # (models/vocabularies/LdaModel_EN_*: "come,know,make,upon,veri,...")
+        assert stem_native("very") == "veri"
+        assert stem_native("little") == "littl"
+        assert stem_native("Holmes") == "Holm"
+
+
+class TestLemmaParity:
+    def test_lemmas_match_python(self):
+        for w in LEMMA_WORDS:
+            assert lemma_native(w) == textproc.lemma(w), w
+
+
+class TestPipelineParity:
+    @pytest.mark.parametrize("lemmatize", [True, False])
+    @pytest.mark.parametrize("dedup", [True, False])
+    def test_docs_match_python(self, lemmatize, dedup):
+        sw = frozenset({"the", "and", "of", "und"})
+        for d in DOCS:
+            py = textproc.preprocess_document(
+                d, stop_words=sw, lemmatize=lemmatize,
+                dedup_within_sentence=dedup,
+            )
+            na = preprocess_document_native(
+                d, stop_words=sw, lemmatize=lemmatize,
+                dedup_within_sentence=dedup,
+            )
+            assert py == na, (d, py[:10], na[:10])
+
+    def test_batch_matches_sequential(self):
+        rs = preprocess_documents(DOCS)
+        for d, r in zip(DOCS, rs):
+            assert r == preprocess_document_native(d)
+
+
+class TestCorpusParity:
+    def test_all_languages(self, reference_resources):
+        """First 40 KB of one book per language: byte-identical tokens."""
+        books = os.path.join(reference_resources, "books")
+        langs = sorted(os.listdir(books))
+        assert len(langs) == 8
+        for lang in langs:
+            d = os.path.join(books, lang)
+            names = sorted(
+                f for f in os.listdir(d)
+                if f.endswith(".txt")
+                and os.path.isfile(os.path.join(d, f))
+            )
+            text = open(
+                os.path.join(d, names[0]), encoding="utf-8", errors="replace"
+            ).read()[:40_000]
+            py = textproc.preprocess_document(text)
+            na = preprocess_document_native(text)
+            assert py == na, f"{lang}/{names[0]}: diverged"
+
+
+class TestPipelineIntegration:
+    def test_text_preprocessor_backends_agree(self):
+        from spark_text_clustering_tpu.pipeline import TextPreprocessor
+
+        ds = {"texts": DOCS}
+        py = TextPreprocessor(backend="python").transform(ds)["tokens"]
+        na = TextPreprocessor(backend="native").transform(ds)["tokens"]
+        auto = TextPreprocessor(backend="auto").transform(ds)["tokens"]
+        assert py == na == auto
+
+    def test_unknown_backend_rejected(self):
+        from spark_text_clustering_tpu.pipeline import TextPreprocessor
+
+        with pytest.raises(ValueError):
+            TextPreprocessor(backend="gpu")

@@ -14,6 +14,17 @@ from spark_text_clustering_tpu.ops.sparse import batch_from_rows
 from spark_text_clustering_tpu.parallel.mesh import make_mesh
 
 
+def _mesh1():
+    """A 1x1 mesh pinned to one CPU device: jax.devices() varies by
+    platform (1 axon TPU normally, 8 virtual CPUs under the escape hatch),
+    so single-device tests must pin explicitly."""
+    import jax
+
+    return make_mesh(
+        data_shards=1, model_shards=1, devices=jax.devices("cpu")[:1]
+    )
+
+
 def _dense(rows, v):
     x = np.zeros((len(rows), v), np.float32)
     for d, (ids, wts) in enumerate(rows):
@@ -36,7 +47,7 @@ def test_loss_decreases(tiny_corpus_rows):
     for iters in (1, 5, 25):
         opt = NMF(
             Params(k=4, max_iterations=iters, seed=0),
-            mesh=make_mesh(data_shards=1, model_shards=1),
+            mesh=_mesh1(),
         )
         opt.fit(rows, vocab)
         losses.append(opt.last_loss)
@@ -46,7 +57,7 @@ def test_loss_decreases(tiny_corpus_rows):
 def test_matches_dense_numpy_reference(tiny_corpus_rows):
     rows, vocab = tiny_corpus_rows
     v, k, iters = len(vocab), 4, 15
-    mesh = make_mesh(data_shards=1, model_shards=1)
+    mesh = _mesh1()
     opt = NMF(Params(k=k, max_iterations=iters, seed=3), mesh=mesh)
     model = opt.fit(rows, vocab)
 
@@ -80,7 +91,7 @@ def test_mesh_invariance(tiny_corpus_rows, eight_devices):
     and the learned topic structure."""
     rows, vocab = tiny_corpus_rows
     p = Params(k=2, max_iterations=60, seed=1)
-    single = NMF(p, mesh=make_mesh(data_shards=1, model_shards=1)).fit(
+    single = NMF(p, mesh=_mesh1()).fit(
         rows, vocab
     )
     sharded = NMF(
@@ -101,7 +112,7 @@ def test_transform_reconstructs(tiny_corpus_rows):
     rows, vocab = tiny_corpus_rows
     opt = NMF(
         Params(k=4, max_iterations=60, seed=0),
-        mesh=make_mesh(data_shards=1, model_shards=1),
+        mesh=_mesh1(),
     )
     model = opt.fit(rows, vocab)
     w = model.transform(rows)
@@ -122,7 +133,7 @@ def test_topic_distribution_and_describe(tiny_corpus_rows):
     rows, vocab = tiny_corpus_rows
     model = NMF(
         Params(k=2, max_iterations=60, seed=0),
-        mesh=make_mesh(data_shards=1, model_shards=1),
+        mesh=_mesh1(),
     ).fit(rows, vocab)
 
     # The synthetic corpus has two disjoint topic blocks (terms 0-24 vs
@@ -146,7 +157,7 @@ def test_empty_doc_gets_uniform(tiny_corpus_rows):
     rows, vocab = tiny_corpus_rows
     model = NMF(
         Params(k=3, max_iterations=20, seed=0),
-        mesh=make_mesh(data_shards=1, model_shards=1),
+        mesh=_mesh1(),
     ).fit(rows, vocab)
     empty = (np.zeros(0, np.int32), np.zeros(0, np.float32))
     dist = model.topic_distribution([rows[0], empty])
@@ -157,7 +168,7 @@ def test_save_load_roundtrip(tiny_corpus_rows, tmp_path):
     rows, vocab = tiny_corpus_rows
     model = NMF(
         Params(k=3, max_iterations=10, seed=0),
-        mesh=make_mesh(data_shards=1, model_shards=1),
+        mesh=_mesh1(),
     ).fit(rows, vocab)
     path = str(tmp_path / "nmf_model")
     model.save(path)
@@ -180,7 +191,7 @@ def test_pipeline_estimator_swap(tiny_corpus_rows):
     ds = {"rows": rows, "vocab": vocab}
     t = NMFEstimator(
         Params(k=2, max_iterations=30, seed=0),
-        mesh=make_mesh(data_shards=1, model_shards=1),
+        mesh=_mesh1(),
     ).fit(ds)
     out = t.transform(ds)
     assert isinstance(out["model"], NMFModel)

@@ -396,20 +396,41 @@ class TestStreamingOnlineLDA:
             )
 
     def test_source_state_survives_restart(self, tmp_path):
-        """FileStreamSource with a state_path must not re-emit files already
-        consumed by a previous process (resume would double-train them)."""
+        """Committed files must not re-emit after a restart; UNcommitted
+        files (consumed after the last commit — i.e. not yet covered by a
+        model checkpoint) MUST re-emit, or a crash would drop them from
+        training forever."""
         d = tmp_path / "in"
         d.mkdir()
         state = str(tmp_path / "seen.txt")
         (d / "a.txt").write_text("first wave")
         src1 = FileStreamSource(str(d), state_path=state)
         assert len(src1.poll()) == 1
+        src1.commit()
+        (d / "lost.txt").write_text("consumed but never committed")
+        assert len(src1.poll()) == 1  # consumed, NOT committed ("crash")
 
         (d / "b.txt").write_text("second wave")
         src2 = FileStreamSource(str(d), state_path=state)  # "restart"
         mb = src2.poll()
-        assert [os.path.basename(n) for n in mb.names] == ["b.txt"]
+        assert [os.path.basename(n) for n in mb.names] == [
+            "b.txt",
+            "lost.txt",
+        ] or [os.path.basename(n) for n in mb.names] == [
+            "lost.txt",
+            "b.txt",
+        ]
         assert src2.poll() is None
+
+    def test_scorer_keep_results_false_caps_memory(self):
+        model = _toy_model()
+        scorer = StreamingScorer(
+            model, batch_capacity=4, keep_results=False
+        )
+        out = scorer.process(_mb(TOPIC_A_DOCS + TOPIC_B_DOCS))
+        assert len(out) == 8            # per-trigger results still returned
+        assert scorer.results == []     # nothing retained
+        assert scorer.tallies.sum() == 8
 
     def test_cli_stream_score_and_train(self, tmp_path):
         """End-to-end smoke: stream-train on a watched dir, then
