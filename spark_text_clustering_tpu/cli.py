@@ -90,10 +90,15 @@ def cmd_train(args: argparse.Namespace) -> int:
                           idf_floor=params.idf_floor))
     stages.append(LDA(params))
 
-    with timer.phase("preprocess+vectorize+train"):
-        fitted = Pipeline(stages).fit(
-            {"texts": texts}
-        )
+    from .utils.profiling import MetricsLogger, trace
+
+    metrics = MetricsLogger(args.metrics_file)
+    metrics.log("corpus", documents=len(texts), books_dir=args.books)
+    with trace(args.profile_dir):
+        with timer.phase("preprocess+vectorize+train"):
+            fitted = Pipeline(stages).fit(
+                {"texts": texts}
+            )
 
     lda_stage = fitted.stages[-1]
     model: LDAModel = lda_stage.model
@@ -124,6 +129,16 @@ def cmd_train(args: argparse.Namespace) -> int:
     out_dir = model_dir_name(args.lang, base=args.models_dir)
     model.save(out_dir)
     print(f"model saved to {out_dir}")
+
+    metrics.log_phases(timer.phases)
+    metrics.log_iteration_times(model.iteration_times)
+    metrics.log(
+        "model_saved",
+        path=out_dir,
+        k=model.k,
+        vocab_size=model.vocab_size,
+        algorithm=params.algorithm,
+    )
     return 0
 
 
@@ -310,6 +325,12 @@ def build_parser() -> argparse.ArgumentParser:
     tr.add_argument("--data-shards", type=int, default=None)
     tr.add_argument("--model-shards", type=int, default=1)
     tr.add_argument("--models-dir", default="models")
+    tr.add_argument("--profile-dir", default=None,
+                    help="capture a jax.profiler device trace here "
+                         "(view with TensorBoard/xprof)")
+    tr.add_argument("--metrics-file", default=None,
+                    help="append structured JSONL metrics (phases, "
+                         "per-iteration times) to this file")
     tr.add_argument("--no-tfidf", action="store_true",
                     help="train on raw counts instead of TF-IDF pseudo-counts")
     tr.add_argument("--no-lemmatize", action="store_true")

@@ -82,6 +82,40 @@ class EStepResult(NamedTuple):
     gamma: jnp.ndarray        # [B, k] variational doc-topic posteriors
     sstats: jnp.ndarray       # [k, V] raw sufficient stats (NOT yet * expElogbeta)
     iters: jnp.ndarray        # scalar int32 — inner iterations actually run
+    #                           (-1 under the pallas backend: each tile
+    #                           converges independently, no single count)
+
+
+def _resolve_gamma_backend(backend: str) -> str:
+    """"auto" resolves via STC_GAMMA_BACKEND (default "xla"): the Pallas
+    kernel (VMEM-resident inner loop, ops/pallas_estep.py) is opt-in until
+    profiled faster than XLA's lowering on the target TPU generation —
+    flipping a whole deployment's hot path on an unprofiled kernel is how
+    regressions ship.  Set STC_GAMMA_BACKEND=pallas to opt in globally, or
+    pass backend="pallas" per call."""
+    if backend == "auto":
+        import os
+
+        backend = os.environ.get("STC_GAMMA_BACKEND", "xla")
+    if backend not in ("xla", "pallas"):
+        raise ValueError(f"unknown gamma backend {backend!r}")
+    return backend
+
+
+def _run_gamma_fixed_point(
+    eb, cts, alpha, gamma0, max_inner, tol, backend: str
+):
+    """Dispatch the gamma loop to XLA or the Pallas kernel."""
+    if _resolve_gamma_backend(backend) == "pallas":
+        from .pallas_estep import gamma_fixed_point_pallas
+
+        gamma = gamma_fixed_point_pallas(
+            eb, cts, alpha, gamma0, max_inner=max_inner, tol=tol,
+            # forced-pallas on CPU (tests) runs the same kernel interpreted
+            interpret=jax.default_backend() != "tpu",
+        )
+        return gamma, jnp.int32(-1)  # per-tile loop: no single iter count
+    return _gamma_fixed_point(eb, cts, alpha, gamma0, max_inner, tol)
 
 
 def _gamma_fixed_point(
@@ -116,7 +150,7 @@ def _gamma_fixed_point(
     return gamma, iters
 
 
-@partial(jax.jit, static_argnames=("max_inner", "vocab_size"))
+@partial(jax.jit, static_argnames=("max_inner", "vocab_size", "backend"))
 def e_step(
     batch: DocTermBatch,
     exp_elog_beta: jnp.ndarray,   # [k, V]
@@ -125,13 +159,16 @@ def e_step(
     vocab_size: int,
     max_inner: int = 100,
     tol: float = 1e-3,
+    backend: str = "auto",
 ) -> EStepResult:
     """Batched per-document variational E-step: gamma fixed point plus the
     sufficient-statistics scatter-add (SURVEY.md §3.3)."""
     ids, cts = batch.token_ids, batch.token_weights           # [B, L]
     # Hoisted gather: per-doc slice of exp(E[log beta]) — [B, L, k].
     eb = jnp.moveaxis(exp_elog_beta, 0, -1)[ids]              # [B, L, k]
-    gamma, iters = _gamma_fixed_point(eb, cts, alpha, gamma0, max_inner, tol)
+    gamma, iters = _run_gamma_fixed_point(
+        eb, cts, alpha, gamma0, max_inner, tol, backend
+    )
 
     # Final responsibilities -> sufficient statistics in ONE scatter-add.
     exp_etheta = jnp.exp(dirichlet_expectation(gamma))         # [B, k]
@@ -146,7 +183,7 @@ def e_step(
     return EStepResult(gamma, sstats_vt.T, iters)
 
 
-@partial(jax.jit, static_argnames=("max_inner",))
+@partial(jax.jit, static_argnames=("max_inner", "backend"))
 def infer_gamma(
     batch: DocTermBatch,
     exp_elog_beta: jnp.ndarray,
@@ -154,17 +191,18 @@ def infer_gamma(
     gamma0: jnp.ndarray,
     max_inner: int = 100,
     tol: float = 1e-3,
+    backend: str = "auto",
 ) -> jnp.ndarray:
     """Gamma-only inference (no sufficient statistics) — the cheap path for
     scoring and ELBO evaluation."""
     eb = jnp.moveaxis(exp_elog_beta, 0, -1)[batch.token_ids]
-    gamma, _ = _gamma_fixed_point(
-        eb, batch.token_weights, alpha, gamma0, max_inner, tol
+    gamma, _ = _run_gamma_fixed_point(
+        eb, batch.token_weights, alpha, gamma0, max_inner, tol, backend
     )
     return gamma
 
 
-@partial(jax.jit, static_argnames=("max_inner",))
+@partial(jax.jit, static_argnames=("max_inner", "backend"))
 def topic_inference(
     batch: DocTermBatch,
     exp_elog_beta: jnp.ndarray,
@@ -172,13 +210,16 @@ def topic_inference(
     gamma0: jnp.ndarray,
     max_inner: int = 100,
     tol: float = 1e-3,
+    backend: str = "auto",
 ) -> jnp.ndarray:
     """``LocalLDAModel.topicDistribution`` equivalent (LDALoader.scala:108):
     E-step with fixed topics, returns normalized gamma [B, k].  Empty docs
     (all-zero weights) get the uniform distribution, matching MLlib."""
     cts = batch.token_weights
     eb = jnp.moveaxis(exp_elog_beta, 0, -1)[batch.token_ids]
-    gamma, _ = _gamma_fixed_point(eb, cts, alpha, gamma0, max_inner, tol)
+    gamma, _ = _run_gamma_fixed_point(
+        eb, cts, alpha, gamma0, max_inner, tol, backend
+    )
     nonempty = cts.sum(axis=-1, keepdims=True) > 0
     k = gamma.shape[-1]
     dist = gamma / gamma.sum(axis=-1, keepdims=True)

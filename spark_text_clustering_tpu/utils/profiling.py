@@ -1,0 +1,99 @@
+"""Tracing, profiling, and structured metrics.
+
+The reference's only observability is ``System.nanoTime`` prints and
+MLlib's ``iterationTimes`` metadata (SURVEY.md §5 "Tracing / profiling",
+"Metrics / logging / observability": no structured logging, no metrics
+sink).  This module supplies the layer it lacks, TPU-style:
+
+  * ``trace(log_dir)``      — ``jax.profiler`` device trace (XLA ops, HBM,
+                              fusion view in TensorBoard/xprof) around any
+                              region; no-op fallback when the profiler is
+                              unavailable on a backend.
+  * ``annotate(name)``      — named sub-spans inside a trace (shows up on
+                              the xprof timeline like a Spark stage name).
+  * ``MetricsLogger``       — append-only JSONL metrics sink: phase wall
+                              times, per-iteration times, corpus stats —
+                              the machine-readable twin of the reference's
+                              ~80 println call sites (LDAClustering.scala:
+                              28-34,60-92), persisted alongside the model
+                              like ``iterationTimes``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from contextlib import contextmanager
+from typing import Dict, Optional
+
+__all__ = ["trace", "annotate", "MetricsLogger"]
+
+
+@contextmanager
+def trace(log_dir: Optional[str]):
+    """Capture a jax.profiler device trace into ``log_dir`` (view with
+    TensorBoard's profile plugin / xprof).  ``None`` disables tracing so
+    call sites can pass a CLI flag straight through."""
+    if not log_dir:
+        yield
+        return
+    import jax
+
+    os.makedirs(log_dir, exist_ok=True)
+    try:
+        jax.profiler.start_trace(log_dir)
+    except Exception:          # profiler unavailable on this backend
+        yield
+        return
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+@contextmanager
+def annotate(name: str):
+    """Named span on the profiler timeline (and a cheap no-op outside an
+    active trace)."""
+    import jax
+
+    with jax.profiler.TraceAnnotation(name):
+        yield
+
+
+class MetricsLogger:
+    """Append-only JSONL metrics sink.
+
+    Every record carries a wall-clock timestamp and an event name:
+
+        {"ts": 1700000000.123, "event": "train_iteration",
+         "iteration": 3, "seconds": 0.21}
+
+    ``path=None`` silently drops records, so instrumented code never has to
+    guard on whether metrics were requested.
+    """
+
+    def __init__(self, path: Optional[str]) -> None:
+        self.path = path
+        if path:
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+            # truncate: one run, one metrics file
+            with open(path, "w", encoding="utf-8"):
+                pass
+
+    def log(self, event: str, **fields) -> None:
+        if not self.path:
+            return
+        rec: Dict = {"ts": time.time(), "event": event}
+        rec.update(fields)
+        with open(self.path, "a", encoding="utf-8") as f:
+            f.write(json.dumps(rec) + "\n")
+
+    def log_phases(self, phases: Dict[str, float]) -> None:
+        for name, seconds in phases.items():
+            self.log("phase", name=name, seconds=round(seconds, 6))
+
+    def log_iteration_times(self, times) -> None:
+        for i, s in enumerate(times):
+            self.log("train_iteration", iteration=i, seconds=round(s, 6))

@@ -1,0 +1,95 @@
+"""Profiling/metrics subsystem (utils/profiling.py) — the observability
+layer the reference lacks (SURVEY.md §5: println-only, no metrics sink)."""
+
+import json
+import os
+
+import numpy as np
+
+from spark_text_clustering_tpu.utils.profiling import (
+    MetricsLogger,
+    annotate,
+    trace,
+)
+
+
+class TestMetricsLogger:
+    def test_none_path_is_silent_noop(self):
+        m = MetricsLogger(None)
+        m.log("anything", x=1)
+        m.log_phases({"a": 1.0})
+        m.log_iteration_times([0.1, 0.2])  # must not raise
+
+    def test_jsonl_records(self, tmp_path):
+        p = str(tmp_path / "m.jsonl")
+        m = MetricsLogger(p)
+        m.log("corpus", documents=51)
+        m.log_phases({"read": 0.5, "train": 2.0})
+        m.log_iteration_times([0.1, 0.2, 0.3])
+        recs = [json.loads(line) for line in open(p)]
+        assert [r["event"] for r in recs] == [
+            "corpus", "phase", "phase",
+            "train_iteration", "train_iteration", "train_iteration",
+        ]
+        assert recs[0]["documents"] == 51
+        assert all("ts" in r for r in recs)
+        assert recs[3]["iteration"] == 0 and recs[3]["seconds"] == 0.1
+
+    def test_truncates_previous_run(self, tmp_path):
+        p = str(tmp_path / "m.jsonl")
+        MetricsLogger(p).log("old")
+        m2 = MetricsLogger(p)
+        m2.log("new")
+        recs = [json.loads(line) for line in open(p)]
+        assert [r["event"] for r in recs] == ["new"]
+
+
+class TestTrace:
+    def test_none_dir_noop(self):
+        with trace(None):
+            pass
+
+    def test_trace_captures(self, tmp_path):
+        import jax.numpy as jnp
+
+        d = str(tmp_path / "prof")
+        with trace(d):
+            with annotate("matmul"):
+                (jnp.ones((64, 64)) @ jnp.ones((64, 64))).block_until_ready()
+        # the profiler writes a plugins/profile/<run> tree when available
+        if os.path.isdir(d):
+            assert any(os.scandir(d))
+
+
+class TestCliIntegration:
+    def test_train_writes_metrics(self, tmp_path):
+        from spark_text_clustering_tpu.cli import main
+
+        books = tmp_path / "books"
+        books.mkdir()
+        texts = [
+            "piano violin orchestra symphony melody harmony rhythm",
+            "electron proton quantum particle physics energy atom",
+            "violin cello symphony opera melody chord orchestra",
+            "neutron fission atom reactor physics energy proton",
+        ]
+        for i, t in enumerate(texts):
+            (books / f"b{i}.txt").write_text(t * 5)
+        mf = str(tmp_path / "metrics.jsonl")
+        rc = main([
+            "train", "--books", str(books), "--k", "2",
+            "--max-iterations", "3", "--algorithm", "online",
+            "--no-lemmatize", "--models-dir", str(tmp_path / "models"),
+            "--metrics-file", mf,
+        ])
+        assert rc == 0
+        events = [json.loads(line)["event"] for line in open(mf)]
+        assert "corpus" in events
+        assert events.count("train_iteration") == 3
+        assert "model_saved" in events
+        phases = [
+            json.loads(line) for line in open(mf)
+            if json.loads(line)["event"] == "phase"
+        ]
+        assert any(p["name"] == "preprocess+vectorize+train" for p in phases)
+        assert all(np.isfinite(p["seconds"]) for p in phases)
