@@ -1,26 +1,37 @@
-"""Benchmark: EM LDA iteration time on the reference's own workload.
+"""Benchmark: EM LDA iteration time on the reference's own workload, plus
+the online-VB (north-star) docs/sec + log-perplexity bench.
 
-Reproduces the reference's headline measurable (BASELINE.md): mean
-wall-seconds per EM iteration training k=5 LDA on the 51 English books with
-a TF-IDF corpus (V capped like the reference run at ~39k terms).  The
-baseline is 0.817 s/iter — the ``iterationTimes`` frozen in
-``models/LdaModel_EN_1591049082850/metadata`` (Spark local[*], 12 GB).
+Headline metric reproduces the reference's only measurable (BASELINE.md):
+mean wall-seconds per EM iteration training k=5 LDA on the 51 English books
+with a TF-IDF corpus.  The baseline is 0.817 s/iter — the ``iterationTimes``
+frozen in ``models/LdaModel_EN_1591049082850/metadata`` (Spark local[*]).
+The secondary block benches the BASELINE.md row-1 config: online VB on a 20
+Newsgroups-shaped corpus (11,314 docs, k=20, HashingTF-width 2^18 vocab),
+reporting docs/sec and final log-perplexity.
 
 Prints ONE JSON line:
   {"metric": ..., "value": <s/iter>, "unit": "s/iter",
-   "vs_baseline": <baseline / ours, i.e. x-times-faster>}
+   "vs_baseline": <baseline / ours>, "platform": ..., "online": {...}}
+
+Robustness (round-1 post-mortem): the sandbox's TPU bring-up can hang or
+fail at interpreter startup, which in round 1 cost the whole artifact
+(BENCH_r01 rc=1).  This script therefore runs as a PARENT that never
+imports jax: it probes the TPU in a throwaway subprocess with retries and
+bounded backoff, runs the actual bench in a child under whichever platform
+came up, and — if the chip never appears — falls back to the virtual CPU
+platform so a parsable JSON record is always produced.
 
 Preprocessing (host CPU) is excluded from the timed region, matching the
 reference's iterationTimes semantics (MLlib times only lda.run iterations).
 Preprocessed rows are cached under .bench_cache/ so reruns time only the
-TPU loop.  Falls back to a synthetic corpus of the same shape if the
-reference corpus is unavailable.
+accelerator loop.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import subprocess
 import sys
 import time
 
@@ -28,11 +39,123 @@ import numpy as np
 
 BASELINE_S_PER_ITER = 0.817  # BASELINE.md: EM EN, 50 iters, Spark local[*]
 REFERENCE_RESOURCES = "/root/reference/TextClustering/src/main/resources"
-CACHE = os.path.join(os.path.dirname(os.path.abspath(__file__)), ".bench_cache")
+REPO_DIR = os.path.dirname(os.path.abspath(__file__))
+CACHE = os.path.join(REPO_DIR, ".bench_cache")
 K = 5
 VOCAB_SIZE = 39_380  # match the reference EN model's vocabSize
 ITERS = 50
 
+# BASELINE.md row 1 shape: 20 Newsgroups, k=20, HashingTF -> IDF -> LDA.
+# The corpus itself is not redistributable in this image, so the bench uses
+# a synthetic corpus of identical shape (doc count, hash width, Zipf terms).
+ONLINE_N_DOCS = 11_314
+ONLINE_K = 20
+ONLINE_NUM_FEATURES = 1 << 18
+ONLINE_ITERS = 50
+
+
+# =====================================================================
+# Parent: platform probing + child supervision (no jax import here).
+# =====================================================================
+
+from spark_text_clustering_tpu.utils.env import scrubbed_cpu_env
+
+
+def _probe_tpu(attempts: int = 3, probe_timeout: int = 90) -> bool:
+    """Can a fresh interpreter bring up an ACCELERATOR backend under the
+    CURRENT env?  jax silently falling back to CPU must not count.  Retries
+    with bounded backoff — round-1 showed one-shot init can fail
+    transiently (UNAVAILABLE) or hang outright."""
+    code = (
+        "import jax; assert len(jax.devices()) >= 1; "
+        "b = jax.default_backend(); assert b != 'cpu', b; print('ok', b)"
+    )
+    backoff = [0, 10, 30]
+    for i in range(attempts):
+        if backoff[min(i, len(backoff) - 1)]:
+            time.sleep(backoff[min(i, len(backoff) - 1)])
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c", code],
+                capture_output=True,
+                text=True,
+                timeout=probe_timeout,
+            )
+            if r.returncode == 0 and "ok" in r.stdout:
+                return True
+            sys.stderr.write(
+                f"# tpu probe attempt {i + 1}/{attempts} rc={r.returncode}: "
+                f"{r.stderr.strip().splitlines()[-1] if r.stderr.strip() else ''}\n"
+            )
+        except subprocess.TimeoutExpired:
+            sys.stderr.write(
+                f"# tpu probe attempt {i + 1}/{attempts} timed out "
+                f"({probe_timeout}s)\n"
+            )
+    return False
+
+
+def _run_child(env: dict, timeout: int = 2400):
+    """Run the bench child; return the parsed JSON record or None."""
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--child"],
+            capture_output=True,
+            text=True,
+            timeout=timeout,
+            env=env,
+            cwd=REPO_DIR,
+        )
+    except subprocess.TimeoutExpired:
+        sys.stderr.write(f"# bench child timed out ({timeout}s)\n")
+        return None
+    sys.stderr.write(r.stderr[-4000:])
+    if r.returncode != 0:
+        sys.stderr.write(f"# bench child rc={r.returncode}\n")
+        return None
+    for line in r.stdout.splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                continue
+    sys.stderr.write("# bench child produced no JSON line\n")
+    return None
+
+
+def main() -> None:
+    on_tpu = _probe_tpu()
+    record = None
+    if on_tpu:
+        record = _run_child(dict(os.environ))
+    if record is None:
+        # Chip never appeared (or the TPU child died): CPU fallback still
+        # yields an honest measurement against the Spark-CPU baseline.
+        # The child self-reports its actual backend in record["platform"].
+        record = _run_child(scrubbed_cpu_env())
+        if record is not None:
+            record["platform_fallback"] = True
+    if record is None:
+        print(
+            json.dumps(
+                {
+                    "metric": "em_lda_s_per_iter_en_books_k5",
+                    "value": None,
+                    "unit": "s/iter",
+                    "vs_baseline": 0.0,
+                    "error": "bench child failed on both tpu and cpu",
+                }
+            )
+        )
+        sys.exit(1)
+    print(json.dumps(record))
+
+
+# =====================================================================
+# Child: the actual measurements (safe to import jax here — the parent
+# only launches us under a platform that proved reachable).
+# =====================================================================
 
 def _load_rows():
     """TF-IDF rows for books/English — cached after first run."""
@@ -90,13 +213,26 @@ def _load_rows():
     return rows, len(vocab)
 
 
-def main() -> None:
-    import jax
+def _synthetic_20ng_rows(rng: np.random.Generator):
+    """20NG-shaped corpus: 11,314 docs, Zipf-distributed hashed term ids,
+    ~110 distinct terms per doc (the post-stopword 20NG profile)."""
+    rows = []
+    # Zipf over the hash space: draw ranks, map through a fixed permutation
+    # so hot terms are spread across the id range like murmur3 would.
+    perm = rng.permutation(ONLINE_NUM_FEATURES)
+    for _ in range(ONLINE_N_DOCS):
+        nnz = max(4, int(rng.lognormal(mean=4.4, sigma=0.8)))
+        nnz = min(nnz, 2048)
+        ranks = rng.zipf(1.3, size=nnz * 2) - 1
+        ranks = ranks[ranks < ONLINE_NUM_FEATURES][:nnz]
+        ids = np.unique(perm[ranks]).astype(np.int32)
+        cts = rng.integers(1, 6, size=ids.size).astype(np.float32)
+        rows.append((ids, cts))
+    return rows
 
-    # Persistent XLA compile cache: repeat bench runs skip the 20-40s compile.
-    jax.config.update(
-        "jax_compilation_cache_dir", os.path.join(CACHE, "xla_cache")
-    )
+
+def _bench_em():
+    import jax
 
     from spark_text_clustering_tpu.config import Params
     from spark_text_clustering_tpu.models.em_lda import EMLDA
@@ -117,6 +253,95 @@ def main() -> None:
     model = opt.fit(rows, vocab)
     total = time.perf_counter() - t0
     s_per_iter = float(np.mean(model.iteration_times))
+    sys.stderr.write(
+        f"# EM: {len(rows)} docs, V={vocab_len}, k={K}, {ITERS} iters, "
+        f"total {total:.1f}s, logLik {opt.last_log_likelihood:.1f}, "
+        f"baseline {BASELINE_S_PER_ITER}s/iter (Spark local[*])\n"
+    )
+    return s_per_iter
+
+
+def _bench_online():
+    """BASELINE.md row-1 shape: online VB docs/sec + final log-perplexity."""
+    import jax
+    import jax.numpy as jnp
+
+    from spark_text_clustering_tpu.config import Params
+    from spark_text_clustering_tpu.models.online_lda import OnlineLDA
+    from spark_text_clustering_tpu.ops.lda_math import (
+        approx_bound,
+        dirichlet_expectation,
+        infer_gamma,
+        init_gamma,
+    )
+    from spark_text_clustering_tpu.ops.sparse import batch_from_rows
+    from spark_text_clustering_tpu.parallel import make_mesh
+
+    rng = np.random.default_rng(20)
+    rows = _synthetic_20ng_rows(rng)
+    mesh = make_mesh(data_shards=len(jax.devices()), model_shards=1)
+    params = Params(
+        k=ONLINE_K,
+        algorithm="online",
+        max_iterations=ONLINE_ITERS,
+        seed=0,
+    )
+    opt = OnlineLDA(params, mesh=mesh)
+    vocab = [f"h{i}" for i in range(ONLINE_NUM_FEATURES)]
+
+    # Warmup one iteration ON THE SAME INSTANCE (shares the cached jitted
+    # step_fn, so the timed run hits the compile cache), then the timed run.
+    opt.fit(rows, vocab, max_iterations=1)
+
+    t0 = time.perf_counter()
+    model = opt.fit(rows, vocab)
+    total = time.perf_counter() - t0
+    bsz = opt.last_batch_size  # effective size incl. the data-shard round-up
+    docs_per_sec = ONLINE_ITERS * bsz / total
+
+    # Log-perplexity (MLlib ``logPerplexity`` semantics: -bound / token
+    # count) on a fixed 512-doc evaluation batch.
+    eval_rows = rows[:512]
+    batch = batch_from_rows(eval_rows)
+    lam = jnp.asarray(model.lam)
+    alpha = jnp.asarray(model.alpha)
+    eb = jnp.exp(dirichlet_expectation(lam))
+    gamma = infer_gamma(
+        batch, eb, alpha, init_gamma(None, batch.num_docs, ONLINE_K)
+    )
+    n_tokens = float(np.asarray(batch.token_weights).sum())
+    bound = float(
+        approx_bound(
+            batch, gamma, lam, alpha, model.eta,
+            corpus_size=len(eval_rows), batch_docs=len(eval_rows),
+        )
+    )
+    log_perplexity = -bound / max(n_tokens, 1.0)
+    sys.stderr.write(
+        f"# online: {len(rows)} docs, V={ONLINE_NUM_FEATURES}, k={ONLINE_K}, "
+        f"{ONLINE_ITERS} iters x {bsz} docs/batch, total {total:.1f}s, "
+        f"{docs_per_sec:.0f} docs/s, logPerp {log_perplexity:.3f}\n"
+    )
+    return docs_per_sec, log_perplexity, bsz
+
+
+def child_main() -> None:
+    import jax
+
+    # Persistent XLA compile cache: repeat bench runs skip the 20-40s compile.
+    # Keyed by backend + host so an AOT result built on another machine (or
+    # for another platform) can never be loaded here (SIGILL hazard).
+    import platform
+
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        os.path.join(
+            CACHE, f"xla_cache_{jax.default_backend()}_{platform.node()}"
+        ),
+    )
+
+    s_per_iter = _bench_em()
+    docs_per_sec, log_perp, bsz = _bench_online()
 
     print(
         json.dumps(
@@ -125,16 +350,23 @@ def main() -> None:
                 "value": round(s_per_iter, 6),
                 "unit": "s/iter",
                 "vs_baseline": round(BASELINE_S_PER_ITER / s_per_iter, 2),
+                "platform": jax.default_backend(),
+                "online": {
+                    "corpus": "20ng-shaped-synthetic",
+                    "n_docs": ONLINE_N_DOCS,
+                    "k": ONLINE_K,
+                    "num_features": ONLINE_NUM_FEATURES,
+                    "batch_size": bsz,
+                    "docs_per_sec": round(docs_per_sec, 1),
+                    "log_perplexity": round(log_perp, 4),
+                },
             }
         )
-    )
-    print(
-        f"# {len(rows)} docs, V={vocab_len}, k={K}, {ITERS} iters, "
-        f"total {total:.1f}s, logLik {opt.last_log_likelihood:.1f}, "
-        f"baseline {BASELINE_S_PER_ITER}s/iter (Spark local[*])",
-        file=sys.stderr,
     )
 
 
 if __name__ == "__main__":
-    main()
+    if "--child" in sys.argv:
+        child_main()
+    else:
+        main()

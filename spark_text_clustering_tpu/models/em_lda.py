@@ -40,10 +40,11 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..config import Params
 from ..ops.sparse import DocTermBatch, batch_from_rows
 from ..parallel.collectives import (
-    all_gather_model,
     data_shard_batch,
+    gather_model_rows,
+    model_row_sum,
     psum_data,
-    scatter_model,
+    scatter_add_model_shard,
 )
 from ..parallel.mesh import DATA_AXIS, MODEL_AXIS, make_mesh, model_sharding
 from ..utils.timing import IterationTimer
@@ -69,11 +70,13 @@ def make_em_train_step(
     v = vocab_size
 
     def _step(n_wk_shard, n_dk, step, ids, wts):
-        n_wk = all_gather_model(n_wk_shard, axis=-1)           # [k, V_pad]
-        n_k = n_wk.sum(axis=-1)                                # [k]
+        # Vocab-sharded (SURVEY.md §7 hard part 5): the full [k, V] N_wk
+        # never materializes — per-token rows are combined from the shards
+        # by ONE psum over "model" inside gather_model_rows.
+        n_k = model_row_sum(n_wk_shard)                        # [k]
 
         # MLlib computePTopic: (N_wk + eta - 1)(N_dk + alpha - 1)/(N_k + V*eta - V)
-        term_f = jnp.moveaxis(n_wk, 0, -1)[ids] + (eta - 1.0)  # [B, L, k]
+        term_f = gather_model_rows(n_wk_shard, ids) + (eta - 1.0)  # [B, L, k]
         doc_f = n_dk + (alpha - 1.0)                           # [B, k]
         denom = n_k + (eta * v - v)                            # [k]
         phi = term_f * (doc_f / denom)[:, None, :]             # [B, L, k]
@@ -81,14 +84,11 @@ def make_em_train_step(
         wphi = wts[..., None] * phi                            # [B, L, k]
 
         n_dk_new = wphi.sum(axis=1)                            # [B, k]
-        k = n_dk.shape[-1]
-        n_wk_new = (
-            jnp.zeros((n_wk.shape[-1], k), jnp.float32)
-            .at[ids.reshape(-1)]
-            .add(wphi.reshape(-1, k))
-        ).T                                                    # [k, V_pad]
+        n_wk_new = scatter_add_model_shard(
+            ids, wphi, n_wk_shard.shape[-1]
+        )                                                      # [k, V_pad/s]
         n_wk_new = psum_data(n_wk_new)                         # graph shuffle -> psum
-        return scatter_model(n_wk_new, axis=-1), n_dk_new, step + 1
+        return n_wk_new, n_dk_new, step + 1
 
     sharded = jax.shard_map(
         _step,
@@ -193,13 +193,13 @@ class EMLDA:
             )(keys)
             wphi0 = wts[..., None] * phi0
             n_dk = wphi0.sum(axis=1)
-            n_wk = (
-                jnp.zeros((v_pad, k), jnp.float32)
-                .at[ids.reshape(-1)]
-                .add(wphi0.reshape(-1, k))
-            ).T
+            # Shard-local scatter: init peak memory matches the train step's
+            # [k, V_pad/s], not the full vocab width.
+            n_wk = scatter_add_model_shard(
+                ids, wphi0, v_pad // self.mesh.shape[MODEL_AXIS]
+            )
             n_wk = psum_data(n_wk)
-            return scatter_model(n_wk, axis=-1), n_dk
+            return n_wk, n_dk
 
         return jax.jit(
             jax.shard_map(

@@ -36,6 +36,8 @@ from .sparse import DocTermBatch
 
 __all__ = [
     "dirichlet_expectation",
+    "dirichlet_expectation_sharded",
+    "token_sstats_factors",
     "init_lambda",
     "init_gamma",
     "e_step",
@@ -54,6 +56,31 @@ def dirichlet_expectation(alpha: jnp.ndarray) -> jnp.ndarray:
     """E[log X] for X ~ Dir(alpha), rows are distributions:
     psi(alpha) - psi(sum(alpha, -1))."""
     return digamma(alpha) - digamma(alpha.sum(axis=-1, keepdims=True))
+
+
+def dirichlet_expectation_sharded(
+    shard: jnp.ndarray, row_sum: jnp.ndarray
+) -> jnp.ndarray:
+    """``dirichlet_expectation`` for a vocab-sharded table [k, V/s] whose
+    TRUE row sums [k] were reduced across shards (``model_row_sum``) — the
+    full [k, V] row never exists on any device."""
+    return digamma(shard) - digamma(row_sum)[..., None]
+
+
+def token_sstats_factors(
+    eb_tok: jnp.ndarray,    # [B, L, k] gathered exp(E[log beta]) at tokens
+    cts: jnp.ndarray,       # [B, L]
+    gamma: jnp.ndarray,     # [B, k]
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Final-responsibility factors shared by ``e_step`` and the sharded
+    train steps: returns (exp_etheta [B, k], vals [B, L, k]) where ``vals``
+    scatter-added over token ids gives the raw sufficient statistics.
+    One definition keeps the training hot path and the scoring/eval path
+    numerically identical."""
+    exp_etheta = jnp.exp(dirichlet_expectation(gamma))
+    phinorm = jnp.einsum("blk,bk->bl", eb_tok, exp_etheta) + _PHI_EPS
+    vals = (cts / phinorm)[..., None] * exp_etheta[:, None, :]
+    return exp_etheta, vals
 
 
 def init_lambda(
@@ -171,10 +198,7 @@ def e_step(
     )
 
     # Final responsibilities -> sufficient statistics in ONE scatter-add.
-    exp_etheta = jnp.exp(dirichlet_expectation(gamma))         # [B, k]
-    phinorm = jnp.einsum("blk,bk->bl", eb, exp_etheta) + _PHI_EPS
-    ratio = cts / phinorm                                      # [B, L]
-    vals = ratio[..., None] * exp_etheta[:, None, :]           # [B, L, k]
+    exp_etheta, vals = token_sstats_factors(eb, cts, gamma)
     sstats_vt = (
         jnp.zeros((vocab_size, exp_etheta.shape[-1]), jnp.float32)
         .at[ids.reshape(-1)]

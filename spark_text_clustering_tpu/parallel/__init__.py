@@ -1,8 +1,11 @@
 from .collectives import (
     all_gather_model,
     data_shard_batch,
+    gather_model_rows,
+    model_row_sum,
     psum_data,
     psum_model,
+    scatter_add_model_shard,
     scatter_model,
 )
 from .mesh import (
@@ -18,8 +21,11 @@ from .mesh import (
 __all__ = [
     "all_gather_model",
     "data_shard_batch",
+    "gather_model_rows",
+    "model_row_sum",
     "psum_data",
     "psum_model",
+    "scatter_add_model_shard",
     "scatter_model",
     "DATA_AXIS",
     "MODEL_AXIS",

@@ -30,7 +30,7 @@ from .config import Params
 from .ops.sparse import batch_from_rows
 from .ops.tfidf import doc_freq, hashing_tf_ids, idf_from_df, idf_transform
 from .utils.textproc import preprocess_document
-from .utils.vocab import build_vocab, count_terms, count_vectors
+from .utils.vocab import build_vocab, count_terms_parallel, count_vectors
 
 __all__ = [
     "is_hashed_vocab",
@@ -164,13 +164,21 @@ class CountVectorizerModel(Transformer):
 
 
 class CountVectorizer(Estimator):
-    """Frequency-ranked exact vocabulary (LDAClustering.scala:144-167)."""
+    """Frequency-ranked exact vocabulary (LDAClustering.scala:144-167).
 
-    def __init__(self, vocab_size: int = 2_900_000):
+    Counting is sharded across host processes (``count_terms_parallel`` —
+    Spark's reduceByKey analogue); results are identical to serial counting
+    at any worker count."""
+
+    def __init__(
+        self, vocab_size: int = 2_900_000, num_workers: Optional[int] = None
+    ):
         self.vocab_size = vocab_size
+        self.num_workers = num_workers
 
     def fit(self, ds: Dict) -> CountVectorizerModel:
-        vocab, _ = build_vocab(count_terms(ds["tokens"]), self.vocab_size)
+        counts = count_terms_parallel(ds["tokens"], self.num_workers)
+        vocab, _ = build_vocab(counts, self.vocab_size)
         return CountVectorizerModel(vocab)
 
 

@@ -1,0 +1,38 @@
+"""Sandbox/runtime environment hygiene (host side, jax-free imports).
+
+The TPU sandbox arms a site hook (``sitecustomize`` on ``PYTHONPATH``) that
+registers the axon TPU plugin at interpreter startup whenever
+``PALLAS_AXON_POOL_IPS`` is set, and backend bring-up BLOCKS indefinitely
+when the chip is unreachable.  Round 1 lost both driver artifacts to this
+exact hang.  Every place that needs a guaranteed-to-come-up CPU platform
+(test harness, bench fallback, multichip dryrun, spawned worker processes)
+shares this one scrub so the rule set cannot drift apart.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Mapping, MutableMapping, Optional
+
+__all__ = ["scrub_axon_env", "scrubbed_cpu_env"]
+
+
+def scrub_axon_env(env: MutableMapping[str, str]) -> None:
+    """Remove the axon site hook's trigger variables in place."""
+    for k in list(env):
+        if k.startswith("PALLAS_AXON") or k.startswith("AXON"):
+            env.pop(k)
+
+
+def scrubbed_cpu_env(
+    n_devices: int = 1, base: Optional[Mapping[str, str]] = None
+) -> dict:
+    """A copy of ``base`` (default ``os.environ``) that forces an
+    ``n_devices``-wide virtual CPU platform and disarms the axon hook —
+    for subprocesses that must start even when the TPU is unreachable."""
+    env = dict(os.environ if base is None else base)
+    scrub_axon_env(env)
+    env.pop("PYTHONPATH", None)  # drops the axon sitecustomize hook
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = f"--xla_force_host_platform_device_count={n_devices}"
+    return env

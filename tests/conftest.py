@@ -1,9 +1,23 @@
-"""Test harness: force an 8-device virtual CPU platform BEFORE jax imports —
-the TPU-world analogue of a fake Spark cluster (SURVEY.md §4)."""
+"""Test harness: force an 8-device virtual CPU platform — the TPU-world
+analogue of a fake Spark cluster (SURVEY.md §4).
+
+Env vars alone are NOT enough: pytest plugins (jaxtyping) import jax during
+pytest bootstrap, BEFORE this conftest runs, so jax's ``jax_platforms``
+config captures the sandbox's ``JAX_PLATFORMS=axon`` at that import.  A
+later ``jax.devices()`` would then try to bring up the axon TPU plugin —
+which BLOCKS indefinitely when the chip is unreachable (this hung every
+pytest invocation, including ``pytest --version``).  The runtime
+``jax.config.update`` below overrides the captured value; the env writes
+still matter for subprocesses tests spawn."""
 
 import os
 
+from spark_text_clustering_tpu.utils.env import scrub_axon_env
+
 os.environ["JAX_PLATFORMS"] = "cpu"
+# Disarm the axon site hook for any subprocess (it re-arms via PYTHONPATH
+# sitecustomize whenever PALLAS_AXON_POOL_IPS is set).
+scrub_axon_env(os.environ)
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
@@ -14,8 +28,8 @@ import jax  # noqa: E402
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
-# The sandbox pins JAX_PLATFORMS=axon (one real TPU); route tests to the
-# 8-device virtual CPU platform instead.
+jax.config.update("jax_platforms", "cpu")
+
 CPU_DEVICES = jax.devices("cpu")
 jax.config.update("jax_default_device", CPU_DEVICES[0])
 
