@@ -56,6 +56,11 @@ class Params:
     # Device/runtime
     data_shards: Optional[int] = None   # None -> all devices on the "data" axis
     model_shards: int = 1               # vocab-axis sharding of beta [k, V]
+    # Group docs into power-of-two nnz buckets per iteration instead of one
+    # global max-nnz row width (SURVEY.md §7 hard part 1): bounds padding
+    # waste when doc lengths span orders of magnitude.  Numerically
+    # equivalent (per-doc keyed inits make runs bucketing-invariant).
+    bucket_by_length: bool = True
 
     def resolved_alpha(self) -> float:
         if self.doc_concentration > 0:

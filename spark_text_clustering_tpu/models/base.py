@@ -23,9 +23,10 @@ from ..ops.lda_math import (
     dirichlet_expectation,
     infer_gamma,
     init_gamma,
+    init_gamma_rows,
     topic_inference,
 )
-from ..ops.sparse import DocTermBatch, batch_from_rows
+from ..ops.sparse import DocTermBatch, batch_from_rows, bucket_by_length
 
 __all__ = ["LDAModel"]
 
@@ -107,23 +108,43 @@ class LDAModel:
         ``seed=None`` uses the deterministic all-ones gamma init; the
         reference's scoring is reproducible to ~1e-6 across runs regardless
         of its random init (SURVEY.md §4), i.e. the fixed point dominates.
+
+        Row lists are scored per power-of-two length bucket (SURVEY.md §7
+        hard part 1) so one book-sized doc does not pad every note-sized
+        doc to its width; per-doc keyed inits make the result independent
+        of the bucketing.
         """
-        batch = (
-            docs
-            if isinstance(docs, DocTermBatch)
-            else batch_from_rows(list(docs))
-        )
-        key = None if seed is None else jax.random.PRNGKey(seed)
-        gamma0 = init_gamma(key, batch.num_docs, self.k, self.gamma_shape)
-        dist = topic_inference(
-            batch,
-            self._exp_elog_beta(),
-            jnp.asarray(self.alpha, jnp.float32),
-            gamma0,
-            max_inner=max_inner,
-            tol=tol,
-        )
-        return np.asarray(dist)
+        alpha = jnp.asarray(self.alpha, jnp.float32)
+        eb = self._exp_elog_beta()
+        if isinstance(docs, DocTermBatch):
+            batch = docs
+            key = None if seed is None else jax.random.PRNGKey(seed)
+            gamma0 = init_gamma(key, batch.num_docs, self.k, self.gamma_shape)
+            return np.asarray(
+                topic_inference(
+                    batch, eb, alpha, gamma0, max_inner=max_inner, tol=tol
+                )
+            )
+
+        rows = list(docs)
+        out = np.zeros((len(rows), self.k), np.float32)
+        for _, (batch, idxs) in sorted(bucket_by_length(rows).items()):
+            if seed is None:
+                gamma0 = init_gamma(
+                    None, batch.num_docs, self.k, self.gamma_shape
+                )
+            else:
+                gamma0 = init_gamma_rows(
+                    jax.random.PRNGKey(seed),
+                    jnp.asarray(np.asarray(idxs, np.int32)),
+                    self.k,
+                    self.gamma_shape,
+                )
+            dist = topic_inference(
+                batch, eb, alpha, gamma0, max_inner=max_inner, tol=tol
+            )
+            out[idxs] = np.asarray(dist)
+        return out
 
     # ---- evaluation ----------------------------------------------------
     def log_likelihood(

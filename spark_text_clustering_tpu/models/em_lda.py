@@ -38,7 +38,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..config import Params
-from ..ops.sparse import DocTermBatch, batch_from_rows
+from ..ops.sparse import DocTermBatch, batch_from_rows, bucket_by_length
 from ..parallel.collectives import (
     data_shard_batch,
     gather_model_rows,
@@ -60,59 +60,89 @@ class EMState(NamedTuple):
     step: jnp.ndarray
 
 
-def make_em_train_step(
+def _em_edge_pass(n_wk_shard, n_dk, ids, wts, *, alpha, eta, v):
+    """The per-edge posterior + aggregation of one EM sweep over one doc
+    batch — vocab-sharded (SURVEY.md §7 hard part 5): the full [k, V] N_wk
+    never materializes; per-token rows are combined from the shards by ONE
+    psum over "model" inside gather_model_rows.  Returns (n_wk_partial
+    [psum-reduced over "data"], n_dk_new); the caller accumulates partials
+    across length buckets before adopting them as the next N_wk."""
+    n_k = model_row_sum(n_wk_shard)                        # [k]
+
+    # MLlib computePTopic: (N_wk + eta - 1)(N_dk + alpha - 1)/(N_k + V*eta - V)
+    term_f = gather_model_rows(n_wk_shard, ids) + (eta - 1.0)  # [B, L, k]
+    doc_f = n_dk + (alpha - 1.0)                           # [B, k]
+    denom = n_k + (eta * v - v)                            # [k]
+    phi = term_f * (doc_f / denom)[:, None, :]             # [B, L, k]
+    phi = phi / (phi.sum(-1, keepdims=True) + 1e-30)
+    wphi = wts[..., None] * phi                            # [B, L, k]
+
+    n_dk_new = wphi.sum(axis=1)                            # [B, k]
+    n_wk_partial = scatter_add_model_shard(
+        ids, wphi, n_wk_shard.shape[-1]
+    )                                                      # [k, V_pad/s]
+    n_wk_partial = psum_data(n_wk_partial)                 # graph shuffle -> psum
+    return n_wk_partial, n_dk_new
+
+
+def make_em_bucket_step(
     mesh: Mesh, *, alpha: float, eta: float, vocab_size: int
-) -> Callable[[EMState, DocTermBatch], EMState]:
-    """One full-corpus EM iteration (the body of the reference's 50x hot
-    loop, LDAClustering.scala:61).  ``vocab_size`` is the TRUE V (not the
-    shard-padded width) so the smoothing denominator — and therefore the
-    trained counts — are identical across mesh topologies."""
-    v = vocab_size
-
-    def _step(n_wk_shard, n_dk, step, ids, wts):
-        # Vocab-sharded (SURVEY.md §7 hard part 5): the full [k, V] N_wk
-        # never materializes — per-token rows are combined from the shards
-        # by ONE psum over "model" inside gather_model_rows.
-        n_k = model_row_sum(n_wk_shard)                        # [k]
-
-        # MLlib computePTopic: (N_wk + eta - 1)(N_dk + alpha - 1)/(N_k + V*eta - V)
-        term_f = gather_model_rows(n_wk_shard, ids) + (eta - 1.0)  # [B, L, k]
-        doc_f = n_dk + (alpha - 1.0)                           # [B, k]
-        denom = n_k + (eta * v - v)                            # [k]
-        phi = term_f * (doc_f / denom)[:, None, :]             # [B, L, k]
-        phi = phi / (phi.sum(-1, keepdims=True) + 1e-30)
-        wphi = wts[..., None] * phi                            # [B, L, k]
-
-        n_dk_new = wphi.sum(axis=1)                            # [B, k]
-        n_wk_new = scatter_add_model_shard(
-            ids, wphi, n_wk_shard.shape[-1]
-        )                                                      # [k, V_pad/s]
-        n_wk_new = psum_data(n_wk_new)                         # graph shuffle -> psum
-        return n_wk_new, n_dk_new, step + 1
+):
+    """Jitted edge pass over ONE length bucket: (n_wk, n_dk_b, batch) ->
+    (n_wk_partial, n_dk_b_new).  One returned function serves every bucket —
+    jax.jit caches per batch shape, and bucket shapes are fixed across
+    iterations, so compiles are bounded by the bucket count."""
 
     sharded = jax.shard_map(
-        _step,
+        partial(_em_edge_pass, alpha=alpha, eta=eta, v=vocab_size),
         mesh=mesh,
         in_specs=(
             P(None, MODEL_AXIS),     # n_wk shard
             P(DATA_AXIS, None),      # n_dk
-            P(),                     # step
             P(DATA_AXIS, None),      # ids
             P(DATA_AXIS, None),      # wts
         ),
-        out_specs=(P(None, MODEL_AXIS), P(DATA_AXIS, None), P()),
+        out_specs=(P(None, MODEL_AXIS), P(DATA_AXIS, None)),
         # n_wk is data-replicated by construction (psum over "data"); the
         # static VMA checker can't see that through the model-axis slice.
         check_vma=False,
     )
 
     @jax.jit
+    def bucket_step(n_wk, n_dk, batch: DocTermBatch):
+        return sharded(n_wk, n_dk, batch.token_ids, batch.token_weights)
+
+    return bucket_step
+
+
+def make_em_train_step(
+    mesh: Mesh, *, alpha: float, eta: float, vocab_size: int
+) -> Callable[[EMState, DocTermBatch], EMState]:
+    """One full-corpus, single-bucket EM iteration (the body of the
+    reference's 50x hot loop, LDAClustering.scala:61).  ``vocab_size`` is
+    the TRUE V (not the shard-padded width) so the smoothing denominator —
+    and therefore the trained counts — are identical across mesh
+    topologies.  The bucketed fit path uses ``make_em_bucket_step``."""
+
+    sharded = jax.shard_map(
+        partial(_em_edge_pass, alpha=alpha, eta=eta, v=vocab_size),
+        mesh=mesh,
+        in_specs=(
+            P(None, MODEL_AXIS),
+            P(DATA_AXIS, None),
+            P(DATA_AXIS, None),
+            P(DATA_AXIS, None),
+        ),
+        out_specs=(P(None, MODEL_AXIS), P(DATA_AXIS, None)),
+        check_vma=False,
+    )
+
+    @jax.jit
     def train_step(state: EMState, batch: DocTermBatch) -> EMState:
-        n_wk, n_dk, step = sharded(
-            state.n_wk, state.n_dk, state.step,
-            batch.token_ids, batch.token_weights,
+        n_wk, n_dk = sharded(
+            state.n_wk, state.n_dk, batch.token_ids, batch.token_weights
         )
-        return EMState(n_wk, n_dk, step)
+        return EMState(n_wk, n_dk, state.step + 1)
 
     return train_step
 
@@ -172,22 +202,27 @@ class EMLDA:
         self._step_fn = None
         self._step_fn_vocab = None
 
-    def _init_state(self, batch: DocTermBatch, k: int, v_pad: int, seed: int):
+    def _init_state(
+        self,
+        batch: DocTermBatch,
+        doc_ids: jnp.ndarray,
+        k: int,
+        v_pad: int,
+        seed: int,
+    ):
         """Soft random edge assignments aggregated into counts — the dense
         analogue of MLlib's random vertex gamma init — sampled PER DATA
         SHARD inside shard_map so init memory scales like the train step
         (the dense [B, L, k] sample never materializes unsharded)."""
 
-        def _init(ids, wts):
-            # Per-DOC keys from the global doc index: the same doc draws the
-            # same init regardless of mesh topology (sharding-invariant
-            # results), while the dense [B, L, k] sample stays shard-local.
+        def _init(ids, wts, dids):
+            # Per-DOC keys from the ORIGINAL doc index: the same doc draws
+            # the same init regardless of mesh topology OR length bucketing
+            # (sharding- and bucketing-invariant results), while the dense
+            # [B, L, k] sample stays shard-local.
             base = jax.random.PRNGKey(seed)
-            b_local, row_len = ids.shape
-            d0 = jax.lax.axis_index(DATA_AXIS) * b_local
-            keys = jax.vmap(lambda i: jax.random.fold_in(base, i))(
-                d0 + jnp.arange(b_local)
-            )
+            row_len = ids.shape[1]
+            keys = jax.vmap(lambda i: jax.random.fold_in(base, i))(dids)
             phi0 = jax.vmap(
                 lambda kk: jax.random.dirichlet(kk, jnp.ones((k,)), (row_len,))
             )(keys)
@@ -205,11 +240,45 @@ class EMLDA:
             jax.shard_map(
                 _init,
                 mesh=self.mesh,
-                in_specs=(P(DATA_AXIS, None), P(DATA_AXIS, None)),
+                in_specs=(
+                    P(DATA_AXIS, None),
+                    P(DATA_AXIS, None),
+                    P(DATA_AXIS),
+                ),
                 out_specs=(P(None, MODEL_AXIS), P(DATA_AXIS, None)),
                 check_vma=False,
             )
-        )(batch.token_ids, batch.token_weights)
+        )(batch.token_ids, batch.token_weights, doc_ids)
+
+    def _bucket_plan(self, rows, n: int):
+        """[(batch, doc_ids_dev, idxs)] per length bucket (one bucket when
+        ``Params.bucket_by_length`` is off).  Docs are padded per bucket to a
+        data-shard multiple; pad rows get doc ids >= n (weight 0 — inert).
+        Bucketing bounds padding waste when doc nnz spans orders of
+        magnitude (SURVEY.md §7 hard part 1): one 50k-term book among
+        8-term notes no longer forces every row to 65,536 slots."""
+        if self.params.bucket_by_length:
+            buckets = bucket_by_length(rows)
+        else:
+            whole = batch_from_rows(rows)
+            buckets = {whole.row_len: (whole, list(range(n)))}
+        plan = []
+        for _, (batch, idxs) in sorted(buckets.items()):
+            batch = data_shard_batch(self.mesh, batch)
+            doc_ids = np.fromiter(
+                idxs, dtype=np.int32, count=len(idxs)
+            )
+            pad = batch.num_docs - len(idxs)
+            if pad:
+                doc_ids = np.concatenate(
+                    [doc_ids, np.arange(n, n + pad, dtype=np.int32)]
+                )
+            doc_ids = jax.device_put(
+                jnp.asarray(doc_ids),
+                NamedSharding(self.mesh, P(DATA_AXIS)),
+            )
+            plan.append((batch, doc_ids, idxs))
+        return plan
 
     def fit(
         self,
@@ -225,68 +294,94 @@ class EMLDA:
         eta = p.resolved_eta()
 
         v_pad = ((v + p.model_shards - 1) // p.model_shards) * p.model_shards
-        batch = batch_from_rows(rows)
-        batch = data_shard_batch(self.mesh, batch)   # pads B to shard multiple
-        b_pad = batch.num_docs
+        plan = self._bucket_plan(rows, n)
+        dk_sharding = NamedSharding(self.mesh, P(DATA_AXIS, None))
 
         ckpt_path = (
             os.path.join(p.checkpoint_dir, "em_state.npz")
             if p.checkpoint_dir
             else None
         )
+
+        def _assemble_n_dk(n_dk_list) -> np.ndarray:
+            """Per-bucket device arrays -> [n, k] in original row order."""
+            full = np.zeros((n, k), np.float32)
+            for (batch_b, _, idxs), dk in zip(plan, n_dk_list):
+                full[idxs] = np.asarray(jax.device_get(dk))[: len(idxs)]
+            return full
+
+        def _split_n_dk(full: np.ndarray):
+            """[n, k] -> per-bucket padded device arrays."""
+            out = []
+            for batch_b, _, idxs in plan:
+                arr = np.zeros((batch_b.num_docs, k), np.float32)
+                arr[: len(idxs)] = full[idxs]
+                out.append(jax.device_put(jnp.asarray(arr), dk_sharding))
+            return out
+
         start_it = 0
         if ckpt_path and os.path.exists(ckpt_path):
             st = load_train_state(ckpt_path)
             start_it = st["step"]
-            if st["n_wk"].shape != (k, v_pad) or st["n_dk"].shape != (b_pad, k):
+            if st["n_wk"].shape != (k, v_pad) or st["n_dk"].shape != (n, k):
                 raise ValueError(
                     f"checkpoint shapes n_wk{st['n_wk'].shape}/"
                     f"n_dk{st['n_dk'].shape} do not match this run "
-                    f"({(k, v_pad)}/{(b_pad, k)}) — topology or params differ"
+                    f"({(k, v_pad)}/{(n, k)}) — topology or params differ"
                 )
-            state = EMState(
-                jax.device_put(jnp.asarray(st["n_wk"]),
-                               model_sharding(self.mesh)),
-                jax.device_put(jnp.asarray(st["n_dk"]),
-                               NamedSharding(self.mesh, P(DATA_AXIS, None))),
-                jnp.int32(start_it),
+            n_wk = jax.device_put(
+                jnp.asarray(st["n_wk"]), model_sharding(self.mesh)
             )
+            n_dk_list = _split_n_dk(st["n_dk"])
         else:
-            n_wk, n_dk = self._init_state(batch, k, v_pad, p.seed)
-            state = EMState(n_wk, n_dk, jnp.int32(0))
+            n_wk = None
+            n_dk_list = []
+            for batch_b, doc_ids_b, _ in plan:
+                part, dk = self._init_state(batch_b, doc_ids_b, k, v_pad, p.seed)
+                n_wk = part if n_wk is None else n_wk + part
+                n_dk_list.append(dk)
 
         if self._step_fn is None or self._step_fn_vocab != v:
-            self._step_fn = make_em_train_step(
+            self._step_fn = make_em_bucket_step(
                 self.mesh, alpha=alpha, eta=eta, vocab_size=v
             )
             self._step_fn_vocab = v
-        step_fn = self._step_fn
+        bucket_step = self._step_fn
         timer = IterationTimer()
         for it in range(start_it, n_iters):
             timer.start()
-            state = step_fn(state, batch)
-            state.n_wk.block_until_ready()
+            # All buckets read the SAME previous n_wk; partials sum to the
+            # next n_wk (the aggregateMessages of one whole-graph sweep).
+            acc = None
+            for bi, (batch_b, _, _) in enumerate(plan):
+                part, dk_new = bucket_step(n_wk, n_dk_list[bi], batch_b)
+                acc = part if acc is None else acc + part
+                n_dk_list[bi] = dk_new
+            n_wk = acc
+            n_wk.block_until_ready()
             timer.stop()
             if verbose:
                 print(f"EM iter {it}: {timer.times[-1]:.3f}s")
             if ckpt_path and (it + 1) % p.checkpoint_interval == 0:
                 save_train_state(
                     ckpt_path, it + 1,
-                    n_wk=np.asarray(jax.device_get(state.n_wk)),
-                    n_dk=np.asarray(jax.device_get(state.n_dk)),
+                    n_wk=np.asarray(jax.device_get(n_wk)),
+                    n_dk=_assemble_n_dk(n_dk_list),
                 )
 
-        n_wk_full = np.asarray(jax.device_get(state.n_wk))
+        n_wk_full = np.asarray(jax.device_get(n_wk))
         n_wk_np = n_wk_full[:, :v]
-        n_dk_full = np.asarray(jax.device_get(state.n_dk))
         self.last_log_likelihood = float(
-            em_log_likelihood(
-                batch,
-                jnp.asarray(n_wk_full),
-                jnp.asarray(n_dk_full),
-                alpha,
-                eta,
-                vocab_size=v,
+            sum(
+                em_log_likelihood(
+                    batch_b,
+                    jnp.asarray(n_wk_full),
+                    n_dk_list[bi],
+                    alpha,
+                    eta,
+                    vocab_size=v,
+                )
+                for bi, (batch_b, _, _) in enumerate(plan)
             )
         )
         return LDAModel(
@@ -297,5 +392,5 @@ class EMLDA:
             gamma_shape=p.gamma_shape,
             iteration_times=list(timer.times),
             algorithm="em",
-            step=int(state.step),
+            step=start_it + len(timer.times),
         )

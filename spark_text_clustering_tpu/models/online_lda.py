@@ -36,6 +36,7 @@ from ..ops.lda_math import (
     _run_gamma_fixed_point,
     dirichlet_expectation_sharded,
     init_gamma,
+    init_gamma_rows,
     init_lambda,
     token_sstats_factors,
 )
@@ -52,7 +53,13 @@ from ..utils.timing import IterationTimer
 from .base import LDAModel
 from .persistence import load_train_state, save_train_state
 
-__all__ = ["OnlineLDA", "make_online_train_step"]
+__all__ = [
+    "OnlineLDA",
+    "make_online_train_step",
+    "make_online_eb",
+    "make_online_estep",
+    "make_online_mstep",
+]
 
 
 class TrainState(NamedTuple):
@@ -166,9 +173,122 @@ def make_online_train_step(
     return train_step
 
 
+def make_online_eb(mesh: Mesh):
+    """Jitted exp(E[log beta]) from the lambda shard — computed ONCE per
+    iteration, shared by every length bucket's E-step."""
+
+    def _eb(lam_shard):
+        row_sum = model_row_sum(lam_shard)
+        return jnp.exp(dirichlet_expectation_sharded(lam_shard, row_sum))
+
+    return jax.jit(
+        jax.shard_map(
+            _eb,
+            mesh=mesh,
+            in_specs=(P(None, MODEL_AXIS),),
+            out_specs=P(None, MODEL_AXIS),
+            check_vma=False,
+        )
+    )
+
+
+def make_online_estep(
+    mesh: Mesh,
+    *,
+    alpha: float | np.ndarray,
+    max_inner: int = 100,
+    tol: float = 1e-3,
+):
+    """Jitted per-bucket E-step: (eb_shard, batch, gamma0) ->
+    (sstats_shard, nonempty_docs), both already psum-reduced over "data".
+    One returned function serves every bucket — jax.jit caches per batch
+    shape, and the power-of-two doc/length padding keeps the distinct
+    shape count logarithmic."""
+    alpha_arr = jnp.asarray(alpha, jnp.float32)
+
+    def _estep(eb_shard, ids, wts, gamma0):
+        eb_tok = gather_model_rows(eb_shard, ids)            # [B, L, k]
+        gamma, _ = _run_gamma_fixed_point(
+            eb_tok, wts, alpha_arr, gamma0, max_inner, tol, "auto"
+        )
+        _, vals = token_sstats_factors(eb_tok, wts, gamma)
+        sstats_shard = scatter_add_model_shard(
+            ids, vals, eb_shard.shape[-1]
+        )
+        sstats_shard = psum_data(sstats_shard)
+        count = psum_data((wts.sum(-1) > 0).sum().astype(jnp.float32))
+        return sstats_shard, count
+
+    sharded = jax.shard_map(
+        _estep,
+        mesh=mesh,
+        in_specs=(
+            P(None, MODEL_AXIS),
+            P(DATA_AXIS, None),
+            P(DATA_AXIS, None),
+            P(DATA_AXIS, None),
+        ),
+        out_specs=(P(None, MODEL_AXIS), P()),
+        check_vma=False,
+    )
+
+    @jax.jit
+    def estep(eb_shard, batch: DocTermBatch, gamma0):
+        return sharded(
+            eb_shard, batch.token_ids, batch.token_weights, gamma0
+        )
+
+    return estep
+
+
+def make_online_mstep(mesh: Mesh, *, eta: float, tau0: float, kappa: float):
+    """Jitted M-step over the accumulated bucket statistics:
+    (lam_shard, eb_shard, sstats, batch_docs, step, corpus_size) ->
+    lam_shard' — Hoffman's lambda_hat blend, shard-local per V-slice."""
+
+    def _mstep(lam_shard, eb_shard, sstats, batch_docs, step, corpus_sz):
+        rho = (tau0 + step.astype(jnp.float32) + 1.0) ** (-kappa)
+        lam_hat = eta + (corpus_sz / jnp.maximum(batch_docs, 1.0)) * (
+            sstats * eb_shard
+        )
+        return (1.0 - rho) * lam_shard + rho * lam_hat
+
+    sharded = jax.shard_map(
+        _mstep,
+        mesh=mesh,
+        in_specs=(
+            P(None, MODEL_AXIS),
+            P(None, MODEL_AXIS),
+            P(None, MODEL_AXIS),
+            P(),
+            P(),
+            P(),
+        ),
+        out_specs=P(None, MODEL_AXIS),
+        check_vma=False,
+    )
+
+    @jax.jit
+    def mstep(lam_shard, eb_shard, sstats, batch_docs, step, corpus_sz):
+        return sharded(
+            lam_shard, eb_shard, sstats,
+            jnp.asarray(batch_docs, jnp.float32),
+            jnp.asarray(step, jnp.int32),
+            jnp.asarray(corpus_sz, jnp.float32),
+        )
+
+    return mstep
+
+
 class OnlineLDA:
     """Estimator: ``fit(rows) -> LDAModel`` (the ``lda.run(corpus)`` of the
-    reference's online path, LDAClustering.scala:43,61)."""
+    reference's online path, LDAClustering.scala:43,61).
+
+    The fit loop samples MLlib's minibatch globally, then groups the sample
+    into power-of-two length buckets (SURVEY.md §7 hard part 1) so one
+    100k-term book does not force every doc's row to its width; sufficient
+    statistics accumulate across buckets before the single M-step, which is
+    mathematically identical to the unbucketed update."""
 
     def __init__(
         self,
@@ -247,55 +367,86 @@ class OnlineLDA:
             lam0 = init_lambda(
                 jax.random.fold_in(base_key, 0xFFFF), k, v_pad, p.gamma_shape
             )
-        lam0 = jax.device_put(lam0, model_sharding(self.mesh))
-        state = TrainState(lam0, jnp.int32(start_it))
+        lam = jax.device_put(lam0, model_sharding(self.mesh))
 
         if self._step_fn is None or self._step_fn_corpus != n:
-            self._step_fn = make_online_train_step(
-                self.mesh,
-                alpha=alpha,
-                eta=eta,
-                tau0=p.tau0,
-                kappa=p.kappa,
-                corpus_size=n,
+            self._step_fn = (
+                make_online_eb(self.mesh),
+                make_online_estep(
+                    self.mesh, alpha=alpha, max_inner=100, tol=1e-3
+                ),
+                make_online_mstep(
+                    self.mesh, eta=eta, tau0=p.tau0, kappa=p.kappa
+                ),
             )
             self._step_fn_corpus = n
-        step_fn = self._step_fn
+        eb_fn, estep_fn, mstep_fn = self._step_fn
+        dk_spec = NamedSharding(self.mesh, P(DATA_AXIS, None))
 
         timer = IterationTimer()
         for it in range(start_it, n_iters):
             timer.start()
-            # Per-iteration derived streams => deterministic resume.
+            # Per-iteration derived streams => deterministic resume.  The
+            # minibatch is sampled GLOBALLY (MLlib's Bernoulli analogue),
+            # then grouped by length bucket — grouping changes shapes, not
+            # which docs are visited or what they contribute.
             rng = np.random.default_rng((p.seed, it))
             pick = rng.choice(n, size=min(bsz, n), replace=False)
-            batch = batch_from_rows([rows[i] for i in pick], row_len=row_len)
-            batch = data_shard_batch(self.mesh, batch)
-            gamma0 = init_gamma(
-                jax.random.fold_in(base_key, it), batch.num_docs, k,
-                p.gamma_shape,
-            )
-            gamma0 = jax.device_put(
-                gamma0, NamedSharding(self.mesh, P(DATA_AXIS, None))
-            )
-            state = step_fn(state, batch, gamma0)
-            state.lam.block_until_ready()
+            if p.bucket_by_length:
+                groups: dict = {}
+                for i in pick:
+                    L = max(8, next_pow2(len(rows[i][0])))
+                    groups.setdefault(L, []).append(i)
+            else:
+                groups = {row_len: list(pick)}
+
+            eb = eb_fn(lam)
+            key_it = jax.random.fold_in(base_key, it)
+            sstats_acc = None
+            count_acc = None
+            for L, idxs in sorted(groups.items()):
+                # Pad the doc axis to a power of two (>= data shards) so
+                # the per-(B, L) jit cache stays logarithmic in size.
+                b_pad = max(next_pow2(len(idxs)), n_data)
+                batch = batch_from_rows(
+                    [rows[i] for i in idxs], row_len=L
+                ).pad_rows_to(b_pad)
+                batch = DocTermBatch(
+                    jax.device_put(batch.token_ids, dk_spec),
+                    jax.device_put(batch.token_weights, dk_spec),
+                )
+                doc_ids = np.asarray(
+                    list(idxs) + list(range(n, n + b_pad - len(idxs))),
+                    np.int32,
+                )
+                # Per-doc keyed gamma init: the same (iteration, doc) pair
+                # draws the same init in any bucketing/sharding layout.
+                gamma0 = init_gamma_rows(
+                    key_it, jnp.asarray(doc_ids), k, p.gamma_shape
+                )
+                gamma0 = jax.device_put(gamma0, dk_spec)
+                sstats, cnt = estep_fn(eb, batch, gamma0)
+                sstats_acc = sstats if sstats_acc is None else sstats_acc + sstats
+                count_acc = cnt if count_acc is None else count_acc + cnt
+            lam = mstep_fn(lam, eb, sstats_acc, count_acc, it, float(n))
+            lam.block_until_ready()
             timer.stop()
             if verbose:
                 print(f"iter {it}: {timer.times[-1]:.3f}s")
             if ckpt_path and (it + 1) % p.checkpoint_interval == 0:
                 save_train_state(
                     ckpt_path, it + 1,
-                    lam=np.asarray(jax.device_get(state.lam)),
+                    lam=np.asarray(jax.device_get(lam)),
                 )
 
-        lam = np.asarray(jax.device_get(state.lam))[:, :v]
+        lam_np = np.asarray(jax.device_get(lam))[:, :v]
         return LDAModel(
-            lam=lam,
+            lam=lam_np,
             vocab=list(vocab),
             alpha=alpha,
             eta=float(eta),
             gamma_shape=p.gamma_shape,
             iteration_times=list(timer.times),
             algorithm="online",
-            step=int(state.step),
+            step=start_it + len(timer.times),
         )

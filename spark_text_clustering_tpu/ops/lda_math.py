@@ -40,6 +40,7 @@ __all__ = [
     "token_sstats_factors",
     "init_lambda",
     "init_gamma",
+    "init_gamma_rows",
     "e_step",
     "infer_gamma",
     "topic_inference",
@@ -103,6 +104,23 @@ def init_gamma(
         jax.random.gamma(key, gamma_shape, (n_docs, k), jnp.float32)
         / gamma_shape
     )
+
+
+def init_gamma_rows(
+    key: jax.Array,
+    doc_ids: jnp.ndarray,       # [B] global document indices
+    k: int,
+    gamma_shape: float = 100.0,
+) -> jnp.ndarray:
+    """Per-document gamma init keyed by GLOBAL doc index: the same document
+    draws the same init regardless of how the batch was bucketed, sharded,
+    or ordered — the property that makes bucketed and unbucketed training
+    runs comparable."""
+    keys = jax.vmap(lambda i: jax.random.fold_in(key, i))(doc_ids)
+    draw = jax.vmap(
+        lambda kk: jax.random.gamma(kk, gamma_shape, (k,), jnp.float32)
+    )(keys)
+    return draw / gamma_shape
 
 
 class EStepResult(NamedTuple):

@@ -27,6 +27,7 @@ __all__ = [
     "DocTermBatch",
     "batch_from_rows",
     "bucket_by_length",
+    "bucket_indices_by_length",
     "next_pow2",
     "pad_rows",
 ]
@@ -119,6 +120,20 @@ def batch_from_rows(
     return DocTermBatch(jnp.asarray(ids), jnp.asarray(wts))
 
 
+def bucket_indices_by_length(
+    rows: Sequence[Tuple[np.ndarray, np.ndarray]],
+    min_row_len: int = 8,
+) -> Dict[int, List[int]]:
+    """{bucket_len: original_row_indices} — the single definition of the
+    power-of-two bucketing rule, shared by training, scoring, and
+    ``bucket_by_length`` so jit-cache shapes stay aligned across paths."""
+    buckets: Dict[int, List[int]] = {}
+    for idx, (ids, _) in enumerate(rows):
+        L = max(min_row_len, next_pow2(len(ids)))
+        buckets.setdefault(L, []).append(idx)
+    return buckets
+
+
 def bucket_by_length(
     rows: Sequence[Tuple[np.ndarray, np.ndarray]],
     min_row_len: int = 8,
@@ -128,11 +143,7 @@ def bucket_by_length(
     Returns {bucket_len: (batch, original_row_indices)} — the TPU analogue of
     the reference's one-RDD-row-per-doc with ragged sparsity.
     """
-    buckets: Dict[int, List[int]] = {}
-    for idx, (ids, _) in enumerate(rows):
-        L = max(min_row_len, next_pow2(len(ids)))
-        buckets.setdefault(L, []).append(idx)
     out: Dict[int, Tuple[DocTermBatch, List[int]]] = {}
-    for L, idxs in sorted(buckets.items()):
+    for L, idxs in sorted(bucket_indices_by_length(rows, min_row_len).items()):
         out[L] = (batch_from_rows([rows[i] for i in idxs], row_len=L), idxs)
     return out
