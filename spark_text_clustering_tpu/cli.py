@@ -57,7 +57,22 @@ def _load_stop_words(path: Optional[str]) -> frozenset:
     return parse_stop_words(read_stop_word_file(path))
 
 
+def _init_distributed(args: argparse.Namespace) -> bool:
+    """Join the multi-host platform when requested (must precede any jax
+    work — SURVEY.md §2.5 comm backend); returns True on the process that
+    owns driver-side effects (save/report)."""
+    from .parallel.mesh import initialize_distributed, is_coordinator
+
+    initialize_distributed(
+        coordinator_address=getattr(args, "coordinator", None),
+        num_processes=getattr(args, "num_processes", None),
+        process_id=getattr(args, "process_id", None),
+    )
+    return is_coordinator()
+
+
 def cmd_train(args: argparse.Namespace) -> int:
+    coordinator = _init_distributed(args)
     timer = PhaseTimer()
     sw = _load_stop_words(args.stop_words)
     with timer.phase("read"):
@@ -92,9 +107,11 @@ def cmd_train(args: argparse.Namespace) -> int:
 
     from .utils.profiling import MetricsLogger, trace
 
-    metrics = MetricsLogger(args.metrics_file)
+    # driver-side sinks write from the coordinator only: a worker opening
+    # the same --metrics-file would truncate the coordinator's records
+    metrics = MetricsLogger(args.metrics_file if coordinator else None)
     metrics.log("corpus", documents=len(texts), books_dir=args.books)
-    with trace(args.profile_dir):
+    with trace(args.profile_dir if coordinator else None):
         with timer.phase("preprocess+vectorize+train"):
             fitted = Pipeline(stages).fit(
                 {"texts": texts}
@@ -103,42 +120,44 @@ def cmd_train(args: argparse.Namespace) -> int:
     lda_stage = fitted.stages[-1]
     model: LDAModel = lda_stage.model
 
-    # corpus summary (LDAClustering.scala:28-34 prints)
-    print("Training corpus summary:")
-    print(f"\t Trained on {len(texts)} documents")
-    print(f"\t Vocabulary size: {model.vocab_size} terms")
-    print(f"\t Topics: {model.k}; algorithm: {params.algorithm}")
-    print(f"\t Preprocessing+training time: "
-          f"{timer.phases['preprocess+vectorize+train']:.1f}s "
-          f"(mean iter {np.mean(model.iteration_times):.3f}s)")
-    # avg log-likelihood, the reference's single quality metric
-    # (LDAClustering.scala:73-78, EM only); divided by the corpus actually
-    # trained on (nonempty docs), matching corpus.count()
-    if lda_stage.log_likelihood is not None and lda_stage.corpus_size:
-        print(f"The average log likelihood of the training data: "
-              f"{lda_stage.log_likelihood / lda_stage.corpus_size}")
+    if coordinator:
+        # corpus summary (LDAClustering.scala:28-34 prints)
+        print("Training corpus summary:")
+        print(f"\t Trained on {len(texts)} documents")
+        print(f"\t Vocabulary size: {model.vocab_size} terms")
+        print(f"\t Topics: {model.k}; algorithm: {params.algorithm}")
+        print(f"\t Preprocessing+training time: "
+              f"{timer.phases['preprocess+vectorize+train']:.1f}s "
+              f"(mean iter {np.mean(model.iteration_times):.3f}s)")
+        # avg log-likelihood, the reference's single quality metric
+        # (LDAClustering.scala:73-78, EM only); divided by the corpus
+        # actually trained on (nonempty docs), matching corpus.count()
+        if lda_stage.log_likelihood is not None and lda_stage.corpus_size:
+            print(f"The average log likelihood of the training data: "
+                  f"{lda_stage.log_likelihood / lda_stage.corpus_size}")
 
-    # top-10 terms per topic (LDAClustering.scala:81-92)
-    print(f"{model.k} topics:")
-    for i, topic in enumerate(model.describe_topics_terms(10)):
-        print(f"TOPIC {i}")
-        for term, w in topic:
-            print(f"{term}\t{w}")
-        print()
+        # top-10 terms per topic (LDAClustering.scala:81-92)
+        print(f"{model.k} topics:")
+        for i, topic in enumerate(model.describe_topics_terms(10)):
+            print(f"TOPIC {i}")
+            for term, w in topic:
+                print(f"{term}\t{w}")
+            print()
 
-    out_dir = model_dir_name(args.lang, base=args.models_dir)
-    model.save(out_dir)
-    print(f"model saved to {out_dir}")
+    if coordinator:
+        out_dir = model_dir_name(args.lang, base=args.models_dir)
+        model.save(out_dir)
+        print(f"model saved to {out_dir}")
 
-    metrics.log_phases(timer.phases)
-    metrics.log_iteration_times(model.iteration_times)
-    metrics.log(
-        "model_saved",
-        path=out_dir,
-        k=model.k,
-        vocab_size=model.vocab_size,
-        algorithm=params.algorithm,
-    )
+        metrics.log_phases(timer.phases)
+        metrics.log_iteration_times(model.iteration_times)
+        metrics.log(
+            "model_saved",
+            path=out_dir,
+            k=model.k,
+            vocab_size=model.vocab_size,
+            algorithm=params.algorithm,
+        )
     return 0
 
 
@@ -230,7 +249,10 @@ def cmd_stream_score(args: argparse.Namespace) -> int:
 
 def cmd_stream_train(args: argparse.Namespace) -> int:
     """Continuous online-VB training over a watched directory; saves the
-    final model like ``train`` does."""
+    final model like ``train`` does.  Single-process only: multi-host
+    would need cross-process agreement on which files each poll tick
+    ingests, or the first collective deadlocks — batch ``train`` is the
+    multi-host path."""
     from .streaming import FileStreamSource, StreamingOnlineLDA
 
     params = Params(
@@ -282,6 +304,16 @@ def cmd_stream_train(args: argparse.Namespace) -> int:
     model.save(out_dir)
     print(f"model saved to {out_dir}")
     return 0
+
+
+def _add_distributed_args(p: argparse.ArgumentParser) -> None:
+    """Multi-host DCN flags (every process runs the same command with its
+    own --process-id; tests/test_multihost.py exercises the path)."""
+    p.add_argument("--coordinator", default=None,
+                   help="host:port of process 0 for jax.distributed "
+                        "multi-host bring-up")
+    p.add_argument("--num-processes", type=int, default=None)
+    p.add_argument("--process-id", type=int, default=None)
 
 
 def _add_stream_args(p: argparse.ArgumentParser) -> None:
@@ -336,6 +368,7 @@ def build_parser() -> argparse.ArgumentParser:
     tr.add_argument("--no-lemmatize", action="store_true")
     tr.add_argument("--include-all", action="store_true",
                     help="ingest non-.txt files too (reference behavior)")
+    _add_distributed_args(tr)
     tr.set_defaults(fn=cmd_train)
 
     sc = sub.add_parser("score", help="score books against a saved model")

@@ -41,12 +41,20 @@ from ..config import Params
 from ..ops.sparse import DocTermBatch, batch_from_rows, bucket_by_length
 from ..parallel.collectives import (
     data_shard_batch,
+    fetch_global,
     gather_model_rows,
     model_row_sum,
     psum_data,
     scatter_add_model_shard,
 )
-from ..parallel.mesh import DATA_AXIS, MODEL_AXIS, make_mesh, model_sharding
+from ..parallel.mesh import (
+    DATA_AXIS,
+    MODEL_AXIS,
+    agree_checkpoint_exists,
+    is_coordinator,
+    make_mesh,
+    model_sharding,
+)
 from ..utils.timing import IterationTimer
 from .base import LDAModel
 from .persistence import load_train_state, save_train_state
@@ -307,7 +315,7 @@ class EMLDA:
             """Per-bucket device arrays -> [n, k] in original row order."""
             full = np.zeros((n, k), np.float32)
             for (batch_b, _, idxs), dk in zip(plan, n_dk_list):
-                full[idxs] = np.asarray(jax.device_get(dk))[: len(idxs)]
+                full[idxs] = fetch_global(dk)[: len(idxs)]
             return full
 
         def _split_n_dk(full: np.ndarray):
@@ -320,7 +328,7 @@ class EMLDA:
             return out
 
         start_it = 0
-        if ckpt_path and os.path.exists(ckpt_path):
+        if agree_checkpoint_exists(ckpt_path):
             st = load_train_state(ckpt_path)
             start_it = st["step"]
             if st["n_wk"].shape != (k, v_pad) or st["n_dk"].shape != (n, k):
@@ -363,13 +371,16 @@ class EMLDA:
             if verbose:
                 print(f"EM iter {it}: {timer.times[-1]:.3f}s")
             if ckpt_path and (it + 1) % p.checkpoint_interval == 0:
-                save_train_state(
-                    ckpt_path, it + 1,
-                    n_wk=np.asarray(jax.device_get(n_wk)),
-                    n_dk=_assemble_n_dk(n_dk_list),
-                )
+                # fetches are collective (every process participates);
+                # only the coordinator touches the shared filesystem
+                n_wk_host = fetch_global(n_wk)
+                n_dk_host = _assemble_n_dk(n_dk_list)
+                if is_coordinator():
+                    save_train_state(
+                        ckpt_path, it + 1, n_wk=n_wk_host, n_dk=n_dk_host
+                    )
 
-        n_wk_full = np.asarray(jax.device_get(n_wk))
+        n_wk_full = fetch_global(n_wk)
         n_wk_np = n_wk_full[:, :v]
         self.last_log_likelihood = float(
             sum(

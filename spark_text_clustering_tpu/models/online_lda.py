@@ -43,12 +43,20 @@ from ..ops.lda_math import (
 from ..ops.sparse import DocTermBatch, batch_from_rows, next_pow2
 from ..parallel.collectives import (
     data_shard_batch,
+    fetch_global,
     gather_model_rows,
     model_row_sum,
     psum_data,
     scatter_add_model_shard,
 )
-from ..parallel.mesh import DATA_AXIS, MODEL_AXIS, make_mesh, model_sharding
+from ..parallel.mesh import (
+    DATA_AXIS,
+    MODEL_AXIS,
+    agree_checkpoint_exists,
+    is_coordinator,
+    make_mesh,
+    model_sharding,
+)
 from ..utils.timing import IterationTimer
 from .base import LDAModel
 from .persistence import load_train_state, save_train_state
@@ -355,7 +363,7 @@ class OnlineLDA:
         )
         start_it = 0
         base_key = jax.random.PRNGKey(p.seed)
-        if ckpt_path and os.path.exists(ckpt_path):
+        if agree_checkpoint_exists(ckpt_path):
             st = load_train_state(ckpt_path)
             lam_np, start_it = st["lam"], st["step"]
             if lam_np.shape != (k, v_pad):
@@ -434,12 +442,12 @@ class OnlineLDA:
             if verbose:
                 print(f"iter {it}: {timer.times[-1]:.3f}s")
             if ckpt_path and (it + 1) % p.checkpoint_interval == 0:
-                save_train_state(
-                    ckpt_path, it + 1,
-                    lam=np.asarray(jax.device_get(lam)),
-                )
+                # collective fetch on every process; one writer
+                lam_host = fetch_global(lam)
+                if is_coordinator():
+                    save_train_state(ckpt_path, it + 1, lam=lam_host)
 
-        lam_np = np.asarray(jax.device_get(lam))[:, :v]
+        lam_np = fetch_global(lam)[:, :v]
         return LDAModel(
             lam=lam_np,
             vocab=list(vocab),

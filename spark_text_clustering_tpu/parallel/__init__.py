@@ -13,6 +13,7 @@ from .mesh import (
     MODEL_AXIS,
     data_sharding,
     initialize_distributed,
+    is_coordinator,
     make_mesh,
     model_sharding,
     replicated,
@@ -31,6 +32,7 @@ __all__ = [
     "MODEL_AXIS",
     "data_sharding",
     "initialize_distributed",
+    "is_coordinator",
     "make_mesh",
     "model_sharding",
     "replicated",

@@ -33,6 +33,7 @@ __all__ = [
     "all_gather_model",
     "scatter_model",
     "data_shard_batch",
+    "fetch_global",
 ]
 
 
@@ -121,6 +122,21 @@ def scatter_model(x, axis: int = -1):
     size = lax.axis_size(MODEL_AXIS)
     shard = x.shape[axis] // size
     return lax.dynamic_slice_in_dim(x, idx * shard, shard, axis=axis)
+
+
+def fetch_global(x):
+    """Device->host of a possibly multi-host array — Spark's "collect to
+    driver".  ``jax.device_get`` alone raises on arrays whose shards live on
+    other hosts' devices; the DCN all-gather first brings every shard local.
+    Collective: in multi-process runs EVERY process must call this (all do —
+    it replaces each bare device_get on the train paths)."""
+    import numpy as np
+
+    if jax.process_count() == 1:
+        return np.asarray(jax.device_get(x))
+    from jax.experimental import multihost_utils
+
+    return np.asarray(multihost_utils.process_allgather(x, tiled=True))
 
 
 def data_shard_batch(mesh: Mesh, batch):

@@ -15,6 +15,7 @@ Spark's cluster manager).
 
 from __future__ import annotations
 
+import os
 from typing import Optional, Sequence
 
 import jax
@@ -22,7 +23,8 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 __all__ = ["make_mesh", "data_sharding", "model_sharding", "replicated",
-           "initialize_distributed", "DATA_AXIS", "MODEL_AXIS"]
+           "initialize_distributed", "is_coordinator",
+           "DATA_AXIS", "MODEL_AXIS"]
 
 DATA_AXIS = "data"
 MODEL_AXIS = "model"
@@ -66,12 +68,61 @@ def initialize_distributed(
     num_processes: Optional[int] = None,
     process_id: Optional[int] = None,
 ) -> None:
-    """Multi-host bring-up over DCN (SURVEY.md §2.5 "Communication backend").
-    No-op when single-process args are absent."""
+    """Multi-host bring-up over DCN (SURVEY.md §2.5 "Communication backend"
+    — the jax.distributed analogue of Spark's cluster manager + netty RPC).
+
+    Must run BEFORE any other jax call so the local runtime registers with
+    the coordinator and ``jax.devices()`` returns the global device set.
+    No-op without a coordinator address (plain single-process runs);
+    partial arguments are an error, not a silent no-op — otherwise N
+    processes launched with only --num-processes/--process-id would each
+    believe they are the coordinator and train N duplicate models.
+    Exercised for real (2 OS processes, CPU) by tests/test_multihost.py.
+    """
     if coordinator_address is None:
+        if num_processes is not None or process_id is not None:
+            raise ValueError(
+                "num_processes/process_id require coordinator_address "
+                "(pass --coordinator host:port on every process)"
+            )
         return
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
         num_processes=num_processes,
         process_id=process_id,
     )
+
+
+def is_coordinator() -> bool:
+    """True on the process that owns driver-side effects (model save,
+    report writes) — process 0, every process in single-host runs."""
+    return jax.process_index() == 0
+
+
+def agree_checkpoint_exists(path: Optional[str]) -> bool:
+    """Whether a fit should resume from ``path``, agreed across processes.
+
+    Checkpoints are written by the coordinator only, so multi-host resume
+    requires checkpoint_dir to be ONE shared filesystem.  If processes
+    disagree about the file's existence they would take different branches
+    and issue mismatched collectives — a silent pod-wide hang.  The
+    coordinator's view is broadcast and any dissenting process raises a
+    clear error instead."""
+    if not path:
+        return False
+    exists = os.path.exists(path)
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        coord = bool(int(multihost_utils.broadcast_one_to_all(
+            np.asarray(int(exists), np.int32)
+        )))
+        if coord != exists:
+            raise RuntimeError(
+                f"checkpoint {path}: exists={exists} on process "
+                f"{jax.process_index()} but {coord} on the coordinator — "
+                "checkpoint_dir must be a shared filesystem visible to "
+                "every process"
+            )
+        return coord
+    return exists

@@ -1,0 +1,137 @@
+"""Worker for the 2-process DCN bring-up test (run by test_multihost.py).
+
+Each process owns 2 virtual CPU devices (the parent sets
+``--xla_force_host_platform_device_count=2``); ``jax.distributed`` joins
+them into one 4-device platform — the CPU stand-in for multi-host TPU over
+DCN (SURVEY.md §2.5 "Communication backend": Spark's cluster manager ->
+``jax.distributed`` + collectives).
+
+Usage: python multihost_worker.py <process_id> <num_processes> <port> <out>
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+import numpy as np
+
+
+def make_toy_em_inputs():
+    """One shared toy EM problem — the parent test re-runs the identical
+    inputs single-process and compares, so both sides MUST build them from
+    this one function."""
+    k, v, b, length = 3, 16, 8, 5
+    rng = np.random.default_rng(7)
+    ids = rng.integers(0, v, size=(b, length)).astype(np.int32)
+    wts = rng.random((b, length)).astype(np.float32) + 0.1
+    n_wk0 = (rng.random((k, v)).astype(np.float32) + 0.5)
+    n_dk0 = (rng.random((b, k)).astype(np.float32) + 0.5)
+    return k, v, ids, wts, n_wk0, n_dk0
+
+
+def make_toy_fit_rows():
+    """A tiny deterministic corpus for the end-to-end multi-host fit."""
+    rng = np.random.default_rng(11)
+    v = 24
+    rows = []
+    for d in range(12):
+        lo, hi = (0, 12) if d % 2 == 0 else (12, 24)
+        terms = np.sort(rng.choice(np.arange(lo, hi), size=6, replace=False))
+        wts = rng.random(6).astype(np.float32) + 0.2
+        rows.append((terms.astype(np.int32), wts))
+    vocab = [f"t{i}" for i in range(v)]
+    return rows, vocab
+
+
+def main() -> int:
+    pid, nproc, port, out_path = (
+        int(sys.argv[1]), int(sys.argv[2]), sys.argv[3], sys.argv[4]
+    )
+
+    from spark_text_clustering_tpu.parallel.mesh import (
+        DATA_AXIS,
+        initialize_distributed,
+        make_mesh,
+    )
+
+    initialize_distributed(
+        coordinator_address=f"127.0.0.1:{port}",
+        num_processes=nproc,
+        process_id=pid,
+    )
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    assert jax.process_count() == nproc, jax.process_count()
+    n_dev = jax.device_count()
+    assert n_dev == 2 * nproc, n_dev
+    assert len(jax.local_devices()) == 2
+
+    mesh = make_mesh()  # (4, 1) over the GLOBAL device set
+
+    # --- cross-process reduction: sum over a data-sharded global array ----
+    x = np.arange(n_dev * 3, dtype=np.float32).reshape(n_dev, 3)
+    sh = NamedSharding(mesh, P(DATA_AXIS, None))
+    xg = jax.make_array_from_callback(x.shape, sh, lambda idx: x[idx])
+    total = jax.jit(lambda a: a.sum())(xg)
+    np.testing.assert_allclose(float(total), x.sum())
+
+    # --- one EM train step over the 2-process mesh ------------------------
+    from spark_text_clustering_tpu.models.em_lda import (
+        EMState,
+        make_em_train_step,
+    )
+    from spark_text_clustering_tpu.ops.sparse import DocTermBatch
+
+    k, v, ids, wts, n_wk0, n_dk0 = make_toy_em_inputs()
+
+    def put(arr, spec):
+        return jax.make_array_from_callback(
+            arr.shape, NamedSharding(mesh, spec), lambda idx: arr[idx]
+        )
+
+    batch = DocTermBatch(
+        token_ids=put(ids, P(DATA_AXIS, None)),
+        token_weights=put(wts, P(DATA_AXIS, None)),
+    )
+    state = EMState(
+        n_wk=put(n_wk0, P()),
+        n_dk=put(n_dk0, P(DATA_AXIS, None)),
+        step=jnp.zeros((), jnp.int32),
+    )
+    step_fn = make_em_train_step(mesh, alpha=11.0, eta=1.1, vocab_size=v)
+    new_state = step_fn(state, batch)
+
+    # n_wk comes back replicated (psum over "data", model_shards=1), so it
+    # is process-addressable everywhere; every process must agree.
+    n_wk = np.asarray(new_state.n_wk)
+
+    # --- full EMLDA.fit end-to-end across the process boundary -----------
+    # Exercises data_shard_batch's cross-host device_put, fetch_global's
+    # DCN all-gather (n_dk is sharded over devices of BOTH processes), and
+    # the coordinator-only checkpoint write.
+    from spark_text_clustering_tpu.config import Params
+    from spark_text_clustering_tpu.models.em_lda import EMLDA
+
+    rows, vocab = make_toy_fit_rows()
+    ckpt_dir = os.path.join(os.path.dirname(out_path), "ckpt")
+    est = EMLDA(
+        Params(k=2, max_iterations=4, algorithm="em", seed=0,
+               checkpoint_dir=ckpt_dir, checkpoint_interval=2),
+        mesh=mesh,
+    )
+    model = est.fit(rows, vocab)
+    lam = np.asarray(model.lam)
+    ckpt_exists = os.path.exists(os.path.join(ckpt_dir, "em_state.npz"))
+    if pid == 0:
+        assert ckpt_exists, "coordinator checkpoint missing"
+        np.savez(out_path, n_wk=n_wk, total=float(total), fit_lam=lam)
+    print(f"proc {pid}: ok devices={n_dev}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,124 @@
+"""Multi-host (DCN) bring-up: 2 real OS processes join one jax.distributed
+platform and run collectives + an EM train step across the process boundary
+(VERDICT round-1 item 9; SURVEY.md §2.5 "Communication backend").
+
+The reference gets multi-node from Spark's cluster manager + netty shuffle;
+our equivalent is ``jax.distributed.initialize`` + XLA collectives, and this
+test is the 2-process CPU analogue of a 2-host TPU pod slice: each process
+owns 2 virtual CPU devices, the mesh spans all 4, and the EM step's
+``psum`` over "data" crosses processes.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from spark_text_clustering_tpu.utils.env import scrubbed_cpu_env
+
+_WORKER = os.path.join(os.path.dirname(__file__), "multihost_worker.py")
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_partial_distributed_args_rejected():
+    """--num-processes/--process-id without --coordinator must raise, not
+    silently let every process believe it is the coordinator."""
+    from spark_text_clustering_tpu.parallel.mesh import initialize_distributed
+
+    with pytest.raises(ValueError, match="coordinator_address"):
+        initialize_distributed(num_processes=2)
+    with pytest.raises(ValueError, match="coordinator_address"):
+        initialize_distributed(process_id=1)
+    initialize_distributed()  # no args: single-process no-op
+
+
+def test_two_process_bringup_and_em_step(tmp_path):
+    port = _free_port()
+    out = str(tmp_path / "proc0.npz")
+    env = scrubbed_cpu_env(n_devices=2)
+    env["PYTHONPATH"] = _REPO  # package import only; axon hook stays dropped
+
+    procs = [
+        subprocess.Popen(
+            [sys.executable, _WORKER, str(pid), "2", str(port), out],
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for pid in range(2)
+    ]
+    outputs = []
+    for p in procs:
+        try:
+            stdout, _ = p.communicate(timeout=240)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outputs.append(stdout)
+    for pid, (p, stdout) in enumerate(zip(procs, outputs)):
+        assert p.returncode == 0, f"proc {pid} failed:\n{stdout}"
+        assert f"proc {pid}: ok devices=4" in stdout
+
+    # process 0 saved the post-step n_wk and the end-to-end fit's topics;
+    # both must match the same computation run single-process on an
+    # identically-shaped 4x1 mesh (sharding-invariance across the process
+    # boundary).  Inputs come from the ONE shared factory in the worker
+    # module so the two sides can never drift apart.
+    data = np.load(out)
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from multihost_worker import make_toy_em_inputs, make_toy_fit_rows
+    from spark_text_clustering_tpu.config import Params
+    from spark_text_clustering_tpu.models.em_lda import (
+        EMLDA,
+        EMState,
+        make_em_train_step,
+    )
+    from spark_text_clustering_tpu.ops.sparse import DocTermBatch
+    from spark_text_clustering_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh(data_shards=4, model_shards=1,
+                     devices=jax.devices("cpu")[:4])
+    k, v, ids, wts, n_wk0, n_dk0 = make_toy_em_inputs()
+
+    def put(arr, spec):
+        return jax.device_put(arr, NamedSharding(mesh, spec))
+
+    state = EMState(
+        n_wk=put(n_wk0, P()),
+        n_dk=put(n_dk0, P("data", None)),
+        step=jnp.zeros((), jnp.int32),
+    )
+    batch = DocTermBatch(
+        token_ids=put(ids, P("data", None)),
+        token_weights=put(wts, P("data", None)),
+    )
+    step_fn = make_em_train_step(mesh, alpha=11.0, eta=1.1, vocab_size=v)
+    expected = np.asarray(step_fn(state, batch).n_wk)
+
+    np.testing.assert_allclose(data["n_wk"], expected, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(data["total"], np.arange(12.0).sum())
+
+    rows, vocab = make_toy_fit_rows()
+    est = EMLDA(
+        Params(k=2, max_iterations=4, algorithm="em", seed=0), mesh=mesh
+    )
+    expected_lam = np.asarray(est.fit(rows, vocab).lam)
+    np.testing.assert_allclose(
+        data["fit_lam"], expected_lam, rtol=1e-4, atol=1e-5
+    )
