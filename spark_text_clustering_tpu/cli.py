@@ -144,7 +144,6 @@ def cmd_train(args: argparse.Namespace) -> int:
                 print(f"{term}\t{w}")
             print()
 
-    if coordinator:
         out_dir = model_dir_name(args.lang, base=args.models_dir)
         model.save(out_dir)
         print(f"model saved to {out_dir}")

@@ -60,7 +60,19 @@ class Params:
     # global max-nnz row width (SURVEY.md §7 hard part 1): bounds padding
     # waste when doc lengths span orders of magnitude.  Numerically
     # equivalent (per-doc keyed inits make runs bucketing-invariant).
-    bucket_by_length: bool = True
+    # "auto" (EM) buckets only when the single-bucket padded token grid is
+    # large enough for padding FLOPs to outweigh the extra per-bucket
+    # dispatches — measured on TPU, small corpora are dispatch-bound and
+    # run ~2x faster as one bucket.
+    bucket_by_length: object = "auto"  # True | False | "auto"
+    # Online VB: keep the padded corpus resident on device and assemble
+    # each minibatch with an on-device gather (one fused step per
+    # iteration) when it fits this budget; "auto" falls back to the
+    # host-streaming bucketed path for corpora over budget.  Measured on
+    # TPU the host path spends >70% of each iteration building/transferring
+    # batches.
+    device_resident: object = "auto"   # True | False | "auto"
+    resident_budget_bytes: int = 2 << 30
 
     def resolved_alpha(self) -> float:
         if self.doc_concentration > 0:

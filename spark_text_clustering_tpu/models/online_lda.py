@@ -33,6 +33,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..config import Params
 from ..ops.lda_math import (
+    _resolve_gamma_backend,
     _run_gamma_fixed_point,
     dirichlet_expectation_sharded,
     init_gamma,
@@ -45,9 +46,11 @@ from ..parallel.collectives import (
     data_shard_batch,
     fetch_global,
     gather_model_rows,
+    gather_model_rows_kbl,
     model_row_sum,
     psum_data,
     scatter_add_model_shard,
+    scatter_add_model_shard_kbl,
 )
 from ..parallel.mesh import (
     DATA_AXIS,
@@ -67,12 +70,85 @@ __all__ = [
     "make_online_eb",
     "make_online_estep",
     "make_online_mstep",
+    "make_online_resident_step",
+    "make_online_resident_chunk",
 ]
 
 
 class TrainState(NamedTuple):
     lam: jnp.ndarray     # [k, V/model_shards] per device along "model"
     step: jnp.ndarray    # scalar int32
+
+
+def _estep_block(eb_shard, ids, wts, gamma0, alpha_arr, max_inner, tol):
+    """Gather -> gamma fixed point -> per-shard raw sufficient statistics,
+    dispatching on the gamma backend.  Shared by every online E-step
+    (fused train step, resident step, per-bucket host step) so the
+    backend/layout choice lives in exactly one place.  Returns
+    (sstats_shard [k, V/s] NOT yet psum-reduced over "data", gamma)."""
+    if _resolve_gamma_backend("auto") == "pallas":
+        # VMEM-resident Pallas E-step in the [k, B, L] layout the gather
+        # produces — measured ~4.5x over the XLA loop on TPU, and the
+        # layout choice avoids a slab transpose that costs more than the
+        # kernel (ops/pallas_estep.py layout notes).
+        from ..ops.lda_math import token_sstats_factors_kbl
+        from ..ops.pallas_estep import gamma_fixed_point_pallas_kbl
+
+        eb_tok = gather_model_rows_kbl(eb_shard, ids)    # [k, B, L]
+        gamma = gamma_fixed_point_pallas_kbl(
+            eb_tok, wts, alpha_arr, gamma0,
+            max_inner=max_inner, tol=tol,
+            interpret=jax.default_backend() != "tpu",
+        )
+        vals = token_sstats_factors_kbl(eb_tok, wts, gamma)
+        sstats_shard = scatter_add_model_shard_kbl(
+            ids, vals, eb_shard.shape[-1]
+        )                                                # [k, V/s]
+    else:
+        eb_tok = gather_model_rows(eb_shard, ids)        # [B, L, k]
+        gamma, _ = _run_gamma_fixed_point(
+            eb_tok, wts, alpha_arr, gamma0, max_inner, tol, "auto"
+        )
+        _, vals = token_sstats_factors(eb_tok, wts, gamma)
+        sstats_shard = scatter_add_model_shard(
+            ids, vals, eb_shard.shape[-1]
+        )                                                # [k, V/s]
+    return sstats_shard, gamma
+
+
+def _online_step_core(
+    lam_shard, step, ids, wts, gamma0, corpus_sz,
+    *, alpha_arr, eta, tau0, kappa, max_inner, tol,
+):
+    """One full online-VB update given an assembled, data-sharded minibatch
+    — shared verbatim by the host-streaming step and the device-resident
+    step so the two paths cannot drift numerically.
+
+    Vocab-sharded E-step (SURVEY.md §7 hard part 5): the full [k, V]
+    lambda NEVER materializes on any device.  Per-device lambda-derived
+    memory is [k, V/s] (lam + its exp-E[log beta]); the only exchanged
+    token tensor is the [B, L, k] gather, communicated once per step.
+    """
+    row_sum = model_row_sum(lam_shard)                   # [k]
+    eb_shard = jnp.exp(
+        dirichlet_expectation_sharded(lam_shard, row_sum)
+    )                                                    # [k, V/s]
+
+    sstats_shard, _ = _estep_block(
+        eb_shard, ids, wts, gamma0, alpha_arr, max_inner, tol
+    )
+    # treeAggregate -> one psum over the data axis (SURVEY.md §3.3).
+    sstats_shard = psum_data(sstats_shard)
+    batch_docs = psum_data((wts.sum(-1) > 0).sum().astype(jnp.float32))
+
+    # M-step (Hoffman): lambda_hat = eta + (D/|B|) * sstats ∘ expElogbeta
+    # — shard-local: each device updates only its V-slice.
+    rho = (tau0 + step.astype(jnp.float32) + 1.0) ** (-kappa)
+    lam_hat = eta + (corpus_sz / jnp.maximum(batch_docs, 1.0)) * (
+        sstats_shard * eb_shard
+    )
+    lam_new = (1.0 - rho) * lam_shard + rho * lam_hat
+    return lam_new, step + 1
 
 
 def make_online_train_step(
@@ -102,37 +178,11 @@ def make_online_train_step(
     alpha_arr = jnp.asarray(alpha, jnp.float32)
 
     def _step(lam_shard, step, ids, wts, gamma0, corpus_sz):
-        # Vocab-sharded E-step (SURVEY.md §7 hard part 5): the full [k, V]
-        # lambda NEVER materializes on any device.  Per-device lambda-derived
-        # memory is [k, V/s] (lam + its exp-E[log beta]); the only exchanged
-        # token tensor is the [B, L, k] gather, communicated once per step.
-        row_sum = model_row_sum(lam_shard)                   # [k]
-        eb_shard = jnp.exp(
-            dirichlet_expectation_sharded(lam_shard, row_sum)
-        )                                                    # [k, V/s]
-        eb_tok = gather_model_rows(eb_shard, ids)            # [B, L, k]
-
-        gamma, _ = _run_gamma_fixed_point(
-            eb_tok, wts, alpha_arr, gamma0, max_inner, tol, "auto"
+        return _online_step_core(
+            lam_shard, step, ids, wts, gamma0, corpus_sz,
+            alpha_arr=alpha_arr, eta=eta, tau0=tau0, kappa=kappa,
+            max_inner=max_inner, tol=tol,
         )
-
-        # Final responsibilities -> per-shard sufficient statistics; then
-        # treeAggregate -> one psum over the data axis (SURVEY.md §3.3).
-        _, vals = token_sstats_factors(eb_tok, wts, gamma)
-        sstats_shard = scatter_add_model_shard(
-            ids, vals, lam_shard.shape[-1]
-        )                                                    # [k, V/s]
-        sstats_shard = psum_data(sstats_shard)
-        batch_docs = psum_data((wts.sum(-1) > 0).sum().astype(jnp.float32))
-
-        # M-step (Hoffman): lambda_hat = eta + (D/|B|) * sstats ∘ expElogbeta
-        # — shard-local: each device updates only its V-slice.
-        rho = (tau0 + step.astype(jnp.float32) + 1.0) ** (-kappa)
-        lam_hat = eta + (corpus_sz / jnp.maximum(batch_docs, 1.0)) * (
-            sstats_shard * eb_shard
-        )
-        lam_new = (1.0 - rho) * lam_shard + rho * lam_hat
-        return lam_new, step + 1
 
     sharded = jax.shard_map(
         _step,
@@ -215,13 +265,8 @@ def make_online_estep(
     alpha_arr = jnp.asarray(alpha, jnp.float32)
 
     def _estep(eb_shard, ids, wts, gamma0):
-        eb_tok = gather_model_rows(eb_shard, ids)            # [B, L, k]
-        gamma, _ = _run_gamma_fixed_point(
-            eb_tok, wts, alpha_arr, gamma0, max_inner, tol, "auto"
-        )
-        _, vals = token_sstats_factors(eb_tok, wts, gamma)
-        sstats_shard = scatter_add_model_shard(
-            ids, vals, eb_shard.shape[-1]
+        sstats_shard, _ = _estep_block(
+            eb_shard, ids, wts, gamma0, alpha_arr, max_inner, tol
         )
         sstats_shard = psum_data(sstats_shard)
         count = psum_data((wts.sum(-1) > 0).sum().astype(jnp.float32))
@@ -288,6 +333,150 @@ def make_online_mstep(mesh: Mesh, *, eta: float, tau0: float, kappa: float):
     return mstep
 
 
+def make_online_resident_step(
+    mesh: Mesh,
+    *,
+    alpha: float | np.ndarray,
+    eta: float,
+    tau0: float,
+    kappa: float,
+    k: int,
+    gamma_shape: float,
+    seed: int,
+    max_inner: int = 100,
+    tol: float = 1e-3,
+):
+    """One FUSED online-VB iteration over a device-resident corpus.
+
+    Measured on TPU, the host-streaming loop spends >70% of every
+    iteration building padded batches in Python and device_put-ting them
+    (plus one dispatch per length bucket); this step removes all of it.
+    The padded corpus [N_pad, L] lives sharded over "data" for the whole
+    fit; per iteration the host sends only the [B] minibatch indices and
+    the WHOLE update — batch assembly, gamma init, E-step, stats psum,
+    M-step — runs as one jitted dispatch.
+
+    Batch assembly is an ownership gather over the data axis (the same
+    psum trick ``gather_model_rows`` uses over "model"): each shard emits
+    the picked rows it owns, zeros elsewhere, and one psum over "data"
+    assembles the batch replicated; each shard then slices its own B/s
+    rows.  Gamma init derives from fold_in(base_key, step) and the GLOBAL
+    doc ids, so resident and host paths draw identical per-doc inits.
+
+    Returned fn: (state, ids_res, wts_res, pick, corpus_sz) -> state.
+    ``pick`` is [B] replicated global doc ids, B a multiple of the data
+    axis; ids beyond the true corpus hit all-zero pad rows and contribute
+    nothing.
+    """
+    sharded = _make_resident_sharded(
+        mesh, alpha=alpha, eta=eta, tau0=tau0, kappa=kappa, k=k,
+        gamma_shape=gamma_shape, seed=seed, max_inner=max_inner, tol=tol,
+    )
+
+    @jax.jit
+    def resident_step(
+        state: TrainState, ids_res, wts_res, pick, corpus_sz
+    ) -> TrainState:
+        lam, step = sharded(
+            state.lam, state.step, ids_res, wts_res, pick,
+            jnp.asarray(corpus_sz, jnp.float32),
+        )
+        return TrainState(lam, step)
+
+    return resident_step
+
+
+def _make_resident_sharded(
+    mesh, *, alpha, eta, tau0, kappa, k, gamma_shape, seed, max_inner, tol
+):
+    """The shard_mapped (unjitted) resident iteration shared by the
+    single-step and multi-iteration (scan) wrappers."""
+    alpha_arr = jnp.asarray(alpha, jnp.float32)
+    base_key = jax.random.PRNGKey(seed)
+    n_data = mesh.shape[DATA_AXIS]
+
+    def _step(lam_shard, step, ids_res, wts_res, pick, corpus_sz):
+        shard_n = ids_res.shape[0]
+        ofs = jax.lax.axis_index(DATA_AXIS) * shard_n
+        local = pick - ofs
+        own = jnp.logical_and(local >= 0, local < shard_n)
+        localc = jnp.clip(local, 0, shard_n - 1)
+        ids_b = psum_data(jnp.where(own[:, None], ids_res[localc], 0))
+        wts_b = psum_data(jnp.where(own[:, None], wts_res[localc], 0.0))
+
+        b_shard = pick.shape[0] // n_data
+        row0 = jax.lax.axis_index(DATA_AXIS) * b_shard
+        ids_s = jax.lax.dynamic_slice_in_dim(ids_b, row0, b_shard, 0)
+        wts_s = jax.lax.dynamic_slice_in_dim(wts_b, row0, b_shard, 0)
+        pick_s = jax.lax.dynamic_slice_in_dim(pick, row0, b_shard, 0)
+
+        key_it = jax.random.fold_in(base_key, step)
+        gamma0 = init_gamma_rows(key_it, pick_s, k, gamma_shape)
+        return _online_step_core(
+            lam_shard, step, ids_s, wts_s, gamma0, corpus_sz,
+            alpha_arr=alpha_arr, eta=eta, tau0=tau0, kappa=kappa,
+            max_inner=max_inner, tol=tol,
+        )
+
+    return jax.shard_map(
+        _step,
+        mesh=mesh,
+        in_specs=(
+            P(None, MODEL_AXIS),      # lam shard
+            P(),                      # step
+            P(DATA_AXIS, None),       # resident token ids
+            P(DATA_AXIS, None),       # resident token weights
+            P(),                      # pick (replicated)
+            P(),                      # corpus size
+        ),
+        out_specs=(P(None, MODEL_AXIS), P()),
+        check_vma=False,
+    )
+
+
+def make_online_resident_chunk(
+    mesh: Mesh,
+    *,
+    alpha: float | np.ndarray,
+    eta: float,
+    tau0: float,
+    kappa: float,
+    k: int,
+    gamma_shape: float,
+    seed: int,
+    max_inner: int = 100,
+    tol: float = 1e-3,
+):
+    """Multi-iteration resident runner: ONE dispatch executes a whole
+    checkpoint interval of online-VB updates via ``lax.scan`` over a
+    [m, B] block of precomputed minibatch picks.  Per-iteration host syncs
+    cost a network round trip each when the chip sits behind a tunnel
+    (see ``make_em_chunk_runner``); here the host only draws pick indices
+    and dispatches once per interval.  jit-cached per (m, B) — at most
+    the interval and one remainder."""
+    sharded = _make_resident_sharded(
+        mesh, alpha=alpha, eta=eta, tau0=tau0, kappa=kappa, k=k,
+        gamma_shape=gamma_shape, seed=seed, max_inner=max_inner, tol=tol,
+    )
+
+    @jax.jit
+    def resident_chunk(
+        state: TrainState, ids_res, wts_res, picks, corpus_sz
+    ) -> TrainState:
+        cs = jnp.asarray(corpus_sz, jnp.float32)
+
+        def body(st, pick):
+            lam, step = sharded(
+                st.lam, st.step, ids_res, wts_res, pick, cs
+            )
+            return TrainState(lam, step), None
+
+        state, _ = jax.lax.scan(body, state, picks)
+        return state
+
+    return resident_chunk
+
+
 class OnlineLDA:
     """Estimator: ``fit(rows) -> LDAModel`` (the ``lda.run(corpus)`` of the
     reference's online path, LDAClustering.scala:43,61).
@@ -316,7 +505,28 @@ class OnlineLDA:
         # the step closure) so it survives repeat fits (bench warmup).
         self._step_fn = None
         self._step_fn_corpus = None
+        self._resident_fn = None
+        self._resident_chunk_fn = None
         self.last_batch_size: Optional[int] = None
+
+    def _resident_arrays(self, rows, n: int, row_len: int):
+        """Upload the padded corpus [N_pad, row_len] sharded over "data",
+        or None when the device-resident path is off / over budget
+        (``Params.device_resident`` / ``resident_budget_bytes``)."""
+        p = self.params
+        n_data = self.mesh.shape[DATA_AXIS]
+        n_pad = ((n + n_data - 1) // n_data) * n_data
+        nbytes = n_pad * row_len * 8  # int32 ids + float32 weights
+        if p.device_resident is not True and not (
+            p.device_resident == "auto" and nbytes <= p.resident_budget_bytes
+        ):
+            return None
+        batch = batch_from_rows(rows, row_len=row_len).pad_rows_to(n_pad)
+        spec = NamedSharding(self.mesh, P(DATA_AXIS, None))
+        return (
+            jax.device_put(batch.token_ids, spec),
+            jax.device_put(batch.token_weights, spec),
+        )
 
     # -----------------------------------------------------------------
     def fit(
@@ -377,6 +587,91 @@ class OnlineLDA:
             )
         lam = jax.device_put(lam0, model_sharding(self.mesh))
 
+        timer = IterationTimer()
+        resident = self._resident_arrays(rows, n, row_len)
+        if resident is not None:
+            # Device-resident fast path: corpus uploaded once, minibatch
+            # assembled on device, E+M fused into ONE dispatch/iteration
+            # (the host path below spends most of each iteration building
+            # and transferring padded batches).  Same sample stream, same
+            # per-doc gamma inits => same math as the host path.
+            ids_res, wts_res = resident
+            state = TrainState(lam, jnp.asarray(start_it, jnp.int32))
+
+            def make_pick(it: int) -> np.ndarray:
+                # Per-iteration derived stream => deterministic resume,
+                # identical to the host path's sampling.
+                rng = np.random.default_rng((p.seed, it))
+                pick = rng.choice(n, size=min(bsz, n), replace=False)
+                if pick.size < bsz:
+                    pick = np.concatenate(
+                        [pick, np.arange(n, n + bsz - pick.size)]
+                    )
+                return pick.astype(np.int32)
+
+            if verbose:
+                if self._resident_fn is None:
+                    self._resident_fn = make_online_resident_step(
+                        self.mesh, alpha=alpha, eta=eta, tau0=p.tau0,
+                        kappa=p.kappa, k=k, gamma_shape=p.gamma_shape,
+                        seed=p.seed,
+                    )
+                for it in range(start_it, n_iters):
+                    timer.start()
+                    state = self._resident_fn(
+                        state, ids_res, wts_res,
+                        jnp.asarray(make_pick(it)), float(n),
+                    )
+                    state.lam.block_until_ready()
+                    timer.stop()
+                    print(f"iter {it}: {timer.times[-1]:.3f}s")
+                    if ckpt_path and (it + 1) % p.checkpoint_interval == 0:
+                        lam_host = fetch_global(state.lam)
+                        if is_coordinator():
+                            save_train_state(ckpt_path, it + 1, lam=lam_host)
+            else:
+                # Chunked: scan a whole checkpoint interval per dispatch
+                # (see make_online_resident_chunk — per-iteration syncs
+                # cost a tunnel round trip each).  Iteration times are
+                # recorded as the chunk mean.
+                if self._resident_chunk_fn is None:
+                    self._resident_chunk_fn = make_online_resident_chunk(
+                        self.mesh, alpha=alpha, eta=eta, tau0=p.tau0,
+                        kappa=p.kappa, k=k, gamma_shape=p.gamma_shape,
+                        seed=p.seed,
+                    )
+                interval = max(1, p.checkpoint_interval)
+                it = start_it
+                while it < n_iters:
+                    m = min(interval - (it % interval), n_iters - it)
+                    picks = np.stack(
+                        [make_pick(i) for i in range(it, it + m)]
+                    )
+                    timer.start()
+                    state = self._resident_chunk_fn(
+                        state, ids_res, wts_res, jnp.asarray(picks), float(n)
+                    )
+                    state.lam.block_until_ready()
+                    timer.stop()
+                    chunk_t = timer.times.pop()
+                    timer.times.extend([chunk_t / m] * m)
+                    it += m
+                    if ckpt_path and it % interval == 0:
+                        lam_host = fetch_global(state.lam)
+                        if is_coordinator():
+                            save_train_state(ckpt_path, it, lam=lam_host)
+            lam_np = fetch_global(state.lam)[:, :v]
+            return LDAModel(
+                lam=lam_np,
+                vocab=list(vocab),
+                alpha=alpha,
+                eta=float(eta),
+                gamma_shape=p.gamma_shape,
+                iteration_times=list(timer.times),
+                algorithm="online",
+                step=start_it + len(timer.times),
+            )
+
         if self._step_fn is None or self._step_fn_corpus != n:
             self._step_fn = (
                 make_online_eb(self.mesh),
@@ -391,7 +686,6 @@ class OnlineLDA:
         eb_fn, estep_fn, mstep_fn = self._step_fn
         dk_spec = NamedSharding(self.mesh, P(DATA_AXIS, None))
 
-        timer = IterationTimer()
         for it in range(start_it, n_iters):
             timer.start()
             # Per-iteration derived streams => deterministic resume.  The

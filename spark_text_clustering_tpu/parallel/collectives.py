@@ -29,7 +29,9 @@ __all__ = [
     "psum_model",
     "model_row_sum",
     "gather_model_rows",
+    "gather_model_rows_kbl",
     "scatter_add_model_shard",
+    "scatter_add_model_shard_kbl",
     "all_gather_model",
     "scatter_model",
     "data_shard_batch",
@@ -84,6 +86,42 @@ def gather_model_rows(table_shard, ids):
     vals = jnp.moveaxis(table_shard, 0, -1)[local]        # [..., k]
     vals = jnp.where(in_shard[..., None], vals, 0.0)
     return psum_model(vals)
+
+
+def gather_model_rows_kbl(table_shard, ids):
+    """``gather_model_rows`` in [k, ...] layout: returns [k, *ids.shape]
+    with the token axis LAST (the 128-lane dimension on TPU).  The Pallas
+    E-step consumes this directly — producing [..., k] and transposing
+    later measurably costs more than the E-step kernel itself."""
+    shard_v = table_shard.shape[-1]
+    local, in_shard = _model_shard_local_ids(ids, shard_v)
+    local = jnp.clip(local, 0, shard_v - 1)
+    vals = jnp.take(table_shard, local, axis=1)           # [k, ...]
+    vals = jnp.where(in_shard[None], vals, 0.0)
+    return psum_model(vals)
+
+
+def scatter_add_model_shard_kbl(ids, vals, shard_v):
+    """``scatter_add_model_shard`` for [k, B, L] values: one scatter per
+    topic row straight into the [k, V/s] stats layout — no [.., k]-minor
+    relayout of the big slab.
+
+    ids:  [B, L] global vocab ids.
+    vals: [k, B, L] per-token values.
+    returns: [k, shard_v] partial stats (still to be psum-reduced over
+    "data").
+    """
+    k = vals.shape[0]
+    local, in_shard = _model_shard_local_ids(ids, shard_v)
+    local = jnp.where(in_shard, local, shard_v)           # overflow row
+    flat_ids = local.reshape(-1)
+    flat_vals = vals.reshape(k, -1)
+    out = jax.vmap(
+        lambda row: jnp.zeros((shard_v + 1,), jnp.float32)
+        .at[flat_ids]
+        .add(row)
+    )(flat_vals)
+    return out[:, :shard_v]
 
 
 def scatter_add_model_shard(ids, vals, shard_v):

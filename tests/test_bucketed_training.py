@@ -74,6 +74,9 @@ def test_online_bucketed_matches_unbucketed_full_batch(
             k=3, algorithm="online", max_iterations=2, seed=0,
             batch_size=len(skewed_rows), data_shards=2, model_shards=1,
             bucket_by_length=bucketed,
+            # pin the host-streaming path: this test is about bucketing,
+            # and the device-resident path would bypass both branches
+            device_resident=False,
         )
         models.append(OnlineLDA(params, mesh=mesh).fit(skewed_rows, vocab))
     np.testing.assert_allclose(

@@ -1,0 +1,140 @@
+"""Device-resident online-VB training path (the TPU fast path: corpus
+uploaded once, minibatch assembled on device by a data-axis ownership
+gather, E+M fused into one dispatch per iteration).
+
+The resident path must be numerically interchangeable with the
+host-streaming path — same sample stream, same per-doc gamma inits — and
+must fall back cleanly when the padded corpus exceeds the budget."""
+
+import numpy as np
+import pytest
+
+from spark_text_clustering_tpu.config import Params
+from spark_text_clustering_tpu.models.em_lda import EMLDA
+from spark_text_clustering_tpu.models.online_lda import OnlineLDA
+
+
+@pytest.fixture(scope="module")
+def corpus():
+    rng = np.random.default_rng(3)
+    v = 500
+    rows = []
+    for d in range(40):
+        nnz = int(rng.integers(5, 60))
+        ids = np.sort(rng.choice(v, size=nnz, replace=False)).astype(np.int32)
+        rows.append((ids, rng.integers(1, 6, nnz).astype(np.float32)))
+    vocab = [f"t{i}" for i in range(v)]
+    return rows, vocab
+
+
+def _fit(rows, vocab, mesh, **over):
+    base = dict(
+        k=4, algorithm="online", max_iterations=6, seed=0,
+        data_shards=mesh.shape["data"], model_shards=mesh.shape["model"],
+    )
+    base.update(over)
+    return OnlineLDA(Params(**base), mesh=mesh).fit(rows, vocab)
+
+
+def test_resident_matches_host_path(corpus, eight_devices):
+    from spark_text_clustering_tpu.parallel.mesh import make_mesh
+
+    rows, vocab = corpus
+    mesh = make_mesh(data_shards=4, model_shards=1,
+                     devices=eight_devices[:4])
+    resident = _fit(rows, vocab, mesh, device_resident=True)
+    host = _fit(rows, vocab, mesh, device_resident=False)
+    np.testing.assert_allclose(resident.lam, host.lam, rtol=5e-3, atol=1e-5)
+
+
+def test_resident_matches_host_path_model_sharded(corpus, eight_devices):
+    """Resident assembly composes with vocab sharding (2x2 mesh)."""
+    from spark_text_clustering_tpu.parallel.mesh import make_mesh
+
+    rows, vocab = corpus
+    mesh = make_mesh(data_shards=2, model_shards=2,
+                     devices=eight_devices[:4])
+    resident = _fit(rows, vocab, mesh, device_resident=True)
+    host = _fit(rows, vocab, mesh, device_resident=False)
+    np.testing.assert_allclose(resident.lam, host.lam, rtol=5e-3, atol=1e-5)
+
+
+def test_budget_fallback(corpus, eight_devices):
+    """Over-budget corpora silently take the host path (and still fit)."""
+    from spark_text_clustering_tpu.parallel.mesh import make_mesh
+
+    rows, vocab = corpus
+    mesh = make_mesh(data_shards=4, model_shards=1,
+                     devices=eight_devices[:4])
+    params = Params(
+        k=4, algorithm="online", max_iterations=2, seed=0,
+        data_shards=4, model_shards=1, resident_budget_bytes=16,
+    )
+    est = OnlineLDA(params, mesh=mesh)
+    assert est._resident_arrays(rows, len(rows), 64) is None
+    model = est.fit(rows, vocab)
+    assert model.lam.shape == (4, len(vocab))
+
+
+def test_resident_checkpoint_resume(corpus, eight_devices, tmp_path):
+    """Interrupted resident fit resumes mid-training and lands on the same
+    model as one uninterrupted run (resume derives the SAME sample stream
+    and gamma keys from the restored step)."""
+    from spark_text_clustering_tpu.parallel.mesh import make_mesh
+
+    rows, vocab = corpus
+    mesh = make_mesh(data_shards=4, model_shards=1,
+                     devices=eight_devices[:4])
+    full = _fit(rows, vocab, mesh, device_resident=True)
+
+    ck = str(tmp_path / "ck")
+    partial = _fit(rows, vocab, mesh, device_resident=True,
+                   checkpoint_dir=ck, checkpoint_interval=3,
+                   max_iterations=3)
+    assert partial.step == 3
+    resumed = _fit(rows, vocab, mesh, device_resident=True,
+                   checkpoint_dir=ck, checkpoint_interval=3)
+    np.testing.assert_allclose(resumed.lam, full.lam, rtol=1e-4, atol=1e-6)
+
+
+def test_em_auto_bucketing_collapses_small_corpus(corpus, eight_devices):
+    """bucket_by_length="auto" uses ONE bucket for dispatch-bound small
+    corpora and still matches the forced-bucketed result."""
+    from spark_text_clustering_tpu.parallel.mesh import make_mesh
+
+    rows, vocab = corpus
+    mesh = make_mesh(data_shards=2, model_shards=1,
+                     devices=eight_devices[:2])
+    auto = EMLDA(Params(k=3, algorithm="em", max_iterations=3, seed=0,
+                        bucket_by_length="auto"), mesh=mesh)
+    plan = auto._bucket_plan(rows, len(rows))
+    assert len(plan) == 1  # 40 docs x <=64 slots is far below the threshold
+    forced = EMLDA(Params(k=3, algorithm="em", max_iterations=3, seed=0,
+                          bucket_by_length=True), mesh=mesh)
+    m_auto = auto.fit(rows, vocab)
+    m_forced = forced.fit(rows, vocab)
+    np.testing.assert_allclose(
+        m_auto.lam, m_forced.lam, rtol=5e-3, atol=1e-5
+    )
+
+
+def test_pallas_estep_path_matches_xla(corpus, eight_devices, monkeypatch):
+    """STC_GAMMA_BACKEND=pallas routes the online step through the
+    [k, B, L] gather/kernel/scatter path (interpreted on CPU); the fitted
+    model must agree with the XLA path within the fixed point's own
+    tolerance semantics."""
+    from spark_text_clustering_tpu.parallel.mesh import make_mesh
+
+    rows, vocab = corpus
+    mesh = make_mesh(data_shards=2, model_shards=2,
+                     devices=eight_devices[:4])
+    monkeypatch.setenv("STC_GAMMA_BACKEND", "pallas")
+    pallas = _fit(rows, vocab, mesh, max_iterations=3)
+    monkeypatch.setenv("STC_GAMMA_BACKEND", "xla")
+    xla = _fit(rows, vocab, mesh, max_iterations=3)
+    np.testing.assert_allclose(pallas.lam, xla.lam, rtol=2e-2, atol=1e-4)
+    # topic rankings must agree exactly on a corpus this separable
+    np.testing.assert_array_equal(
+        np.asarray(pallas.lam).argmax(axis=0),
+        np.asarray(xla.lam).argmax(axis=0),
+    )
