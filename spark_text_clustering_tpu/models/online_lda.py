@@ -148,6 +148,9 @@ def _online_step_core(
         sstats_shard * eb_shard
     )
     lam_new = (1.0 - rho) * lam_shard + rho * lam_hat
+    # An empty minibatch (possible under Bernoulli sampling on a tiny
+    # corpus) must not decay lambda toward eta — MLlib skips the update.
+    lam_new = jnp.where(batch_docs > 0.0, lam_new, lam_shard)
     return lam_new, step + 1
 
 
@@ -304,7 +307,9 @@ def make_online_mstep(mesh: Mesh, *, eta: float, tau0: float, kappa: float):
         lam_hat = eta + (corpus_sz / jnp.maximum(batch_docs, 1.0)) * (
             sstats * eb_shard
         )
-        return (1.0 - rho) * lam_shard + rho * lam_hat
+        lam_new = (1.0 - rho) * lam_shard + rho * lam_hat
+        # empty minibatch -> no update (see _online_step_core)
+        return jnp.where(batch_docs > 0.0, lam_new, lam_shard)
 
     sharded = jax.shard_map(
         _mstep,
@@ -544,15 +549,46 @@ class OnlineLDA:
         alpha = np.full((k,), p.resolved_alpha(), np.float32)
         eta = p.resolved_eta()
 
-        # Minibatch size: MLlib samples each doc w.p. f per iteration; we
-        # draw a fixed-size sample (stable shapes for XLA) of round(f*N).
-        if p.batch_size is not None:
+        # Minibatch sizing.  MLlib samples each doc w.p. f per iteration;
+        # sampling="fixed" (default) draws exactly round(f*N) docs for
+        # stable XLA shapes, sampling="bernoulli" keeps MLlib's semantics
+        # and pads the batch tensor to a 4-sigma static bound (overflow
+        # probability ~3e-5/iteration; overflowing draws truncate).
+        if p.sampling not in ("fixed", "bernoulli"):
+            raise ValueError(
+                f"unknown sampling {p.sampling!r} (use 'fixed'|'bernoulli')"
+            )
+        # clamped to [.., 1]: batch_size > n and mini_batch_fraction on a
+        # 1-doc corpus (0.05 + 1/1) both legally exceed 1
+        fraction = min(
+            1.0,
+            p.batch_size / max(1, n) if p.batch_size is not None
+            else p.mini_batch_fraction(n),
+        )
+        if p.sampling == "bernoulli":
+            mean = fraction * n
+            bsz = int(np.ceil(mean + 4.0 * np.sqrt(mean * (1 - fraction)) + 1))
+            bsz = min(bsz, n)
+        elif p.batch_size is not None:
             bsz = min(p.batch_size, n)
         else:
-            bsz = max(1, min(n, round(p.mini_batch_fraction(n) * n)))
+            bsz = max(1, min(n, round(fraction * n)))
         n_data = self.mesh.shape[DATA_AXIS]
         bsz = ((bsz + n_data - 1) // n_data) * n_data
         self.last_batch_size = min(bsz, n)
+
+        def sample_pick(it: int) -> np.ndarray:
+            """Unpadded minibatch doc ids for iteration ``it`` — ONE
+            per-iteration derived stream shared by the resident and
+            host-streaming paths (deterministic resume; identical
+            minibatches on either path)."""
+            rng = np.random.default_rng((p.seed, it))
+            if p.sampling == "bernoulli":
+                pick = np.flatnonzero(rng.random(n) < fraction)
+                return pick[:bsz].astype(np.int32)
+            return rng.choice(
+                n, size=min(bsz, n), replace=False
+            ).astype(np.int32)
         # One static row length for the whole run (jit cache friendly).
         max_nnz = max((len(i) for i, _ in rows), default=1)
         row_len = max(8, next_pow2(max_nnz))
@@ -599,10 +635,9 @@ class OnlineLDA:
             state = TrainState(lam, jnp.asarray(start_it, jnp.int32))
 
             def make_pick(it: int) -> np.ndarray:
-                # Per-iteration derived stream => deterministic resume,
-                # identical to the host path's sampling.
-                rng = np.random.default_rng((p.seed, it))
-                pick = rng.choice(n, size=min(bsz, n), replace=False)
+                # sample_pick + pad to the static B (pad ids >= n hit
+                # all-zero resident rows — inert).
+                pick = sample_pick(it)
                 if pick.size < bsz:
                     pick = np.concatenate(
                         [pick, np.arange(n, n + bsz - pick.size)]
@@ -688,12 +723,20 @@ class OnlineLDA:
 
         for it in range(start_it, n_iters):
             timer.start()
-            # Per-iteration derived streams => deterministic resume.  The
-            # minibatch is sampled GLOBALLY (MLlib's Bernoulli analogue),
-            # then grouped by length bucket — grouping changes shapes, not
-            # which docs are visited or what they contribute.
-            rng = np.random.default_rng((p.seed, it))
-            pick = rng.choice(n, size=min(bsz, n), replace=False)
+            # The minibatch is sampled GLOBALLY (sample_pick — shared
+            # with the resident path), then grouped by length bucket —
+            # grouping changes shapes, not which docs are visited or
+            # what they contribute.
+            pick = sample_pick(it)
+            if pick.size == 0:
+                # Bernoulli drew nothing: MLlib skips the update entirely
+                # (but the checkpoint cadence must not skip with it)
+                timer.stop()
+                if ckpt_path and (it + 1) % p.checkpoint_interval == 0:
+                    lam_host = fetch_global(lam)
+                    if is_coordinator():
+                        save_train_state(ckpt_path, it + 1, lam=lam_host)
+                continue
             if p.bucket_by_length:
                 groups: dict = {}
                 for i in pick:
