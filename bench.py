@@ -38,11 +38,13 @@ import time
 import numpy as np
 
 BASELINE_S_PER_ITER = 0.817  # BASELINE.md: EM EN, 50 iters, Spark local[*]
+BASELINE_S_PER_ITER_GE = 2.103  # BASELINE.md: EM GE (V=154,741)
 REFERENCE_RESOURCES = "/root/reference/TextClustering/src/main/resources"
 REPO_DIR = os.path.dirname(os.path.abspath(__file__))
 CACHE = os.path.join(REPO_DIR, ".bench_cache")
 K = 5
 VOCAB_SIZE = 39_380  # match the reference EN model's vocabSize
+VOCAB_SIZE_GE = 154_741  # the reference GE model's vocabSize
 ITERS = 50
 
 # BASELINE.md row 1 shape: 20 Newsgroups, k=20, HashingTF -> IDF -> LDA.
@@ -166,25 +168,41 @@ def main() -> None:
 # only launches us under a platform that proved reachable).
 # =====================================================================
 
-def _load_rows():
-    """TF-IDF rows for books/English — cached after first run."""
-    cache_f = os.path.join(CACHE, "en_tfidf_rows.npz")
+_LANGS = {
+    # lang -> (books subdir, stop-word file, reference model vocabSize)
+    "EN": ("books/English", "stopWords_EN.txt", VOCAB_SIZE),
+    "GE": ("books/German", "stopWords_GE.txt", VOCAB_SIZE_GE),
+}
+
+
+def _load_rows(lang: str = "EN"):
+    """TF-IDF rows for the reference corpus — cached after first run."""
+    books_dir, sw_file, vocab_cap = _LANGS[lang]
+    cache_f = os.path.join(CACHE, f"{lang.lower()}_tfidf_rows.npz")
     if os.path.exists(cache_f):
         z = np.load(cache_f, allow_pickle=True)
         rows = list(zip(z["ids"], z["wts"]))
         return rows, int(z["vocab_len"])
 
-    books = os.path.join(REFERENCE_RESOURCES, "books/English")
+    books = os.path.join(REFERENCE_RESOURCES, books_dir)
     if not os.path.isdir(books):
+        if lang != "EN":
+            # secondary benches SKIP rather than publish a synthetic
+            # timing against the real Spark baseline
+            raise FileNotFoundError(f"{books} not mounted")
+        # EN is the headline metric: a record must always be produced,
+        # so fall back to an EN-shaped synthetic corpus (the record's
+        # corpus provenance is visible in stderr).
+        sys.stderr.write(f"# {books} not mounted: EN-shaped synthetic\n")
         rng = np.random.default_rng(0)
         rows = []
         for _ in range(51):
             nnz = int(rng.integers(2000, 20000))
             ids = np.sort(
-                rng.choice(VOCAB_SIZE, size=nnz, replace=False)
+                rng.choice(vocab_cap, size=nnz, replace=False)
             ).astype(np.int32)
             rows.append((ids, rng.integers(1, 50, nnz).astype(np.float32)))
-        return rows, VOCAB_SIZE
+        return rows, vocab_cap
 
     from spark_text_clustering_tpu.pipeline import (
         IDF,
@@ -199,13 +217,13 @@ def _load_rows():
     )
 
     sw = parse_stop_words(
-        read_stop_word_file(os.path.join(REFERENCE_RESOURCES, "stopWords_EN.txt"))
+        read_stop_word_file(os.path.join(REFERENCE_RESOURCES, sw_file))
     )
     texts = [d.text for d in read_text_dir(books)]
     # the product featurization path: preprocess -> exact vocab -> TF-IDF
     featurizer = Pipeline([
         TextPreprocessor(stop_words=sw),
-        CountVectorizer(vocab_size=VOCAB_SIZE),
+        CountVectorizer(vocab_size=vocab_cap),
         IDF(min_doc_freq=2, idf_floor=0.0001),
     ]).fit({"texts": texts})
     ds = featurizer.transform({"texts": texts})
@@ -240,14 +258,14 @@ def _synthetic_20ng_rows(rng: np.random.Generator):
     return rows
 
 
-def _bench_em():
+def _bench_em(lang: str = "EN", baseline: float = BASELINE_S_PER_ITER):
     import jax
 
     from spark_text_clustering_tpu.config import Params
     from spark_text_clustering_tpu.models.em_lda import EMLDA
     from spark_text_clustering_tpu.parallel import make_mesh
 
-    rows, vocab_len = _load_rows()
+    rows, vocab_len = _load_rows(lang)
     vocab = [f"t{i}" for i in range(vocab_len)]
 
     mesh = make_mesh(data_shards=len(jax.devices()), model_shards=1)
@@ -265,9 +283,9 @@ def _bench_em():
     total = time.perf_counter() - t0
     s_per_iter = float(np.mean(model.iteration_times))
     sys.stderr.write(
-        f"# EM: {len(rows)} docs, V={vocab_len}, k={K}, {ITERS} iters, "
-        f"total {total:.1f}s, logLik {opt.last_log_likelihood:.1f}, "
-        f"baseline {BASELINE_S_PER_ITER}s/iter (Spark local[*])\n"
+        f"# EM {lang}: {len(rows)} docs, V={vocab_len}, k={K}, {ITERS} "
+        f"iters, total {total:.1f}s, logLik {opt.last_log_likelihood:.1f}, "
+        f"baseline {baseline}s/iter (Spark local[*])\n"
     )
     return s_per_iter
 
@@ -368,7 +386,12 @@ def child_main() -> None:
         os.path.join(CACHE, f"xla_cache_{jax.default_backend()}_{fp}"),
     )
 
-    s_per_iter = _bench_em()
+    s_per_iter = _bench_em("EN", BASELINE_S_PER_ITER)
+    ge_s_per_iter = None
+    try:
+        ge_s_per_iter = _bench_em("GE", BASELINE_S_PER_ITER_GE)
+    except Exception as exc:  # GE corpus optional; EN stays the headline
+        sys.stderr.write(f"# GE bench skipped: {exc!r}\n")
     docs_per_sec, log_perp, bsz = _bench_online()
 
     print(
@@ -379,6 +402,17 @@ def child_main() -> None:
                 "unit": "s/iter",
                 "vs_baseline": round(BASELINE_S_PER_ITER / s_per_iter, 2),
                 "platform": jax.default_backend(),
+                "em_ge": (
+                    {
+                        "value": round(ge_s_per_iter, 6),
+                        "unit": "s/iter",
+                        "vs_baseline": round(
+                            BASELINE_S_PER_ITER_GE / ge_s_per_iter, 2
+                        ),
+                    }
+                    if ge_s_per_iter
+                    else None
+                ),
                 "online": {
                     "corpus": "20ng-shaped-synthetic",
                     "n_docs": ONLINE_N_DOCS,

@@ -199,3 +199,35 @@ def test_golden_report_parse_sanity(reference_resources):
         assert np.argmax(dist) == argmax
         assert dist[argmax] == pytest.approx(weight)
         assert sum(dist) == pytest.approx(1.0, abs=1e-6)
+
+
+GE_MODEL = "models/LdaModel_GE_1591070442475"
+
+
+def test_ge_model_import(reference_resources):
+    """The German frozen model (V=154,741 — SURVEY.md §2.6) imports with
+    the same invariants as the EN one: totals match the term-topic count
+    row sums, the sidecar lines up, and describe_topics normalizes by
+    topic totals."""
+    path = os.path.join(reference_resources, GE_MODEL)
+    if not os.path.isdir(path):
+        pytest.skip("frozen GE model not present")
+    art = MLlibLDAArtifacts(path)
+    assert art.k == 5
+    assert art.vocab_size == 154_741
+    np.testing.assert_allclose(
+        art.beta.sum(axis=1), art.global_topic_totals, rtol=1e-12
+    )
+    model = load_reference_model(path)
+    assert len(model.vocab) == art.vocab_size
+    topics = model.describe_topics_terms(10)
+    assert len(topics) == 5
+    beta64 = art.beta / art.beta.sum(axis=1, keepdims=True)
+    vocab_index = {t: i for i, t in enumerate(model.vocab)}
+    for t, terms in enumerate(topics):
+        assert len(terms) == 10
+        # weights descend and match the float64 normalization
+        ws = [w for _, w in terms]
+        assert all(a >= b for a, b in zip(ws, ws[1:]))
+        for term, w in terms:
+            assert beta64[t, vocab_index[term]] == pytest.approx(w, rel=1e-5)
