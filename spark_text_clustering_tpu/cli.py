@@ -89,6 +89,7 @@ def cmd_train(args: argparse.Namespace) -> int:
         algorithm=args.algorithm,
         checkpoint_dir=args.checkpoint_dir,
         checkpoint_interval=args.checkpoint_interval,
+        sampling=args.sampling,
         seed=args.seed,
         data_shards=args.data_shards,
         model_shards=args.model_shards,
@@ -349,6 +350,11 @@ def build_parser() -> argparse.ArgumentParser:
     tr.add_argument("--vocab-size", type=int, default=2_900_000)
     tr.add_argument(
         "--algorithm", default="em", choices=["em", "online", "nmf"]
+    )
+    tr.add_argument(
+        "--sampling", default="fixed", choices=["fixed", "bernoulli"],
+        help="online minibatch sampling: fixed-size round(f*N) or "
+             "MLlib's per-doc Bernoulli(f)",
     )
     tr.add_argument("--checkpoint-dir", default=None)
     tr.add_argument("--checkpoint-interval", type=int, default=10)

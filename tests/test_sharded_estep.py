@@ -151,8 +151,9 @@ def test_em_fit_model_sharded_end_to_end(eight_devices, tiny_corpus_rows):
 
 def test_ccnews_config_compiles_sharded(eight_devices):
     """The north-star CC-News config (k=500, V=10M — BASELINE.md pod-scale
-    row) COMPILES with vocab-sharded lambda: per-device lambda tensors are
-    [500, 10M/8] (~2.5 GB each, 1/8th of the full table) and no
+    row) COMPILES with vocab-sharded lambda: on this 2x4 mesh every
+    per-device lambda tensor is [500, 10M/4] (~5 GB, a quarter of the
+    ~20 GB full table; more model shards shrink it further) and no
     full-width f32 tensor exists in the SPMD module.  Lowered from
     ShapeDtypeStructs, so nothing is allocated — this pins the structural
     memory property at the scale that motivated the sharded E-step."""
