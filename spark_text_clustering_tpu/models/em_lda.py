@@ -422,6 +422,16 @@ class EMLDA:
                 n_wk = part if n_wk is None else n_wk + part
                 n_dk_list.append(dk)
 
+        def save_checkpoint(step_no: int, n_wk_arr, n_dk_l) -> None:
+            # fetches are collective (every process participates); only
+            # the coordinator touches the shared filesystem
+            n_wk_host = fetch_global(n_wk_arr)
+            n_dk_host = _assemble_n_dk(n_dk_l)
+            if is_coordinator():
+                save_train_state(
+                    ckpt_path, step_no, n_wk=n_wk_host, n_dk=n_dk_host
+                )
+
         timer = IterationTimer()
         if verbose:
             # Per-iteration dispatch + sync: observable progress, one print
@@ -446,14 +456,7 @@ class EMLDA:
                 timer.stop()
                 print(f"EM iter {it}: {timer.times[-1]:.3f}s")
                 if ckpt_path and (it + 1) % p.checkpoint_interval == 0:
-                    # fetches are collective (every process participates);
-                    # only the coordinator touches the shared filesystem
-                    n_wk_host = fetch_global(n_wk)
-                    n_dk_host = _assemble_n_dk(n_dk_list)
-                    if is_coordinator():
-                        save_train_state(
-                            ckpt_path, it + 1, n_wk=n_wk_host, n_dk=n_dk_host
-                        )
+                    save_checkpoint(it + 1, n_wk, n_dk_list)
         else:
             # Chunked path: lax.scan runs a whole checkpoint interval as
             # ONE dispatch — per-iteration host syncs cost a network round
@@ -479,17 +482,10 @@ class EMLDA:
                 n_wk, n_dks = run_chunk(n_wk, n_dks, bucket_arrays, m)
                 n_wk.block_until_ready()
                 timer.stop()
-                chunk_t = timer.times.pop()
-                timer.times.extend([chunk_t / m] * m)
+                timer.split_last(m)
                 it += m
                 if ckpt_path and it % interval == 0:
-                    n_dk_list = list(n_dks)
-                    n_wk_host = fetch_global(n_wk)
-                    n_dk_host = _assemble_n_dk(n_dk_list)
-                    if is_coordinator():
-                        save_train_state(
-                            ckpt_path, it, n_wk=n_wk_host, n_dk=n_dk_host
-                        )
+                    save_checkpoint(it, n_wk, list(n_dks))
             n_dk_list = list(n_dks)
 
         n_wk_full = fetch_global(n_wk)

@@ -623,6 +623,12 @@ class OnlineLDA:
             )
         lam = jax.device_put(lam0, model_sharding(self.mesh))
 
+        def save_checkpoint(step_no: int, lam_arr) -> None:
+            # collective fetch on every process; one writer
+            lam_host = fetch_global(lam_arr)
+            if is_coordinator():
+                save_train_state(ckpt_path, step_no, lam=lam_host)
+
         timer = IterationTimer()
         resident = self._resident_arrays(rows, n, row_len)
         if resident is not None:
@@ -661,9 +667,7 @@ class OnlineLDA:
                     timer.stop()
                     print(f"iter {it}: {timer.times[-1]:.3f}s")
                     if ckpt_path and (it + 1) % p.checkpoint_interval == 0:
-                        lam_host = fetch_global(state.lam)
-                        if is_coordinator():
-                            save_train_state(ckpt_path, it + 1, lam=lam_host)
+                        save_checkpoint(it + 1, state.lam)
             else:
                 # Chunked: scan a whole checkpoint interval per dispatch
                 # (see make_online_resident_chunk — per-iteration syncs
@@ -688,13 +692,10 @@ class OnlineLDA:
                     )
                     state.lam.block_until_ready()
                     timer.stop()
-                    chunk_t = timer.times.pop()
-                    timer.times.extend([chunk_t / m] * m)
+                    timer.split_last(m)
                     it += m
                     if ckpt_path and it % interval == 0:
-                        lam_host = fetch_global(state.lam)
-                        if is_coordinator():
-                            save_train_state(ckpt_path, it, lam=lam_host)
+                        save_checkpoint(it, state.lam)
             lam_np = fetch_global(state.lam)[:, :v]
             return LDAModel(
                 lam=lam_np,
@@ -733,9 +734,7 @@ class OnlineLDA:
                 # (but the checkpoint cadence must not skip with it)
                 timer.stop()
                 if ckpt_path and (it + 1) % p.checkpoint_interval == 0:
-                    lam_host = fetch_global(lam)
-                    if is_coordinator():
-                        save_train_state(ckpt_path, it + 1, lam=lam_host)
+                    save_checkpoint(it + 1, lam)
                 continue
             if p.bucket_by_length:
                 groups: dict = {}
@@ -779,10 +778,7 @@ class OnlineLDA:
             if verbose:
                 print(f"iter {it}: {timer.times[-1]:.3f}s")
             if ckpt_path and (it + 1) % p.checkpoint_interval == 0:
-                # collective fetch on every process; one writer
-                lam_host = fetch_global(lam)
-                if is_coordinator():
-                    save_train_state(ckpt_path, it + 1, lam=lam_host)
+                save_checkpoint(it + 1, lam)
 
         lam_np = fetch_global(lam)[:, :v]
         return LDAModel(

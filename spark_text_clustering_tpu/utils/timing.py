@@ -48,3 +48,11 @@ class IterationTimer:
         if self._t0 is not None:
             self.times.append(time.perf_counter() - self._t0)
             self._t0 = None
+
+    def split_last(self, m: int) -> None:
+        """Replace the last recorded span with ``m`` equal slices — how a
+        scan-chunked loop reports per-iteration means (the chunk runs as
+        one dispatch, so individual iterations are not observable)."""
+        if m > 1 and self.times:
+            chunk = self.times.pop()
+            self.times.extend([chunk / m] * m)
