@@ -30,6 +30,16 @@ def make_toy_em_inputs():
     return k, v, ids, wts, n_wk0, n_dk0
 
 
+def make_online_toy_params():
+    """Shared Params for the resident online cross-process fit — the
+    parent test re-runs it single-process, so both sides MUST build from
+    this one factory (same rule as make_toy_em_inputs)."""
+    from spark_text_clustering_tpu.config import Params
+
+    return Params(k=2, max_iterations=5, algorithm="online", seed=0,
+                  batch_size=6, device_resident=True)
+
+
 def make_toy_fit_rows():
     """A tiny deterministic corpus for the end-to-end multi-host fit."""
     rng = np.random.default_rng(11)
@@ -126,9 +136,20 @@ def main() -> int:
     model = est.fit(rows, vocab)
     lam = np.asarray(model.lam)
     ckpt_exists = os.path.exists(os.path.join(ckpt_dir, "em_state.npz"))
+
+    # --- device-resident online fit across the process boundary ----------
+    # The resident minibatch assembly is an ownership-psum gather over
+    # "data": with the corpus sharded across BOTH processes' devices,
+    # every pick crosses DCN.
+    from spark_text_clustering_tpu.models.online_lda import OnlineLDA
+
+    online = OnlineLDA(make_online_toy_params(), mesh=mesh)
+    online_lam = np.asarray(online.fit(rows, vocab).lam)
+
     if pid == 0:
         assert ckpt_exists, "coordinator checkpoint missing"
-        np.savez(out_path, n_wk=n_wk, total=float(total), fit_lam=lam)
+        np.savez(out_path, n_wk=n_wk, total=float(total), fit_lam=lam,
+                 online_lam=online_lam)
     print(f"proc {pid}: ok devices={n_dev}")
     return 0
 

@@ -122,3 +122,12 @@ def test_two_process_bringup_and_em_step(tmp_path):
     np.testing.assert_allclose(
         data["fit_lam"], expected_lam, rtol=1e-4, atol=1e-5
     )
+
+    from multihost_worker import make_online_toy_params
+    from spark_text_clustering_tpu.models.online_lda import OnlineLDA
+
+    online = OnlineLDA(make_online_toy_params(), mesh=mesh)
+    expected_online = np.asarray(online.fit(rows, vocab).lam)
+    np.testing.assert_allclose(
+        data["online_lam"], expected_online, rtol=1e-4, atol=1e-5
+    )
