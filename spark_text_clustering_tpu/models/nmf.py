@@ -22,8 +22,11 @@ scatter-add + tiny [k, k] matmuls:
   H <- H * (W^T X) / ((W^T W) H)      W^T X: scatter-add, psum over "data"
                                       W^T W: [k, k] psum over "data"
 
-No driver round-trips; the only cross-chip traffic is two small psums and
-the H all-gather (which disappears when model_shards=1).
+No driver round-trips, and the full [k, V] H never materializes on any
+device (same memory contract as the LDA steps).  Cross-chip traffic per
+step: the [B, L, k] token-row ownership gather over "model", two [k, k]
+psums, and the W^T X sufficient-statistics psum over "data" — a
+[k, V/model_shards] slab, the same reduction the LDA steps pay.
 """
 
 from __future__ import annotations
@@ -40,10 +43,11 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..config import Params
 from ..ops.sparse import DocTermBatch, batch_from_rows
 from ..parallel.collectives import (
-    all_gather_model,
     data_shard_batch,
+    gather_model_rows,
     psum_data,
-    scatter_model,
+    psum_model,
+    scatter_add_model_shard,
 )
 from ..parallel.mesh import DATA_AXIS, MODEL_AXIS, make_mesh, model_sharding
 from ..utils.timing import IterationTimer
@@ -64,35 +68,36 @@ def _gather_h(h: jnp.ndarray, ids: jnp.ndarray) -> jnp.ndarray:
 
 
 def make_nmf_train_step(
-    mesh: Mesh, *, vocab_size: int
+    mesh: Mesh,
 ) -> Callable[[NMFTrainState, DocTermBatch], NMFTrainState]:
     """Build the jitted, shard_mapped multiplicative-update step.
 
-    ``batch`` must be doc-sharded over "data"; H is V-sharded over "model".
-    Pad docs (all weights 0) have X H^T == 0, so their W rows decay to 0 and
-    contribute nothing to W^T X / W^T W — padding is numerically inert.
+    ``batch`` must be doc-sharded over "data"; H is V-sharded over
+    "model" (shard widths come from H itself).  Pad docs (all weights 0)
+    have X H^T == 0, so their W rows decay to 0 and contribute nothing to
+    W^T X / W^T W — padding is numerically inert.
     """
 
     def _step(w, h_shard, ids, wts):
-        h = all_gather_model(h_shard, axis=-1)                 # [k, V]
+        # The full [k, V] H never materializes (same contract as the LDA
+        # steps, SURVEY.md §7 hard part 5): token rows come from the
+        # ownership gather, every H-side reduction is a [k, k] psum or a
+        # shard-local product.
 
         # --- W update (local to each data shard) -----------------------
-        hg = _gather_h(h, ids)                                 # [B, L, k]
+        hg = gather_model_rows(h_shard, ids)                   # [B, L, k]
         xht = jnp.einsum("blk,bl->bk", hg, wts)                # [B, k]
-        hht = h @ h.T                                          # [k, k]
+        hht = psum_model(h_shard @ h_shard.T)                  # [k, k]
         w = w * xht / (w @ hht + _EPS)
 
-        # --- H update (psum the doc-side reductions) -------------------
+        # --- H update (shard-local on each V-slice) --------------------
         wtw = psum_data(w.T @ w)                               # [k, k]
         vals = wts[..., None] * w[:, None, :]                  # [B, L, k]
-        wtx_vt = (
-            jnp.zeros((vocab_size, w.shape[-1]), jnp.float32)
-            .at[ids.reshape(-1)]
-            .add(vals.reshape(-1, w.shape[-1]))
-        )                                                      # [V, k]
-        wtx = psum_data(wtx_vt.T)                              # [k, V]
-        h = h * wtx / (wtw @ h + _EPS)
-        return w, scatter_model(h, axis=-1)
+        wtx_shard = psum_data(
+            scatter_add_model_shard(ids, vals, h_shard.shape[-1])
+        )                                                      # [k, V/s]
+        h_shard = h_shard * wtx_shard / (wtw @ h_shard + _EPS)
+        return w, h_shard
 
     sharded = jax.shard_map(
         _step,
@@ -247,7 +252,6 @@ class NMF:
         # Per-instance step cache (the EMLDA pattern): repeat fits on the
         # same vocab size skip shard_map construction + XLA retrace.
         self._step_fn = None
-        self._step_fn_vocab: Optional[int] = None
 
     def fit(
         self,
@@ -287,9 +291,9 @@ class NMF:
         h = jax.device_put(h, model_sharding(self.mesh))
         state = NMFTrainState(w, h)
 
-        if self._step_fn is None or self._step_fn_vocab != v_pad:
-            self._step_fn = make_nmf_train_step(self.mesh, vocab_size=v_pad)
-            self._step_fn_vocab = v_pad
+        if self._step_fn is None:
+            # one step fn per estimator; jit re-specializes per shape
+            self._step_fn = make_nmf_train_step(self.mesh)
         step_fn = self._step_fn
         timer = IterationTimer()
         for it in range(p.max_iterations):

@@ -32,8 +32,6 @@ __all__ = [
     "gather_model_rows_kbl",
     "scatter_add_model_shard",
     "scatter_add_model_shard_kbl",
-    "all_gather_model",
-    "scatter_model",
     "data_shard_batch",
     "fetch_global",
 ]
@@ -144,22 +142,6 @@ def scatter_add_model_shard(ids, vals, shard_v):
         .add(vals.reshape(-1, k))
     )
     return out[:shard_v].T
-
-
-def all_gather_model(x, axis: int = -1):
-    """Materialize the full vocab axis from model shards (lambda [k, V/s] ->
-    [k, V]).  Retained for small-V paths (NMF's dense H update); the LDA
-    train steps use ``gather_model_rows`` instead so the full [k, V] never
-    materializes per device."""
-    return lax.all_gather(x, MODEL_AXIS, axis=axis, tiled=True)
-
-
-def scatter_model(x, axis: int = -1):
-    """Slice a full-vocab array back down to this device's model shard."""
-    idx = lax.axis_index(MODEL_AXIS)
-    size = lax.axis_size(MODEL_AXIS)
-    shard = x.shape[axis] // size
-    return lax.dynamic_slice_in_dim(x, idx * shard, shard, axis=axis)
 
 
 def fetch_global(x):

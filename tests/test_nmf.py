@@ -196,3 +196,51 @@ def test_pipeline_estimator_swap(tiny_corpus_rows):
     out = t.transform(ds)
     assert isinstance(out["model"], NMFModel)
     assert out["topic_distribution"].shape == (len(rows), 2)
+
+
+def test_nmf_step_never_materializes_full_h(eight_devices):
+    """Same structural HBM guarantee as the LDA steps: in the 2-vocab-shard
+    SPMD module every H-derived tensor is [k, V/2]; no full-width f32
+    tensor exists (the old step all-gathered H every iteration)."""
+    import re
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from spark_text_clustering_tpu.models.nmf import (
+        NMFTrainState,
+        make_nmf_train_step,
+    )
+    from spark_text_clustering_tpu.ops.sparse import DocTermBatch
+    from spark_text_clustering_tpu.parallel.mesh import model_sharding
+
+    k, v, b, length = 4, 1024, 8, 32
+    mesh = make_mesh(data_shards=1, model_shards=2,
+                     devices=eight_devices[:2])
+    rng = np.random.default_rng(0)
+    state = NMFTrainState(
+        jax.device_put(
+            jnp.asarray(rng.random((b, k)).astype(np.float32)),
+            NamedSharding(mesh, P("data", None)),
+        ),
+        jax.device_put(
+            jnp.asarray(rng.random((k, v)).astype(np.float32)),
+            model_sharding(mesh),
+        ),
+    )
+    batch = DocTermBatch(
+        jax.device_put(
+            jnp.asarray(rng.integers(0, v, (b, length)).astype(np.int32)),
+            NamedSharding(mesh, P("data", None)),
+        ),
+        jax.device_put(
+            jnp.asarray(rng.random((b, length)).astype(np.float32)),
+            NamedSharding(mesh, P("data", None)),
+        ),
+    )
+    step = make_nmf_train_step(mesh)
+    hlo = step.lower(state, batch).compile().as_text()
+    assert re.search(rf"f32\[{k},{v // 2}\]", hlo)
+    full = re.findall(rf"f32\[(?:\d+,)?{v}(?:,\d+)?\]", hlo)
+    assert not full, f"full-width H tensors found: {full[:5]}"
