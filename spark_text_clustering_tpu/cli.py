@@ -28,7 +28,7 @@ from .pipeline import (
     IDF,
     LDA,
     CountVectorizer,
-    Pipeline,
+    Estimator,
     TextPreprocessor,
 )
 from .models.base import LDAModel
@@ -95,16 +95,15 @@ def cmd_train(args: argparse.Namespace) -> int:
         model_shards=args.model_shards,
     )
 
-    stages: List[object] = [
+    feat_stages: List[object] = [
         TextPreprocessor(stop_words=sw, lemmatize=not args.no_lemmatize),
         CountVectorizer(vocab_size=params.vocab_size),
     ]
     if not args.no_tfidf:
         # the reference trains LDA on TF-IDF pseudo-counts
         # (LDAClustering.scala:180-192)
-        stages.append(IDF(min_doc_freq=params.min_doc_freq,
-                          idf_floor=params.idf_floor))
-    stages.append(LDA(params))
+        feat_stages.append(IDF(min_doc_freq=params.min_doc_freq,
+                               idf_floor=params.idf_floor))
 
     from .utils.profiling import MetricsLogger, trace
 
@@ -112,30 +111,53 @@ def cmd_train(args: argparse.Namespace) -> int:
     # the same --metrics-file would truncate the coordinator's records
     metrics = MetricsLogger(args.metrics_file if coordinator else None)
     metrics.log("corpus", documents=len(texts), books_dir=args.books)
-    with trace(args.profile_dir if coordinator else None):
-        with timer.phase("preprocess+vectorize+train"):
-            fitted = Pipeline(stages).fit(
-                {"texts": texts}
-            )
 
-    lda_stage = fitted.stages[-1]
+    with timer.phase("preprocess"):
+        # fit + transform each featurization stage ONCE (lemmatization is
+        # the dominant host cost; Pipeline.fit followed by a separate
+        # transform would run it twice)
+        ds: dict = {"texts": texts}
+        for stage in feat_stages:
+            t = stage.fit(ds) if isinstance(stage, Estimator) else stage
+            ds = t.transform(ds)
+    rows = ds["rows"]
+    n_docs = sum(1 for i, _ in rows if len(i) > 0)
+    # the reference's "token" count is DISTINCT terms per doc summed
+    # (Sum of numActives, LDAClustering.scala:195-197)
+    n_tokens = sum(len(i) for i, _ in rows)
+    actual_v = (
+        len(ds["vocab"]) if ds.get("vocab") is not None
+        else ds["num_features"]
+    )
+
+    if coordinator:
+        # corpus summary, reference format (LDAClustering.scala:28-34);
+        # timings print full precision like Scala's Double.toString
+        print()
+        print("Corpus summary:")
+        print(f"\t Training set size: {n_docs} documents")
+        print(f"\t Vocabulary size: {actual_v} terms")
+        print(f"\t Training set size: {n_tokens} tokens")
+        print(f"\t Preprocessing time: {timer.phases['preprocess']} sec")
+        print()
+        print("LDA model training started")
+
+    with trace(args.profile_dir if coordinator else None):
+        with timer.phase("train"):
+            lda_stage = LDA(params).fit(ds)
     model: LDAModel = lda_stage.model
 
     if coordinator:
-        # corpus summary (LDAClustering.scala:28-34 prints)
-        print("Training corpus summary:")
-        print(f"\t Trained on {len(texts)} documents")
-        print(f"\t Vocabulary size: {model.vocab_size} terms")
-        print(f"\t Topics: {model.k}; algorithm: {params.algorithm}")
-        print(f"\t Preprocessing+training time: "
-              f"{timer.phases['preprocess+vectorize+train']:.1f}s "
-              f"(mean iter {np.mean(model.iteration_times):.3f}s)")
+        # LDAClustering.scala:63-78 prints
+        print("Finished training LDA model.  Summary:")
+        print(f"\t Training time: {timer.phases['train']} sec")
         # avg log-likelihood, the reference's single quality metric
-        # (LDAClustering.scala:73-78, EM only); divided by the corpus
-        # actually trained on (nonempty docs), matching corpus.count()
+        # (EM only); divided by the corpus actually trained on (nonempty
+        # docs), matching corpus.count()
         if lda_stage.log_likelihood is not None and lda_stage.corpus_size:
-            print(f"The average log likelihood of the training data: "
+            print(f"\t Training data average log likelihood: "
                   f"{lda_stage.log_likelihood / lda_stage.corpus_size}")
+            print()
 
         # top-10 terms per topic (LDAClustering.scala:81-92)
         print(f"{model.k} topics:")

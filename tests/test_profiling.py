@@ -91,5 +91,39 @@ class TestCliIntegration:
             json.loads(line) for line in open(mf)
             if json.loads(line)["event"] == "phase"
         ]
-        assert any(p["name"] == "preprocess+vectorize+train" for p in phases)
+        names = {p["name"] for p in phases}
+        # the reference times preprocessing and training separately
+        # (LDAClustering.scala:22-34, :58-64)
+        assert {"read", "preprocess", "train"} <= names
         assert all(np.isfinite(p["seconds"]) for p in phases)
+
+
+class TestConsoleParity:
+    def test_train_prints_reference_summary(self, tmp_path, capsys):
+        """cmd_train's console output follows the reference's exact
+        summary format (LDAClustering.scala:28-34, :60-64, :73-78,
+        :85-92), incl. the distinct-terms 'token' count semantics."""
+        from spark_text_clustering_tpu.cli import main
+
+        books = tmp_path / "books"
+        books.mkdir()
+        (books / "a.txt").write_text("piano violin orchestra symphony " * 9)
+        (books / "b.txt").write_text("electron proton quantum atom " * 9)
+        rc = main([
+            "train", "--books", str(books), "--k", "2",
+            "--max-iterations", "2", "--no-lemmatize", "--no-tfidf",
+            "--models-dir", str(tmp_path / "models"),
+        ])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "Corpus summary:" in out
+        assert "\t Training set size: 2 documents" in out
+        assert "\t Vocabulary size: 8 terms" in out
+        # 4 distinct terms per doc (numActives), repeats NOT counted
+        assert "\t Training set size: 8 tokens" in out
+        assert "\t Preprocessing time: " in out
+        assert "LDA model training started" in out
+        assert "Finished training LDA model.  Summary:" in out
+        assert "\t Training time: " in out
+        assert "\t Training data average log likelihood: " in out
+        assert "2 topics:" in out and "TOPIC 0" in out and "TOPIC 1" in out
