@@ -121,3 +121,23 @@ def test_book_assignments_match_golden_report(
     agreement = agree / compared
     print(f"\ngolden argmax agreement {agreement:.4f} ({agree}/{compared})")
     assert agreement >= 0.88
+
+
+def test_multilingual_train_smoke(reference_resources, tmp_path):
+    """The reference routes 8 languages through the same pipeline
+    (LDALoader.scala:46-56); the Dutch shelf (5 books, non-English
+    diacritics) must train end-to-end through the CLI with no stop-word
+    file — the smallest committed multilingual corpus."""
+    books = os.path.join(reference_resources, "books/Dutch")
+    if not os.path.isdir(books):
+        pytest.skip("Dutch books not present")
+    from spark_text_clustering_tpu.cli import main
+
+    rc = main([
+        "train", "--books", books, "--lang", "DU", "--k", "2",
+        "--max-iterations", "2",
+        "--models-dir", str(tmp_path / "models"),
+    ])
+    assert rc == 0
+    saved = os.listdir(tmp_path / "models")
+    assert len(saved) == 1 and saved[0].startswith("LdaModel_DU_")
