@@ -157,7 +157,19 @@ def load_train_state(path: str) -> dict:
 
 
 def load_model(path: str):
+    """Load a saved model from ``path`` — ours (meta.json + arrays.npz +
+    vocab.txt) or, transparently, a reference-format MLlib
+    DistributedLDAModel (Parquet datasets + ``metadata/part-00000``,
+    SURVEY.md §3.5): users migrating from the reference can point
+    ``score`` straight at their existing frozen model directories."""
     from .base import LDAModel
+
+    if not os.path.exists(os.path.join(path, "meta.json")) and os.path.exists(
+        os.path.join(path, "metadata", "part-00000")
+    ):
+        from .reference_import import load_reference_model
+
+        return load_reference_model(path, placeholder_vocab_ok=False)
 
     with open(os.path.join(path, "meta.json")) as f:
         meta = json.load(f)

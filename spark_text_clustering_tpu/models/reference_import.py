@@ -134,7 +134,9 @@ def load_reference_vocab(model_path: str) -> List[str]:
 
 
 def load_reference_model(
-    model_path: str, vocab: Optional[List[str]] = None
+    model_path: str,
+    vocab: Optional[List[str]] = None,
+    placeholder_vocab_ok: bool = True,
 ) -> LDAModel:
     """Import a frozen MLlib DistributedLDAModel as one of ours.
 
@@ -149,6 +151,16 @@ def load_reference_model(
         try:
             vocab = load_reference_vocab(model_path)
         except FileNotFoundError:
+            if not placeholder_vocab_ok:
+                # user-facing loads (score --model <frozen dir>) must not
+                # silently vectorize against fabricated term names — every
+                # doc would come out all-zero with no error
+                raise FileNotFoundError(
+                    f"vocabulary sidecar missing for {model_path} "
+                    "(expected ../vocabularies/<model_name> next to the "
+                    "model dir, LDAClustering.scala:71-72) — scoring "
+                    "needs the real term names"
+                ) from None
             vocab = [f"term_{i}" for i in range(art.vocab_size)]
     meta = art.metadata
     alpha = np.asarray(meta["docConcentration"], np.float32)

@@ -105,3 +105,46 @@ class TestTrainResume:
         ).fit(rows, vocab)
 
         np.testing.assert_allclose(m_full.lam, m_resumed.lam, rtol=1e-6)
+
+
+def test_load_model_accepts_mllib_layout(reference_resources):
+    """load_model transparently imports a reference-format MLlib model dir
+    (metadata/part-00000 + Parquet), so `score --model <frozen dir>` works
+    for users migrating from the reference."""
+    import os
+
+    import pytest
+
+    pytest.importorskip("pyarrow.parquet")
+    path = os.path.join(
+        reference_resources, "models/LdaModel_EN_1591049082850"
+    )
+    if not os.path.isdir(path):
+        pytest.skip("frozen EN model not present")
+    from spark_text_clustering_tpu.models.persistence import load_model
+
+    model = load_model(path)
+    assert model.k == 5 and model.vocab_size == 39_380
+    assert model.vocab[0] == "come"
+
+
+def test_load_model_mllib_requires_vocab_sidecar(
+    reference_resources, tmp_path
+):
+    """A frozen model dir copied WITHOUT its vocabulary sidecar must raise
+    (not silently score against fabricated term names)."""
+    import os
+    import shutil
+
+    import pytest
+
+    pytest.importorskip("pyarrow.parquet")
+    src = os.path.join(reference_resources, "models/LdaModel_EN_1591049082850")
+    if not os.path.isdir(src):
+        pytest.skip("frozen EN model not present")
+    dst = str(tmp_path / "LdaModel_EN_1591049082850")
+    shutil.copytree(src, dst)  # no ../vocabularies sidecar next to it
+    from spark_text_clustering_tpu.models.persistence import load_model
+
+    with pytest.raises(FileNotFoundError, match="vocabulary sidecar"):
+        load_model(dst)
