@@ -60,41 +60,17 @@ ONLINE_ITERS = 50
 # Parent: platform probing + child supervision (no jax import here).
 # =====================================================================
 
-from spark_text_clustering_tpu.utils.env import scrubbed_cpu_env
+from spark_text_clustering_tpu.utils.env import (
+    probe_accelerator,
+    scrubbed_cpu_env,
+)
 
 
-def _probe_tpu(attempts: int = 3, probe_timeout: int = 90) -> bool:
+def _probe_tpu() -> bool:
     """Can a fresh interpreter bring up an ACCELERATOR backend under the
-    CURRENT env?  jax silently falling back to CPU must not count.  Retries
-    with bounded backoff — round-1 showed one-shot init can fail
-    transiently (UNAVAILABLE) or hang outright."""
-    code = (
-        "import jax; assert len(jax.devices()) >= 1; "
-        "b = jax.default_backend(); assert b != 'cpu', b; print('ok', b)"
-    )
-    backoff = [0, 10, 30]
-    for i in range(attempts):
-        if backoff[min(i, len(backoff) - 1)]:
-            time.sleep(backoff[min(i, len(backoff) - 1)])
-        try:
-            r = subprocess.run(
-                [sys.executable, "-c", code],
-                capture_output=True,
-                text=True,
-                timeout=probe_timeout,
-            )
-            if r.returncode == 0 and "ok" in r.stdout:
-                return True
-            sys.stderr.write(
-                f"# tpu probe attempt {i + 1}/{attempts} rc={r.returncode}: "
-                f"{r.stderr.strip().splitlines()[-1] if r.stderr.strip() else ''}\n"
-            )
-        except subprocess.TimeoutExpired:
-            sys.stderr.write(
-                f"# tpu probe attempt {i + 1}/{attempts} timed out "
-                f"({probe_timeout}s)\n"
-            )
-    return False
+    CURRENT env?  (Shared hardened probe: retries with backoff, rejects
+    the silent CPU fallback, cannot hang.)"""
+    return probe_accelerator(verbose=True)["ok"]
 
 
 def _run_child(env: dict, timeout: int = 2400):

@@ -328,6 +328,48 @@ def cmd_stream_train(args: argparse.Namespace) -> int:
     return 0
 
 
+def cmd_doctor(args: argparse.Namespace) -> int:
+    """Environment health report: accelerator reachability (probed in a
+    throwaway subprocess so a wedged TPU tunnel can only time out, never
+    hang this process — the round-1 failure mode), native preprocessing
+    backend, and gamma-backend resolution."""
+    from .utils.env import probe_accelerator, scrubbed_cpu_env
+
+    print("spark_text_clustering_tpu doctor")
+
+    acc = probe_accelerator(
+        attempts=1, probe_timeout=args.probe_timeout,
+        require_accelerator=False,
+    )
+    if acc["ok"] and acc["backend"] != "cpu":
+        print(f"  accelerator: OK — jax {acc['version']}, backend "
+              f"{acc['backend']}, {acc['devices']} device(s)")
+    elif acc["ok"]:
+        # jax came up but only on its CPU platform — that is NOT a
+        # reachable accelerator (the silent-fallback bench.py guards for)
+        print(f"  accelerator: NONE — jax {acc['version']} fell back to "
+              f"the cpu platform ({acc['devices']} device(s))")
+    else:
+        print(f"  accelerator: UNREACHABLE ({acc['error']})")
+
+    cpu = probe_accelerator(
+        attempts=1, probe_timeout=120, require_accelerator=False,
+        env=scrubbed_cpu_env(8),
+    )
+    print(f"  cpu fallback (8 virtual devices): "
+          f"{'OK' if cpu['ok'] else 'FAILED (' + cpu['error'] + ')'}")
+
+    from .utils.native import native_available
+
+    print(f"  native textproc (C++ ctypes): "
+          f"{'OK' if native_available() else 'unavailable — Python path'}")
+
+    forced = os.environ.get("STC_GAMMA_BACKEND", "")
+    print(f"  gamma backend: "
+          f"{forced or 'auto (pallas on TPU, xla elsewhere)'}")
+    return 0
+
+
 def _add_distributed_args(p: argparse.ArgumentParser) -> None:
     """Multi-host DCN flags (every process runs the same command with its
     own --process-id; tests/test_multihost.py exercises the path)."""
@@ -443,6 +485,12 @@ def build_parser() -> argparse.ArgumentParser:
     st.add_argument("--model-shards", type=int, default=1)
     st.add_argument("--models-dir", default="models")
     st.set_defaults(fn=cmd_stream_train)
+
+    dr = sub.add_parser(
+        "doctor", help="environment health report (hang-proof probes)"
+    )
+    dr.add_argument("--probe-timeout", type=int, default=60)
+    dr.set_defaults(fn=cmd_doctor)
     return ap
 
 

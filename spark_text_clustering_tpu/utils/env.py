@@ -11,10 +11,14 @@ shares this one scrub so the rule set cannot drift apart.
 
 from __future__ import annotations
 
+import json
 import os
+import subprocess
+import sys
+import time
 from typing import Mapping, MutableMapping, Optional
 
-__all__ = ["scrub_axon_env", "scrubbed_cpu_env"]
+__all__ = ["scrub_axon_env", "scrubbed_cpu_env", "probe_accelerator"]
 
 
 def scrub_axon_env(env: MutableMapping[str, str]) -> None:
@@ -36,3 +40,77 @@ def scrubbed_cpu_env(
     env["JAX_PLATFORMS"] = "cpu"
     env["XLA_FLAGS"] = f"--xla_force_host_platform_device_count={n_devices}"
     return env
+
+
+def probe_accelerator(
+    attempts: int = 3,
+    probe_timeout: int = 90,
+    require_accelerator: bool = True,
+    env: Optional[Mapping[str, str]] = None,
+    verbose: bool = False,
+) -> dict:
+    """Can a FRESH interpreter bring up a jax backend under ``env``
+    (default: the current environment)?
+
+    Probed in a throwaway subprocess so a wedged TPU tunnel can only
+    time out, never hang the caller (round-1 lost both driver artifacts
+    to exactly that hang).  Retries with bounded backoff — one-shot init
+    can fail transiently (UNAVAILABLE).  With ``require_accelerator``,
+    jax silently falling back to its CPU platform counts as failure.
+
+    Returns ``{"ok", "backend", "version", "devices", "error"}``;
+    shared by bench.py's TPU gate and the CLI ``doctor`` subcommand so
+    the two health checks cannot drift apart.
+    """
+    code = (
+        "import jax, json; d = jax.devices(); "
+        "print('PROBE', json.dumps({'v': jax.__version__, "
+        "'b': jax.default_backend(), 'n': len(d)}))"
+    )
+    backoff = [0, 10, 30]
+    last_err = ""
+    for i in range(attempts):
+        delay = backoff[min(i, len(backoff) - 1)]
+        if delay:
+            time.sleep(delay)
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c", code],
+                capture_output=True,
+                text=True,
+                timeout=probe_timeout,
+                env=None if env is None else dict(env),
+            )
+        except subprocess.TimeoutExpired:
+            last_err = f"probe hung >{probe_timeout}s"
+        else:
+            line = next(
+                (ln for ln in r.stdout.splitlines()
+                 if ln.startswith("PROBE ")),
+                None,
+            )
+            if r.returncode == 0 and line:
+                info = json.loads(line[len("PROBE "):])
+                if require_accelerator and info["b"] == "cpu":
+                    last_err = "jax fell back to the cpu platform"
+                else:
+                    return {
+                        "ok": True,
+                        "backend": info["b"],
+                        "version": info["v"],
+                        "devices": info["n"],
+                        "error": "",
+                    }
+            else:
+                tail = (
+                    r.stderr.strip().splitlines()[-1]
+                    if r.stderr.strip() else ""
+                )
+                last_err = f"rc={r.returncode} {tail}".strip()
+        if verbose:
+            sys.stderr.write(
+                f"# accelerator probe attempt {i + 1}/{attempts}: "
+                f"{last_err}\n"
+            )
+    return {"ok": False, "backend": None, "version": None,
+            "devices": 0, "error": last_err}

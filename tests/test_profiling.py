@@ -127,3 +127,18 @@ class TestConsoleParity:
         assert "\t Training time: " in out
         assert "\t Training data average log likelihood: " in out
         assert "2 topics:" in out and "TOPIC 0" in out and "TOPIC 1" in out
+
+
+def test_doctor_reports_environment(capsys):
+    """`doctor` must produce a full health report without hanging even
+    when the accelerator is unreachable (probes run in throwaway
+    subprocesses with timeouts)."""
+    from spark_text_clustering_tpu.cli import main
+
+    rc = main(["doctor", "--probe-timeout", "45"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "accelerator:" in out
+    assert "cpu fallback (8 virtual devices): OK" in out
+    assert "native textproc" in out
+    assert "gamma backend:" in out
