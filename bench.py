@@ -154,7 +154,11 @@ _LANGS = {
 def _load_rows(lang: str = "EN"):
     """TF-IDF rows for the reference corpus — cached after first run."""
     books_dir, sw_file, vocab_cap = _LANGS[lang]
-    cache_f = os.path.join(CACHE, f"{lang.lower()}_tfidf_rows.npz")
+    from spark_text_clustering_tpu.utils.textproc import TEXTPROC_VERSION
+
+    cache_f = os.path.join(
+        CACHE, f"{lang.lower()}_tfidf_rows_v{TEXTPROC_VERSION}.npz"
+    )
     if os.path.exists(cache_f):
         z = np.load(cache_f, allow_pickle=True)
         rows = list(zip(z["ids"], z["wts"]))

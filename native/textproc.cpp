@@ -21,6 +21,7 @@
 #include <cstdlib>
 #include <cstring>
 #include <string>
+#include <unordered_map>
 #include <unordered_set>
 #include <vector>
 
@@ -177,10 +178,13 @@ bool is_special(u32 c) {
 }
 
 // ---------------------------------------------------------------------------
-// Porter stemmer — NLTK PorterStemmer(mode="ORIGINAL_ALGORITHM"),
-// stem(word, to_lowercase=False).  Operates on code points; vowel tests use
-// LOWERCASE ascii a/e/i/o/u only (so uppercase letters count as consonants,
-// exactly like the Python original running on a non-lowercased string).
+// Porter stemmer — NLTK PorterStemmer(mode="MARTIN_EXTENSIONS"),
+// stem(word, to_lowercase=False): the published algorithm plus Martin's
+// m>0 "bli"->"ble" / "logi"->"log" departures and the len<=2 early return,
+// matching OpenNLP's tartarus port (see textproc.py for the frozen-vocab
+// evidence).  Operates on code points; vowel tests use LOWERCASE ascii
+// a/e/i/o/u only (so uppercase letters count as consonants, exactly like
+// the Python original running on a non-lowercased string).
 // ---------------------------------------------------------------------------
 struct Porter {
   static bool is_vowel_char(u32 c) {
@@ -331,14 +335,14 @@ struct Porter {
   }
 
   static U32s step2(U32s w) {
-    // ORIGINAL_ALGORITHM rule list (abli variant, no alli-first, no
-    // fulli/logi)
+    // MARTIN_EXTENSIONS rule list: bli variant (not abli), logi appended
+    // last; no NLTK-only alli-first/fulli
     if (try_rule(w, "ational", "ate", M_GT_0)) return w;
     if (try_rule(w, "tional", "tion", M_GT_0)) return w;
     if (try_rule(w, "enci", "ence", M_GT_0)) return w;
     if (try_rule(w, "anci", "ance", M_GT_0)) return w;
     if (try_rule(w, "izer", "ize", M_GT_0)) return w;
-    if (try_rule(w, "abli", "able", M_GT_0)) return w;
+    if (try_rule(w, "bli", "ble", M_GT_0)) return w;
     if (try_rule(w, "alli", "al", M_GT_0)) return w;
     if (try_rule(w, "entli", "ent", M_GT_0)) return w;
     if (try_rule(w, "eli", "e", M_GT_0)) return w;
@@ -353,6 +357,7 @@ struct Porter {
     if (try_rule(w, "aliti", "al", M_GT_0)) return w;
     if (try_rule(w, "iviti", "ive", M_GT_0)) return w;
     if (try_rule(w, "biliti", "ble", M_GT_0)) return w;
+    if (try_rule(w, "logi", "log", M_GT_0)) return w;
     return w;
   }
 
@@ -408,6 +413,8 @@ struct Porter {
   }
 
   static U32s stem(U32s w) {
+    // martin-mode early return: strings of length <= 2 skip stemming
+    if (w.size() <= 2) return w;
     w = step1a(std::move(w));
     w = step1b(std::move(w));
     w = step1c(std::move(w));
@@ -431,30 +438,114 @@ struct IrregularEntry {
 const IrregularEntry kIrregular[] = {
     {"was", "be"},       {"were", "be"},     {"been", "be"},
     {"is", "be"},        {"are", "be"},      {"am", "be"},
-    {"has", "have"},     {"had", "have"},    {"having", "have"},
+    {"being", "be"},     {"has", "have"},    {"had", "have"},
+    {"having", "have"},
     {"did", "do"},       {"does", "do"},     {"done", "do"},
+    {"doing", "do"},
     {"went", "go"},      {"gone", "go"},     {"goes", "go"},
-    {"said", "say"},     {"says", "say"},    {"saw", "see"},
-    {"seen", "see"},     {"made", "make"},   {"came", "come"},
-    {"taken", "take"},   {"took", "take"},   {"given", "give"},
-    {"gave", "give"},    {"got", "get"},     {"gotten", "get"},
+    {"going", "go"},
+    {"said", "say"},     {"says", "say"},    {"saying", "say"},
+    {"saw", "see"},      {"seen", "see"},
+    {"made", "make"},    {"came", "come"},   {"taken", "take"},
+    {"took", "take"},    {"given", "give"},  {"gave", "give"},
+    {"got", "get"},      {"gotten", "get"},
     {"knew", "know"},    {"known", "know"},  {"thought", "think"},
     {"told", "tell"},    {"found", "find"},  {"left", "leave"},
     {"felt", "feel"},    {"kept", "keep"},   {"held", "hold"},
     {"brought", "bring"},{"stood", "stand"}, {"sat", "sit"},
     {"spoke", "speak"},  {"spoken", "speak"},{"heard", "hear"},
-    {"meant", "mean"},   {"men", "man"},     {"women", "woman"},
-    {"children", "child"},{"feet", "foot"},  {"teeth", "tooth"},
-    {"mice", "mouse"},   {"people", "person"},{"wives", "wife"},
-    {"lives", "life"},   {"leaves", "leaf"}, {"selves", "self"},
-    {"eyes", "eye"},     {"better", "good"}, {"best", "good"},
-    {"worse", "bad"},    {"worst", "bad"},
+    {"meant", "mean"},
+    // strong / irregular verbs
+    {"abode", "abide"},  {"arose", "arise"}, {"arisen", "arise"},
+    {"awoke", "awake"},  {"awoken", "awake"},{"bade", "bid"},
+    {"begotten", "beget"},{"besought", "beseech"},{"hewn", "hew"},
+    {"befallen", "befall"},{"befell", "befall"},{"beheld", "behold"},
+    {"foresaw", "foresee"},{"foreseen", "foresee"},
+    {"forsaken", "forsake"},{"forsook", "forsake"},{"leapt", "leap"},
+    {"outgrown", "outgrow"},{"overheard", "overhear"},
+    {"overtaken", "overtake"},{"overthrown", "overthrow"},
+    {"overtook", "overtake"},{"undergone", "undergo"},
+    {"undertaken", "undertake"},{"undertook", "undertake"},
+    {"withdrawn", "withdraw"},{"withheld", "withhold"},
+    {"slain", "slay"},   {"slew", "slay"},   {"slung", "sling"},
+    {"smitten", "smite"},{"smote", "smite"}, {"spat", "spit"},
+    {"stank", "stink"},  {"striven", "strive"},{"strode", "stride"},
+    {"swollen", "swell"},{"trodden", "tread"},
+    {"ate", "eat"},      {"eaten", "eat"},   {"became", "become"},
+    {"began", "begin"},  {"begun", "begin"}, {"bent", "bend"},
+    {"bitten", "bite"},  {"blew", "blow"},   {"blown", "blow"},
+    {"bore", "bear"},    {"borne", "bear"},  {"bought", "buy"},
+    {"bred", "breed"},   {"broke", "break"}, {"broken", "break"},
+    {"built", "build"},  {"burnt", "burn"},  {"caught", "catch"},
+    {"chose", "choose"}, {"chosen", "choose"},{"clung", "cling"},
+    {"crept", "creep"},  {"dealt", "deal"},  {"drank", "drink"},
+    {"drunk", "drink"},  {"dreamt", "dream"},{"drew", "draw"},
+    {"drawn", "draw"},   {"drove", "drive"}, {"driven", "drive"},
+    {"dug", "dig"},      {"fed", "feed"},    {"fell", "fall"},
+    {"fallen", "fall"},  {"fled", "flee"},   {"flew", "fly"},
+    {"flown", "fly"},    {"flung", "fling"}, {"forbade", "forbid"},
+    {"forgave", "forgive"},{"forgot", "forget"},{"forgotten", "forget"},
+    {"fought", "fight"}, {"froze", "freeze"},{"frozen", "freeze"},
+    {"grew", "grow"},    {"grown", "grow"},  {"hid", "hide"},
+    {"hidden", "hide"},  {"hung", "hang"},   {"knelt", "kneel"},
+    {"laid", "lay"},     {"lain", "lie"},    {"leant", "lean"},
+    {"learnt", "learn"}, {"led", "lead"},    {"lent", "lend"},
+    {"lit", "light"},    {"lost", "lose"},   {"met", "meet"},
+    {"mistook", "mistake"},{"overcame", "overcome"},{"paid", "pay"},
+    {"ran", "run"},      {"rang", "ring"},   {"rung", "ring"},
+    {"rode", "ride"},    {"ridden", "ride"}, {"risen", "rise"},
+    {"sang", "sing"},    {"sung", "sing"},   {"sank", "sink"},
+    {"sunk", "sink"},    {"sent", "send"},   {"shook", "shake"},
+    {"shaken", "shake"}, {"shone", "shine"}, {"shot", "shoot"},
+    {"shown", "show"},   {"shrank", "shrink"},{"slept", "sleep"},
+    {"slid", "slide"},   {"sold", "sell"},   {"sought", "seek"},
+    {"sped", "speed"},   {"spent", "spend"}, {"spun", "spin"},
+    {"sprang", "spring"},{"sprung", "spring"},{"stole", "steal"},
+    {"stolen", "steal"}, {"stuck", "stick"}, {"stung", "sting"},
+    {"strove", "strive"},{"struck", "strike"},{"swam", "swim"},
+    {"swum", "swim"},    {"swept", "sweep"}, {"swore", "swear"},
+    {"sworn", "swear"},  {"swung", "swing"}, {"taught", "teach"},
+    {"threw", "throw"},  {"thrown", "throw"},{"tore", "tear"},
+    {"torn", "tear"},    {"trod", "tread"},  {"understood", "understand"},
+    {"wept", "weep"},    {"woke", "wake"},   {"woken", "wake"},
+    {"won", "win"},      {"wore", "wear"},   {"worn", "wear"},
+    {"wove", "weave"},   {"woven", "weave"}, {"withdrew", "withdraw"},
+    {"wrote", "write"},  {"written", "write"},{"wrung", "wring"},
+    // irregular plurals
+    {"men", "man"},      {"women", "woman"}, {"children", "child"},
+    {"feet", "foot"},    {"teeth", "tooth"}, {"mice", "mouse"},
+    {"people", "person"},{"wives", "wife"},  {"lives", "life"},
+    {"leaves", "leaf"},  {"selves", "self"}, {"eyes", "eye"},
+    {"gentlemen", "gentleman"},{"countrymen", "countryman"},
+    {"fishermen", "fisherman"},{"workmen", "workman"},
+    {"horsemen", "horseman"},{"policemen", "policeman"},
+    {"seamen", "seaman"},{"townsmen", "townsman"},
+    {"kinsmen", "kinsman"},{"madmen", "madman"},
+    {"frenchmen", "frenchman"},{"englishmen", "englishman"},
+    {"clergymen", "clergyman"},{"noblemen", "nobleman"},
+    {"footmen", "footman"},{"huntsmen", "huntsman"},
+    {"boatmen", "boatman"},{"statesmen", "statesman"},
+    {"tradesmen", "tradesman"},{"watchmen", "watchman"},
+    {"foremen", "foreman"},{"firemen", "fireman"},
+    {"midshipmen", "midshipman"},{"oarsmen", "oarsman"},
+    {"herdsmen", "herdsman"},{"marksmen", "marksman"},
+    {"wolves", "wolf"},{"knives", "knife"},
+    {"thieves", "thief"},{"shelves", "shelf"},{"halves", "half"},
+    {"calves", "calf"},  {"elves", "elf"},   {"loaves", "loaf"},
+    {"geese", "goose"},  {"oxen", "ox"},
+    // suppletive comparatives
+    {"better", "good"},  {"best", "good"},   {"worse", "bad"},
+    {"worst", "bad"},
 };
 
 const char* irregular_lookup(const string& low) {
-  for (auto& e : kIrregular)
-    if (low == e.from) return e.to;
-  return nullptr;
+  static const std::unordered_map<string, const char*> kMap = [] {
+    std::unordered_map<string, const char*> m;
+    for (auto& e : kIrregular) m.emplace(e.from, e.to);
+    return m;
+  }();
+  auto it = kMap.find(low);
+  return it == kMap.end() ? nullptr : it->second;
 }
 
 // Python's _strip_double compares RAW chars (`stem_[-1] not in "ls"` — an
@@ -464,7 +555,8 @@ U32s strip_double_raw(const U32s& stem) {
   if (n >= 2 && stem[n - 1] == stem[n - 2] &&
       !(stem[n - 1] == 'a' || stem[n - 1] == 'e' || stem[n - 1] == 'i' ||
         stem[n - 1] == 'o' || stem[n - 1] == 'u') &&
-      stem[n - 1] != 'l' && stem[n - 1] != 's') {
+      stem[n - 1] != 'l' && stem[n - 1] != 's' && stem[n - 1] != 'f' &&
+      stem[n - 1] != 'z') {  // fall, miss, sniff, buzz keep doubles
     return U32s(stem.begin(), stem.end() - 1);
   }
   return stem;
@@ -476,15 +568,27 @@ bool lower_is_vowel(u32 c) {
 }
 
 // textproc._needs_e(stem_.lower()): called on the LOWERCASED stem.
+// Mirrors the Python rule set exactly: [sz] not preceded by s/z, then CVC
+// with the -er/-en/-on/-el/-om unstressed-syllable exclusions (see
+// textproc.py for the Porter-equalization rationale).
 bool needs_e_lower(const U32s& low) {
   size_t n = low.size();
+  if (n >= 2 && (low[n - 1] == 's' || low[n - 1] == 'z') &&
+      low[n - 2] != 's' && low[n - 2] != 'z')
+    return true;
+  // associate/appreciate-class "-iat" stems (V,V,C fails the CVC test)
+  if (n >= 3 && low[n - 3] == 'i' && low[n - 2] == 'a' && low[n - 1] == 't')
+    return true;
   if (n < 3) return false;
   u32 c1 = low[n - 3], v = low[n - 2], c2 = low[n - 1];
   bool cond = !lower_is_vowel(c2) && c2 != 'w' && c2 != 'x' && c2 != 'y' &&
               lower_is_vowel(v) && !lower_is_vowel(c1);
   if (!cond) return false;
-  // `not any(ch in _VOWELS for ch in stem_[:-3][-1:])`
-  if (n >= 4 && lower_is_vowel(low[n - 4])) return false;
+  // _NO_E_SUFFIXES = ("er", "en", "on", "el", "om")
+  u32 a = low[n - 2], b = low[n - 1];
+  if ((a == 'e' && (b == 'r' || b == 'n' || b == 'l')) ||
+      (a == 'o' && (b == 'n' || b == 'm')))
+    return false;
   return true;
 }
 
@@ -508,7 +612,8 @@ U32s lemma(const U32s& word) {
   U32s low = ascii_lower_all(word);
   // irregular table: keys are pure-ASCII, so an ASCII-lower lookup matches
   // Python's full .lower() for every word that can possibly hit the table
-  if (low.size() <= 8) {
+  // (longest key: "understood", 10)
+  if (low.size() <= 10) {
     bool all_ascii = true;
     for (u32 c : low)
       if (c >= 0x80) {
@@ -568,6 +673,10 @@ U32s lemma(const U32s& word) {
     out.push_back('y');
     return out;
   }
+  if (ends_with_low(low, "eed")) {
+    // leave -eed words whole: Porter step-1b handles both classes
+    return word;
+  }
   if (ends_with_low(low, "ed") && n > 4) {
     U32s stem(word.begin(), word.end() - 2);
     if (!any_vowel_lower(stem)) return word;
@@ -584,9 +693,75 @@ U32s lemma(const U32s& word) {
 }
 
 // ---------------------------------------------------------------------------
+// textproc._simple_lower: 1:1 per-code-point lowercase via kLowerPairs
+// (binary search; multi-char lowerings are identity on both sides).
+// ---------------------------------------------------------------------------
+u32 simple_lower_cp(u32 c) {
+  size_t lo = 0, hi = kLowerPairs_len;
+  while (lo < hi) {
+    size_t mid = (lo + hi) / 2;
+    if (kLowerPairs[mid][0] < c)
+      lo = mid + 1;
+    else
+      hi = mid;
+  }
+  if (lo < kLowerPairs_len && kLowerPairs[lo][0] == c)
+    return kLowerPairs[lo][1];
+  return c;
+}
+
+U32s simple_lower(const U32s& w) {
+  U32s out = w;
+  for (auto& c : out) c = simple_lower_cp(c);
+  return out;
+}
+
+// ---------------------------------------------------------------------------
+// textproc._split_contraction: (base, clitic lemma or nullptr).  Unknown
+// apostrophe forms keep the whole word as base (old single-word path).
+// ---------------------------------------------------------------------------
+struct SplitWord {
+  U32s base;
+  const char* clitic;  // nullptr = no clitic token
+};
+
+SplitWord split_contraction(const U32s& w) {
+  size_t i = 0, n = w.size();
+  for (; i < n; ++i)
+    if (w[i] == '\'' || w[i] == 0x2019) break;
+  if (i == n) return {w, nullptr};
+  U32s base(w.begin(), w.begin() + (long)i);
+  string suf;  // ascii-lowered suffix; non-ascii cannot hit the map
+  bool ascii = true;
+  for (size_t j = i + 1; j < n; ++j) {
+    if (w[j] >= 0x80) {
+      ascii = false;
+      break;
+    }
+    suf += (char)ascii_lower(w[j]);
+  }
+  if (ascii) {
+    if (suf == "t" && base.size() > 1 &&
+        simple_lower_cp(base.back()) == (u32)'n') {
+      base.pop_back();  // isn't -> is + not
+      return {std::move(base), "not"};
+    }
+    if (suf == "ll") return {std::move(base), "will"};
+    if (suf == "ve") return {std::move(base), "have"};
+    if (suf == "re") return {std::move(base), "be"};
+    if (suf == "d") return {std::move(base), "would"};
+    if (suf == "s" || suf == "m") return {std::move(base), nullptr};
+  }
+  return {w, nullptr};
+}
+
+// ---------------------------------------------------------------------------
 // lemmatize_text (textproc.lemmatize_text): sentence split on
 // (?<=[.!?])\s+, word regex [^\W\d_]+(?:['’][^\W\d_]+)?, optional
-// within-sentence dedup, lemma, keep len > min_len.
+// within-sentence dedup on the RAW word, contraction split, document-level
+// case folding (fold a non-lowercase base when its lowercase form occurs
+// anywhere in the document), lemma, keep len > min_len, clitic lemma after
+// its base.
 // ---------------------------------------------------------------------------
 void words_of_sentence(const U32s& sent, vector<U32s>& out) {
   size_t i = 0, n = sent.size();
@@ -613,7 +788,8 @@ void words_of_sentence(const U32s& sent, vector<U32s>& out) {
   }
 }
 
-U32s lemmatize_text(const U32s& text, int min_len_exclusive, bool dedup) {
+U32s lemmatize_text(const U32s& text, int min_len_exclusive, bool dedup,
+                    bool fold_case) {
   U32s out;
   size_t n = text.size();
   size_t start = 0;
@@ -631,6 +807,10 @@ U32s lemmatize_text(const U32s& text, int min_len_exclusive, bool dedup) {
   }
   sentences.emplace_back(start, n);
 
+  // pass 1: dedup raw words, split contractions, collect lowercase bases
+  vector<vector<SplitWord>> sent_parts;
+  sent_parts.reserve(sentences.size());
+  std::unordered_set<string> lower_bases;
   std::unordered_set<string> seen;
   vector<U32s> words;
   for (auto& [s, e] : sentences) {
@@ -638,15 +818,41 @@ U32s lemmatize_text(const U32s& text, int min_len_exclusive, bool dedup) {
     words.clear();
     words_of_sentence(sent, words);
     seen.clear();
+    sent_parts.emplace_back();
+    auto& parts = sent_parts.back();
     for (auto& w : words) {
       if (dedup) {
         string key = encode_utf8(w);
         if (!seen.insert(std::move(key)).second) continue;
       }
-      U32s lm = lemma(w);
+      SplitWord sw = split_contraction(w);
+      if (fold_case && sw.base == simple_lower(sw.base))
+        lower_bases.insert(encode_utf8(sw.base));
+      parts.push_back(std::move(sw));
+    }
+  }
+
+  // pass 2: fold, lemma, emit (clitic lemma follows its base)
+  for (auto& parts : sent_parts) {
+    for (auto& p : parts) {
+      U32s base = p.base;
+      if (fold_case) {
+        U32s low = simple_lower(base);
+        if (low != base && lower_bases.count(encode_utf8(low)))
+          base = std::move(low);
+      }
+      U32s lm = lemma(base);
       if ((int)lm.size() > min_len_exclusive) {
         if (!out.empty()) out.push_back(' ');
         out.insert(out.end(), lm.begin(), lm.end());
+      }
+      if (p.clitic) {
+        size_t cl = strlen(p.clitic);
+        if ((int)cl > min_len_exclusive) {
+          if (!out.empty()) out.push_back(' ');
+          for (const char* q = p.clitic; *q; ++q)
+            out.push_back((u32)(unsigned char)*q);
+        }
       }
     }
   }
@@ -698,7 +904,7 @@ extern "C" {
 char* stc_preprocess(const char* text, long text_len,
                      const char* stop_words_nl,
                      int lemmatize, int min_lemma_len_exclusive, int dedup,
-                     long* out_len) {
+                     int fold_case, long* out_len) {
   std::unordered_set<string> stops;
   if (stop_words_nl && *stop_words_nl) {
     const char* p = stop_words_nl;
@@ -713,7 +919,8 @@ char* stc_preprocess(const char* text, long text_len,
 
   U32s cps = decode_utf8(text, (size_t)text_len);
   if (lemmatize) {
-    cps = lemmatize_text(cps, min_lemma_len_exclusive, dedup != 0);
+    cps = lemmatize_text(cps, min_lemma_len_exclusive, dedup != 0,
+                         fold_case != 0);
   }
   // filter_special_characters
   for (auto& c : cps)
@@ -765,6 +972,6 @@ char* stc_lemma(const char* word) {
 
 void stc_free(char* p) { free(p); }
 
-int stc_abi_version() { return 2; }
+int stc_abi_version() { return 3; }
 
 }  // extern "C"

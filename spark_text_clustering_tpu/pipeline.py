@@ -103,6 +103,7 @@ class TextPreprocessor(Transformer):
         stop_words: frozenset = frozenset(),
         lemmatize: bool = True,
         dedup_within_sentence: bool = True,
+        fold_case: bool = True,
         backend: str = "auto",
     ) -> None:
         if backend not in ("auto", "native", "python"):
@@ -110,6 +111,7 @@ class TextPreprocessor(Transformer):
         self.stop_words = stop_words
         self.lemmatize = lemmatize
         self.dedup = dedup_within_sentence
+        self.fold_case = fold_case
         self.backend = backend
 
     def _use_native(self) -> bool:
@@ -136,6 +138,7 @@ class TextPreprocessor(Transformer):
                 stop_words=self.stop_words,
                 lemmatize=self.lemmatize,
                 dedup_within_sentence=self.dedup,
+                fold_case=self.fold_case,
             )
         else:
             out["tokens"] = [
@@ -144,6 +147,7 @@ class TextPreprocessor(Transformer):
                     stop_words=self.stop_words,
                     lemmatize=self.lemmatize,
                     dedup_within_sentence=self.dedup,
+                    fold_case=self.fold_case,
                 )
                 for t in ds["texts"]
             ]

@@ -93,7 +93,7 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.stc_preprocess.restype = ctypes.c_void_p
         lib.stc_preprocess.argtypes = [
             ctypes.c_char_p, ctypes.c_long, ctypes.c_char_p,
-            ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
             ctypes.POINTER(ctypes.c_long),
         ]
         lib.stc_stem.restype = ctypes.c_void_p
@@ -102,7 +102,7 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.stc_lemma.argtypes = [ctypes.c_char_p]
         lib.stc_free.argtypes = [ctypes.c_void_p]
         lib.stc_abi_version.restype = ctypes.c_int
-        if lib.stc_abi_version() != 2:
+        if lib.stc_abi_version() != 3:
             return None
         _lib = lib
         return _lib
@@ -125,6 +125,7 @@ def preprocess_document_native(
     lemmatize: bool = True,
     min_lemma_len_exclusive: int = 3,
     dedup_within_sentence: bool = True,
+    fold_case: bool = True,
 ) -> List[str]:
     """Native twin of ``textproc.preprocess_document`` (same signature,
     same tokens)."""
@@ -141,6 +142,7 @@ def preprocess_document_native(
         1 if lemmatize else 0,
         min_lemma_len_exclusive,
         1 if dedup_within_sentence else 0,
+        1 if fold_case else 0,
         ctypes.byref(out_len),
     )
     try:
@@ -156,6 +158,7 @@ def preprocess_documents(
     lemmatize: bool = True,
     min_lemma_len_exclusive: int = 3,
     dedup_within_sentence: bool = True,
+    fold_case: bool = True,
     max_workers: Optional[int] = None,
 ) -> List[List[str]]:
     """Preprocess a corpus in parallel across host cores (ctypes releases
@@ -171,6 +174,7 @@ def preprocess_documents(
                     lemmatize=lemmatize,
                     min_lemma_len_exclusive=min_lemma_len_exclusive,
                     dedup_within_sentence=dedup_within_sentence,
+                    fold_case=fold_case,
                 ),
                 texts,
             )

@@ -10,12 +10,27 @@ semantics of the reference's JVM NLP stack (SURVEY.md §2.1/§2.3):
                         lemmas with length > 3" filter and the per-sentence
                         word-dedup quirk (``(words zip tags).toMap``).
                         CoreNLP is not bit-reproducible in Python; we use a
-                        deterministic rule lemmatizer (SURVEY.md §7 hard part 6).
+                        deterministic rule lemmatizer (SURVEY.md §7 hard
+                        part 6) with three CoreNLP-observed behaviors the
+                        frozen vocabularies demand: document-level case
+                        folding (CoreNLP lowercases the lemma of every
+                        non-proper-noun, so sentence-initial "There"/"That"
+                        must fold to their stop-listed lowercase forms),
+                        clitic contraction lemmas ('ll -> will, n't -> not —
+                        CoreNLP tokenizes "we'll" into "we" + "'ll" before
+                        lemmatizing), and an irregular-form table.
   * tokenizer         — OpenNLP ``SimpleTokenizer`` equivalent: maximal runs
                         of a single character class (LDAClustering.scala:133-135)
   * Porter stemmer    — OpenNLP ``PorterStemmer`` equivalent via NLTK's
-                        original-algorithm mode, case-preserved
-                        (vocab evidence: "Holm", "veri", "littl")
+                        MARTIN_EXTENSIONS mode, case-preserved.  Frozen-vocab
+                        evidence pins the variant: "possibl"/"apolog"/
+                        "mytholog" present with "possibli"/"apologi" absent
+                        (the m>0 "bli"->"ble" and "logi"->"log" departures
+                        fired), while "feebli"/"nobli"/"theologi" ARE present
+                        (m=0 stems the departures leave alone) — exactly the
+                        tartarus/Martin algorithm OpenNLP ships, which NLTK
+                        calls MARTIN_EXTENSIONS.  Case-preservation evidence:
+                        "Holm", "veri", "littl".
   * stop words        — comma-split, case-sensitive, applied PRE-stemming
                         (LDAClustering.scala:125-137)
 """
@@ -29,6 +44,7 @@ from typing import Iterable, List, Sequence
 from nltk.stem import PorterStemmer
 
 __all__ = [
+    "TEXTPROC_VERSION",
     "filter_special_characters",
     "lemmatize_text",
     "simple_tokenize",
@@ -36,6 +52,11 @@ __all__ = [
     "parse_stop_words",
     "preprocess_document",
 ]
+
+# Bumped whenever the emitted token stream changes (stemmer variant, lemma
+# rules, case folding...); cache keys derived from preprocessing output
+# include it so stale artifacts can never be replayed across versions.
+TEXTPROC_VERSION = 3
 
 # --------------------------------------------------------------------------
 # Cleaning (LDAClustering.scala:283-284): the reference replaces this char
@@ -61,11 +82,13 @@ def simple_tokenize(text: str) -> List[str]:
 
 
 # --------------------------------------------------------------------------
-# Porter stemming. OpenNLP's PorterStemmer is the classic Porter algorithm
-# and preserves case of the leading letter ("Holmes" -> "Holm"); NLTK's
-# ORIGINAL_ALGORITHM mode with to_lowercase disabled matches that behavior.
+# Porter stemming. OpenNLP's PorterStemmer is the tartarus.org Porter port
+# (the published algorithm plus Martin's m>0 "bli"->"ble" / "logi"->"log"
+# departures and the len<=2 early return) and preserves case ("Holmes" ->
+# "Holm"); NLTK's MARTIN_EXTENSIONS mode with to_lowercase disabled matches
+# it — see the module docstring for the frozen-vocab evidence.
 # --------------------------------------------------------------------------
-_STEMMER = PorterStemmer(mode="ORIGINAL_ALGORITHM")
+_STEMMER = PorterStemmer(mode="MARTIN_EXTENSIONS")
 
 
 @lru_cache(maxsize=1 << 18)
@@ -103,23 +126,95 @@ def parse_stop_words(text_or_lines) -> frozenset:
 _SENT_SPLIT_RE = re.compile(r"(?<=[.!?])\s+")
 _WORD_RE = re.compile(r"[^\W\d_]+(?:['’][^\W\d_]+)?", re.UNICODE)
 
-# Small irregular-form table (most frequent English irregulars; CoreNLP's
-# Morphology resolves these via its finite-state lexicon).
+# Irregular-form table (frequent English irregulars; CoreNLP's Morphology
+# resolves these via its finite-state lexicon).  Entries whose source AND
+# target are both <= 3 chars are dropped by the lemma-length filter either
+# way; they are kept for when callers lower ``min_len_exclusive``.
 _IRREGULAR = {
     "was": "be", "were": "be", "been": "be", "is": "be", "are": "be",
-    "am": "be", "has": "have", "had": "have", "having": "have",
-    "did": "do", "does": "do", "done": "do",
-    "went": "go", "gone": "go", "goes": "go",
-    "said": "say", "says": "say", "saw": "see", "seen": "see",
+    "am": "be", "being": "be", "has": "have", "had": "have",
+    "having": "have",
+    "did": "do", "does": "do", "done": "do", "doing": "do",
+    "went": "go", "gone": "go", "goes": "go", "going": "go",
+    "said": "say", "says": "say", "saying": "say", "saw": "see",
+    "seen": "see",
     "made": "make", "came": "come", "taken": "take", "took": "take",
     "given": "give", "gave": "give", "got": "get", "gotten": "get",
     "knew": "know", "known": "know", "thought": "think", "told": "tell",
     "found": "find", "left": "leave", "felt": "feel", "kept": "keep",
     "held": "hold", "brought": "bring", "stood": "stand", "sat": "sit",
     "spoke": "speak", "spoken": "speak", "heard": "hear", "meant": "mean",
+    # strong / irregular verbs
+    "abode": "abide", "arose": "arise", "arisen": "arise",
+    "awoke": "awake", "awoken": "awake", "bade": "bid",
+    "begotten": "beget", "besought": "beseech", "hewn": "hew",
+    "befallen": "befall", "befell": "befall", "beheld": "behold",
+    "foresaw": "foresee", "foreseen": "foresee", "forsaken": "forsake",
+    "forsook": "forsake", "leapt": "leap", "outgrown": "outgrow",
+    "overheard": "overhear", "overtaken": "overtake",
+    "overthrown": "overthrow", "overtook": "overtake",
+    "undergone": "undergo", "undertaken": "undertake",
+    "undertook": "undertake", "withdrawn": "withdraw",
+    "withheld": "withhold",
+    "slain": "slay", "slew": "slay", "slung": "sling",
+    "smitten": "smite", "smote": "smite", "spat": "spit",
+    "stank": "stink", "striven": "strive", "strode": "stride",
+    "swollen": "swell", "trodden": "tread",
+    "ate": "eat", "eaten": "eat", "became": "become", "began": "begin",
+    "begun": "begin", "bent": "bend", "bitten": "bite", "blew": "blow",
+    "blown": "blow", "bore": "bear", "borne": "bear", "bought": "buy",
+    "bred": "breed", "broke": "break", "broken": "break", "built": "build",
+    "burnt": "burn", "caught": "catch", "chose": "choose",
+    "chosen": "choose", "clung": "cling", "crept": "creep", "dealt": "deal",
+    "drank": "drink", "drunk": "drink", "dreamt": "dream", "drew": "draw",
+    "drawn": "draw", "drove": "drive", "driven": "drive", "dug": "dig",
+    "fed": "feed", "fell": "fall", "fallen": "fall", "fled": "flee",
+    "flew": "fly", "flown": "fly", "flung": "fling", "forbade": "forbid",
+    "forgave": "forgive", "forgot": "forget", "forgotten": "forget",
+    "fought": "fight", "froze": "freeze", "frozen": "freeze",
+    "grew": "grow", "grown": "grow", "hid": "hide", "hidden": "hide",
+    "hung": "hang", "knelt": "kneel", "laid": "lay", "lain": "lie",
+    "leant": "lean", "learnt": "learn", "led": "lead", "lent": "lend",
+    "lit": "light", "lost": "lose", "met": "meet", "mistook": "mistake",
+    "overcame": "overcome", "paid": "pay", "ran": "run", "rang": "ring",
+    "rung": "ring", "rode": "ride", "ridden": "ride", "risen": "rise",
+    "sang": "sing", "sung": "sing", "sank": "sink", "sunk": "sink",
+    "sent": "send", "shook": "shake", "shaken": "shake", "shone": "shine",
+    "shot": "shoot", "shown": "show", "shrank": "shrink", "slept": "sleep",
+    "slid": "slide", "sold": "sell", "sought": "seek", "sped": "speed",
+    "spent": "spend", "spun": "spin", "sprang": "spring",
+    "sprung": "spring", "stole": "steal", "stolen": "steal",
+    "stuck": "stick", "stung": "sting", "strove": "strive",
+    "struck": "strike", "swam": "swim", "swum": "swim", "swept": "sweep",
+    "swore": "swear", "sworn": "swear", "swung": "swing",
+    "taught": "teach", "threw": "throw", "thrown": "throw", "tore": "tear",
+    "torn": "tear", "trod": "tread", "understood": "understand",
+    "wept": "weep", "woke": "wake", "woken": "wake", "won": "win",
+    "wore": "wear", "worn": "wear", "wove": "weave", "woven": "weave",
+    "withdrew": "withdraw", "wrote": "write", "written": "write",
+    "wrung": "wring",
+    # irregular plurals
     "men": "man", "women": "woman", "children": "child", "feet": "foot",
     "teeth": "tooth", "mice": "mouse", "people": "person", "wives": "wife",
     "lives": "life", "leaves": "leaf", "selves": "self", "eyes": "eye",
+    "gentlemen": "gentleman", "countrymen": "countryman",
+    "fishermen": "fisherman", "workmen": "workman",
+    "horsemen": "horseman", "policemen": "policeman",
+    "seamen": "seaman", "townsmen": "townsman", "kinsmen": "kinsman",
+    "madmen": "madman", "frenchmen": "frenchman",
+    "englishmen": "englishman", "clergymen": "clergyman",
+    "noblemen": "nobleman", "footmen": "footman",
+    "huntsmen": "huntsman", "boatmen": "boatman",
+    "statesmen": "statesman", "tradesmen": "tradesman",
+    "watchmen": "watchman", "foremen": "foreman",
+    "firemen": "fireman", "midshipmen": "midshipman",
+    "oarsmen": "oarsman", "herdsmen": "herdsman",
+    "marksmen": "marksman",
+    "wolves": "wolf", "knives": "knife",
+    "thieves": "thief", "shelves": "shelf", "halves": "half",
+    "calves": "calf", "elves": "elf", "loaves": "loaf", "geese": "goose",
+    "oxen": "ox",
+    # suppletive comparatives
     "better": "good", "best": "good", "worse": "bad", "worst": "bad",
 }
 
@@ -132,24 +227,85 @@ def _strip_double(stem_: str) -> str:
         len(stem_) >= 2
         and stem_[-1] == stem_[-2]
         and stem_[-1] not in _VOWELS
-        and stem_[-1] not in "ls"  # fall/fell, miss keep doubles
+        and stem_[-1] not in "lsfz"  # fall, miss, sniff, buzz keep doubles
     ):
         return stem_[:-1]
     return stem_
 
 
+_NO_E_SUFFIXES = ("er", "en", "on", "el", "om")
+
+
 def _needs_e(stem_: str) -> bool:
-    """making -> mak -> make: restore silent e after C{v}C[^aeiouwxy]."""
+    """Restore the silent e a regular -ed/-ing suffix consumed.  Takes the
+    LOWERCASED stripped stem.  Fires for:
+
+      * [sz] not preceded by s/z ("rais" -> "raise", "caus" -> "cause",
+        "nurs" -> "nurse", "elaps" -> "elapse", "seiz" -> "seize"): without
+        the e, Porter's step-1a eats the bare s and the stem diverges from
+        the frozen vocab ("pass"/"possess" keep their double s);
+      * C{v}C[^aeiouwxy] ("mak" -> "make", "admir" -> "admire",
+        "hesitat" -> "hesitate") — EXCEPT unstressed final syllables
+        -er/-en/-on/-el/-om, which double the strip instead ("remember",
+        "happen", "reason": no e).  Over-restoration is harmless where the
+        lexicon is ambiguous ("visit" -> "visite"): Porter's step-5a strips
+        a trailing e whose stem has m>1, so "visite" and "visit" stem
+        identically, while the -ate verbs the reference vocab contains as
+        "hesit"/"separ"/"agit" NEED the e for step 4 to fire.
+
+    -eed words never reach here: the -ed branch leaves them whole and
+    Porter's step-1b (eed -> ee, m>0) reproduces the reference's stems for
+    both the noun class ("speed") and the -ee verb pasts ("agreed"->"agre").
+    """
+    if len(stem_) >= 2 and stem_[-1] in "sz" and stem_[-2] not in "sz":
+        return True
+    if stem_.endswith("iat"):
+        # associate/appreciate-class: V,V,C fails the CVC test but the
+        # reference vocab holds the step-4 "ate"-stripped stems ("associ")
+        return True
     if len(stem_) < 3:
         return False
     c1, v, c2 = stem_[-3], stem_[-2], stem_[-1]
-    return (
-        c2 not in _VOWELS
-        and c2 not in "wxy"
-        and v in _VOWELS
-        and c1 not in _VOWELS
-        and not any(ch in _VOWELS for ch in stem_[:-3][-1:])
-    )
+    if c2 in _VOWELS or c2 in "wxy" or v not in _VOWELS or c1 in _VOWELS:
+        return False
+    if stem_.endswith(_NO_E_SUFFIXES):
+        return False
+    return True
+
+
+@lru_cache(maxsize=1 << 17)
+def _simple_lower(word: str) -> str:
+    """1:1 per-code-point lowercase — parity twin of the native
+    ``kLowerPairs`` table.  Code points whose ``str.lower()`` expands to
+    multiple characters (e.g. 'İ') are left unchanged so both paths agree."""
+    return "".join(c if len(low := c.lower()) != 1 else low for c in word)
+
+
+# CoreNLP's PTB tokenizer splits clitic contractions ("we'll" -> "we" +
+# "'ll") and Morphology lemmatizes the clitic itself; these are the lemmas
+# it produces.  None = the clitic contributes no token ('s possessive, 'm
+# whose lemma "be" is length-filtered anyway).
+_CONTRACTION_SUFFIX = {
+    "ll": "will", "ve": "have", "re": "be", "d": "would",
+    "s": None, "m": None,
+}
+
+
+def _split_contraction(word: str):
+    """Split a word the token regex captured with an apostrophe group into
+    (base, clitic_lemma_or_None).  Unknown apostrophe forms ("o'clock")
+    return (word, None) and take the whole-word path."""
+    for sep in ("'", "’"):
+        i = word.find(sep)
+        if i != -1:
+            base, suf = word[:i], word[i + 1:]
+            low = suf.lower()
+            if low == "t" and len(base) > 1 and base.lower().endswith("n"):
+                return base[:-1], "not"  # isn't -> is + not
+            if low in _CONTRACTION_SUFFIX:
+                return base, _CONTRACTION_SUFFIX[low]
+            return word, None
+    return word, None
 
 
 def lemma(word: str) -> str:
@@ -182,6 +338,11 @@ def lemma(word: str) -> str:
     # -ed
     if low.endswith("ied") and len(low) > 4:
         return word[:-3] + "y"
+    if low.endswith("eed"):
+        # leave -eed words whole: Porter's step-1b (eed -> ee when m>0)
+        # then lands "agreed" on the frozen vocab's "agre" while keeping
+        # the noun class ("speed", "breed") intact
+        return word
     if low.endswith("ed") and len(low) > 4:
         stem_ = word[:-2]
         if not any(ch in _VOWELS for ch in stem_.lower()):
@@ -199,16 +360,25 @@ def lemmatize_text(
     text: str,
     min_len_exclusive: int = 3,
     dedup_within_sentence: bool = True,
+    fold_case: bool = True,
 ) -> str:
     """CoreNLP ``getLemmaText`` equivalent (LDAClustering.scala:293-309):
-    sentence split -> per-word lemma -> keep lemmas with
-    ``len > min_len_exclusive`` -> join with spaces.
+    sentence split -> contraction split -> case fold -> per-word lemma ->
+    keep lemmas with ``len > min_len_exclusive`` -> join with spaces.
 
     ``dedup_within_sentence=True`` reproduces the reference's
     ``(words zip tags).toMap`` quirk (repeated words within one sentence are
     counted once); disable for exact-count vectorization.
+
+    ``fold_case=True`` approximates CoreNLP's POS-aware lemma lowercasing
+    (Morphology lowercases every lemma whose tag is not NNP/NNPS): a
+    non-lowercase word is folded when its lowercase form also occurs in the
+    document — sentence-initial "There"/"Perhaps" fold into their stop-
+    listed/vocab lowercase twins, while names like "Holmes", which never
+    appear lowercase, keep their case exactly as the frozen vocab shows.
     """
-    pieces: List[str] = []
+    lower_bases: set = set()
+    sentence_parts: List[List[tuple]] = []
     for sentence in _SENT_SPLIT_RE.split(text):
         words = _WORD_RE.findall(sentence)
         if dedup_within_sentence:
@@ -219,10 +389,26 @@ def lemmatize_text(
                     seen.add(w)
                     uniq.append(w)
             words = uniq
+        parts = []
         for w in words:
-            lm = lemma(w)
+            base, clitic = _split_contraction(w)
+            parts.append((base, clitic))
+            if fold_case and base == _simple_lower(base):
+                lower_bases.add(base)
+        sentence_parts.append(parts)
+
+    pieces: List[str] = []
+    for parts in sentence_parts:
+        for base, clitic in parts:
+            if fold_case:
+                low = _simple_lower(base)
+                if low != base and low in lower_bases:
+                    base = low
+            lm = lemma(base)
             if len(lm) > min_len_exclusive:
                 pieces.append(lm)
+            if clitic is not None and len(clitic) > min_len_exclusive:
+                pieces.append(clitic)
     return " ".join(pieces)
 
 
@@ -237,12 +423,14 @@ def preprocess_document(
     lemmatize: bool = True,
     min_lemma_len_exclusive: int = 3,
     dedup_within_sentence: bool = True,
+    fold_case: bool = True,
 ) -> List[str]:
     if lemmatize:
         text = lemmatize_text(
             text,
             min_len_exclusive=min_lemma_len_exclusive,
             dedup_within_sentence=dedup_within_sentence,
+            fold_case=fold_case,
         )
     text = filter_special_characters(text)
     out: List[str] = []

@@ -12,9 +12,15 @@ path and therefore measures the CoreNLP-vs-rule-lemmatizer vocabulary
 agreement (SURVEY.md §7 hard part 6) end to end.
 
 Measured at commit time on the full corpus: 48/51 books (94.1%) agree with
-the golden argmax, 95.9% of token occurrences and 87.2% of distinct token
-types are found in the reference's 39,380-stem vocabulary.  Thresholds
-below leave margin for numeric drift, not for regressions.
+the golden argmax, 99.75% of token occurrences and 93.3% of distinct token
+types are found in the reference's 39,380-stem vocabulary (up from
+95.9%/87.2% before the MARTIN_EXTENSIONS Porter switch + case-folding/
+contraction/irregular lemmatizer upgrade).  The three disagreeing books are
+genuine near-ties: their top-two topic margins are 0.008-0.11 against a
+corpus-median argmax margin of 0.36, at 98-99.7% per-book token coverage —
+the residual count differences come from CoreNLP's sentence splitter
+interacting with the per-sentence dedup quirk, not from vocabulary.
+Thresholds below leave margin for numeric drift, not for regressions.
 """
 
 from __future__ import annotations
@@ -78,8 +84,8 @@ def test_corpus_shape(scored_corpus):
 
 def test_vocabulary_agreement_with_reference(scored_corpus):
     """Our preprocessing's tokens land in the CoreNLP+Porter-built frozen
-    vocabulary: occurrence coverage >= 90%, distinct-type coverage >= 80%
-    (measured 95.9% / 87.2%)."""
+    vocabulary: occurrence coverage >= 98%, distinct-type coverage >= 88%
+    (measured 99.75% / 93.3%)."""
     model, _, tokens, _ = scored_corpus
     vocab_set = set(model.vocab)
     occurrences = sum(len(t) for t in tokens)
@@ -92,8 +98,8 @@ def test_vocabulary_agreement_with_reference(scored_corpus):
     print(f"\ntoken-occurrence coverage {occ_cov:.4f} "
           f"({occ_hits}/{occurrences}); "
           f"type coverage {type_cov:.4f} ({type_hits}/{len(types)})")
-    assert occ_cov >= 0.90
-    assert type_cov >= 0.80
+    assert occ_cov >= 0.98
+    assert type_cov >= 0.88
 
 
 def test_book_assignments_match_golden_report(
@@ -145,9 +151,11 @@ def test_multilingual_train_smoke(reference_resources, tmp_path):
 
 def test_german_vocabulary_agreement(reference_resources):
     """Non-English lemmatizer parity: raw books/German preprocessed by our
-    rule lemmatizer lands 98.7% of token occurrences inside the frozen GE
+    rule lemmatizer lands 98.9% of token occurrences inside the frozen GE
     model's 154,741-stem vocabulary (the reference ran English CoreNLP on
-    German too, so most words pass through both pipelines unchanged).
+    German too, so most words pass through both pipelines unchanged; the
+    document-level case folding is German-safe because capitalized nouns
+    never occur lowercase and therefore keep their case).
     No golden GE report exists, and the frozen model has 49 docs for 50
     book files (one dropped at train time shifts every doc id), so
     coverage is the strongest checkable property here."""
@@ -171,4 +179,4 @@ def test_german_vocabulary_agreement(reference_resources):
     hits = sum(1 for t in tokens for tok in t if tok in vocab_set)
     cov = hits / occ
     print(f"\nGE token-occurrence coverage {cov:.4f} ({hits}/{occ})")
-    assert cov >= 0.95
+    assert cov >= 0.97

@@ -55,6 +55,25 @@ class TestStem:
         assert stem("Holmes") == "Holm"
         assert stem("Watson")[0] == "W"
 
+    def test_martin_departures(self):
+        """The frozen EN vocab pins OpenNLP's Porter variant to the
+        tartarus/Martin algorithm (NLTK MARTIN_EXTENSIONS): it contains
+        "possibl"/"apolog"/"mytholog" but NOT "possibli"/"apologi" (the
+        m>0 bli->ble / logi->log departures fired), while "feebli"/
+        "nobli"/"theologi" ARE present (m=0 stems the departures skip)."""
+        assert stem("possibly") == "possibl"
+        assert stem("apology") == "apolog"
+        assert stem("mythology") == "mytholog"
+        # m=0 before the suffix: departures do not fire
+        assert stem("feebly") == "feebli"
+        assert stem("nobly") == "nobli"
+        assert stem("theology") == "theologi"
+
+    def test_martin_short_word_early_return(self):
+        # tartarus port: words of length <= 2 skip stemming entirely
+        assert stem("as") == "as"
+        assert stem("is") == "is"
+
 
 class TestStopWords:
     def test_comma_single_line(self):
@@ -99,6 +118,70 @@ class TestLemma:
             "tiger tiger burning bright", dedup_within_sentence=False
         )
         assert out2.split().count("tiger") == 2
+
+    def test_strong_verbs(self):
+        assert lemma("began") == "begin"
+        assert lemma("threw") == "throw"
+        assert lemma("grew") == "grow"
+        assert lemma("wrote") == "write"
+        assert lemma("arose") == "arise"
+
+    def test_capitalized_irregular_keeps_case(self):
+        # word[0] case restored like the capitalized entries in the vocab
+        assert lemma("Began") == "Begin"
+        assert lemma("Gentlemen") == "Gentleman"
+
+    def test_silent_e_restoration(self):
+        # {v}/C + s/z: Porter step-1a must see the e ("rais" in the vocab)
+        assert lemma("raised") == "raise"
+        assert lemma("caused") == "cause"
+        assert lemma("increased") == "increase"
+        assert lemma("nursed") == "nurse"
+        assert lemma("elapsed") == "elapse"
+        # -ate verbs: step 4 needs the e to land on "hesit"/"associ"
+        assert lemma("hesitated") == "hesitate"
+        assert lemma("associated") == "associate"
+        # unstressed -er/-en/-on: no e
+        assert lemma("remembered") == "remember"
+        assert lemma("happened") == "happen"
+        assert lemma("reasoned") == "reason"
+
+    def test_eed_words_left_to_porter(self):
+        # "agreed" stays whole: Porter's eed->ee step lands it on the
+        # frozen vocab's "agre" while "speed"/"breed" keep their noun form
+        assert lemma("agreed") == "agreed"
+        assert stem(lemma("agreed")) == "agre"
+        assert stem(lemma("speed")) == "speed"
+
+    def test_double_consonant_ff_zz_kept(self):
+        assert lemma("sniffed") == "sniff"
+        assert lemma("buzzing") == "buzz"
+        assert lemma("hopping") == "hop"
+
+    def test_case_folding_document_level(self):
+        # CoreNLP lowercases every non-NNP lemma; we fold a capitalized
+        # word when its lowercase form occurs in the same document
+        out = lemmatize_text("There they go. It is there still.")
+        assert "there" in out.split() and "There" not in out.split()
+        # a name that never appears lowercase keeps its case
+        out2 = lemmatize_text("Holmes looked up. Later Holmes smiled.")
+        assert "Holmes" in out2.split()
+        # folding off: the capitalized form survives
+        out3 = lemmatize_text(
+            "There they go. It is there still.", fold_case=False
+        )
+        assert "There" in out3.split()
+
+    def test_contraction_clitics(self):
+        # CoreNLP splits clitics and lemmatizes them ('ll -> will)
+        out = lemmatize_text("we'll need the carriage").split()
+        assert "will" in out and "carriage" in out
+        # n't -> not (len 3, dropped by the default filter), base survives
+        out2 = lemmatize_text("they didn't hurry", min_len_exclusive=2)
+        assert "not" in out2.split()
+        # possessive 's contributes nothing; the base word is kept
+        out3 = lemmatize_text("Watson's revolver").split()
+        assert "Watson" in out3 and "revolver" in out3
 
 
 class TestPreprocess:
