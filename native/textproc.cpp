@@ -808,15 +808,27 @@ U32s lemmatize_text(const U32s& text, int min_len_exclusive, bool dedup,
   sentences.emplace_back(start, n);
 
   // pass 1: dedup raw words, split contractions, collect lowercase bases
+  // and NNP evidence (capitalized forms seen past a sentence start; the
+  // evidence scan runs BEFORE dedup, like the Python twin)
   vector<vector<SplitWord>> sent_parts;
   sent_parts.reserve(sentences.size());
   std::unordered_set<string> lower_bases;
+  std::unordered_set<string> noninitial_caps;
   std::unordered_set<string> seen;
   vector<U32s> words;
   for (auto& [s, e] : sentences) {
     U32s sent(text.begin() + (long)s, text.begin() + (long)e);
     words.clear();
     words_of_sentence(sent, words);
+    if (fold_case) {
+      for (size_t wi = 0; wi < words.size(); ++wi) {
+        U32s base = split_contraction(words[wi]).base;
+        if (base == simple_lower(base))
+          lower_bases.insert(encode_utf8(base));
+        else if (wi > 0)
+          noninitial_caps.insert(encode_utf8(base));
+      }
+    }
     seen.clear();
     sent_parts.emplace_back();
     auto& parts = sent_parts.back();
@@ -825,10 +837,7 @@ U32s lemmatize_text(const U32s& text, int min_len_exclusive, bool dedup,
         string key = encode_utf8(w);
         if (!seen.insert(std::move(key)).second) continue;
       }
-      SplitWord sw = split_contraction(w);
-      if (fold_case && sw.base == simple_lower(sw.base))
-        lower_bases.insert(encode_utf8(sw.base));
-      parts.push_back(std::move(sw));
+      parts.push_back(split_contraction(w));
     }
   }
 
@@ -836,12 +845,21 @@ U32s lemmatize_text(const U32s& text, int min_len_exclusive, bool dedup,
   for (auto& parts : sent_parts) {
     for (auto& p : parts) {
       U32s base = p.base;
+      bool is_nnp = false;
       if (fold_case) {
         U32s low = simple_lower(base);
-        if (low != base && lower_bases.count(encode_utf8(low)))
-          base = std::move(low);
+        if (low != base) {
+          if (lower_bases.count(encode_utf8(low)))
+            base = std::move(low);
+          else if (noninitial_caps.count(encode_utf8(base)))
+            // NNP-ish: capitalized, no lowercase twin in the document,
+            // and seen mid-sentence at least once — CoreNLP returns NNP
+            // lemmas unchanged (no plural strip).  Sentence-initial-only
+            // capitalized forms still lemmatize normally.
+            is_nnp = true;
+        }
       }
-      U32s lm = lemma(base);
+      U32s lm = is_nnp ? base : lemma(base);
       if ((int)lm.size() > min_len_exclusive) {
         if (!out.empty()) out.push_back(' ');
         out.insert(out.end(), lm.begin(), lm.end());

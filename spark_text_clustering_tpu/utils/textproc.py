@@ -56,7 +56,7 @@ __all__ = [
 # Bumped whenever the emitted token stream changes (stemmer variant, lemma
 # rules, case folding...); cache keys derived from preprocessing output
 # include it so stale artifacts can never be replayed across versions.
-TEXTPROC_VERSION = 3
+TEXTPROC_VERSION = 4
 
 # --------------------------------------------------------------------------
 # Cleaning (LDAClustering.scala:283-284): the reference replaces this char
@@ -256,6 +256,13 @@ def _needs_e(stem_: str) -> bool:
     -eed words never reach here: the -ed branch leaves them whole and
     Porter's step-1b (eed -> ee, m>0) reproduces the reference's stems for
     both the noun class ("speed") and the -ee verb pasts ("agreed"->"agre").
+
+    Known divergence (vowel+s stems): the [sz] rule over-restores for the
+    -us Latinate class — "focused" -> "focuse" stems to "focus", while
+    CoreNLP's lemma "focus" + Porter yields "focu".  This class is absorbed
+    in the measured golden coverage (99.75% EN occurrence); excluding
+    vowel+'s' stems here would instead break the "rais"/"caus" class the
+    frozen vocab does demand, so the over-restoration is kept.
     """
     if len(stem_) >= 2 and stem_[-1] in "sz" and stem_[-2] not in "sz":
         return True
@@ -370,17 +377,35 @@ def lemmatize_text(
     ``(words zip tags).toMap`` quirk (repeated words within one sentence are
     counted once); disable for exact-count vectorization.
 
-    ``fold_case=True`` approximates CoreNLP's POS-aware lemma lowercasing
-    (Morphology lowercases every lemma whose tag is not NNP/NNPS): a
-    non-lowercase word is folded when its lowercase form also occurs in the
-    document — sentence-initial "There"/"Perhaps" fold into their stop-
-    listed/vocab lowercase twins, while names like "Holmes", which never
-    appear lowercase, keep their case exactly as the frozen vocab shows.
+    ``fold_case=True`` approximates CoreNLP's POS-aware lemma handling
+    (Morphology lowercases every lemma whose tag is not NNP/NNPS and returns
+    NNP lemmas unchanged): a non-lowercase word is folded when its lowercase
+    form also occurs in the document — sentence-initial "There"/"Perhaps"
+    fold into their stop-listed/vocab lowercase twins — while a capitalized
+    word with NO lowercase twin in the document AND at least one
+    mid-sentence capitalized occurrence is treated as a proper noun and
+    passed through whole ("Holmes" stays "Holmes"; no plural strip).  A
+    capitalized form seen ONLY at sentence starts is ambiguous ("Dogs
+    bark.") and takes the regular ``lemma()`` path.  With
+    ``fold_case=False`` every word takes the regular ``lemma()`` path, so
+    the -s rule may still rewrite capitalized forms ("Holmes"->"Holme").
     """
     lower_bases: set = set()
+    noninitial_caps: set = set()
     sentence_parts: List[List[tuple]] = []
     for sentence in _SENT_SPLIT_RE.split(text):
         words = _WORD_RE.findall(sentence)
+        if fold_case:
+            # NNP evidence pass runs BEFORE dedup: a capitalized form seen
+            # anywhere past a sentence start is strong proper-noun evidence
+            # (sentence-initial capitalization alone is ambiguous — "Dogs
+            # bark." must still take the plural strip).
+            for pos, w in enumerate(words):
+                base = _split_contraction(w)[0]
+                if base == _simple_lower(base):
+                    lower_bases.add(base)
+                elif pos > 0:
+                    noninitial_caps.add(base)
         if dedup_within_sentence:
             seen = set()
             uniq = []
@@ -389,22 +414,28 @@ def lemmatize_text(
                     seen.add(w)
                     uniq.append(w)
             words = uniq
-        parts = []
-        for w in words:
-            base, clitic = _split_contraction(w)
-            parts.append((base, clitic))
-            if fold_case and base == _simple_lower(base):
-                lower_bases.add(base)
+        parts = [_split_contraction(w) for w in words]
         sentence_parts.append(parts)
 
     pieces: List[str] = []
     for parts in sentence_parts:
         for base, clitic in parts:
+            is_nnp = False
             if fold_case:
                 low = _simple_lower(base)
-                if low != base and low in lower_bases:
-                    base = low
-            lm = lemma(base)
+                if low != base:
+                    if low in lower_bases:
+                        base = low
+                    elif base in noninitial_caps:
+                        # NNP-ish: a capitalized word with no lowercase twin
+                        # anywhere in the document AND at least one
+                        # mid-sentence capitalized occurrence.  CoreNLP's
+                        # Morphology returns NNP/NNPS lemmas unchanged, so
+                        # names like "Holmes" keep their surface form (no
+                        # plural strip); a sentence-initial-only
+                        # capitalized plural still lemmatizes normally.
+                        is_nnp = True
+            lm = base if is_nnp else lemma(base)
             if len(lm) > min_len_exclusive:
                 pieces.append(lm)
             if clitic is not None and len(clitic) > min_len_exclusive:

@@ -100,16 +100,17 @@ class TestLemmaParity:
 class TestPipelineParity:
     @pytest.mark.parametrize("lemmatize", [True, False])
     @pytest.mark.parametrize("dedup", [True, False])
-    def test_docs_match_python(self, lemmatize, dedup):
+    @pytest.mark.parametrize("fold_case", [True, False])
+    def test_docs_match_python(self, lemmatize, dedup, fold_case):
         sw = frozenset({"the", "and", "of", "und"})
         for d in DOCS:
             py = textproc.preprocess_document(
                 d, stop_words=sw, lemmatize=lemmatize,
-                dedup_within_sentence=dedup,
+                dedup_within_sentence=dedup, fold_case=fold_case,
             )
             na = preprocess_document_native(
                 d, stop_words=sw, lemmatize=lemmatize,
-                dedup_within_sentence=dedup,
+                dedup_within_sentence=dedup, fold_case=fold_case,
             )
             assert py == na, (d, py[:10], na[:10])
 

@@ -172,6 +172,15 @@ class TestLemma:
         )
         assert "There" in out3.split()
 
+    def test_sentence_initial_plural_not_nnp(self):
+        # a capitalized form seen ONLY at sentence starts is ambiguous and
+        # must still take the regular lemma path (plural strip), while a
+        # mid-sentence capitalized occurrence marks the form as NNP-ish
+        out = lemmatize_text("Dogs barked loudly. Dogs scattered.")
+        assert "Dogs" not in out.split()
+        out2 = lemmatize_text("Jones spoke. Then Jones left.").split()
+        assert "Jones" in out2
+
     def test_contraction_clitics(self):
         # CoreNLP splits clitics and lemmatizes them ('ll -> will)
         out = lemmatize_text("we'll need the carriage").split()
