@@ -44,6 +44,12 @@ class LDAModel:
     iteration_times: List[float] = field(default_factory=list)
     algorithm: str = "online"
     step: int = 0
+    # jit-backed sharded scoring/eval fns, keyed by (kind, mesh, params):
+    # rebuilding the shard_map per call would recompile the CC-News-scale
+    # SPMD module on every evaluation
+    _fn_cache: dict = field(
+        default_factory=dict, init=False, repr=False, compare=False
+    )
 
     # ---- shape accessors (MLlib: model.k, model.vocabSize) -------------
     @property
@@ -95,12 +101,34 @@ class LDAModel:
     def _exp_elog_beta(self) -> jnp.ndarray:
         return jnp.exp(dirichlet_expectation(self._safe_lam()))
 
+    def _lam_on_mesh(self, mesh) -> jnp.ndarray:
+        """lambda zero-padded to a model-shard multiple and placed V-sharded
+        over "model" — the input every mesh-backed scoring/eval fn takes.
+        Pad columns are masked out inside those fns (sharded_eval).  Cached
+        per mesh: models are immutable after fit, and re-uploading [k, V]
+        per scoring bucket would dominate the scoring cost."""
+        key = ("lam_on_mesh", mesh)
+        lam_dev = self._fn_cache.get(key)
+        if lam_dev is None:
+            from ..parallel.mesh import MODEL_AXIS, model_sharding
+
+            s = mesh.shape[MODEL_AXIS]
+            v = self.vocab_size
+            v_pad = ((v + s - 1) // s) * s
+            lam = np.asarray(self.lam, np.float32)
+            if v_pad != v:
+                lam = np.pad(lam, ((0, 0), (0, v_pad - v)))
+            lam_dev = jax.device_put(jnp.asarray(lam), model_sharding(mesh))
+            self._fn_cache[key] = lam_dev
+        return lam_dev
+
     def topic_distribution(
         self,
         docs: Union[DocTermBatch, Sequence[Tuple[np.ndarray, np.ndarray]]],
         max_inner: int = 100,
         tol: float = 1e-3,
         seed: Optional[int] = None,
+        mesh=None,
     ) -> np.ndarray:
         """Per-doc posterior topic mixture [B, k]
         (``LocalLDAModel.topicDistribution``, LDALoader.scala:108).
@@ -113,7 +141,16 @@ class LDAModel:
         hard part 1) so one book-sized doc does not pad every note-sized
         doc to its width; per-doc keyed inits make the result independent
         of the bucketing.
+
+        ``mesh`` switches to the V-sharded inference path (sharded_eval):
+        lambda lives [k, V/s] per device and docs shard over "data" — the
+        scoring-side twin of the sharded train step, required at configs
+        where [k, V] exceeds one device's HBM (SURVEY.md §7 hard part 5).
         """
+        if mesh is not None:
+            return self._topic_distribution_sharded(
+                docs, max_inner, tol, seed, mesh
+            )
         alpha = jnp.asarray(self.alpha, jnp.float32)
         eb = self._exp_elog_beta()
         if isinstance(docs, DocTermBatch):
@@ -126,44 +163,128 @@ class LDAModel:
                 )
             )
 
+        return self._score_bucketed(
+            docs,
+            seed,
+            lambda batch, gamma0: np.asarray(
+                topic_inference(
+                    batch, eb, alpha, gamma0, max_inner=max_inner, tol=tol
+                )
+            ),
+        )
+
+    def _gamma0_for_bucket(self, batch, idxs, seed) -> jnp.ndarray:
+        """Per-bucket gamma init: seeded inits are keyed by GLOBAL doc
+        index so results are independent of the bucketing (the same
+        property the training paths pin via ``init_gamma_rows``)."""
+        if seed is None:
+            return init_gamma(None, batch.num_docs, self.k, self.gamma_shape)
+        return init_gamma_rows(
+            jax.random.PRNGKey(seed),
+            jnp.asarray(np.asarray(idxs, np.int32)),
+            self.k,
+            self.gamma_shape,
+        )
+
+    def _score_bucketed(self, docs, seed, run_batch) -> np.ndarray:
+        """Shared scoring loop over power-of-two length buckets; both the
+        local and the mesh-backed paths provide only ``run_batch``."""
         rows = list(docs)
         out = np.zeros((len(rows), self.k), np.float32)
         for _, (batch, idxs) in sorted(bucket_by_length(rows).items()):
-            if seed is None:
-                gamma0 = init_gamma(
-                    None, batch.num_docs, self.k, self.gamma_shape
-                )
-            else:
-                gamma0 = init_gamma_rows(
-                    jax.random.PRNGKey(seed),
-                    jnp.asarray(np.asarray(idxs, np.int32)),
-                    self.k,
-                    self.gamma_shape,
-                )
-            dist = topic_inference(
-                batch, eb, alpha, gamma0, max_inner=max_inner, tol=tol
-            )
-            out[idxs] = np.asarray(dist)
+            gamma0 = self._gamma0_for_bucket(batch, idxs, seed)
+            out[idxs] = run_batch(batch, gamma0)[: len(idxs)]
         return out
+
+    def _sharded_fn(self, kind: str, mesh, **kw):
+        """Build-once cache for the mesh-backed scoring/eval fns."""
+        key = (kind, mesh, tuple(sorted(kw.items())))
+        fn = self._fn_cache.get(key)
+        if fn is None:
+            from . import sharded_eval
+
+            alpha = np.broadcast_to(
+                np.asarray(self.alpha, np.float32), (self.k,)
+            )
+            factory = getattr(sharded_eval, f"make_sharded_{kind}")
+            fn = factory(
+                mesh, alpha=alpha, vocab_size=self.vocab_size, **kw
+            )
+            self._fn_cache[key] = fn
+        return fn
+
+    def _pad_and_place_gamma0(self, mesh, batch: DocTermBatch, gamma0):
+        """Doc-pad a batch to the data-axis multiple and place it together
+        with its gamma0 (pad rows init to ones — weight-zero pad docs
+        converge to gamma == alpha, the exact-cancellation property the
+        sharded bound relies on).  Shared by every mesh-backed entry."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ..parallel.collectives import data_shard_batch
+        from ..parallel.mesh import DATA_AXIS
+
+        sharded = data_shard_batch(mesh, batch)
+        pad = sharded.num_docs - batch.num_docs
+        if pad:
+            gamma0 = jnp.concatenate(
+                [gamma0, jnp.ones((pad, self.k), jnp.float32)]
+            )
+        gamma0 = jax.device_put(
+            gamma0, NamedSharding(mesh, P(DATA_AXIS, None))
+        )
+        return sharded, gamma0
+
+    def _run_batch_on_mesh(self, mesh, fn, batch: DocTermBatch, gamma0):
+        """Doc-pad + place a batch and its gamma0, run ``fn(lam, batch,
+        gamma0, ...)``, return the un-padded [B, ...] host result."""
+        from ..parallel.collectives import fetch_global
+
+        sharded, gamma0 = self._pad_and_place_gamma0(mesh, batch, gamma0)
+        return fetch_global(fn(self._lam_on_mesh(mesh), sharded, gamma0))[
+            : batch.num_docs
+        ]
+
+    def _topic_distribution_sharded(
+        self, docs, max_inner, tol, seed, mesh
+    ) -> np.ndarray:
+        infer = self._sharded_fn(
+            "topic_inference", mesh, max_inner=max_inner, tol=tol
+        )
+        if isinstance(docs, DocTermBatch):
+            key = None if seed is None else jax.random.PRNGKey(seed)
+            gamma0 = init_gamma(key, docs.num_docs, self.k, self.gamma_shape)
+            return self._run_batch_on_mesh(mesh, infer, docs, gamma0)
+        return self._score_bucketed(
+            docs,
+            seed,
+            lambda batch, gamma0: self._run_batch_on_mesh(
+                mesh, infer, batch, gamma0
+            ),
+        )
 
     # ---- evaluation ----------------------------------------------------
     def log_likelihood(
         self,
         docs: Union[DocTermBatch, Sequence[Tuple[np.ndarray, np.ndarray]]],
         seed: Optional[int] = None,
+        mesh=None,
     ) -> float:
         """Variational lower bound on log p(docs) (``logLikelihood``,
-        LDAClustering.scala:73-78 prints bound / corpusSize)."""
+        LDAClustering.scala:73-78 prints bound / corpusSize).  With
+        ``mesh``, the bound is evaluated V-sharded (sharded_eval) — no
+        full-width [k, V] tensor on any device."""
         batch = (
             docs
             if isinstance(docs, DocTermBatch)
             else batch_from_rows(list(docs))
         )
+        n_docs = float(np.asarray((batch.token_weights.sum(-1) > 0).sum()))
+        if mesh is not None:
+            return self._log_likelihood_sharded(batch, seed, n_docs, mesh)
         key = None if seed is None else jax.random.PRNGKey(seed)
         gamma0 = init_gamma(key, batch.num_docs, self.k, self.gamma_shape)
         alpha = jnp.asarray(self.alpha, jnp.float32)
         gamma = infer_gamma(batch, self._exp_elog_beta(), alpha, gamma0)
-        n_docs = float(np.asarray((batch.token_weights.sum(-1) > 0).sum()))
         bound = approx_bound(
             batch,
             gamma,
@@ -175,7 +296,19 @@ class LDAModel:
         )
         return float(bound)
 
-    def log_perplexity(self, docs) -> float:
+    def _log_likelihood_sharded(self, batch, seed, n_docs, mesh) -> float:
+        loglik = self._sharded_fn(
+            "log_likelihood", mesh, eta=float(self.eta)
+        )
+        key = None if seed is None else jax.random.PRNGKey(seed)
+        gamma0 = init_gamma(key, batch.num_docs, self.k, self.gamma_shape)
+        sharded, gamma0 = self._pad_and_place_gamma0(mesh, batch, gamma0)
+        bound = loglik(
+            self._lam_on_mesh(mesh), sharded, gamma0, n_docs, n_docs
+        )
+        return float(np.asarray(jax.device_get(bound)))
+
+    def log_perplexity(self, docs, mesh=None) -> float:
         """-bound / total token mass (MLlib ``logPerplexity``)."""
         batch = (
             docs
@@ -183,7 +316,7 @@ class LDAModel:
             else batch_from_rows(list(docs))
         )
         tokens = float(np.asarray(batch.token_weights.sum()))
-        return -self.log_likelihood(batch) / max(tokens, 1.0)
+        return -self.log_likelihood(batch, mesh=mesh) / max(tokens, 1.0)
 
     # ---- persistence (delegates; see models/persistence.py) ------------
     def save(self, path: str) -> None:
