@@ -95,6 +95,15 @@ def cmd_train(args: argparse.Namespace) -> int:
         model_shards=args.model_shards,
     )
 
+    # ONE mesh shared by the device stages (IDF df-psum + LDA train):
+    # building it here rather than inside each estimator keeps the
+    # topology consistent across the featurization and training steps
+    from .parallel.mesh import make_mesh
+
+    mesh = make_mesh(
+        data_shards=params.data_shards, model_shards=params.model_shards
+    )
+
     feat_stages: List[object] = [
         TextPreprocessor(stop_words=sw, lemmatize=not args.no_lemmatize),
         CountVectorizer(vocab_size=params.vocab_size),
@@ -103,7 +112,7 @@ def cmd_train(args: argparse.Namespace) -> int:
         # the reference trains LDA on TF-IDF pseudo-counts
         # (LDAClustering.scala:180-192)
         feat_stages.append(IDF(min_doc_freq=params.min_doc_freq,
-                               idf_floor=params.idf_floor))
+                               idf_floor=params.idf_floor, mesh=mesh))
 
     from .utils.profiling import MetricsLogger, trace
 
@@ -144,7 +153,7 @@ def cmd_train(args: argparse.Namespace) -> int:
 
     with trace(args.profile_dir if coordinator else None):
         with timer.phase("train"):
-            lda_stage = LDA(params).fit(ds)
+            lda_stage = LDA(params, mesh=mesh).fit(ds)
     model: LDAModel = lda_stage.model
 
     if coordinator:

@@ -8,8 +8,10 @@ IDF semantics are MLlib's as exercised by the reference
     (the 0.0001 edge weights visible in the saved models' tokenCounts)
 
 The distributed fit is ONE reduction over doc-sharded df counts — Spark's
-aggregate becomes a ``psum`` over the "data" mesh axis (done by the caller in
-``parallel``; this module is single-shard pure math).
+aggregate becomes a ``psum`` over the "data" mesh axis
+(``make_doc_freq_sharded``; the ``IDF`` pipeline stage drives it per length
+bucket so fit memory is bounded by the largest bucket, not one global
+max-length batch).
 
 HashingTF (a north-star addition, BASELINE.json) uses Spark-compatible
 MurmurHash3 x86_32 with seed 42 over UTF-8 bytes, so hashed features line up
@@ -29,6 +31,7 @@ from .sparse import DocTermBatch
 
 __all__ = [
     "doc_freq",
+    "make_doc_freq_sharded",
     "idf_from_df",
     "idf_transform",
     "murmur3_32",
@@ -44,6 +47,45 @@ def doc_freq(batch: DocTermBatch, vocab_size: int) -> jnp.ndarray:
         .at[batch.token_ids.reshape(-1)]
         .add(present.reshape(-1))
     )
+
+
+def make_doc_freq_sharded(mesh, vocab_size: int):
+    """Doc-sharded ``doc_freq``: each data shard scatter-adds its own docs'
+    term presence, then ONE ``psum`` over "data" combines — Spark's df
+    aggregate (LDAClustering.scala:174-177) as a collective.  The returned
+    fn takes a batch doc-sharded over "data" and returns the replicated
+    [vocab_size] df.  Counts are exact in float32 up to 2^24 docs (the df
+    values are integers).
+
+    Scatter-add of 1.0s is order-independent AND exact, so the result is
+    bitwise identical at any shard count."""
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.collectives import psum_data
+    from ..parallel.mesh import DATA_AXIS
+
+    def _df(ids, wts):
+        present = (wts > 0).astype(jnp.float32)
+        local = (
+            jnp.zeros((vocab_size,), jnp.float32)
+            .at[ids.reshape(-1)]
+            .add(present.reshape(-1))
+        )
+        return psum_data(local)
+
+    sharded = jax.shard_map(
+        _df,
+        mesh=mesh,
+        in_specs=(P(DATA_AXIS, None), P(DATA_AXIS, None)),
+        out_specs=P(),
+        check_vma=False,
+    )
+
+    @jax.jit
+    def df_fn(batch: DocTermBatch) -> jnp.ndarray:
+        return sharded(batch.token_ids, batch.token_weights)
+
+    return df_fn
 
 
 def idf_from_df(

@@ -231,22 +231,48 @@ class IDFModel(Transformer):
 
 class IDF(Estimator):
     """MLlib IDF(minDocFreq=2) with the reference's 0.0001 floor
-    (LDAClustering.scala:174-192)."""
+    (LDAClustering.scala:174-192).
 
-    def __init__(self, min_doc_freq: int = 2, idf_floor: float = 0.0001):
+    The df pass runs per power-of-two length bucket — fit memory is
+    bounded by the LARGEST BUCKET, never one global max-length batch (at
+    BASELINE.md's 1M-10M-doc rows a single batch at global max length is a
+    host/HBM wall).  With ``mesh``, each bucket is doc-sharded over "data"
+    and reduced with one psum (``make_doc_freq_sharded``); df values are
+    integral, so results are bitwise identical at any shard count."""
+
+    def __init__(
+        self, min_doc_freq: int = 2, idf_floor: float = 0.0001, mesh=None
+    ):
         self.min_doc_freq = min_doc_freq
         self.idf_floor = idf_floor
+        self.mesh = mesh
 
     def fit(self, ds: Dict) -> IDFModel:
+        from .ops.sparse import bucket_by_length
+
         rows = ds["rows"]
         v = (
             len(ds["vocab"])
             if ds.get("vocab") is not None
             else ds["num_features"]
         )
-        batch = batch_from_rows(rows)
+        df_fn = None
+        if self.mesh is not None:
+            from .ops.tfidf import make_doc_freq_sharded
+            from .parallel.collectives import data_shard_batch
+
+            sharded_df = make_doc_freq_sharded(self.mesh, v)
+            df_fn = lambda b: sharded_df(data_shard_batch(self.mesh, b))
+        df = None
+        for _, (batch, _) in sorted(bucket_by_length(rows).items()):
+            part = df_fn(batch) if df_fn else doc_freq(batch, v)
+            df = part if df is None else df + part
+        if df is None:  # empty corpus
+            import jax.numpy as jnp
+
+            df = jnp.zeros((v,), jnp.float32)
         # MLlib: m = number of vectors in the RDD, empties included
-        idf = idf_from_df(doc_freq(batch, v), len(rows), self.min_doc_freq)
+        idf = idf_from_df(df, len(rows), self.min_doc_freq)
         return IDFModel(np.asarray(idf), self.idf_floor)
 
 
