@@ -10,10 +10,14 @@ from .lda_math import (
 from .sparse import DocTermBatch, batch_from_rows, bucket_by_length, next_pow2
 from .tfidf import (
     doc_freq,
+    hash_buckets,
     hashing_tf_ids,
+    hashing_tf_rows,
     idf_from_df,
     idf_transform,
+    make_doc_freq_sharded,
     murmur3_32,
+    murmur3_32_batch,
 )
 
 __all__ = [
@@ -29,8 +33,12 @@ __all__ = [
     "bucket_by_length",
     "next_pow2",
     "doc_freq",
+    "hash_buckets",
     "hashing_tf_ids",
+    "hashing_tf_rows",
     "idf_from_df",
     "idf_transform",
+    "make_doc_freq_sharded",
     "murmur3_32",
+    "murmur3_32_batch",
 ]

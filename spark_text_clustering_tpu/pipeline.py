@@ -28,7 +28,7 @@ import numpy as np
 
 from .config import Params
 from .ops.sparse import batch_from_rows
-from .ops.tfidf import doc_freq, hashing_tf_ids, idf_from_df, idf_transform
+from .ops.tfidf import doc_freq, idf_from_df, idf_transform
 from .utils.textproc import preprocess_document
 from .utils.vocab import build_vocab, count_terms_parallel, count_vectors
 
@@ -67,12 +67,10 @@ def make_vectorizer(vocab: Sequence[str]):
     bucketing.  The single scoring-time vectorization policy for every call
     site (batch CLI, streaming scorer, streaming trainer)."""
     if is_hashed_vocab(vocab):
-        from .ops.tfidf import hashing_tf_ids
+        from .ops.tfidf import hashing_tf_rows
 
         n = len(vocab)
-        return lambda tokens_lists: [
-            hashing_tf_ids(toks, n) for toks in tokens_lists
-        ]
+        return lambda tokens_lists: hashing_tf_rows(tokens_lists, n)
     cvm = CountVectorizerModel(list(vocab))
     return lambda tokens_lists: cvm.transform({"tokens": tokens_lists})["rows"]
 
@@ -194,10 +192,10 @@ class HashingTF(Transformer):
         self.num_features = num_features
 
     def transform(self, ds: Dict) -> Dict:
+        from .ops.tfidf import hashing_tf_rows
+
         out = dict(ds)
-        out["rows"] = [
-            hashing_tf_ids(toks, self.num_features) for toks in ds["tokens"]
-        ]
+        out["rows"] = hashing_tf_rows(ds["tokens"], self.num_features)
         out["vocab"] = None
         out["num_features"] = self.num_features
         return out
