@@ -93,6 +93,7 @@ def cmd_train(args: argparse.Namespace) -> int:
         seed=args.seed,
         data_shards=args.data_shards,
         model_shards=args.model_shards,
+        keep_doc_topic_counts=bool(getattr(args, "export_mllib", False)),
     )
 
     # ONE mesh shared by the device stages (IDF df-psum + LDA train):
@@ -179,6 +180,28 @@ def cmd_train(args: argparse.Namespace) -> int:
         out_dir = model_dir_name(args.lang, base=args.models_dir)
         model.save(out_dir)
         print(f"model saved to {out_dir}")
+
+        if getattr(args, "export_mllib", False):
+            if lda_stage.doc_topic_counts is None:
+                # the DistributedLDAModel layout is MLlib's EM artifact
+                # class: without doc vertices (N_dk) Spark's load would
+                # build a graph whose doc nodes have null attributes
+                print(
+                    "--export-mllib requires --algorithm em "
+                    "(DistributedLDAModel is MLlib's EM artifact class); "
+                    "skipping export"
+                )
+            else:
+                from .models.reference_export import save_reference_model
+
+                mllib_dir = out_dir + "_mllib"
+                save_reference_model(
+                    model,
+                    mllib_dir,
+                    doc_topic_counts=lda_stage.doc_topic_counts,
+                    doc_rows=[(i, w) for i, w in rows if len(i) > 0],
+                )
+                print(f"MLlib-format model exported to {mllib_dir}")
 
         metrics.log_phases(timer.phases)
         metrics.log_iteration_times(model.iteration_times)
@@ -443,6 +466,11 @@ def build_parser() -> argparse.ArgumentParser:
                          "per-iteration times) to this file")
     tr.add_argument("--no-tfidf", action="store_true",
                     help="train on raw counts instead of TF-IDF pseudo-counts")
+    tr.add_argument("--export-mllib", action="store_true",
+                    help="also write the model in Spark MLlib "
+                         "DistributedLDAModel format (Parquet graph + "
+                         "metadata + vocab sidecar) so Spark tooling can "
+                         "load it")
     tr.add_argument("--no-lemmatize", action="store_true")
     tr.add_argument("--include-all", action="store_true",
                     help="ingest non-.txt files too (reference behavior)")

@@ -82,6 +82,11 @@ class Params:
     # batches.
     device_resident: object = "auto"   # True | False | "auto"
     resident_budget_bytes: int = 2 << 30
+    # EM only: assemble and retain the full [n_docs, k] doc-topic counts
+    # on the host after fit — needed by the MLlib-format export's doc
+    # vertices (reference_export), costs one device->host fetch per
+    # bucket, so off unless asked for (CLI --export-mllib sets it).
+    keep_doc_topic_counts: bool = False
 
     def resolved_alpha(self) -> float:
         if self.doc_concentration > 0:

@@ -13,4 +13,7 @@ __all__ = [
     "make_nmf_train_step",
     "OnlineLDA",
     "make_online_train_step",
+    # lazy: reference_import.load_reference_model (pyarrow reader) and
+    # reference_export.save_reference_model (pyarrow writer) are imported
+    # from their modules directly to keep pyarrow optional at import time
 ]

@@ -262,6 +262,7 @@ class EMLDA:
             data_shards=params.data_shards, model_shards=params.model_shards
         )
         self.last_log_likelihood: Optional[float] = None
+        self.last_doc_topic_counts: Optional[np.ndarray] = None
         # jit cache keyed by vocab size (the only per-fit value baked into
         # the step closure) so it survives repeat fits (bench warmup) but
         # never leaks across fits with different vocabularies
@@ -509,6 +510,11 @@ class EMLDA:
         )
         n_wk_full = fetch_global(n_wk)
         n_wk_np = n_wk_full[:, :v]
+        if p.keep_doc_topic_counts:
+            # doc-topic counts in original row order — the doc vertices of
+            # an MLlib-format export (reference_export); opt-in because
+            # the assembly costs one device->host fetch per bucket
+            self.last_doc_topic_counts = _assemble_n_dk(n_dk_list)
         return LDAModel(
             lam=n_wk_np,
             vocab=list(vocab),

@@ -280,10 +280,12 @@ class LDAModelTransformer(Transformer):
         model,
         log_likelihood: Optional[float] = None,
         corpus_size: Optional[int] = None,
+        doc_topic_counts: Optional[np.ndarray] = None,
     ):
         self.model = model
         self.log_likelihood = log_likelihood  # EM training logLik, if any
         self.corpus_size = corpus_size        # nonempty docs actually trained on
+        self.doc_topic_counts = doc_topic_counts  # EM N_dk (MLlib export)
 
     def transform(self, ds: Dict) -> Dict:
         out = dict(ds)
@@ -326,6 +328,7 @@ class LDA(Estimator):
             model,
             log_likelihood=getattr(opt, "last_log_likelihood", None),
             corpus_size=len(nonempty),
+            doc_topic_counts=getattr(opt, "last_doc_topic_counts", None),
         )
 
 

@@ -193,21 +193,29 @@ class TestMurmurBatch:
             [vocab[j] for j in rng.integers(0, len(vocab), 2000)]
             for _ in range(50)
         ]                                   # 100k tokens
-        t0 = time.perf_counter()
-        fast = hashing_tf_rows(docs, 1 << 18)
-        t_fast = time.perf_counter() - t0
-
-        t0 = time.perf_counter()
         slow = [
             _scalar_hashing_tf_ids(toks, 1 << 18) for toks in docs
         ]
-        t_slow = time.perf_counter() - t0
-
+        fast = hashing_tf_rows(docs, 1 << 18)
         for (ids, cts), (eids, ects) in zip(fast, slow):
             np.testing.assert_array_equal(ids, eids)
             np.testing.assert_array_equal(cts, ects)
+
         # >=10x is the round-2 target (measured ~18x unloaded); the CI
-        # floor is 5x so machine contention cannot flake a correctness run
+        # floor is 5x, best-of-3 so transient machine contention cannot
+        # flake a correctness run
+        def measure(fn):
+            best = float("inf")
+            for _ in range(3):
+                t0 = time.perf_counter()
+                fn()
+                best = min(best, time.perf_counter() - t0)
+            return best
+
+        t_fast = measure(lambda: hashing_tf_rows(docs, 1 << 18))
+        t_slow = measure(
+            lambda: [_scalar_hashing_tf_ids(t, 1 << 18) for t in docs[:10]]
+        ) * (len(docs) / 10)
         assert t_slow / t_fast >= 5, (
             f"batch hashing only {t_slow / t_fast:.1f}x faster"
         )

@@ -1,0 +1,167 @@
+"""MLlib-format model EXPORT (models/reference_export.py) — round-2
+VERDICT Missing #1: migration must be two-way.  The written layout must
+round-trip bitwise through our own importer, reconstruct the doc-term
+edges, and re-exporting a REAL frozen reference model must reproduce its
+parameters exactly."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from spark_text_clustering_tpu.models.base import LDAModel
+from spark_text_clustering_tpu.models.reference_export import (
+    save_reference_model,
+)
+from spark_text_clustering_tpu.models.reference_import import (
+    MLlibLDAArtifacts,
+    load_reference_model,
+    load_reference_vocab,
+    reference_doc_rows,
+)
+
+REFERENCE_MODELS = (
+    "/root/reference/TextClustering/src/main/resources/models"
+)
+
+
+def _toy_model(k=3, v=17, seed=4) -> LDAModel:
+    rng = np.random.default_rng(seed)
+    return LDAModel(
+        lam=rng.gamma(2.0, 3.0, size=(k, v)).astype(np.float32),
+        vocab=[f"stem{i}" for i in range(v)],
+        alpha=np.full((k,), 11.0, np.float32),
+        eta=1.1,
+        gamma_shape=100.0,
+        iteration_times=[0.5, 0.25, 0.125],
+        algorithm="em",
+        step=3,
+    )
+
+
+def _toy_rows(v=17, n=5, seed=8):
+    rng = np.random.default_rng(seed)
+    rows = []
+    for _ in range(n):
+        nnz = int(rng.integers(2, 9))
+        ids = np.sort(rng.choice(v, size=nnz, replace=False)).astype(
+            np.int32
+        )
+        rows.append((ids, rng.uniform(0.0001, 5.0, nnz).astype(np.float32)))
+    return rows
+
+
+class TestRoundTrip:
+    def test_lam_bitwise_and_metadata(self, tmp_path):
+        m = _toy_model()
+        path = str(tmp_path / "models" / "LdaModel_EN_123")
+        save_reference_model(m, path)
+        back = load_reference_model(path)
+        np.testing.assert_array_equal(back.lam, m.lam)  # bitwise
+        np.testing.assert_array_equal(back.alpha, m.alpha)
+        assert back.eta == pytest.approx(m.eta)
+        assert back.gamma_shape == m.gamma_shape
+        assert back.iteration_times == m.iteration_times
+        assert back.vocab == m.vocab  # sidecar round-trip
+        assert load_reference_vocab(path) == m.vocab
+
+    def test_metadata_json_layout(self, tmp_path):
+        m = _toy_model()
+        path = str(tmp_path / "models" / "LdaModel_EN_9")
+        save_reference_model(m, path)
+        with open(os.path.join(path, "metadata", "part-00000")) as f:
+            meta = json.loads(f.readline())
+        assert meta["class"] == (
+            "org.apache.spark.mllib.clustering.DistributedLDAModel"
+        )
+        assert meta["version"] == "1.0"
+        assert meta["k"] == m.k and meta["vocabSize"] == m.vocab_size
+        # Spark writes _SUCCESS markers per dataset
+        for d in (
+            "metadata",
+            "data/globalTopicTotals",
+            "data/topicCounts",
+            "data/tokenCounts",
+        ):
+            assert os.path.exists(os.path.join(path, d, "_SUCCESS"))
+
+    def test_edges_and_doc_vertices(self, tmp_path):
+        m = _toy_model()
+        rows = _toy_rows()
+        rng = np.random.default_rng(1)
+        n_dk = rng.gamma(1.0, 1.0, size=(len(rows), m.k)).astype(np.float32)
+        path = str(tmp_path / "models" / "LdaModel_EN_55")
+        save_reference_model(
+            m, path, doc_topic_counts=n_dk, doc_rows=rows
+        )
+        art = MLlibLDAArtifacts(path)
+        # term vertices + doc vertices decoded
+        np.testing.assert_array_equal(
+            art.beta.astype(np.float32), m.lam
+        )
+        assert sorted(art.doc_gammas) == list(range(len(rows)))
+        for d, g in art.doc_gammas.items():
+            np.testing.assert_array_equal(g.astype(np.float32), n_dk[d])
+        # edges reconstruct the rows exactly (incl. float64 round trip)
+        got = reference_doc_rows(art)
+        assert [d for d, _, _ in got] == list(range(len(rows)))
+        for (_, ids, wts), (eids, ewts) in zip(got, rows):
+            np.testing.assert_array_equal(ids, eids)
+            np.testing.assert_array_equal(wts, ewts)
+        # totals = lam row sums
+        np.testing.assert_allclose(
+            art.global_topic_totals,
+            np.asarray(m.lam, np.float64).sum(axis=1),
+            rtol=1e-12,
+        )
+
+    def test_spark_row_metadata_present(self, tmp_path):
+        pq = pytest.importorskip("pyarrow.parquet")
+        m = _toy_model()
+        path = str(tmp_path / "models" / "LdaModel_EN_77")
+        save_reference_model(m, path)
+        f = os.path.join(
+            path, "data", "topicCounts", "part-00000.snappy.parquet"
+        )
+        md = pq.read_table(f).schema.metadata
+        row_md = json.loads(
+            md[b"org.apache.spark.sql.parquet.row.metadata"]
+        )
+        names = [fl["name"] for fl in row_md["fields"]]
+        assert names == ["id", "topicWeights"]
+        udt = row_md["fields"][1]["type"]
+        assert udt["class"] == "org.apache.spark.mllib.linalg.VectorUDT"
+
+
+class TestFrozenModelReExport:
+    def test_reexport_frozen_en_model(self, tmp_path):
+        """Import the reference's own frozen EN model, export it through
+        our writer, re-import: parameters must survive bitwise."""
+        src = os.path.join(REFERENCE_MODELS, "LdaModel_EN_1591049082850")
+        if not os.path.isdir(src):
+            pytest.skip("frozen reference model not mounted")
+        orig = load_reference_model(src)
+        art = MLlibLDAArtifacts(src)
+        rows = reference_doc_rows(art)
+        path = str(tmp_path / "models" / "LdaModel_EN_re")
+        save_reference_model(
+            orig,
+            path,
+            doc_topic_counts=np.stack(
+                [art.doc_gammas[d] for d in sorted(art.doc_gammas)]
+            ),
+            doc_rows=[(ids, wts) for _, ids, wts in rows],
+        )
+        back = load_reference_model(path)
+        np.testing.assert_array_equal(back.lam, orig.lam)
+        np.testing.assert_array_equal(back.alpha, orig.alpha)
+        assert back.eta == orig.eta
+        assert back.iteration_times == orig.iteration_times
+        assert back.vocab == orig.vocab
+        # the re-exported edge set matches the frozen one
+        art2 = MLlibLDAArtifacts(path)
+        assert len(art2.edges) == len(art.edges)
+        got = {(d, t): w for d, t, w in art2.edges}
+        for d, t, w in art.edges:
+            assert got[(d, t)] == pytest.approx(w, rel=1e-6)
