@@ -204,7 +204,9 @@ def cmd_train(args: argparse.Namespace) -> int:
                 print(f"MLlib-format model exported to {mllib_dir}")
 
         metrics.log_phases(timer.phases)
-        metrics.log_iteration_times(model.iteration_times)
+        metrics.log_iteration_times(
+            model.iteration_times, kind=model.iteration_times_kind
+        )
         metrics.log(
             "model_saved",
             path=out_dir,
@@ -251,11 +253,11 @@ def cmd_score(args: argparse.Namespace) -> int:
         dist,
         rows,
     )
+    # the reference prints every report block to the console as it goes
+    # (LDALoader.scala mirrors each textOutputContent append with a
+    # println) — the report text IS the console output
+    print(text)
     path = write_scoring_report(text, args.output_dir, args.lang)
-    # console tally like LDALoader.scala:142-149
-    tallies = np.bincount(dist.argmax(1), minlength=model.k)
-    for t, c in enumerate(tallies):
-        print(f"topic {t}: {c} books")
     print(f"report written to {path}")
     return 0
 

@@ -42,6 +42,10 @@ class LDAModel:
     eta: float                         # topicConcentration
     gamma_shape: float = 100.0
     iteration_times: List[float] = field(default_factory=list)
+    # "per_iteration": real wall measurements (MLlib iterationTimes
+    # semantics); "interval_mean": scan-chunked fits record each interval's
+    # mean m times — equal TOTAL, but not a per-iteration distribution
+    iteration_times_kind: str = "per_iteration"
     algorithm: str = "online"
     step: int = 0
     # jit-backed sharded scoring/eval fns, keyed by (kind, mesh, params):

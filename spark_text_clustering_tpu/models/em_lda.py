@@ -522,6 +522,7 @@ class EMLDA:
             eta=float(eta),
             gamma_shape=p.gamma_shape,
             iteration_times=list(timer.times),
+            iteration_times_kind=timer.kind,
             algorithm="em",
             step=start_it + len(timer.times),
         )

@@ -164,6 +164,8 @@ class NMFModel:
     vocab: List[str]
     loss: float = float("nan")         # final Frobenius objective
     iteration_times: List[float] = field(default_factory=list)
+    # see LDAModel.iteration_times_kind: interval means vs real samples
+    iteration_times_kind: str = "per_iteration"
     step: int = 0
 
     @property
@@ -312,5 +314,6 @@ class NMF:
             vocab=list(vocab),
             loss=loss,
             iteration_times=list(timer.times),
+            iteration_times_kind=timer.kind,
             step=p.max_iterations,
         )

@@ -704,6 +704,7 @@ class OnlineLDA:
                 eta=float(eta),
                 gamma_shape=p.gamma_shape,
                 iteration_times=list(timer.times),
+                iteration_times_kind=timer.kind,
                 algorithm="online",
                 step=start_it + len(timer.times),
             )
@@ -788,6 +789,7 @@ class OnlineLDA:
             eta=float(eta),
             gamma_shape=p.gamma_shape,
             iteration_times=list(timer.times),
+            iteration_times_kind=timer.kind,
             algorithm="online",
             step=start_it + len(timer.times),
         )

@@ -100,6 +100,7 @@ def save_model(model, path: str) -> None:
             "algorithm": model.algorithm,
             "step": int(model.step),
             "iteration_times": [float(t) for t in model.iteration_times],
+            "iteration_times_kind": model.iteration_times_kind,
         },
         arrays={"lam": model.lam, "alpha": model.alpha},
         vocab=model.vocab,
@@ -116,6 +117,7 @@ def save_nmf_model(model, path: str) -> None:
             "loss": float(model.loss),
             "step": int(model.step),
             "iteration_times": [float(t) for t in model.iteration_times],
+            "iteration_times_kind": model.iteration_times_kind,
         },
         arrays={"h": model.h},
         vocab=model.vocab,
@@ -189,6 +191,9 @@ def load_model(path: str):
             vocab=vocab,
             loss=float(meta.get("loss", float("nan"))),
             iteration_times=list(meta.get("iteration_times", [])),
+            iteration_times_kind=meta.get(
+                "iteration_times_kind", "per_iteration"
+            ),
             step=int(meta.get("step", 0)),
         )
         if model.vocab_size != len(vocab):
@@ -203,6 +208,9 @@ def load_model(path: str):
         eta=float(meta["eta"]),
         gamma_shape=float(meta.get("gamma_shape", 100.0)),
         iteration_times=list(meta.get("iteration_times", [])),
+        iteration_times_kind=meta.get(
+            "iteration_times_kind", "per_iteration"
+        ),
         algorithm=meta.get("algorithm", "online"),
         step=int(meta.get("step", 0)),
     )

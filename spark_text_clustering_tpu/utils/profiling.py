@@ -94,6 +94,9 @@ class MetricsLogger:
         for name, seconds in phases.items():
             self.log("phase", name=name, seconds=round(seconds, 6))
 
-    def log_iteration_times(self, times) -> None:
+    def log_iteration_times(self, times, kind: str = "per_iteration") -> None:
         for i, s in enumerate(times):
-            self.log("train_iteration", iteration=i, seconds=round(s, 6))
+            self.log(
+                "train_iteration", iteration=i, seconds=round(s, 6),
+                kind=kind,
+            )

@@ -93,9 +93,10 @@ def format_scoring_report(
     # --- header: top-weighted terms per topic (LDALoader.scala:66-78) ---
     lines += [_BAR, f"LDA Model: {k} Topics", _BAR]
     topics_terms = model.describe_topics_terms(header_terms)
-    topic_top_sets = [
-        {t for t, _ in topic} for topic in model.describe_topics_terms(topic_pool)
-    ]
+    # ONE ordered top-`topic_pool` pass serves the per-book intersection
+    # sets AND the trailing summary's top-10 prefix
+    topics_pool_terms = model.describe_topics_terms(topic_pool)
+    topic_top_sets = [{t for t, _ in topic} for topic in topics_pool_terms]
     for i, topic in enumerate(topics_terms):
         lines.append(f"TOPIC {i}: top-weighted terms")
         for term, w in topic:
@@ -104,6 +105,7 @@ def format_scoring_report(
     lines.append(_BAR)
 
     # --- per book (LDALoader.scala:110-169) -----------------------------
+    mains: List[int] = []
     for b, (name, dist, (ids, wts)) in enumerate(
         zip(book_names, distributions, book_rows)
     ):
@@ -119,6 +121,7 @@ def format_scoring_report(
         for t in range(k):
             lines.append(f"Nr.: {t} \t\t|\t {java_double_str(float(dist[t]))}")
         main = int(np.argmax(dist))
+        mains.append(main)
         lines.append(
             f"Main topic of the book: Topic Nr. ({main}), "
             f"Weight ({java_double_str(float(dist[main]))})"
@@ -138,6 +141,39 @@ def format_scoring_report(
             _HASH,
             "",
         ]
+
+    # --- trailing topic summary (LDALoader.scala:171-206): top-10 terms
+    # per topic + books-per-topic tallies and name lists.  The name list
+    # reproduces the reference's accumulator formatting exactly: each name
+    # followed by ", ", except every 3rd book in a topic ends its line.
+    # (Absent from the two frozen golden reports — they predate this
+    # section of the reference code — so parity parsers treat it as an
+    # optional tail.)
+    topic_counts = [0] * k
+    topic_names = [""] * k
+    for name, main in zip(book_names, mains):
+        topic_counts[main] += 1
+        topic_names[main] += _book_display_name(name)
+        topic_names[main] += "\n" if topic_counts[main] % 3 == 0 else ", "
+    lines += [_BAR, "List of topics", _BAR]
+    for i in range(k):
+        lines += [_DASH, f"TOPIC {i}: top-weighted terms", _DASH]
+        lines += [
+            f"{term}\t{java_double_str(w)}"
+            for term, w in topics_pool_terms[i][:10]
+        ]
+        lines += [
+            "",
+            _DASH,
+            f"Amount of books in the topic: {topic_counts[i]}",
+            _DASH,
+            "List of Books:",
+            _DASH,
+            topic_names[i],
+            _DASH,
+            "",
+        ]
+    lines += [_BAR, "", _HASH]
     return "\n".join(lines)
 
 

@@ -40,6 +40,7 @@ class IterationTimer:
     def __init__(self) -> None:
         self.times: List[float] = []
         self._t0 = None
+        self._split = False
 
     def start(self) -> None:
         self._t0 = time.perf_counter()
@@ -49,6 +50,16 @@ class IterationTimer:
             self.times.append(time.perf_counter() - self._t0)
             self._t0 = None
 
+    @property
+    def kind(self) -> str:
+        """"per_iteration" when every recorded time is a real wall
+        measurement; "interval_mean" once any chunk was split into equal
+        shares (``split_last``) — consumers comparing iteration-time
+        DISTRIBUTIONS against MLlib's real per-iteration ``iterationTimes``
+        must not mistake interval means for samples (round-2 VERDICT
+        Missing #3)."""
+        return "interval_mean" if self._split else "per_iteration"
+
     def split_last(self, m: int) -> None:
         """Replace the last recorded span with ``m`` equal slices — how a
         scan-chunked loop reports per-iteration means (the chunk runs as
@@ -56,3 +67,4 @@ class IterationTimer:
         if m > 1 and self.times:
             chunk = self.times.pop()
             self.times.extend([chunk / m] * m)
+            self._split = True

@@ -112,6 +112,15 @@ class TestReport:
         assert "Book 0? Vol - Author.txt" in text  # ',' -> '?' escape
         assert "Main topic of the book" in text
         assert text.count("Topics Nr. \t|\t Distribution") == 4
+        # trailing topic summary (LDALoader.scala:171-206)
+        assert "List of topics" in text
+        counts = [
+            int(line.rsplit(" ", 1)[1])
+            for line in text.splitlines()
+            if line.startswith("Amount of books in the topic:")
+        ]
+        assert len(counts) == 3 and sum(counts) == 4
+        assert "List of Books:" in text
 
 
 class TestCLI:
