@@ -48,12 +48,80 @@ VOCAB_SIZE_GE = 154_741  # the reference GE model's vocabSize
 ITERS = 50
 
 # BASELINE.md row 1 shape: 20 Newsgroups, k=20, HashingTF -> IDF -> LDA.
-# The corpus itself is not redistributable in this image, so the bench uses
-# a synthetic corpus of identical shape (doc count, hash width, Zipf terms).
+# The corpus itself is not redistributable in this image (zero egress, no
+# sklearn data cache), so the bench uses a synthetic corpus of identical
+# shape (doc count, hash width, Zipf terms).
 ONLINE_N_DOCS = 11_314
 ONLINE_K = 20
 ONLINE_NUM_FEATURES = 1 << 18
 ONLINE_ITERS = 50
+
+# ---------------------------------------------------------------------
+# Roofline constants + FLOPs models (PERF.md "MFU accounting" documents
+# the derivations).  Peaks are per chip; fp32 work is reported against
+# the bf16 MXU peak, making every MFU number a CONSERVATIVE lower bound.
+# ---------------------------------------------------------------------
+CHIP_PEAKS = {
+    # platform/gen -> (peak FLOP/s, HBM bytes/s)
+    "v5e": (197e12, 819e9),
+    "v4": (275e12, 1228e9),
+}
+
+
+def _chip_peaks():
+    """Peaks for the LIVE chip generation (device_kind, e.g. 'TPU v5e'),
+    with the env var only as a fallback for platforms whose kind string
+    matches nothing."""
+    kind = ""
+    try:
+        import jax
+
+        kind = jax.devices()[0].device_kind.lower()
+    except Exception:
+        pass
+    for gen, peaks in CHIP_PEAKS.items():
+        if gen in kind.replace(" ", ""):
+            return peaks
+    gen = os.environ.get("PALLAS_AXON_TPU_GEN", "v5e")
+    return CHIP_PEAKS.get(gen, CHIP_PEAKS["v5e"])
+
+
+def flops_em_sweep(padded_cells: int, k: int, v: int) -> float:
+    """FLOPs of ONE EM full-corpus sweep (em_lda._em_edge_pass):
+    phi compute (2 ops/cell/topic: mult by doc factor, div by denom is
+    amortized per [B,k]), normalize (sum + div = 2), wphi (1),
+    n_dk reduce (1), n_wk scatter-add (1) -> ~6 FLOPs per padded token
+    cell per topic, plus the k*V row-sum for N_k."""
+    return 6.0 * padded_cells * k + float(k) * v
+
+
+def flops_online_iter(
+    batch_cells: int, k: int, inner_iters: float
+) -> float:
+    """FLOPs of one online-VB iteration (lda_math._gamma_fixed_point +
+    sufficient stats): each inner iteration is two [B,L]x[k] contractions
+    (phinorm + gamma update: 2*2 FLOPs per cell per topic) plus the
+    exp/digamma transcendentals (counted as 1); the final sstats pass adds
+    ~3 more (vals mult, div, scatter-add)."""
+    return (4.0 * inner_iters + 3.0) * batch_cells * k
+
+
+def online_bytes_iter(
+    batch_cells: int, k: int, inner_iters: float
+) -> float:
+    """Minimum HBM traffic of one online iteration under the XLA loop:
+    the [B, L, k] slab re-streamed ~3 passes per inner iteration at 4 B,
+    plus the token arrays (8 B/cell).  The Pallas kernel holds tiles in
+    VMEM, so its achieved number reads BELOW this model — that gap is the
+    kernel's win (PERF.md "MFU accounting")."""
+    return 12.0 * batch_cells * k * inner_iters + 8.0 * batch_cells
+
+
+def em_bytes_sweep(padded_cells: int, k: int, v: int) -> float:
+    """Minimum HBM traffic of one EM sweep: the [B, L, k] gathered slab is
+    written+read ~3 times (gather out, phi, wphi) at 4 bytes, the token
+    arrays read once (8 bytes/cell), and the [k, V] table read + written."""
+    return 12.0 * padded_cells * k + 8.0 * padded_cells + 8.0 * k * v
 
 
 # =====================================================================
@@ -262,12 +330,35 @@ def _bench_em(lang: str = "EN", baseline: float = BASELINE_S_PER_ITER):
     model = opt.fit(rows, vocab)
     total = time.perf_counter() - t0
     s_per_iter = float(np.mean(model.iteration_times))
+    roofline = _roofline(
+        flops=flops_em_sweep(opt.last_padded_cells, K, vocab_len),
+        hbm_bytes=em_bytes_sweep(opt.last_padded_cells, K, vocab_len),
+        seconds=s_per_iter,
+    )
     sys.stderr.write(
         f"# EM {lang}: {len(rows)} docs, V={vocab_len}, k={K}, {ITERS} "
         f"iters, total {total:.1f}s, logLik {opt.last_log_likelihood:.1f}, "
-        f"baseline {baseline}s/iter (Spark local[*])\n"
+        f"baseline {baseline}s/iter (Spark local[*]), "
+        f"{roofline['achieved_gflops']} GFLOP/s\n"
     )
-    return s_per_iter
+    return s_per_iter, roofline
+
+
+def _roofline(flops: float, hbm_bytes: float, seconds: float) -> dict:
+    """Achieved FLOP/s + HBM bytes/s for one measured span, with % of
+    chip peak when running on the TPU (PERF.md "MFU accounting")."""
+    import jax
+
+    out = {
+        "model_flops": round(flops),
+        "achieved_gflops": round(flops / seconds / 1e9, 2),
+        "achieved_hbm_gbps": round(hbm_bytes / seconds / 1e9, 2),
+    }
+    if jax.default_backend() != "cpu":
+        peak_flops, peak_bw = _chip_peaks()
+        out["mfu"] = round(flops / seconds / peak_flops, 5)
+        out["hbm_util"] = round(hbm_bytes / seconds / peak_bw, 4)
+    return out
 
 
 def _bench_online():
@@ -312,27 +403,141 @@ def _bench_online():
     # Log-perplexity (MLlib ``logPerplexity`` semantics: -bound / token
     # count) on a fixed 512-doc evaluation batch.
     eval_rows = rows[:512]
+    log_perplexity = _eval_log_perplexity(
+        np.asarray(model.lam), np.asarray(model.alpha), model.eta,
+        eval_rows,
+    )
+
+    # Roofline: calibrate the dynamic inner-loop depth by replaying one
+    # minibatch E-step through e_step (same math, exposes `iters`) at BOTH
+    # ends of training — a fresh random lambda (early iterations need the
+    # deepest loops) and the final lambda — and use the mean.  Still an
+    # approximation of the 50 actual depths, documented as such.
+    from spark_text_clustering_tpu.ops.lda_math import e_step, init_lambda
+
+    sample = batch_from_rows(rows[:bsz], row_len=opt.last_row_len)
+    gamma0 = init_gamma(None, sample.num_docs, ONLINE_K)
+    inners = []
+    for lam_probe in (
+        init_lambda(jax.random.PRNGKey(0), ONLINE_K, ONLINE_NUM_FEATURES),
+        jnp.asarray(model.lam),
+    ):
+        eb = jnp.exp(dirichlet_expectation(lam_probe))
+        inners.append(int(
+            e_step(
+                sample, eb, jnp.asarray(model.alpha), gamma0,
+                vocab_size=ONLINE_NUM_FEATURES, backend="xla",
+            ).iters
+        ))
+    inner = max(1.0, float(np.mean(inners)))
+    cells = bsz * opt.last_row_len
+    roofline = _roofline(
+        flops=flops_online_iter(cells, ONLINE_K, inner),
+        hbm_bytes=online_bytes_iter(cells, ONLINE_K, inner),
+        seconds=total / ONLINE_ITERS,
+    )
+    roofline["inner_iters_early_final"] = inners
+    sys.stderr.write(
+        f"# online: {len(rows)} docs, V={ONLINE_NUM_FEATURES}, k={ONLINE_K}, "
+        f"{ONLINE_ITERS} iters x {bsz} docs/batch, total {total:.1f}s, "
+        f"{docs_per_sec:.0f} docs/s, logPerp {log_perplexity:.3f}, "
+        f"inner={inner}\n"
+    )
+    return docs_per_sec, log_perplexity, bsz, roofline, rows, eval_rows
+
+
+def _eval_log_perplexity(lam, alpha, eta, eval_rows) -> float:
+    """-bound / token mass on a fixed eval batch — ONE evaluator shared by
+    our model and the CPU-baseline model so the matched-perplexity
+    comparison cannot be skewed by differing bound conventions."""
+    import jax.numpy as jnp
+
+    from spark_text_clustering_tpu.ops.lda_math import (
+        approx_bound,
+        dirichlet_expectation,
+        infer_gamma,
+        init_gamma,
+    )
+    from spark_text_clustering_tpu.ops.sparse import batch_from_rows
+
     batch = batch_from_rows(eval_rows)
-    lam = jnp.asarray(model.lam)
-    alpha = jnp.asarray(model.alpha)
+    lam = jnp.asarray(lam, jnp.float32)
+    alpha = jnp.asarray(alpha, jnp.float32)
     eb = jnp.exp(dirichlet_expectation(lam))
     gamma = infer_gamma(
-        batch, eb, alpha, init_gamma(None, batch.num_docs, ONLINE_K)
+        batch, eb, alpha, init_gamma(None, batch.num_docs, lam.shape[0])
     )
     n_tokens = float(np.asarray(batch.token_weights).sum())
     bound = float(
         approx_bound(
-            batch, gamma, lam, alpha, model.eta,
+            batch, gamma, lam, alpha, float(eta),
             corpus_size=len(eval_rows), batch_docs=len(eval_rows),
         )
     )
-    log_perplexity = -bound / max(n_tokens, 1.0)
-    sys.stderr.write(
-        f"# online: {len(rows)} docs, V={ONLINE_NUM_FEATURES}, k={ONLINE_K}, "
-        f"{ONLINE_ITERS} iters x {bsz} docs/batch, total {total:.1f}s, "
-        f"{docs_per_sec:.0f} docs/s, logPerp {log_perplexity:.3f}\n"
+    return -bound / max(n_tokens, 1.0)
+
+
+def _bench_sklearn_baseline(rows, eval_rows, bsz: int):
+    """BASELINE.md row 1 asks >=10x docs/sec vs Spark local[*] at matched
+    perplexity.  No Spark exists in this image (zero egress, JVM absent),
+    so the measured CPU stand-in is scikit-learn's online LDA — the same
+    Hoffman algorithm family MLlib implements — on the SAME rows, same k,
+    same batch size, same priors, with perplexity evaluated through OUR
+    bound so the comparison is apples-to-apples (VERDICT round-2 item 7
+    explicitly allows a documented sklearn stand-in).
+
+    Returns a record dict or None when sklearn is unavailable."""
+    try:
+        import scipy.sparse as sp
+        from sklearn.decomposition import LatentDirichletAllocation
+    except ImportError:
+        sys.stderr.write("# sklearn unavailable: no CPU baseline\n")
+        return None
+
+    indptr = np.zeros(len(rows) + 1, np.int64)
+    for i, (ids, _) in enumerate(rows):
+        indptr[i + 1] = indptr[i] + len(ids)
+    indices = np.concatenate([ids for ids, _ in rows])
+    data = np.concatenate([cts for _, cts in rows])
+    x = sp.csr_matrix(
+        (data, indices, indptr),
+        shape=(len(rows), ONLINE_NUM_FEATURES),
     )
-    return docs_per_sec, log_perplexity, bsz
+    passes = 3  # ~60 minibatch updates, comparable to our 50
+    lda = LatentDirichletAllocation(
+        n_components=ONLINE_K,
+        learning_method="online",
+        batch_size=bsz,
+        max_iter=passes,
+        total_samples=len(rows),
+        doc_topic_prior=1.0 / ONLINE_K,
+        topic_word_prior=1.0 / ONLINE_K,
+        learning_offset=1024.0,
+        learning_decay=0.51,
+        random_state=0,
+    )
+    t0 = time.perf_counter()
+    lda.fit(x)
+    t = time.perf_counter() - t0
+    docs_per_sec = passes * len(rows) / t
+    log_perp = _eval_log_perplexity(
+        lda.components_, np.full((ONLINE_K,), 1.0 / ONLINE_K),
+        1.0 / ONLINE_K, eval_rows,
+    )
+    sys.stderr.write(
+        f"# sklearn baseline: {passes} passes in {t:.1f}s, "
+        f"{docs_per_sec:.0f} docs/s, logPerp {log_perp:.3f}\n"
+    )
+    import sklearn
+
+    return {
+        "tool": f"sklearn-{sklearn.__version__} online LDA (documented "
+                "Spark-local[*] stand-in; same rows/k/batch/priors)",
+        "passes": passes,
+        "seconds": round(t, 2),
+        "docs_per_sec": round(docs_per_sec, 1),
+        "log_perplexity": round(log_perp, 4),
+    }
 
 
 def child_main() -> None:
@@ -366,13 +571,38 @@ def child_main() -> None:
         os.path.join(CACHE, f"xla_cache_{jax.default_backend()}_{fp}"),
     )
 
-    s_per_iter = _bench_em("EN", BASELINE_S_PER_ITER)
+    s_per_iter, em_roofline = _bench_em("EN", BASELINE_S_PER_ITER)
     ge_s_per_iter = None
+    ge_roofline = None
     try:
-        ge_s_per_iter = _bench_em("GE", BASELINE_S_PER_ITER_GE)
+        ge_s_per_iter, ge_roofline = _bench_em("GE", BASELINE_S_PER_ITER_GE)
     except Exception as exc:  # GE corpus optional; EN stays the headline
         sys.stderr.write(f"# GE bench skipped: {exc!r}\n")
-    docs_per_sec, log_perp, bsz = _bench_online()
+    (docs_per_sec, log_perp, bsz, online_roofline, rows,
+     eval_rows) = _bench_online()
+
+    baseline = _bench_sklearn_baseline(rows, eval_rows, bsz)
+    online_rec = {
+        "corpus": "20ng-shaped-synthetic",
+        "n_docs": ONLINE_N_DOCS,
+        "k": ONLINE_K,
+        "num_features": ONLINE_NUM_FEATURES,
+        "batch_size": bsz,
+        "docs_per_sec": round(docs_per_sec, 1),
+        "log_perplexity": round(log_perp, 4),
+        "roofline": online_roofline,
+        "cpu_baseline": baseline,
+    }
+    if baseline:
+        ratio = round(docs_per_sec / baseline["docs_per_sec"], 2)
+        matched = bool(log_perp <= baseline["log_perplexity"] * 1.01)
+        # the raw throughput ratio is always recorded; the BASELINE.md
+        # row-1 "vs_baseline" claim is only emitted when the matched-
+        # perplexity precondition actually held
+        online_rec["docs_per_sec_ratio"] = ratio
+        online_rec["perplexity_matched"] = matched
+        if matched:
+            online_rec["vs_baseline"] = ratio
 
     print(
         json.dumps(
@@ -382,6 +612,7 @@ def child_main() -> None:
                 "unit": "s/iter",
                 "vs_baseline": round(BASELINE_S_PER_ITER / s_per_iter, 2),
                 "platform": jax.default_backend(),
+                "roofline": em_roofline,
                 "em_ge": (
                     {
                         "value": round(ge_s_per_iter, 6),
@@ -389,19 +620,12 @@ def child_main() -> None:
                         "vs_baseline": round(
                             BASELINE_S_PER_ITER_GE / ge_s_per_iter, 2
                         ),
+                        "roofline": ge_roofline,
                     }
                     if ge_s_per_iter
                     else None
                 ),
-                "online": {
-                    "corpus": "20ng-shaped-synthetic",
-                    "n_docs": ONLINE_N_DOCS,
-                    "k": ONLINE_K,
-                    "num_features": ONLINE_NUM_FEATURES,
-                    "batch_size": bsz,
-                    "docs_per_sec": round(docs_per_sec, 1),
-                    "log_perplexity": round(log_perp, 4),
-                },
+                "online": online_rec,
             }
         )
     )

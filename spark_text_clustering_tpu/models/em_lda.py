@@ -263,6 +263,7 @@ class EMLDA:
         )
         self.last_log_likelihood: Optional[float] = None
         self.last_doc_topic_counts: Optional[np.ndarray] = None
+        self.last_padded_cells: Optional[int] = None
         # jit cache keyed by vocab size (the only per-fit value baked into
         # the step closure) so it survives repeat fits (bench warmup) but
         # never leaks across fits with different vocabularies
@@ -377,6 +378,11 @@ class EMLDA:
 
         v_pad = ((v + p.model_shards - 1) // p.model_shards) * p.model_shards
         plan = self._bucket_plan(rows, n)
+        # padded token cells per full-corpus sweep — the size driver of the
+        # bench's FLOPs/roofline model (bench.py)
+        self.last_padded_cells = sum(
+            b.num_docs * b.row_len for b, _, _ in plan
+        )
         dk_sharding = NamedSharding(self.mesh, P(DATA_AXIS, None))
 
         ckpt_path = (

@@ -513,6 +513,7 @@ class OnlineLDA:
         self._resident_fn = None
         self._resident_chunk_fn = None
         self.last_batch_size: Optional[int] = None
+        self.last_row_len: Optional[int] = None
 
     def _resident_arrays(self, rows, n: int, row_len: int):
         """Upload the padded corpus [N_pad, row_len] sharded over "data",
@@ -592,6 +593,8 @@ class OnlineLDA:
         # One static row length for the whole run (jit cache friendly).
         max_nnz = max((len(i) for i, _ in rows), default=1)
         row_len = max(8, next_pow2(max_nnz))
+        # exposed for the bench's FLOPs/roofline model (bench.py)
+        self.last_row_len = row_len
 
         if v % p.model_shards:
             # pad vocab axis so it divides evenly over model shards

@@ -170,15 +170,35 @@ class CountVectorizer(Estimator):
 
     Counting is sharded across host processes (``count_terms_parallel`` —
     Spark's reduceByKey analogue); results are identical to serial counting
-    at any worker count."""
+    at any worker count.
+
+    ``docs_are_process_local=True`` is the multi-host ingest mode: each
+    ``jax.distributed`` process passes only ITS OWN document shard, the
+    per-host counters merge once over DCN
+    (``merge_term_counts_multihost``), and every process derives the
+    identical global top-V — the cross-host leg of Spark's distributed
+    vocabulary build.  Leave False when every process holds the full
+    corpus (the default replicated-read flow), or shared documents would
+    be counted once per process."""
 
     def __init__(
-        self, vocab_size: int = 2_900_000, num_workers: Optional[int] = None
+        self,
+        vocab_size: int = 2_900_000,
+        num_workers: Optional[int] = None,
+        docs_are_process_local: bool = False,
     ):
         self.vocab_size = vocab_size
         self.num_workers = num_workers
+        self.docs_are_process_local = docs_are_process_local
 
     def fit(self, ds: Dict) -> CountVectorizerModel:
+        if self.docs_are_process_local:
+            from .utils.vocab import build_vocab_multihost
+
+            vocab, _ = build_vocab_multihost(
+                ds["tokens"], self.vocab_size, self.num_workers
+            )
+            return CountVectorizerModel(vocab)
         counts = count_terms_parallel(ds["tokens"], self.num_workers)
         vocab, _ = build_vocab(counts, self.vocab_size)
         return CountVectorizerModel(vocab)

@@ -9,7 +9,14 @@ from .textproc import (
     stem,
 )
 from .timing import IterationTimer, PhaseTimer
-from .vocab import build_vocab, count_terms, count_vector, count_vectors
+from .vocab import (
+    build_vocab,
+    build_vocab_multihost,
+    count_terms,
+    count_vector,
+    count_vectors,
+    merge_term_counts_multihost,
+)
 
 __all__ = [
     "format_scoring_report",
@@ -28,7 +35,9 @@ __all__ = [
     "IterationTimer",
     "PhaseTimer",
     "build_vocab",
+    "build_vocab_multihost",
     "count_terms",
     "count_vector",
     "count_vectors",
+    "merge_term_counts_multihost",
 ]

@@ -40,6 +40,18 @@ def make_online_toy_params():
                   batch_size=6, device_resident=True)
 
 
+def make_toy_token_docs():
+    """Deterministic token documents for the DISTRIBUTED vocab build:
+    term frequencies engineered so the top-V depends on counts from BOTH
+    process shards (term 'cross' is rank-1 only when the shards merge)."""
+    docs = []
+    for d in range(16):
+        toks = [f"term{d % 6}"] * (d % 4 + 1) + ["cross"] * 2
+        toks += [f"rare{d}"]
+        docs.append(toks)
+    return docs
+
+
 def make_toy_fit_rows():
     """A tiny deterministic corpus for the end-to-end multi-host fit."""
     rng = np.random.default_rng(11)
@@ -146,10 +158,27 @@ def main() -> int:
     online = OnlineLDA(make_online_toy_params(), mesh=mesh)
     online_lam = np.asarray(online.fit(rows, vocab).lam)
 
+    # --- distributed vocabulary build (cross-host reduceByKey) ------------
+    # Each process counts ONLY its own document shard; the DCN merge must
+    # reproduce the single-process global top-V on every process.
+    from spark_text_clustering_tpu.utils.vocab import (
+        build_vocab,
+        build_vocab_multihost,
+        count_terms,
+    )
+
+    tok_docs = make_toy_token_docs()
+    local_docs = tok_docs[pid::nproc]
+    vocab_dist, t2i_dist = build_vocab_multihost(local_docs, 8)
+    vocab_global, _ = build_vocab(count_terms(tok_docs), 8)
+    assert vocab_dist == vocab_global, (vocab_dist, vocab_global)
+    assert t2i_dist[vocab_dist[0]] == 0
+
     if pid == 0:
         assert ckpt_exists, "coordinator checkpoint missing"
         np.savez(out_path, n_wk=n_wk, total=float(total), fit_lam=lam,
-                 online_lam=online_lam)
+                 online_lam=online_lam,
+                 vocab_dist=np.asarray(vocab_dist))
     print(f"proc {pid}: ok devices={n_dev}")
     return 0
 

@@ -131,3 +131,15 @@ def test_two_process_bringup_and_em_step(tmp_path):
     np.testing.assert_allclose(
         data["online_lam"], expected_online, rtol=1e-4, atol=1e-5
     )
+
+    # distributed vocab build: the 2-process DCN merge reproduced the
+    # single-process global top-V (each worker asserted agreement
+    # in-process; re-check the coordinator's copy here)
+    from multihost_worker import make_toy_token_docs
+    from spark_text_clustering_tpu.utils.vocab import (
+        build_vocab,
+        count_terms,
+    )
+
+    expected_vocab, _ = build_vocab(count_terms(make_toy_token_docs()), 8)
+    assert list(data["vocab_dist"]) == expected_vocab
