@@ -450,7 +450,7 @@ def build_parser() -> argparse.ArgumentParser:
         "--algorithm", default="em", choices=["em", "online", "nmf"]
     )
     tr.add_argument(
-        "--sampling", default="fixed", choices=["fixed", "bernoulli"],
+        "--sampling", default="fixed", choices=["fixed", "bernoulli", "epoch"],
         help="online minibatch sampling: fixed-size round(f*N) or "
              "MLlib's per-doc Bernoulli(f)",
     )

@@ -57,7 +57,7 @@ class Params:
     # the reference corpus the two train to equal perplexity
     # (tests/test_online_quality.py quantifies the divergence VERDICT
     # round-1 weak-5 flagged).
-    sampling: str = "fixed"  # "fixed" | "bernoulli"
+    sampling: str = "fixed"  # "fixed" | "bernoulli" | "epoch"
     seed: int = 0
     # IDF behavior (LDAClustering.scala:177,184-187)
     min_doc_freq: int = 2

@@ -555,9 +555,10 @@ class OnlineLDA:
         # stable XLA shapes, sampling="bernoulli" keeps MLlib's semantics
         # and pads the batch tensor to a 4-sigma static bound (overflow
         # probability ~3e-5/iteration; overflowing draws truncate).
-        if p.sampling not in ("fixed", "bernoulli"):
+        if p.sampling not in ("fixed", "bernoulli", "epoch"):
             raise ValueError(
-                f"unknown sampling {p.sampling!r} (use 'fixed'|'bernoulli')"
+                f"unknown sampling {p.sampling!r} "
+                "(use 'fixed'|'bernoulli'|'epoch')"
             )
         # clamped to [.., 1]: batch_size > n and mini_batch_fraction on a
         # 1-doc corpus (0.05 + 1/1) both legally exceed 1
@@ -578,11 +579,42 @@ class OnlineLDA:
         bsz = ((bsz + n_data - 1) // n_data) * n_data
         self.last_batch_size = min(bsz, n)
 
+        epoch_perms: dict = {}
+
+        def _epoch_perm(epoch: int) -> np.ndarray:
+            perm = epoch_perms.get(epoch)
+            if perm is None:
+                perm = np.random.default_rng(
+                    (p.seed, 0xE90C, epoch)
+                ).permutation(n).astype(np.int32)
+                epoch_perms.clear()  # only the current boundary pair lives
+                epoch_perms[epoch] = perm
+            return perm
+
         def sample_pick(it: int) -> np.ndarray:
             """Unpadded minibatch doc ids for iteration ``it`` — ONE
             per-iteration derived stream shared by the resident and
             host-streaming paths (deterministic resume; identical
-            minibatches on either path)."""
+            minibatches on either path).
+
+            "fixed"/"bernoulli" draw independently per iteration (MLlib
+            semantics) — over E epochs' worth of iterations a doc is
+            missed with prob e^-E.  "epoch" walks shuffled permutations
+            instead, guaranteeing every doc is seen once per pass (the
+            sklearn/`fit`-loop protocol; measurably better perplexity on
+            corpora with heavy term tails, PERF.md north-star row 1)."""
+            if p.sampling == "epoch":
+                size = min(bsz, n)
+                out = np.empty(size, np.int32)
+                filled = 0
+                start = it * size
+                while filled < size:
+                    epoch, off = divmod(start + filled, n)
+                    perm = _epoch_perm(epoch)
+                    take = min(size - filled, n - off)
+                    out[filled:filled + take] = perm[off:off + take]
+                    filled += take
+                return out
             rng = np.random.default_rng((p.seed, it))
             if p.sampling == "bernoulli":
                 pick = np.flatnonzero(rng.random(n) < fraction)
@@ -590,6 +622,9 @@ class OnlineLDA:
             return rng.choice(
                 n, size=min(bsz, n), replace=False
             ).astype(np.int32)
+
+        # exposed for inspection/tests (the stream is pure in (seed, it))
+        self.sample_pick = sample_pick
         # One static row length for the whole run (jit cache friendly).
         max_nnz = max((len(i) for i, _ in rows), default=1)
         row_len = max(8, next_pow2(max_nnz))

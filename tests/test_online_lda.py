@@ -66,6 +66,45 @@ class TestOnlineLDA:
         )
         assert model.log_perplexity(rows) < rand.log_perplexity(rows)
 
+    def test_epoch_sampling_covers_every_doc(self, tiny_corpus_rows):
+        """sampling="epoch" must walk shuffled permutations: every doc
+        appears exactly once per pass, minibatches are deterministic, and
+        the fit trains a sane model."""
+        from spark_text_clustering_tpu.models.online_lda import OnlineLDA
+
+        rows, vocab = tiny_corpus_rows
+        n = len(rows)
+        bsz = 7  # does not divide n=24: picks cross epoch boundaries
+        p = Params(
+            k=2, algorithm="online", max_iterations=12, batch_size=bsz,
+            sampling="epoch", seed=3,
+        )
+        cpu = jax.devices("cpu")
+        mesh = make_mesh(data_shards=1, model_shards=1, devices=cpu[:1])
+        opt = OnlineLDA(p, mesh=mesh)
+        model = opt.fit(rows, vocab)
+        assert isinstance(model, LDAModel)
+
+        # reconstruct the pick stream exactly as the fit draws it
+        picks = [opt.sample_pick(it) for it in range(12)]
+        stream = np.concatenate(picks)
+        n_epochs = len(stream) // n
+        for e in range(n_epochs):
+            seen = np.sort(stream[e * n:(e + 1) * n])
+            np.testing.assert_array_equal(seen, np.arange(n))
+        # deterministic across instances (resume property)
+        opt2 = OnlineLDA(p, mesh=mesh)
+        opt2.fit(rows, vocab, max_iterations=1)
+        np.testing.assert_array_equal(opt2.sample_pick(5), picks[5])
+
+    def test_epoch_sampling_quality_not_worse(self, tiny_corpus_rows):
+        rows, vocab = tiny_corpus_rows
+        m_fixed = _fit(rows, vocab)
+        m_epoch = _fit(rows, vocab, sampling="epoch")
+        assert m_epoch.log_perplexity(rows) <= (
+            m_fixed.log_perplexity(rows) * 1.02
+        )
+
     def test_model_sharding_consistent(self, tiny_corpus_rows):
         rows, vocab = tiny_corpus_rows
         m1 = _fit(rows, vocab, model_shards=1, data_shards=4)
