@@ -438,13 +438,18 @@ def _bench_online():
             ).iters
         ))
     inner = max(1.0, float(np.mean(inners)))
-    cells = bsz * opt.last_row_len
+    # token cells per iteration under the layout the fit actually used:
+    # the packed layout's cells are the TRUE token count (padded only to
+    # a power of two), the padded grid's are bsz * max_nnz_pow2
+    cells = opt.last_batch_cells
     roofline = _roofline(
         flops=flops_online_iter(cells, ONLINE_K, inner),
         hbm_bytes=online_bytes_iter(cells, ONLINE_K, inner),
         seconds=total / ONLINE_ITERS,
     )
     roofline["inner_iters_early_final"] = inners
+    roofline["token_layout"] = opt.last_layout
+    roofline["batch_cells"] = int(cells)
     sys.stderr.write(
         f"# online: {len(rows)} docs, V={ONLINE_NUM_FEATURES}, k={ONLINE_K}, "
         f"{ONLINE_ITERS} iters x {bsz} docs/batch, total {total:.1f}s, "

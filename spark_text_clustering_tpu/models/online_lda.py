@@ -72,6 +72,7 @@ __all__ = [
     "make_online_mstep",
     "make_online_resident_step",
     "make_online_resident_chunk",
+    "make_online_packed_chunk",
 ]
 
 
@@ -140,18 +141,29 @@ def _online_step_core(
     # treeAggregate -> one psum over the data axis (SURVEY.md §3.3).
     sstats_shard = psum_data(sstats_shard)
     batch_docs = psum_data((wts.sum(-1) > 0).sum().astype(jnp.float32))
+    lam_new = _mstep_blend(
+        lam_shard, eb_shard, sstats_shard, batch_docs, step, corpus_sz,
+        eta=eta, tau0=tau0, kappa=kappa,
+    )
+    return lam_new, step + 1
 
-    # M-step (Hoffman): lambda_hat = eta + (D/|B|) * sstats ∘ expElogbeta
-    # — shard-local: each device updates only its V-slice.
+
+def _mstep_blend(
+    lam_shard, eb_shard, sstats_shard, batch_docs, step, corpus_sz,
+    *, eta, tau0, kappa,
+):
+    """Hoffman's M-step, shard-local per V-slice: lambda_hat = eta +
+    (D/|B|) * sstats ∘ expElogbeta; lambda <- (1-rho) lambda + rho
+    lambda_hat with rho = (tau0 + t)^-kappa.  An empty minibatch
+    (possible under Bernoulli sampling on a tiny corpus) must not decay
+    lambda toward eta — MLlib skips the update.  ONE definition shared by
+    the padded and packed iteration cores."""
     rho = (tau0 + step.astype(jnp.float32) + 1.0) ** (-kappa)
     lam_hat = eta + (corpus_sz / jnp.maximum(batch_docs, 1.0)) * (
         sstats_shard * eb_shard
     )
     lam_new = (1.0 - rho) * lam_shard + rho * lam_hat
-    # An empty minibatch (possible under Bernoulli sampling on a tiny
-    # corpus) must not decay lambda toward eta — MLlib skips the update.
-    lam_new = jnp.where(batch_docs > 0.0, lam_new, lam_shard)
-    return lam_new, step + 1
+    return jnp.where(batch_docs > 0.0, lam_new, lam_shard)
 
 
 def make_online_train_step(
@@ -482,6 +494,111 @@ def make_online_resident_chunk(
     return resident_chunk
 
 
+def make_online_packed_chunk(
+    mesh: Mesh,
+    *,
+    alpha: float | np.ndarray,
+    eta: float,
+    tau0: float,
+    kappa: float,
+    k: int,
+    gamma_shape: float,
+    seed: int,
+    max_inner: int = 100,
+    tol: float = 1e-3,
+):
+    """Multi-iteration TOKEN-PACKED runner: minibatches arrive as flat
+    [m, T] token arrays (ids, weights, per-token doc positions) instead of
+    padded [B, L] grids, so per-iteration FLOPs/bandwidth scale with the
+    TRUE token count — on corpora whose nnz spans orders of magnitude the
+    padded grid wastes 10-20x (PERF.md round-3 diagnosis; SURVEY.md §7
+    hard part 1's "CSR-style" option).
+
+    Token slots are sharded over "data"; gamma [B, k] stays replicated
+    with one psum-over-"data" segment reduction per inner iteration
+    (B*k floats — trivial on ICI).  Gamma inits are keyed by GLOBAL doc
+    id exactly like the padded paths, so the two layouts draw identical
+    per-doc inits and train to the same model (pinned by
+    tests/test_resident_training.py).  Host->device per iteration is
+    ~3*T scalars — the packed batches, not a resident corpus.
+
+    The gamma loop is the XLA segment fixed point (the Pallas kernel is
+    built for the padded [k, B, L] layout; with 10-20x fewer cells the
+    packed XLA loop still wins — a packed Pallas kernel is future work).
+
+    Returned fn: (state, tok_ids [m, T], tok_cts [m, T], tok_seg [m, T],
+    picks [m, B], batch_docs [m], corpus_sz) -> state.
+    """
+    from ..ops.lda_math import (
+        gamma_fixed_point_segments,
+        token_sstats_factors_segments,
+    )
+
+    alpha_arr = jnp.asarray(alpha, jnp.float32)
+    base_key = jax.random.PRNGKey(seed)
+
+    def _iter(lam_shard, step, ids_t, cts_t, seg_t, pick, batch_docs,
+              corpus_sz):
+        row_sum = model_row_sum(lam_shard)
+        eb_shard = jnp.exp(
+            dirichlet_expectation_sharded(lam_shard, row_sum)
+        )
+        eb_tok = gather_model_rows(eb_shard, ids_t)       # [T/s, k]
+        key_it = jax.random.fold_in(base_key, step)
+        gamma0 = init_gamma_rows(key_it, pick, k, gamma_shape)
+        gamma, _ = gamma_fixed_point_segments(
+            eb_tok, cts_t, seg_t, alpha_arr, gamma0, max_inner, tol,
+            reduce_fn=psum_data,
+        )
+        vals = token_sstats_factors_segments(eb_tok, cts_t, seg_t, gamma)
+        sstats_shard = psum_data(
+            scatter_add_model_shard(ids_t, vals, eb_shard.shape[-1])
+        )
+        lam_new = _mstep_blend(
+            lam_shard, eb_shard, sstats_shard, batch_docs, step,
+            corpus_sz, eta=eta, tau0=tau0, kappa=kappa,
+        )
+        return lam_new, step + 1
+
+    sharded = jax.shard_map(
+        _iter,
+        mesh=mesh,
+        in_specs=(
+            P(None, MODEL_AXIS),   # lam shard
+            P(),                   # step
+            P(DATA_AXIS),          # token ids (flat)
+            P(DATA_AXIS),          # token weights
+            P(DATA_AXIS),          # token doc positions
+            P(),                   # pick (global doc ids, replicated)
+            P(),                   # true nonempty doc count
+            P(),                   # corpus size
+        ),
+        out_specs=(P(None, MODEL_AXIS), P()),
+        check_vma=False,
+    )
+
+    @jax.jit
+    def packed_chunk(
+        state: TrainState, tok_ids, tok_cts, tok_seg, picks, batch_docs,
+        corpus_sz,
+    ) -> TrainState:
+        cs = jnp.asarray(corpus_sz, jnp.float32)
+
+        def body(st, xs):
+            ids_t, cts_t, seg_t, pick, bd = xs
+            lam, step = sharded(
+                st.lam, st.step, ids_t, cts_t, seg_t, pick, bd, cs
+            )
+            return TrainState(lam, step), None
+
+        state, _ = jax.lax.scan(
+            body, state, (tok_ids, tok_cts, tok_seg, picks, batch_docs)
+        )
+        return state
+
+    return packed_chunk
+
+
 class OnlineLDA:
     """Estimator: ``fit(rows) -> LDAModel`` (the ``lda.run(corpus)`` of the
     reference's online path, LDAClustering.scala:43,61).
@@ -512,8 +629,116 @@ class OnlineLDA:
         self._step_fn_corpus = None
         self._resident_fn = None
         self._resident_chunk_fn = None
+        self._packed_chunk_fn = None
         self.last_batch_size: Optional[int] = None
         self.last_row_len: Optional[int] = None
+        self.last_layout: str = "padded"
+        self.last_batch_cells: Optional[int] = None
+
+    def _fit_packed(
+        self, rows, vocab, p, n, v, k, alpha, eta, bsz, n_iters,
+        start_it, lam, make_pick, timer, verbose, ckpt_path,
+        save_checkpoint,
+    ) -> LDAModel:
+        """Token-packed training loop (see ``make_online_packed_chunk``):
+        the host keeps the corpus as flat arrays + offsets and ships each
+        chunk's minibatches as [m, T] packed token tensors — ~3*T scalars
+        per iteration, with T the TRUE token count padded to a power of
+        two (vs B * max_nnz for the padded grid)."""
+        from ..ops.sparse import next_pow2
+
+        flat_ids = (
+            np.concatenate([np.asarray(i, np.int32) for i, _ in rows])
+            if rows else np.zeros(0, np.int32)
+        )
+        flat_cts = (
+            np.concatenate([np.asarray(w, np.float32) for _, w in rows])
+            if rows else np.zeros(0, np.float32)
+        )
+        offsets = np.zeros(n + 1, np.int64)
+        np.cumsum([len(i) for i, _ in rows], out=offsets[1:])
+
+        if self._packed_chunk_fn is None:
+            self._packed_chunk_fn = make_online_packed_chunk(
+                self.mesh, alpha=alpha, eta=eta, tau0=p.tau0,
+                kappa=p.kappa, k=k, gamma_shape=p.gamma_shape, seed=p.seed,
+            )
+        n_data = self.mesh.shape[DATA_AXIS]
+        tok_spec = NamedSharding(self.mesh, P(None, DATA_AXIS))
+        rep = NamedSharding(self.mesh, P())
+
+        def pack(pick):
+            """One minibatch -> (ids [t], cts [t], seg [t], nonempty)."""
+            real_pos = np.flatnonzero(pick < n)
+            real = pick[real_pos]
+            lens = offsets[real + 1] - offsets[real]
+            ids_t = np.concatenate(
+                [flat_ids[offsets[d]:offsets[d + 1]] for d in real]
+            ) if real.size else np.zeros(0, np.int32)
+            cts_t = np.concatenate(
+                [flat_cts[offsets[d]:offsets[d + 1]] for d in real]
+            ) if real.size else np.zeros(0, np.float32)
+            seg = np.repeat(real_pos.astype(np.int32), lens)
+            return ids_t, cts_t, seg, float((lens > 0).sum())
+
+        state = TrainState(lam, jnp.asarray(start_it, jnp.int32))
+        interval = 1 if verbose else max(1, p.checkpoint_interval)
+        it = start_it
+        cells_sum = 0
+        iters_run = 0
+        while it < n_iters:
+            m = min(interval - (it % interval), n_iters - it)
+            picks = np.stack([make_pick(i) for i in range(it, it + m)])
+            packs = [pack(pk) for pk in picks]
+            t_pad = next_pow2(max(8, max(pp[0].size for pp in packs)))
+            t_pad = ((t_pad + n_data - 1) // n_data) * n_data
+            tok_ids = np.zeros((m, t_pad), np.int32)
+            tok_cts = np.zeros((m, t_pad), np.float32)
+            tok_seg = np.zeros((m, t_pad), np.int32)
+            bds = np.zeros((m,), np.float32)
+            for j, (ids_t, cts_t, seg, bd) in enumerate(packs):
+                tok_ids[j, : ids_t.size] = ids_t
+                tok_cts[j, : cts_t.size] = cts_t
+                tok_seg[j, : seg.size] = seg
+                bds[j] = bd
+            self.last_layout = "packed"
+            cells_sum += t_pad * m
+            iters_run += m
+            # iteration-weighted mean cells: chunks may land on different
+            # pow2 budgets, and the bench's roofline must not scale the
+            # whole run by one chunk's width
+            self.last_batch_cells = cells_sum // iters_run
+            timer.start()
+            state = self._packed_chunk_fn(
+                state,
+                jax.device_put(tok_ids, tok_spec),
+                jax.device_put(tok_cts, tok_spec),
+                jax.device_put(tok_seg, tok_spec),
+                jax.device_put(picks, rep),
+                jax.device_put(bds, rep),
+                float(n),
+            )
+            state.lam.block_until_ready()
+            timer.stop()
+            if m > 1:
+                timer.split_last(m)
+            if verbose:
+                print(f"iter {it}: {timer.times[-1]:.3f}s (packed)")
+            it += m
+            if ckpt_path and it % max(1, p.checkpoint_interval) == 0:
+                save_checkpoint(it, state.lam)
+        lam_np = fetch_global(state.lam)[:, :v]
+        return LDAModel(
+            lam=lam_np,
+            vocab=list(vocab),
+            alpha=alpha,
+            eta=float(eta),
+            gamma_shape=p.gamma_shape,
+            iteration_times=list(timer.times),
+            iteration_times_kind=timer.kind,
+            algorithm="online",
+            step=start_it + len(timer.times),
+        )
 
     def _resident_arrays(self, rows, n: int, row_len: int):
         """Upload the padded corpus [N_pad, row_len] sharded over "data",
@@ -630,6 +855,8 @@ class OnlineLDA:
         row_len = max(8, next_pow2(max_nnz))
         # exposed for the bench's FLOPs/roofline model (bench.py)
         self.last_row_len = row_len
+        self.last_layout = "padded"
+        self.last_batch_cells = None  # set once bsz is known below
 
         if v % p.model_shards:
             # pad vocab axis so it divides evenly over model shards
@@ -668,6 +895,42 @@ class OnlineLDA:
                 save_train_state(ckpt_path, step_no, lam=lam_host)
 
         timer = IterationTimer()
+
+        def make_pick(it: int) -> np.ndarray:
+            # sample_pick + pad to the static B (pad ids >= n are inert:
+            # all-zero resident rows / zero packed tokens).
+            pick = sample_pick(it)
+            if pick.size < bsz:
+                pick = np.concatenate(
+                    [pick, np.arange(n, n + bsz - pick.size)]
+                )
+            return pick.astype(np.int32)
+
+        mean_nnz = max(
+            1.0, sum(len(i) for i, _ in rows) / max(1, n)
+        )
+        if p.token_layout not in ("padded", "packed", "auto"):
+            raise ValueError(
+                f"unknown token_layout {p.token_layout!r} "
+                "(use 'padded'|'packed'|'auto')"
+            )
+        self.last_batch_cells = bsz * row_len
+        # an EXPLICIT device_resident=True wins over the auto layout
+        # heuristic (the caller asked for one corpus upload + on-device
+        # assembly, e.g. behind a slow tunnel); an explicit
+        # token_layout="packed" wins over everything.
+        use_packed = p.token_layout == "packed" or (
+            p.token_layout == "auto"
+            and p.device_resident is not True
+            and row_len >= 4.0 * mean_nnz
+        )
+        if use_packed:
+            return self._fit_packed(
+                rows, vocab, p, n, v, k, alpha, eta, bsz, n_iters,
+                start_it, lam, make_pick, timer, verbose, ckpt_path,
+                save_checkpoint,
+            )
+
         resident = self._resident_arrays(rows, n, row_len)
         if resident is not None:
             # Device-resident fast path: corpus uploaded once, minibatch
@@ -677,16 +940,6 @@ class OnlineLDA:
             # per-doc gamma inits => same math as the host path.
             ids_res, wts_res = resident
             state = TrainState(lam, jnp.asarray(start_it, jnp.int32))
-
-            def make_pick(it: int) -> np.ndarray:
-                # sample_pick + pad to the static B (pad ids >= n hit
-                # all-zero resident rows — inert).
-                pick = sample_pick(it)
-                if pick.size < bsz:
-                    pick = np.concatenate(
-                        [pick, np.arange(n, n + bsz - pick.size)]
-                    )
-                return pick.astype(np.int32)
 
             if verbose:
                 if self._resident_fn is None:

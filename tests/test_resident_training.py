@@ -97,6 +97,82 @@ def test_resident_checkpoint_resume(corpus, eight_devices, tmp_path):
     np.testing.assert_allclose(resumed.lam, full.lam, rtol=1e-4, atol=1e-6)
 
 
+def test_packed_matches_padded(corpus, eight_devices):
+    """token_layout="packed" (flat [T] token batches + segment E-step)
+    must train to the same model as the padded resident path — identical
+    sample stream and per-doc gamma inits, different tensor layout."""
+    from spark_text_clustering_tpu.parallel.mesh import make_mesh
+
+    rows, vocab = corpus
+    mesh = make_mesh(data_shards=4, model_shards=1,
+                     devices=eight_devices[:4])
+    packed = _fit(rows, vocab, mesh, token_layout="packed")
+    padded = _fit(rows, vocab, mesh, token_layout="padded",
+                  device_resident=True)
+    np.testing.assert_allclose(packed.lam, padded.lam, rtol=5e-3, atol=1e-5)
+
+
+def test_packed_matches_padded_model_sharded(corpus, eight_devices):
+    """Packed composes with vocab sharding (2x2 mesh)."""
+    from spark_text_clustering_tpu.parallel.mesh import make_mesh
+
+    rows, vocab = corpus
+    mesh = make_mesh(data_shards=2, model_shards=2,
+                     devices=eight_devices[:4])
+    packed = _fit(rows, vocab, mesh, token_layout="packed")
+    padded = _fit(rows, vocab, mesh, token_layout="padded",
+                  device_resident=True)
+    np.testing.assert_allclose(packed.lam, padded.lam, rtol=5e-3, atol=1e-5)
+
+
+def test_packed_checkpoint_resume(corpus, eight_devices, tmp_path):
+    from spark_text_clustering_tpu.parallel.mesh import make_mesh
+
+    rows, vocab = corpus
+    mesh = make_mesh(data_shards=4, model_shards=1,
+                     devices=eight_devices[:4])
+    full = _fit(rows, vocab, mesh, token_layout="packed")
+    ck = str(tmp_path / "ckp")
+    partial = _fit(rows, vocab, mesh, token_layout="packed",
+                   checkpoint_dir=ck, checkpoint_interval=3,
+                   max_iterations=3)
+    assert partial.step == 3
+    resumed = _fit(rows, vocab, mesh, token_layout="packed",
+                   checkpoint_dir=ck, checkpoint_interval=3)
+    np.testing.assert_allclose(resumed.lam, full.lam, rtol=1e-4, atol=1e-6)
+
+
+def test_auto_layout_picks_packed_on_skewed_corpus(eight_devices):
+    """token_layout="auto" must switch to packed when the padded grid
+    wastes >= 4x vs the corpus mean nnz (one long doc among short ones)."""
+    from spark_text_clustering_tpu.parallel.mesh import make_mesh
+
+    rng = np.random.default_rng(5)
+    v = 300
+    rows = [
+        (np.sort(rng.choice(v, 8, replace=False)).astype(np.int32),
+         np.ones(8, np.float32))
+        for _ in range(30)
+    ]
+    rows.append((
+        np.sort(rng.choice(v, 250, replace=False)).astype(np.int32),
+        np.ones(250, np.float32),
+    ))
+    vocab = [f"t{i}" for i in range(v)]
+    mesh = make_mesh(data_shards=2, model_shards=1,
+                     devices=eight_devices[:2])
+    est = OnlineLDA(
+        Params(k=3, algorithm="online", max_iterations=4, seed=0,
+               batch_size=8),
+        mesh=mesh,
+    )
+    model = est.fit(rows, vocab)
+    # the packed runner was built (auto chose packed: row_len 256 >= 4*~16)
+    assert est._packed_chunk_fn is not None
+    assert model.lam.shape == (3, v)
+    assert np.isfinite(model.lam).all() and (model.lam > 0).all()
+
+
 def test_em_auto_bucketing_collapses_small_corpus(corpus, eight_devices):
     """bucket_by_length="auto" uses ONE bucket for dispatch-bound small
     corpora and still matches the forced-bucketed result."""
