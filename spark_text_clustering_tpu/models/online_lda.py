@@ -539,11 +539,22 @@ def make_online_packed_chunk(
 
     def _iter(lam_shard, step, ids_t, cts_t, seg_t, pick, batch_docs,
               corpus_sz):
-        row_sum = model_row_sum(lam_shard)
-        eb_shard = jnp.exp(
-            dirichlet_expectation_sharded(lam_shard, row_sum)
+        # exp(E[log beta]) is NEVER materialized over [k, V]: the E-step
+        # only needs it at the batch's tokens (gather lambda rows — exact
+        # — then digamma locally), and the M-step's sstats ∘ expElogbeta
+        # is nonzero ONLY at touched columns, so
+        #   lam' = (1-rho) lam + rho (eta + scale * sstats ∘ eb)
+        # decomposes into a uniform affine map plus one scatter of
+        # rho*scale*(vals ∘ eb_tok).  Per-iteration full-width work drops
+        # from ~6 passes + k*V transcendentals to ONE row-sum pass + the
+        # affine update; transcendentals scale with the token count.
+        from jax.scipy.special import digamma as _digamma
+
+        row_sum = model_row_sum(lam_shard)                # [k]
+        lam_tok = gather_model_rows(lam_shard, ids_t)     # [T/s, k]
+        eb_tok = jnp.exp(
+            _digamma(jnp.maximum(lam_tok, 1e-30)) - _digamma(row_sum)
         )
-        eb_tok = gather_model_rows(eb_shard, ids_t)       # [T/s, k]
         key_it = jax.random.fold_in(base_key, step)
         gamma0 = init_gamma_rows(key_it, pick, k, gamma_shape)
         gamma, _ = gamma_fixed_point_segments(
@@ -551,13 +562,16 @@ def make_online_packed_chunk(
             reduce_fn=psum_data,
         )
         vals = token_sstats_factors_segments(eb_tok, cts_t, seg_t, gamma)
-        sstats_shard = psum_data(
-            scatter_add_model_shard(ids_t, vals, eb_shard.shape[-1])
-        )
-        lam_new = _mstep_blend(
-            lam_shard, eb_shard, sstats_shard, batch_docs, step,
-            corpus_sz, eta=eta, tau0=tau0, kappa=kappa,
-        )
+        touched = psum_data(
+            scatter_add_model_shard(
+                ids_t, vals * eb_tok, lam_shard.shape[-1]
+            )
+        )                                                 # sstats ∘ eb
+        rho = (tau0 + step.astype(jnp.float32) + 1.0) ** (-kappa)
+        scale = corpus_sz / jnp.maximum(batch_docs, 1.0)
+        lam_new = (1.0 - rho) * lam_shard + rho * eta + rho * scale * touched
+        # empty minibatch -> no update (MLlib; see _mstep_blend)
+        lam_new = jnp.where(batch_docs > 0.0, lam_new, lam_shard)
         return lam_new, step + 1
 
     sharded = jax.shard_map(
