@@ -245,7 +245,18 @@ def cmd_score(args: argparse.Namespace) -> int:
 
     ds = pre.transform({"texts": [d.text for d in docs]})
     rows = make_vectorizer(model.vocab)(ds["tokens"])
-    dist = model.topic_distribution(rows)
+    mesh = None
+    if (getattr(args, "data_shards", None) or 1) != 1 or (
+        getattr(args, "model_shards", 1) != 1
+    ):
+        # mesh-backed scoring: lambda V-sharded [k, V/s] per device
+        # (models/sharded_eval) — inference at training scale
+        from .parallel.mesh import make_mesh
+
+        mesh = make_mesh(
+            data_shards=args.data_shards, model_shards=args.model_shards
+        )
+    dist = model.topic_distribution(rows, mesh=mesh)
 
     text = format_scoring_report(
         model,
@@ -490,6 +501,11 @@ def build_parser() -> argparse.ArgumentParser:
     sc.add_argument("--output-dir", default="TestOutput")
     sc.add_argument("--no-lemmatize", action="store_true")
     sc.add_argument("--include-all", action="store_true")
+    sc.add_argument("--data-shards", type=int, default=1,
+                    help="score with documents sharded over the mesh")
+    sc.add_argument("--model-shards", type=int, default=1,
+                    help="score with lambda V-sharded [k, V/s] per device "
+                         "(inference at training scale)")
     sc.set_defaults(fn=cmd_score)
 
     ss = sub.add_parser(
