@@ -246,9 +246,7 @@ def cmd_score(args: argparse.Namespace) -> int:
     ds = pre.transform({"texts": [d.text for d in docs]})
     rows = make_vectorizer(model.vocab)(ds["tokens"])
     mesh = None
-    if (getattr(args, "data_shards", None) or 1) != 1 or (
-        getattr(args, "model_shards", 1) != 1
-    ):
+    if args.data_shards != 1 or args.model_shards != 1:
         # mesh-backed scoring: lambda V-sharded [k, V/s] per device
         # (models/sharded_eval) — inference at training scale
         from .parallel.mesh import make_mesh

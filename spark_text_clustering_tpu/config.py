@@ -82,13 +82,16 @@ class Params:
     # batches.
     device_resident: object = "auto"   # True | False | "auto"
     resident_budget_bytes: int = 2 << 30
-    # Online VB minibatch layout.  "padded": [B, L] grids at the corpus
-    # max row length (the resident fast path).  "packed": flat [T] token
-    # arrays with per-token doc positions — FLOPs/bandwidth scale with
-    # the true token count instead of B*L, the win when nnz spans orders
-    # of magnitude (measured 10-20x padding waste on the 20NG shape,
-    # PERF.md).  "auto" picks packed when the padded grid would waste
-    # >= 4x vs the corpus mean nnz.
+    # Token layout for online VB minibatches AND EM sweeps.  "padded":
+    # [B, L] grids at the corpus max row length.  "packed": flat [T]
+    # token arrays with per-token doc positions — FLOPs/bandwidth scale
+    # with the true token count instead of B*L, the win when nnz spans
+    # orders of magnitude (measured 10-20x padding waste on the 20NG
+    # shape; 27x EM speedup on the EN books, PERF.md).  "auto" picks
+    # packed when the padded grid would waste >= 4x (online — packed
+    # trades the resident corpus for per-iteration host packing) or
+    # >= 2x (EM — both layouts are one dispatch per sweep, so any cell
+    # reduction is pure win).
     token_layout: str = "auto"  # "padded" | "packed" | "auto"
     # EM only: assemble and retain the full [n_docs, k] doc-topic counts
     # on the host after fit — needed by the MLlib-format export's doc

@@ -38,7 +38,12 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..config import Params
-from ..ops.sparse import DocTermBatch, batch_from_rows, bucket_by_length
+from ..ops.sparse import (
+    DocTermBatch,
+    batch_from_rows,
+    bucket_by_length,
+    next_pow2,
+)
 from ..parallel.collectives import (
     data_shard_batch,
     fetch_global,
@@ -63,6 +68,7 @@ __all__ = [
     "EMLDA",
     "make_em_train_step",
     "make_em_chunk_runner",
+    "make_em_packed_runner",
     "em_log_likelihood",
 ]
 
@@ -181,6 +187,73 @@ def make_em_chunk_runner(
     return run_chunk
 
 
+def make_em_packed_runner(
+    mesh: Mesh, *, alpha: float, eta: float, vocab_size: int
+):
+    """TOKEN-PACKED EM sweeps: the corpus's edges live as flat per-shard
+    token arrays (ids, weights, per-token LOCAL doc position) instead of
+    padded [B, L] grids, so each sweep's FLOPs/bandwidth scale with the
+    true edge count — the EN books pad 917k cells for 253k edges (3.6x
+    waste) under the single-bucket grid (PERF.md round 3).
+
+    Sharding is DOC-CONTIGUOUS over "data": the host assigns whole
+    documents to shards (greedy nnz balance), so every document's tokens
+    and its N_dk row live on one shard and the per-sweep ``segment_sum``
+    into N_dk needs NO collective; only the N_wk scatter psum-reduces
+    over "data" (exactly like the padded edge pass).  N_wk stays
+    V-sharded over "model" via the same gather/scatter helpers.
+
+    Returned fn: (n_wk [k, V_pad] V-sharded, n_dk [S*D_max, k]
+    doc-sharded, ids_t [S*T_max] token-sharded, cts_t, seg_t, m) ->
+    (n_wk', n_dk'); one dispatch runs ``m`` whole-corpus sweeps via
+    ``lax.scan``.  Pad token slots (cts == 0) and pad doc rows contribute
+    exactly zero.  Same per-edge math as ``_em_edge_pass`` — from equal
+    initial counts the two layouts produce equal sweeps.
+    """
+
+    def _sweep(n_wk_shard, n_dk, ids_t, cts_t, seg_t):
+        d_max = n_dk.shape[0]
+        n_k = model_row_sum(n_wk_shard)                    # [k]
+        term_f = gather_model_rows(n_wk_shard, ids_t) + (eta - 1.0)
+        doc_f = (n_dk + (alpha - 1.0))[seg_t]              # [T, k]
+        denom = n_k + (eta * vocab_size - vocab_size)      # [k]
+        phi = term_f * (doc_f / denom)                     # [T, k]
+        phi = phi / (phi.sum(-1, keepdims=True) + 1e-30)
+        wphi = cts_t[:, None] * phi                        # [T, k]
+        n_dk_new = jax.ops.segment_sum(wphi, seg_t, num_segments=d_max)
+        n_wk_partial = psum_data(
+            scatter_add_model_shard(ids_t, wphi, n_wk_shard.shape[-1])
+        )
+        return n_wk_partial, n_dk_new
+
+    sharded = jax.shard_map(
+        _sweep,
+        mesh=mesh,
+        in_specs=(
+            P(None, MODEL_AXIS),   # n_wk shard
+            P(DATA_AXIS, None),    # n_dk (doc-sharded, shard-local rows)
+            P(DATA_AXIS),          # token ids (flat, doc-contiguous)
+            P(DATA_AXIS),          # token weights
+            P(DATA_AXIS),          # token LOCAL doc positions
+        ),
+        out_specs=(P(None, MODEL_AXIS), P(DATA_AXIS, None)),
+        check_vma=False,
+    )
+
+    @partial(jax.jit, static_argnames=("m",))
+    def run_chunk(n_wk, n_dk, ids_t, cts_t, seg_t, m: int):
+        def body(carry, _):
+            n_wk, n_dk = carry
+            return sharded(n_wk, n_dk, ids_t, cts_t, seg_t), None
+
+        (n_wk, n_dk), _ = jax.lax.scan(
+            body, (n_wk, n_dk), None, length=m
+        )
+        return n_wk, n_dk
+
+    return run_chunk
+
+
 def make_em_train_step(
     mesh: Mesh, *, alpha: float, eta: float, vocab_size: int
 ) -> Callable[[EMState, DocTermBatch], EMState]:
@@ -271,6 +344,9 @@ class EMLDA:
         self._step_fn_vocab = None
         self._chunk_fn = None
         self._chunk_fn_vocab = None
+        self._packed_fn = None
+        self._packed_fn_vocab = None
+        self.last_layout: str = "padded"
 
     def _init_state(
         self,
@@ -319,6 +395,43 @@ class EMLDA:
                 check_vma=False,
             )
         )(batch.token_ids, batch.token_weights, doc_ids)
+
+    def _packed_plan(self, rows, n: int):
+        """Doc-contiguous token packing for ``make_em_packed_runner``:
+        greedy nnz-balanced assignment of whole documents to data shards.
+        Returns (ids_t, cts_t, seg_t flat [S*T_max], slot [n] mapping
+        global doc -> packed n_dk row, d_max docs/shard, cells)."""
+        n_data = self.mesh.shape[DATA_AXIS]
+        order = sorted(range(n), key=lambda d: -len(rows[d][0]))
+        shard_docs: List[List[int]] = [[] for _ in range(n_data)]
+        loads = [0] * n_data
+        for d in order:
+            s = loads.index(min(loads))
+            shard_docs[s].append(d)
+            loads[s] += max(1, len(rows[d][0]))
+        d_max = max(1, max(len(sd) for sd in shard_docs))
+        t_max = max(8, next_pow2(max(loads)))
+        ids_t = np.zeros((n_data, t_max), np.int32)
+        cts_t = np.zeros((n_data, t_max), np.float32)
+        seg_t = np.zeros((n_data, t_max), np.int32)
+        slot = np.zeros(n, np.int64)
+        for s, sdocs in enumerate(shard_docs):
+            o = 0
+            for j, d in enumerate(sdocs):
+                i, w = rows[d]
+                ids_t[s, o:o + len(i)] = i
+                cts_t[s, o:o + len(i)] = w
+                seg_t[s, o:o + len(i)] = j
+                o += len(i)
+                slot[d] = s * d_max + j
+        return (
+            ids_t.reshape(-1),
+            cts_t.reshape(-1),
+            seg_t.reshape(-1),
+            slot,
+            d_max,
+            n_data * t_max,
+        )
 
     def _bucket_plan(self, rows, n: int):
         """[(batch, doc_ids_dev, idxs)] per length bucket (one bucket when
@@ -440,7 +553,72 @@ class EMLDA:
                 )
 
         timer = IterationTimer()
-        if verbose:
+        self.last_layout = "padded"
+        if p.token_layout not in ("padded", "packed", "auto"):
+            raise ValueError(
+                f"unknown token_layout {p.token_layout!r} "
+                "(use 'padded'|'packed'|'auto')"
+            )
+        total_nnz = sum(len(i) for i, _ in rows)
+        # auto threshold is 2x here (vs online's 4x): packed EM replaces
+        # a ONE-dispatch padded sweep with another one-dispatch sweep, so
+        # any cell reduction is pure win; online's packed path trades the
+        # resident corpus for per-iteration host packing and needs more
+        # waste to pay for it.
+        use_packed = p.token_layout == "packed" or (
+            p.token_layout == "auto"
+            and self.last_padded_cells >= 2.0 * max(1, total_nnz)
+        )
+        if use_packed:
+            # Token-packed sweeps (make_em_packed_runner): one scan
+            # dispatch per interval over flat doc-contiguous token
+            # arrays; same per-edge math from the SAME initial counts as
+            # the padded plan (init/checkpoints stay layout-agnostic).
+            self.last_layout = "packed"
+            (ids_f, cts_f, seg_f, slot, d_max,
+             packed_cells) = self._packed_plan(rows, n)
+            self.last_padded_cells = packed_cells  # true cells processed
+            tok_spec = NamedSharding(self.mesh, P(DATA_AXIS))
+            ids_dev = jax.device_put(ids_f, tok_spec)
+            cts_dev = jax.device_put(cts_f, tok_spec)
+            seg_dev = jax.device_put(seg_f, tok_spec)
+            packed_ndk = np.zeros(
+                (self.mesh.shape[DATA_AXIS] * d_max, k), np.float32
+            )
+            packed_ndk[slot] = _assemble_n_dk(n_dk_list)
+            n_dk_dev = jax.device_put(jnp.asarray(packed_ndk), dk_sharding)
+            if self._packed_fn is None or self._packed_fn_vocab != v:
+                self._packed_fn = make_em_packed_runner(
+                    self.mesh, alpha=alpha, eta=eta, vocab_size=v
+                )
+                self._packed_fn_vocab = v
+            run = self._packed_fn
+            interval = 1 if verbose else max(1, p.checkpoint_interval)
+            it = start_it
+            while it < n_iters:
+                m = min(interval - (it % interval), n_iters - it)
+                timer.start()
+                n_wk, n_dk_dev = run(
+                    n_wk, n_dk_dev, ids_dev, cts_dev, seg_dev, m
+                )
+                n_wk.block_until_ready()
+                timer.stop()
+                if m > 1:
+                    timer.split_last(m)
+                if verbose:
+                    print(f"EM iter {it}: {timer.times[-1]:.3f}s (packed)")
+                it += m
+                if ckpt_path and it % max(1, p.checkpoint_interval) == 0:
+                    # layout-agnostic checkpoint: reorder packed rows
+                    # back to global doc order
+                    n_wk_host = fetch_global(n_wk)
+                    nd_host = fetch_global(n_dk_dev)[slot]
+                    if is_coordinator():
+                        save_train_state(
+                            ckpt_path, it, n_wk=n_wk_host, n_dk=nd_host
+                        )
+            n_dk_list = _split_n_dk(fetch_global(n_dk_dev)[slot])
+        elif verbose:
             # Per-iteration dispatch + sync: observable progress, one print
             # per sweep — the debugging path.
             if self._step_fn is None or self._step_fn_vocab != v:

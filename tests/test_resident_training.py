@@ -173,6 +173,66 @@ def test_auto_layout_picks_packed_on_skewed_corpus(eight_devices):
     assert np.isfinite(model.lam).all() and (model.lam > 0).all()
 
 
+def test_em_packed_matches_padded(corpus, eight_devices):
+    """Packed EM sweeps from the same initial counts must reproduce the
+    padded EM fit (same per-edge math, different tensor layout), on both
+    a data-only and a 2x2 mesh."""
+    from spark_text_clustering_tpu.parallel.mesh import make_mesh
+
+    rows, vocab = corpus
+    for data_s, model_s in ((4, 1), (2, 2)):
+        mesh = make_mesh(data_shards=data_s, model_shards=model_s,
+                         devices=eight_devices[: data_s * model_s])
+        base = dict(k=3, algorithm="em", max_iterations=5, seed=0,
+                    data_shards=data_s, model_shards=model_s)
+        packed_est = EMLDA(
+            Params(**base, token_layout="packed"), mesh=mesh
+        )
+        packed = packed_est.fit(rows, vocab)
+        assert packed_est.last_layout == "packed"
+        padded_est = EMLDA(
+            Params(**base, token_layout="padded"), mesh=mesh
+        )
+        padded = padded_est.fit(rows, vocab)
+        np.testing.assert_allclose(
+            packed.lam, padded.lam, rtol=5e-3, atol=1e-5
+        )
+        assert packed_est.last_log_likelihood == pytest.approx(
+            padded_est.last_log_likelihood, rel=1e-3
+        )
+
+
+def test_em_packed_checkpoint_cross_layout_resume(
+    corpus, eight_devices, tmp_path
+):
+    """EM checkpoints are layout-agnostic: a fit interrupted under the
+    packed layout resumes under the padded layout (and vice versa) and
+    lands on the uninterrupted padded result."""
+    from spark_text_clustering_tpu.parallel.mesh import make_mesh
+
+    rows, vocab = corpus
+    mesh = make_mesh(data_shards=4, model_shards=1,
+                     devices=eight_devices[:4])
+    base = dict(k=3, algorithm="em", max_iterations=6, seed=0)
+    full = EMLDA(Params(**base, token_layout="padded"), mesh=mesh).fit(
+        rows, vocab
+    )
+    ck = str(tmp_path / "ck_x")
+    EMLDA(
+        Params(**base, token_layout="packed", checkpoint_dir=ck,
+               checkpoint_interval=3),
+        mesh=mesh,
+    ).fit(rows, vocab, max_iterations=3)
+    resumed = EMLDA(
+        Params(**base, token_layout="padded", checkpoint_dir=ck,
+               checkpoint_interval=3),
+        mesh=mesh,
+    ).fit(rows, vocab)
+    np.testing.assert_allclose(
+        resumed.lam, full.lam, rtol=5e-3, atol=1e-5
+    )
+
+
 def test_em_auto_bucketing_collapses_small_corpus(corpus, eight_devices):
     """bucket_by_length="auto" uses ONE bucket for dispatch-bound small
     corpora and still matches the forced-bucketed result."""
