@@ -133,6 +133,7 @@ class LDAModel:
         tol: float = 1e-3,
         seed: Optional[int] = None,
         mesh=None,
+        layout: str = "auto",
     ) -> np.ndarray:
         """Per-doc posterior topic mixture [B, k]
         (``LocalLDAModel.topicDistribution``, LDALoader.scala:108).
@@ -150,6 +151,12 @@ class LDAModel:
         lambda lives [k, V/s] per device and docs shard over "data" — the
         scoring-side twin of the sharded train step, required at configs
         where [k, V] exceeds one device's HBM (SURVEY.md §7 hard part 5).
+
+        ``layout``: "padded" scores per power-of-two length bucket (the
+        TPU path — the Pallas gamma kernel is padded-layout); "packed"
+        runs the WHOLE ragged corpus as one flat token batch
+        (``topic_inference_segments``); "auto" picks packed on CPU
+        (measured ~2x) and padded buckets on accelerators.
         """
         if mesh is not None:
             return self._topic_distribution_sharded(
@@ -167,6 +174,13 @@ class LDAModel:
                 )
             )
 
+        use_packed = layout == "packed" or (
+            layout == "auto" and jax.default_backend() == "cpu"
+        )
+        if use_packed:
+            return self._topic_distribution_packed(
+                list(docs), eb, alpha, seed, max_inner, tol
+            )
         return self._score_bucketed(
             docs,
             seed,
@@ -175,6 +189,43 @@ class LDAModel:
                     batch, eb, alpha, gamma0, max_inner=max_inner, tol=tol
                 )
             ),
+        )
+
+    def _topic_distribution_packed(
+        self, rows, eb, alpha, seed, max_inner, tol
+    ) -> np.ndarray:
+        from ..ops.lda_math import topic_inference_segments
+        from ..ops.sparse import next_pow2
+
+        n = len(rows)
+        if n == 0:
+            return np.zeros((0, self.k), np.float32)
+        lens = [len(i) for i, _ in rows]
+        t_pad = next_pow2(max(8, sum(lens)))  # pow2 bounds jit shapes
+        flat_i = np.zeros(t_pad, np.int32)
+        flat_c = np.zeros(t_pad, np.float32)
+        seg = np.zeros(t_pad, np.int32)
+        o = 0
+        for d, (ids, wts) in enumerate(rows):
+            flat_i[o:o + len(ids)] = ids
+            flat_c[o:o + len(ids)] = wts
+            seg[o:o + len(ids)] = d
+            o += len(ids)
+        if seed is None:
+            gamma0 = init_gamma(None, n, self.k, self.gamma_shape)
+        else:
+            gamma0 = init_gamma_rows(
+                jax.random.PRNGKey(seed),
+                jnp.arange(n, dtype=jnp.int32),
+                self.k,
+                self.gamma_shape,
+            )
+        eb_tok = jnp.moveaxis(eb, 0, -1)[jnp.asarray(flat_i)]
+        return np.asarray(
+            topic_inference_segments(
+                eb_tok, jnp.asarray(flat_c), jnp.asarray(seg),
+                alpha, gamma0, max_inner=max_inner, tol=tol,
+            )
         )
 
     def _gamma0_for_bucket(self, batch, idxs, seed) -> jnp.ndarray:

@@ -138,6 +138,23 @@ class TestNumericParity:
         assert got == pytest.approx(ref, rel=1e-4)
 
 
+class TestPackedScoring:
+    def test_packed_matches_padded_layout(self, eight_devices):
+        m = _model()
+        rows = _rows(17, seed=3)
+        rows[4] = (np.zeros(0, np.int32), np.zeros(0, np.float32))
+        pad = m.topic_distribution(rows, layout="padded")
+        pack = m.topic_distribution(rows, layout="packed")
+        np.testing.assert_allclose(pack, pad, rtol=3e-3, atol=2e-5)
+        np.testing.assert_allclose(
+            pack[4], np.full((K,), 1.0 / K), rtol=1e-6
+        )
+        # seeded inits are keyed by doc index in both layouts
+        pad_s = m.topic_distribution(rows, seed=11, layout="padded")
+        pack_s = m.topic_distribution(rows, seed=11, layout="packed")
+        np.testing.assert_allclose(pack_s, pad_s, rtol=3e-3, atol=2e-5)
+
+
 class TestStructural:
     def test_ccnews_scoring_compiles_sharded(self, eight_devices):
         """The CC-News config (k=500, V=10M): topic inference + bound +
