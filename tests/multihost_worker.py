@@ -158,6 +158,17 @@ def main() -> int:
     online = OnlineLDA(make_online_toy_params(), mesh=mesh)
     online_lam = np.asarray(online.fit(rows, vocab).lam)
 
+    # --- packed EM across the process boundary ----------------------------
+    # Doc-contiguous token sharding spans both processes' devices; the
+    # N_wk psum over "data" crosses DCN every sweep.
+    packed_est = EMLDA(
+        Params(k=2, max_iterations=4, algorithm="em", seed=0,
+               token_layout="packed"),
+        mesh=mesh,
+    )
+    packed_lam = np.asarray(packed_est.fit(rows, vocab).lam)
+    assert packed_est.last_layout == "packed"
+
     # --- distributed vocabulary build (cross-host reduceByKey) ------------
     # Each process counts ONLY its own document shard; the DCN merge must
     # reproduce the single-process global top-V on every process.
@@ -177,7 +188,7 @@ def main() -> int:
     if pid == 0:
         assert ckpt_exists, "coordinator checkpoint missing"
         np.savez(out_path, n_wk=n_wk, total=float(total), fit_lam=lam,
-                 online_lam=online_lam,
+                 online_lam=online_lam, packed_lam=packed_lam,
                  vocab_dist=np.asarray(vocab_dist))
     print(f"proc {pid}: ok devices={n_dev}")
     return 0

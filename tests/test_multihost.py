@@ -122,6 +122,10 @@ def test_two_process_bringup_and_em_step(tmp_path):
     np.testing.assert_allclose(
         data["fit_lam"], expected_lam, rtol=1e-4, atol=1e-5
     )
+    # packed EM across the 2-process mesh == single-process padded fit
+    np.testing.assert_allclose(
+        data["packed_lam"], expected_lam, rtol=5e-3, atol=1e-5
+    )
 
     from multihost_worker import make_online_toy_params
     from spark_text_clustering_tpu.models.online_lda import OnlineLDA
