@@ -38,12 +38,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..config import Params
-from ..ops.sparse import (
-    DocTermBatch,
-    batch_from_rows,
-    bucket_by_length,
-    next_pow2,
-)
+from ..ops.sparse import DocTermBatch, batch_from_rows, next_pow2
 from ..parallel.collectives import (
     data_shard_batch,
     fetch_global,
@@ -254,6 +249,96 @@ def make_em_packed_runner(
     return run_chunk
 
 
+def make_em_packed_init(
+    mesh: Mesh, *, k: int, d_max: int, shard_v: int, seed: int
+):
+    """Random soft-assignment init IN the packed layout: per token a
+    Dirichlet(1) topic draw keyed by (GLOBAL doc id, within-doc position)
+    — mesh- and packing-invariant — aggregated straight into (n_wk
+    [k, V_pad] V-sharded, n_dk [S*d_max, k] doc-sharded).  Peak memory is
+    [T, k] per shard: the padded ``_init_state`` samples [B, L, k] on the
+    padded grid and becomes the scale wall exactly when the packed
+    SWEEPS were chosen to avoid that grid (1M-doc EM); this is its
+    packed twin.  NOT draw-for-draw identical to the padded init (the
+    stream is keyed per token, not per padded row) — statistically
+    equivalent; ``EMLDA.fit`` uses it only when the padded init would
+    exceed the resident budget, so small-corpus layout-parity is
+    unaffected."""
+    base = jax.random.PRNGKey(seed)
+
+    def _init(ids_t, cts_t, seg_t, doc_t, pos_t):
+        def draw(doc, pos):
+            kk = jax.random.fold_in(jax.random.fold_in(base, doc), pos)
+            # Dirichlet(1) == normalized Exponential(1): a fixed
+            # bits->float transform per element, no rejection loop —
+            # jax.random.gamma's rejection sampler costs ~20x more and
+            # dominated the init at the 10M-edge scale
+            e = jax.random.exponential(kk, (k,), jnp.float32)
+            return e / e.sum()
+
+        phi0 = jax.vmap(draw)(doc_t, pos_t)                # [T, k]
+        wphi0 = cts_t[:, None] * phi0
+        n_dk = jax.ops.segment_sum(wphi0, seg_t, num_segments=d_max)
+        n_wk = psum_data(
+            scatter_add_model_shard(ids_t, wphi0, shard_v)
+        )
+        return n_wk, n_dk
+
+    sharded = jax.shard_map(
+        _init,
+        mesh=mesh,
+        in_specs=(
+            P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS),
+            P(DATA_AXIS), P(DATA_AXIS),
+        ),
+        out_specs=(P(None, MODEL_AXIS), P(DATA_AXIS, None)),
+        check_vma=False,
+    )
+    return jax.jit(sharded)
+
+
+def make_em_packed_loglik(
+    mesh: Mesh, *, alpha: float, eta: float, vocab_size: int
+):
+    """``DistributedLDAModel.logLikelihood`` over the packed corpus
+    arrays: per-token smoothed phi·theta with a data-psum'd sum — no
+    padded [B, L, k] gather, so eval memory scales with the true edge
+    count like the packed sweeps themselves.  (EM counts carry exact
+    zeros in vocab pad columns, so plain row sums are the true N_k.)"""
+    v = vocab_size
+
+    def _ll(n_wk_shard, n_dk, ids_t, cts_t, seg_t):
+        from .sharded_eval import _masked_row_sum, _shard_col_mask
+
+        # mask vocab pad columns out of N_k (same rule as the padded
+        # evaluator) instead of relying on them staying exactly zero
+        mask = _shard_col_mask(n_wk_shard.shape[-1], v)
+        n_k = _masked_row_sum(n_wk_shard, mask)            # [k]
+        nwk_tok = gather_model_rows(n_wk_shard, ids_t)     # [T, k]
+        phi_w = (nwk_tok + (eta - 1.0)) / (n_k + (eta * v - v))
+        theta = (n_dk + (alpha - 1.0)) / (
+            n_dk.sum(-1, keepdims=True) + n_dk.shape[-1] * (alpha - 1.0)
+        )
+        tok = (phi_w * theta[seg_t]).sum(-1)               # [T]
+        score = (cts_t * jnp.log(jnp.where(tok > 0, tok, 1.0))).sum()
+        return psum_data(score)
+
+    sharded = jax.shard_map(
+        _ll,
+        mesh=mesh,
+        in_specs=(
+            P(None, MODEL_AXIS),
+            P(DATA_AXIS, None),
+            P(DATA_AXIS),
+            P(DATA_AXIS),
+            P(DATA_AXIS),
+        ),
+        out_specs=P(),
+        check_vma=False,
+    )
+    return jax.jit(sharded)
+
+
 def make_em_train_step(
     mesh: Mesh, *, alpha: float, eta: float, vocab_size: int
 ) -> Callable[[EMState, DocTermBatch], EMState]:
@@ -346,6 +431,10 @@ class EMLDA:
         self._chunk_fn_vocab = None
         self._packed_fn = None
         self._packed_fn_vocab = None
+        self._packed_ll_fn = None
+        self._packed_ll_key = None
+        self._packed_init_fn = None
+        self._packed_init_key = None
         self.last_layout: str = "padded"
 
     def _init_state(
@@ -369,9 +458,17 @@ class EMLDA:
             base = jax.random.PRNGKey(seed)
             row_len = ids.shape[1]
             keys = jax.vmap(lambda i: jax.random.fold_in(base, i))(dids)
-            phi0 = jax.vmap(
-                lambda kk: jax.random.dirichlet(kk, jnp.ones((k,)), (row_len,))
+            # Dirichlet(1) == normalized Exponential(1): same law as
+            # jax.random.dirichlet(ones) but a fixed bits->float transform
+            # per element — the gamma rejection sampler behind dirichlet
+            # cost minutes at 10^5-doc scale (measured: 185 of 189 s of a
+            # 50k-doc fit were this init)
+            e = jax.vmap(
+                lambda kk: jax.random.exponential(
+                    kk, (row_len, k), jnp.float32
+                )
             )(keys)
+            phi0 = e / e.sum(-1, keepdims=True)
             wphi0 = wts[..., None] * phi0
             n_dk = wphi0.sum(axis=1)
             # Shard-local scatter: init peak memory matches the train step's
@@ -399,8 +496,10 @@ class EMLDA:
     def _packed_plan(self, rows, n: int):
         """Doc-contiguous token packing for ``make_em_packed_runner``:
         greedy nnz-balanced assignment of whole documents to data shards.
-        Returns (ids_t, cts_t, seg_t flat [S*T_max], slot [n] mapping
-        global doc -> packed n_dk row, d_max docs/shard, cells)."""
+        Returns (ids_t, cts_t, seg_t, doc_t, pos_t flat [S*T_max], slot
+        [n] mapping global doc -> packed n_dk row, d_max docs/shard,
+        cells).  ``doc_t``/``pos_t`` (global doc id and within-doc token
+        position) key the packed init's per-token draws."""
         n_data = self.mesh.shape[DATA_AXIS]
         order = sorted(range(n), key=lambda d: -len(rows[d][0]))
         shard_docs: List[List[int]] = [[] for _ in range(n_data)]
@@ -414,6 +513,8 @@ class EMLDA:
         ids_t = np.zeros((n_data, t_max), np.int32)
         cts_t = np.zeros((n_data, t_max), np.float32)
         seg_t = np.zeros((n_data, t_max), np.int32)
+        doc_t = np.zeros((n_data, t_max), np.int32)
+        pos_t = np.zeros((n_data, t_max), np.int32)
         slot = np.zeros(n, np.int64)
         for s, sdocs in enumerate(shard_docs):
             o = 0
@@ -422,44 +523,62 @@ class EMLDA:
                 ids_t[s, o:o + len(i)] = i
                 cts_t[s, o:o + len(i)] = w
                 seg_t[s, o:o + len(i)] = j
+                doc_t[s, o:o + len(i)] = d
+                pos_t[s, o:o + len(i)] = np.arange(len(i), dtype=np.int32)
                 o += len(i)
                 slot[d] = s * d_max + j
         return (
             ids_t.reshape(-1),
             cts_t.reshape(-1),
             seg_t.reshape(-1),
+            doc_t.reshape(-1),
+            pos_t.reshape(-1),
             slot,
             d_max,
             n_data * t_max,
         )
 
-    def _bucket_plan(self, rows, n: int):
+    def _plan_shape(self, rows, n: int):
+        """The bucket layout the padded path would use, WITHOUT
+        materializing any batch: [(row_len, idxs)] sorted by length.
+        Drives the auto layout decision and the padded-cells metric so
+        packed-mode fits never build (or upload) the padded plan."""
+        from ..ops.sparse import bucket_indices_by_length
+
+        mode = self.params.bucket_by_length
+        use_buckets = bool(mode)
+        idx_by_len = (
+            dict(sorted(bucket_indices_by_length(rows).items()))
+            if use_buckets
+            else {}
+        )
+        if use_buckets and mode == "auto" and len(idx_by_len) > 1:
+            # Dispatch-bound regime: below ~16M padded token cells one
+            # fused launch per iteration beats several small ones
+            # (measured ~2x on TPU for the 51-book EN corpus), and
+            # bucketing only pays when it removes most of the padding.
+            cells = sum(len(idxs) * L for L, idxs in idx_by_len.items())
+            single_cells = n * max(idx_by_len)
+            if single_cells < 16_000_000 or cells > 0.5 * single_cells:
+                use_buckets = False
+        if not use_buckets:
+            max_nnz = max((len(i) for i, _ in rows), default=1)
+            return [(max(8, next_pow2(max_nnz)), list(range(n)))]
+        return list(idx_by_len.items())
+
+    def _bucket_plan(self, rows, n: int, layout_shape=None):
         """[(batch, doc_ids_dev, idxs)] per length bucket (one bucket when
         ``Params.bucket_by_length`` is off).  Docs are padded per bucket to a
         data-shard multiple; pad rows get doc ids >= n (weight 0 — inert).
         Bucketing bounds padding waste when doc nnz spans orders of
         magnitude (SURVEY.md §7 hard part 1): one 50k-term book among
-        8-term notes no longer forces every row to 65,536 slots."""
-        mode = self.params.bucket_by_length
-        use_buckets = bool(mode)
-        if use_buckets:
-            buckets = bucket_by_length(rows)
-            if mode == "auto" and len(buckets) > 1:
-                # Dispatch-bound regime: below ~16M padded token cells one
-                # fused launch per iteration beats several small ones
-                # (measured ~2x on TPU for the 51-book EN corpus), and
-                # bucketing only pays when it removes most of the padding.
-                cells = sum(
-                    b.num_docs * length for length, (b, _) in buckets.items()
-                )
-                single_cells = n * max(buckets)
-                if single_cells < 16_000_000 or cells > 0.5 * single_cells:
-                    use_buckets = False
-        if not use_buckets:
-            whole = batch_from_rows(rows)
-            buckets = {whole.row_len: (whole, list(range(n)))}
+        8-term notes no longer forces every row to 65,536 slots.
+        ``layout_shape`` reuses an already-computed ``_plan_shape``."""
+        if layout_shape is None:
+            layout_shape = self._plan_shape(rows, n)
         plan = []
-        for _, (batch, idxs) in sorted(buckets.items()):
+        for row_len, idxs in layout_shape:
+            batch = batch_from_rows([rows[i] for i in idxs], row_len=row_len)
             batch = data_shard_batch(self.mesh, batch)
             doc_ids = np.fromiter(
                 idxs, dtype=np.int32, count=len(idxs)
@@ -490,18 +609,67 @@ class EMLDA:
         eta = p.resolved_eta()
 
         v_pad = ((v + p.model_shards - 1) // p.model_shards) * p.model_shards
-        plan = self._bucket_plan(rows, n)
-        # padded token cells per full-corpus sweep — the size driver of the
-        # bench's FLOPs/roofline model (bench.py)
-        self.last_padded_cells = sum(
-            b.num_docs * b.row_len for b, _, _ in plan
-        )
         dk_sharding = NamedSharding(self.mesh, P(DATA_AXIS, None))
+
+        if p.token_layout not in ("padded", "packed", "auto"):
+            raise ValueError(
+                f"unknown token_layout {p.token_layout!r} "
+                "(use 'padded'|'packed'|'auto')"
+            )
+        # shape-only layout decision — no padded batch is materialized
+        # unless the padded path (or its init/loglik) actually runs
+        layout_shape = self._plan_shape(rows, n)
+        n_data = self.mesh.shape[DATA_AXIS]
+
+        def _padded_docs(count: int) -> int:
+            return ((count + n_data - 1) // n_data) * n_data
+
+        # padded token cells per full-corpus sweep — the size driver of
+        # the bench's FLOPs/roofline model (bench.py)
+        self.last_padded_cells = sum(
+            _padded_docs(len(idxs)) * L for L, idxs in layout_shape
+        )
+        total_nnz = sum(len(i) for i, _ in rows)
+        # auto threshold is 2x here (vs online's 4x): packed EM replaces
+        # a ONE-dispatch padded sweep with another one-dispatch sweep, so
+        # any cell reduction is pure win; online's packed path trades the
+        # resident corpus for per-iteration host packing and needs more
+        # waste to pay for it.
+        use_packed = p.token_layout == "packed" or (
+            p.token_layout == "auto"
+            and self.last_padded_cells >= 2.0 * max(1, total_nnz)
+        )
+        # The padded init samples a dense [B, L, k] Dirichlet per data
+        # shard; at 1M-doc scale that grid is exactly what the packed
+        # sweeps avoid, so past the resident budget the init goes packed
+        # too (per-token draws; statistically, not draw-for-draw,
+        # equivalent to the padded init).
+        padded_init_bytes = (
+            max(
+                (_padded_docs(len(idxs)) * L for L, idxs in layout_shape),
+                default=0,
+            )
+            // max(1, n_data) * k * 4
+        )
+        use_packed_init = (
+            use_packed and padded_init_bytes > p.resident_budget_bytes
+        )
 
         ckpt_path = (
             os.path.join(p.checkpoint_dir, "em_state.npz")
             if p.checkpoint_dir
             else None
+        )
+        resuming = agree_checkpoint_exists(ckpt_path)
+        # the padded plan (device-resident [B, L] batches) is needed for
+        # the padded loops, the padded init, and padded-mode checkpoints/
+        # loglik; a packed fit that also inits packed (or resumes from a
+        # checkpoint) never builds it
+        need_plan = (not use_packed) or (
+            not resuming and not use_packed_init
+        )
+        plan = (
+            self._bucket_plan(rows, n, layout_shape) if need_plan else []
         )
 
         def _assemble_n_dk(n_dk_list) -> np.ndarray:
@@ -521,7 +689,8 @@ class EMLDA:
             return out
 
         start_it = 0
-        if agree_checkpoint_exists(ckpt_path):
+        ckpt_n_dk_host = None
+        if resuming:
             st = load_train_state(ckpt_path)
             start_it = st["step"]
             if st["n_wk"].shape != (k, v_pad) or st["n_dk"].shape != (n, k):
@@ -533,7 +702,14 @@ class EMLDA:
             n_wk = jax.device_put(
                 jnp.asarray(st["n_wk"]), model_sharding(self.mesh)
             )
-            n_dk_list = _split_n_dk(st["n_dk"])
+            if use_packed:
+                ckpt_n_dk_host = st["n_dk"]
+                n_dk_list = None
+            else:
+                n_dk_list = _split_n_dk(st["n_dk"])
+        elif use_packed_init:
+            n_wk = None       # initialized in the packed branch below
+            n_dk_list = None
         else:
             n_wk = None
             n_dk_list = []
@@ -554,39 +730,50 @@ class EMLDA:
 
         timer = IterationTimer()
         self.last_layout = "padded"
-        if p.token_layout not in ("padded", "packed", "auto"):
-            raise ValueError(
-                f"unknown token_layout {p.token_layout!r} "
-                "(use 'padded'|'packed'|'auto')"
-            )
-        total_nnz = sum(len(i) for i, _ in rows)
-        # auto threshold is 2x here (vs online's 4x): packed EM replaces
-        # a ONE-dispatch padded sweep with another one-dispatch sweep, so
-        # any cell reduction is pure win; online's packed path trades the
-        # resident corpus for per-iteration host packing and needs more
-        # waste to pay for it.
-        use_packed = p.token_layout == "packed" or (
-            p.token_layout == "auto"
-            and self.last_padded_cells >= 2.0 * max(1, total_nnz)
-        )
         if use_packed:
             # Token-packed sweeps (make_em_packed_runner): one scan
             # dispatch per interval over flat doc-contiguous token
             # arrays; same per-edge math from the SAME initial counts as
-            # the padded plan (init/checkpoints stay layout-agnostic).
+            # the padded plan (init/checkpoints stay layout-agnostic)
+            # unless the padded init itself exceeds the budget (above).
             self.last_layout = "packed"
-            (ids_f, cts_f, seg_f, slot, d_max,
+            (ids_f, cts_f, seg_f, doc_f, pos_f, slot, d_max,
              packed_cells) = self._packed_plan(rows, n)
             self.last_padded_cells = packed_cells  # true cells processed
             tok_spec = NamedSharding(self.mesh, P(DATA_AXIS))
             ids_dev = jax.device_put(ids_f, tok_spec)
             cts_dev = jax.device_put(cts_f, tok_spec)
             seg_dev = jax.device_put(seg_f, tok_spec)
-            packed_ndk = np.zeros(
-                (self.mesh.shape[DATA_AXIS] * d_max, k), np.float32
-            )
-            packed_ndk[slot] = _assemble_n_dk(n_dk_list)
-            n_dk_dev = jax.device_put(jnp.asarray(packed_ndk), dk_sharding)
+            if n_dk_list is not None:
+                # small-corpus parity mode: counts from the padded init
+                packed_ndk = np.zeros(
+                    (self.mesh.shape[DATA_AXIS] * d_max, k), np.float32
+                )
+                packed_ndk[slot] = _assemble_n_dk(n_dk_list)
+                n_dk_dev = jax.device_put(
+                    jnp.asarray(packed_ndk), dk_sharding
+                )
+            elif ckpt_n_dk_host is not None:
+                packed_ndk = np.zeros(
+                    (self.mesh.shape[DATA_AXIS] * d_max, k), np.float32
+                )
+                packed_ndk[slot] = ckpt_n_dk_host
+                n_dk_dev = jax.device_put(
+                    jnp.asarray(packed_ndk), dk_sharding
+                )
+            else:
+                init_key = (k, d_max, v_pad // p.model_shards, p.seed)
+                if self._packed_init_key != init_key:
+                    self._packed_init_fn = make_em_packed_init(
+                        self.mesh, k=k, d_max=d_max,
+                        shard_v=v_pad // p.model_shards, seed=p.seed,
+                    )
+                    self._packed_init_key = init_key
+                n_wk, n_dk_dev = self._packed_init_fn(
+                    ids_dev, cts_dev, seg_dev,
+                    jax.device_put(doc_f, tok_spec),
+                    jax.device_put(pos_f, tok_spec),
+                )
             if self._packed_fn is None or self._packed_fn_vocab != v:
                 self._packed_fn = make_em_packed_runner(
                     self.mesh, alpha=alpha, eta=eta, vocab_size=v
@@ -617,7 +804,23 @@ class EMLDA:
                         save_train_state(
                             ckpt_path, it, n_wk=n_wk_host, n_dk=nd_host
                         )
-            n_dk_list = _split_n_dk(fetch_global(n_dk_dev)[slot])
+            # packed eval: no padded plan exists at scale — loglik and the
+            # optional doc-topic export read the packed arrays directly
+            ll_key = (v, alpha, eta)
+            if self._packed_ll_key != ll_key:
+                self._packed_ll_fn = make_em_packed_loglik(
+                    self.mesh, alpha=alpha, eta=eta, vocab_size=v
+                )
+                self._packed_ll_key = ll_key
+            self.last_log_likelihood = float(
+                np.asarray(jax.device_get(
+                    self._packed_ll_fn(
+                        n_wk, n_dk_dev, ids_dev, cts_dev, seg_dev
+                    )
+                ))
+            )
+            if p.keep_doc_topic_counts:
+                self.last_doc_topic_counts = fetch_global(n_dk_dev)[slot]
         elif verbose:
             # Per-iteration dispatch + sync: observable progress, one print
             # per sweep — the debugging path.
@@ -673,32 +876,34 @@ class EMLDA:
                     save_checkpoint(it, n_wk, list(n_dks))
             n_dk_list = list(n_dks)
 
-        # logLikelihood on the mesh BEFORE any host materialization: the
-        # sharded evaluator keeps N_wk [k, V/s] per device, so eval scales
-        # exactly like training (round-2 VERDICT Weak #5: the unsharded
-        # em_log_likelihood put the full [k, V] on one device).
-        from .sharded_eval import make_sharded_em_log_likelihood
+        if self.last_layout != "packed":
+            # logLikelihood on the mesh BEFORE any host materialization:
+            # the sharded evaluator keeps N_wk [k, V/s] per device, so
+            # eval scales exactly like training (round-2 VERDICT Weak #5:
+            # the unsharded em_log_likelihood put the full [k, V] on one
+            # device).  The packed branch evaluated its own loglik above.
+            from .sharded_eval import make_sharded_em_log_likelihood
 
-        loglik_fn = make_sharded_em_log_likelihood(
-            self.mesh, alpha=alpha, eta=eta, vocab_size=v
-        )
-        self.last_log_likelihood = float(
-            sum(
-                np.asarray(
-                    jax.device_get(
-                        loglik_fn(n_wk, n_dk_list[bi], batch_b)
-                    )
-                )
-                for bi, (batch_b, _, _) in enumerate(plan)
+            loglik_fn = make_sharded_em_log_likelihood(
+                self.mesh, alpha=alpha, eta=eta, vocab_size=v
             )
-        )
+            self.last_log_likelihood = float(
+                sum(
+                    np.asarray(
+                        jax.device_get(
+                            loglik_fn(n_wk, n_dk_list[bi], batch_b)
+                        )
+                    )
+                    for bi, (batch_b, _, _) in enumerate(plan)
+                )
+            )
+            if p.keep_doc_topic_counts:
+                # doc-topic counts in original row order — the doc
+                # vertices of an MLlib-format export (reference_export);
+                # opt-in: costs one device->host fetch per bucket
+                self.last_doc_topic_counts = _assemble_n_dk(n_dk_list)
         n_wk_full = fetch_global(n_wk)
         n_wk_np = n_wk_full[:, :v]
-        if p.keep_doc_topic_counts:
-            # doc-topic counts in original row order — the doc vertices of
-            # an MLlib-format export (reference_export); opt-in because
-            # the assembly costs one device->host fetch per bucket
-            self.last_doc_topic_counts = _assemble_n_dk(n_dk_list)
         return LDAModel(
             lam=n_wk_np,
             vocab=list(vocab),
