@@ -1,0 +1,174 @@
+"""Parity tests for the packed-layout Pallas gamma kernel
+(``ops.pallas_packed``) against the XLA segment fixed point
+(``ops.lda_math.gamma_fixed_point_segments``) — same math, tile-aligned
+layout, interpret mode on the CPU harness (the kernel compiles via Mosaic
+on a real chip; tests/test_pallas_estep.py established interpret==Mosaic
+for the padded twin)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from spark_text_clustering_tpu.ops.lda_math import (
+    gamma_fixed_point_segments,
+)
+from spark_text_clustering_tpu.ops.pallas_packed import (
+    docs_gamma_to_tiles,
+    gamma_fixed_point_tiles,
+    plan_tile_pack,
+    tile_gamma_to_docs,
+)
+
+
+def _ragged_packed_batch(rng, b, k, v, nnz_lo=3, nnz_hi=200):
+    """A doc-contiguous flat token stream with heavily skewed doc sizes
+    (the packed layout's reason to exist)."""
+    ids_l, cts_l, seg_l = [], [], []
+    for doc in range(b):
+        nnz = int(rng.integers(nnz_lo, nnz_hi))
+        ids_l.append(rng.choice(v, size=nnz, replace=False).astype(np.int32))
+        cts_l.append(rng.integers(1, 6, nnz).astype(np.float32))
+        seg_l.append(np.full(nnz, doc, np.int32))
+    return (
+        np.concatenate(ids_l),
+        np.concatenate(cts_l),
+        np.concatenate(seg_l),
+    )
+
+
+def _run_both(ids, cts, seg, b, k, v, seed=0, max_inner=300, tol=1e-6,
+              tile_tokens=None):
+    """XLA segment loop vs tile kernel on the same batch; tight tol so
+    both reach the same fixed point regardless of the per-tile vs
+    whole-batch early-exit difference."""
+    rng = np.random.default_rng(seed)
+    lam = rng.gamma(100.0, 0.01, (k, v)).astype(np.float32)
+    from spark_text_clustering_tpu.ops.lda_math import dirichlet_expectation
+
+    eb = np.asarray(jnp.exp(dirichlet_expectation(jnp.asarray(lam))))
+    alpha = np.full((k,), 1.0 / k, np.float32)
+    gamma0 = rng.gamma(100.0, 0.01, (b, k)).astype(np.float32)
+
+    ref, _ = gamma_fixed_point_segments(
+        jnp.asarray(eb.T[ids]),          # [T, k]
+        jnp.asarray(cts),
+        jnp.asarray(seg),
+        jnp.asarray(alpha),
+        jnp.asarray(gamma0),
+        max_inner,
+        tol,
+    )
+
+    plan = plan_tile_pack(ids, cts, seg, b, tile_tokens=tile_tokens)
+    assert plan is not None
+    eb_kt = jnp.asarray(eb[:, plan.ids.reshape(-1)])      # [k, T_tiles]
+    g0_tiles = docs_gamma_to_tiles(
+        jnp.asarray(gamma0), jnp.asarray(plan.doc_ids)
+    )
+    g_tiles = gamma_fixed_point_tiles(
+        eb_kt,
+        jnp.asarray(plan.cts),
+        jnp.asarray(plan.seg),
+        jnp.asarray(alpha),
+        g0_tiles,
+        d=plan.d,
+        max_inner=max_inner,
+        tol=tol,
+        interpret=True,
+    )
+    got = tile_gamma_to_docs(g_tiles, jnp.asarray(plan.doc_ids), b)
+    return np.asarray(ref), np.asarray(got), plan
+
+
+class TestPlanTilePack:
+    def test_no_doc_straddles_and_all_tokens_kept(self):
+        rng = np.random.default_rng(1)
+        b, v = 37, 500
+        ids, cts, seg = _ragged_packed_batch(rng, b, 4, v)
+        plan = plan_tile_pack(ids, cts, seg, b)
+        # every doc appears in exactly one tile
+        docs = plan.doc_ids[plan.doc_ids < b]
+        assert sorted(docs.tolist()) == list(range(b))
+        per_tile_docs = [
+            set(r[r < b].tolist()) for r in plan.doc_ids
+        ]
+        for i in range(len(per_tile_docs)):
+            for j in range(i + 1, len(per_tile_docs)):
+                assert not (per_tile_docs[i] & per_tile_docs[j])
+        # token mass is preserved exactly, doc by doc
+        ref_mass = np.zeros(b)
+        np.add.at(ref_mass, seg, cts)
+        got_mass = np.zeros(b)
+        for ti in range(plan.ids.shape[0]):
+            live = plan.seg[ti] < plan.d
+            np.add.at(
+                got_mass,
+                plan.doc_ids[ti][plan.seg[ti][live]],
+                plan.cts[ti][live],
+            )
+        np.testing.assert_allclose(got_mass, ref_mass, rtol=0)
+        # pad slots are inert
+        assert (plan.cts[plan.seg == plan.d] == 0).all()
+
+    def test_zero_token_docs_get_slots(self):
+        ids = np.array([5, 6, 7], np.int32)
+        cts = np.ones(3, np.float32)
+        seg = np.array([1, 1, 3], np.int32)  # docs 0 and 2 are empty
+        plan = plan_tile_pack(ids, cts, seg, 4)
+        assert sorted(
+            plan.doc_ids[plan.doc_ids < 4].tolist()
+        ) == [0, 1, 2, 3]
+
+    def test_oversize_doc_returns_none(self):
+        ids = np.arange(4096, dtype=np.int32)
+        cts = np.ones(4096, np.float32)
+        seg = np.zeros(4096, np.int32)
+        assert plan_tile_pack(ids, cts, seg, 1, tile_tokens=512) is None
+
+
+class TestTileKernelParity:
+    def test_matches_segment_loop_ragged(self):
+        rng = np.random.default_rng(2)
+        b, k, v = 57, 12, 800
+        ids, cts, seg = _ragged_packed_batch(rng, b, k, v)
+        ref, got, plan = _run_both(ids, cts, seg, b, k, v)
+        assert plan.ids.shape[0] > 1  # the batch really spans tiles
+        np.testing.assert_allclose(got, ref, rtol=2e-3, atol=2e-3)
+
+    def test_matches_segment_loop_small_tiles(self):
+        """Force many tiny tiles (doc-per-tile edge cases included)."""
+        rng = np.random.default_rng(3)
+        b, k, v = 23, 7, 300
+        ids, cts, seg = _ragged_packed_batch(
+            rng, b, k, v, nnz_lo=1, nnz_hi=120
+        )
+        ref, got, plan = _run_both(
+            ids, cts, seg, b, k, v, tile_tokens=128
+        )
+        np.testing.assert_allclose(got, ref, rtol=2e-3, atol=2e-3)
+
+    def test_default_tolerance_agreement(self):
+        """At the training default (tol=1e-3) the per-tile early exit may
+        stop at a slightly different iterate — agreement within the same
+        2e-2 envelope the padded pallas-vs-xla tests pin."""
+        rng = np.random.default_rng(4)
+        b, k, v = 64, 20, 1000
+        ids, cts, seg = _ragged_packed_batch(rng, b, k, v)
+        ref, got, _ = _run_both(
+            ids, cts, seg, b, k, v, max_inner=100, tol=1e-3
+        )
+        np.testing.assert_allclose(got, ref, rtol=2e-2, atol=2e-2)
+
+    def test_empty_docs_uniform_alpha(self):
+        """Docs with no tokens converge to alpha exactly."""
+        rng = np.random.default_rng(5)
+        k, v = 6, 200
+        ids = np.array([1, 2, 3, 9, 10], np.int32)
+        cts = np.ones(5, np.float32)
+        seg = np.array([1, 1, 1, 2, 2], np.int32)  # docs 0, 3 empty
+        b = 4
+        ref, got, _ = _run_both(ids, cts, seg, b, k, v, seed=6)
+        alpha = 1.0 / k
+        np.testing.assert_allclose(got[0], alpha, rtol=1e-5)
+        np.testing.assert_allclose(got[3], alpha, rtol=1e-5)
+        np.testing.assert_allclose(got, ref, rtol=2e-3, atol=2e-3)
