@@ -422,6 +422,14 @@ class EMLDA:
         self.last_log_likelihood: Optional[float] = None
         self.last_doc_topic_counts: Optional[np.ndarray] = None
         self.last_padded_cells: Optional[int] = None
+        # cells actually processed per sweep under the layout the fit
+        # used: the padded grid size for "padded", the true (pow2-padded)
+        # token count for "packed" — bench.py's FLOPs model reads THIS
+        # together with last_layout, so roofline records say which
+        # quantity they model (last_padded_cells always keeps the padded
+        # grid size for the layout auto-decision and cross-layout
+        # comparison)
+        self.last_cells: Optional[int] = None
         # jit cache keyed by vocab size (the only per-fit value baked into
         # the step closure) so it survives repeat fits (bench warmup) but
         # never leaks across fits with different vocabularies
@@ -629,6 +637,7 @@ class EMLDA:
         self.last_padded_cells = sum(
             _padded_docs(len(idxs)) * L for L, idxs in layout_shape
         )
+        self.last_cells = self.last_padded_cells
         total_nnz = sum(len(i) for i, _ in rows)
         # auto threshold is 2x here (vs online's 4x): packed EM replaces
         # a ONE-dispatch padded sweep with another one-dispatch sweep, so
@@ -739,7 +748,7 @@ class EMLDA:
             self.last_layout = "packed"
             (ids_f, cts_f, seg_f, doc_f, pos_f, slot, d_max,
              packed_cells) = self._packed_plan(rows, n)
-            self.last_padded_cells = packed_cells  # true cells processed
+            self.last_cells = packed_cells  # true cells processed
             tok_spec = NamedSharding(self.mesh, P(DATA_AXIS))
             ids_dev = jax.device_put(ids_f, tok_spec)
             cts_dev = jax.device_put(cts_f, tok_spec)

@@ -58,9 +58,14 @@ def probe_accelerator(
     can fail transiently (UNAVAILABLE).  With ``require_accelerator``,
     jax silently falling back to its CPU platform counts as failure.
 
-    Returns ``{"ok", "backend", "version", "devices", "error"}``;
-    shared by bench.py's TPU gate and the CLI ``doctor`` subcommand so
-    the two health checks cannot drift apart.
+    Returns ``{"ok", "backend", "version", "devices", "error",
+    "history"}`` — ``history`` is one entry per attempt
+    (``{"utc", "elapsed_s", "error_class", "error"}``) so artifacts
+    produced on a fallback path can carry the evidence of what was tried
+    and how it failed (round-3 VERDICT: the bench record itself must
+    document the environment when the chip never appears).  Shared by
+    bench.py's TPU gate and the CLI ``doctor`` subcommand so the two
+    health checks cannot drift apart.
     """
     code = (
         "import jax, json; d = jax.devices(); "
@@ -69,10 +74,21 @@ def probe_accelerator(
     )
     backoff = [0, 10, 30]
     last_err = ""
+    history: list = []
+
+    def _note(err_class: str, err: str, t0: float) -> None:
+        history.append({
+            "utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+            "elapsed_s": round(time.monotonic() - t0, 1),
+            "error_class": err_class,
+            "error": err,
+        })
+
     for i in range(attempts):
         delay = backoff[min(i, len(backoff) - 1)]
         if delay:
             time.sleep(delay)
+        t0 = time.monotonic()
         try:
             r = subprocess.run(
                 [sys.executable, "-c", code],
@@ -83,6 +99,7 @@ def probe_accelerator(
             )
         except subprocess.TimeoutExpired:
             last_err = f"probe hung >{probe_timeout}s"
+            _note("hang", last_err, t0)
         else:
             line = next(
                 (ln for ln in r.stdout.splitlines()
@@ -93,13 +110,16 @@ def probe_accelerator(
                 info = json.loads(line[len("PROBE "):])
                 if require_accelerator and info["b"] == "cpu":
                     last_err = "jax fell back to the cpu platform"
+                    _note("cpu_fallback", last_err, t0)
                 else:
+                    _note("ok", "", t0)
                     return {
                         "ok": True,
                         "backend": info["b"],
                         "version": info["v"],
                         "devices": info["n"],
                         "error": "",
+                        "history": history,
                     }
             else:
                 tail = (
@@ -107,10 +127,11 @@ def probe_accelerator(
                     if r.stderr.strip() else ""
                 )
                 last_err = f"rc={r.returncode} {tail}".strip()
+                _note("init_error", last_err, t0)
         if verbose:
             sys.stderr.write(
                 f"# accelerator probe attempt {i + 1}/{attempts}: "
                 f"{last_err}\n"
             )
     return {"ok": False, "backend": None, "version": None,
-            "devices": 0, "error": last_err}
+            "devices": 0, "error": last_err, "history": history}
