@@ -1,0 +1,151 @@
+"""Micro-bench: Pallas E-step kernels vs XLA loops ON THE REAL CHIP.
+
+Verifies Mosaic compilation (the round-3 kernels never compiled on
+hardware — BENCH r4's first child died on an illegal block shape) and
+measures the HBM-restream win for both layouts:
+
+  * padded [B, k, L] kernel (``gamma_fixed_point_pallas_bkl``) vs the
+    XLA ``_gamma_fixed_point`` while_loop, on the 20NG online shape;
+  * packed tile kernel (``gamma_fixed_point_tiles``) vs the XLA segment
+    fixed point, on the same batch token-packed.
+
+Run:  python scripts/bench_kernels_tpu.py   (requires the TPU tunnel)
+Appends a JSON line to stdout; PERF.md records the capture.
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+def _timeit(fn, *args, reps=5, **kw):
+    out = fn(*args, **kw)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn(*args, **kw)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / reps, out
+
+
+def main():
+    platform = jax.default_backend()
+    b, l, k, v = 568, 2048, 20, 1 << 18
+    max_inner, tol = 100, 1e-3
+    rng = np.random.default_rng(0)
+
+    from spark_text_clustering_tpu.ops.lda_math import (
+        _gamma_fixed_point,
+        dirichlet_expectation,
+        gamma_fixed_point_segments,
+    )
+    from spark_text_clustering_tpu.ops.pallas_estep import (
+        gamma_fixed_point_pallas_bkl,
+    )
+    from spark_text_clustering_tpu.ops.pallas_packed import (
+        docs_gamma_to_tiles,
+        gamma_fixed_point_tiles,
+        plan_tile_pack,
+        tile_gamma_to_docs,
+    )
+
+    interp = platform != "tpu"
+
+    # ragged Zipf-ish batch, padded grid [B, L]
+    lens = np.minimum(
+        l, (rng.zipf(1.7, size=b) * 8).astype(np.int64) + 16
+    )
+    ids = np.zeros((b, l), np.int32)
+    cts = np.zeros((b, l), np.float32)
+    for i, n in enumerate(lens):
+        ids[i, :n] = rng.choice(v, size=n, replace=False)
+        cts[i, :n] = rng.integers(1, 6, n)
+    lam = rng.gamma(100.0, 0.01, (k, v)).astype(np.float32)
+    eb_full = np.asarray(
+        jnp.exp(dirichlet_expectation(jnp.asarray(lam)))
+    )
+    alpha = np.full((k,), 0.05, np.float32)
+    gamma0 = rng.gamma(100.0, 0.01, (b, k)).astype(np.float32)
+
+    eb_blk = jnp.asarray(
+        np.moveaxis(eb_full[:, ids], 0, 1)
+    )  # [B, k, L]
+    eb_blk_last = jnp.asarray(eb_full.T[ids])  # [B, L, k]
+    cts_j = jnp.asarray(cts)
+    alpha_j = jnp.asarray(alpha)
+    g0_j = jnp.asarray(gamma0)
+
+    t_xla, g_xla = _timeit(
+        lambda: _gamma_fixed_point(
+            eb_blk_last, cts_j, alpha_j, g0_j, max_inner, tol
+        )[0]
+    )
+    t_pal, g_pal = _timeit(
+        lambda: gamma_fixed_point_pallas_bkl(
+            eb_blk, cts_j, alpha_j, g0_j,
+            max_inner=max_inner, tol=tol, interpret=interp,
+        )
+    )
+    pad_close = float(
+        np.max(
+            np.abs(np.asarray(g_pal) - np.asarray(g_xla))
+            / np.maximum(np.abs(np.asarray(g_xla)), 1e-3)
+        )
+    )
+
+    # token-packed twin of the same batch
+    flat_ids = np.concatenate([ids[i, : lens[i]] for i in range(b)])
+    flat_cts = np.concatenate([cts[i, : lens[i]] for i in range(b)])
+    flat_seg = np.repeat(np.arange(b, dtype=np.int32), lens)
+    t_tok = int(flat_ids.size)
+    eb_tok = jnp.asarray(eb_full.T[flat_ids])  # [T, k]
+    t_seg, g_seg = _timeit(
+        lambda: gamma_fixed_point_segments(
+            eb_tok, jnp.asarray(flat_cts), jnp.asarray(flat_seg),
+            alpha_j, g0_j, max_inner, tol,
+        )[0]
+    )
+    plan = plan_tile_pack(flat_ids, flat_cts, flat_seg, b, k=k)
+    assert plan is not None, "tile geometry over budget"
+    eb_kt = jnp.asarray(eb_full[:, plan.ids.reshape(-1)])
+    g0_tiles = docs_gamma_to_tiles(g0_j, jnp.asarray(plan.doc_ids))
+    t_til, g_til_raw = _timeit(
+        lambda: gamma_fixed_point_tiles(
+            eb_kt, jnp.asarray(plan.cts), jnp.asarray(plan.seg),
+            alpha_j, g0_tiles, d=plan.d,
+            max_inner=max_inner, tol=tol, interpret=interp,
+        )
+    )
+    g_til = tile_gamma_to_docs(
+        g_til_raw, jnp.asarray(plan.doc_ids), b
+    )
+    til_close = float(
+        np.max(
+            np.abs(np.asarray(g_til) - np.asarray(g_seg))
+            / np.maximum(np.abs(np.asarray(g_seg)), 1e-3)
+        )
+    )
+
+    print(json.dumps({
+        "platform": platform,
+        "shape": {"b": b, "l": l, "k": k, "tokens": t_tok,
+                  "tiles": int(plan.ids.shape[0]), "tt": plan.tt,
+                  "d": plan.d},
+        "padded": {"xla_ms": round(t_xla * 1e3, 2),
+                   "pallas_ms": round(t_pal * 1e3, 2),
+                   "speedup": round(t_xla / t_pal, 2),
+                   "max_rel_diff": round(pad_close, 4)},
+        "packed": {"xla_segment_ms": round(t_seg * 1e3, 2),
+                   "pallas_tiles_ms": round(t_til * 1e3, 2),
+                   "speedup": round(t_seg / t_til, 2),
+                   "max_rel_diff": round(til_close, 4)},
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -73,6 +73,7 @@ __all__ = [
     "make_online_resident_step",
     "make_online_resident_chunk",
     "make_online_packed_chunk",
+    "make_online_packed_tiles_chunk",
 ]
 
 
@@ -88,21 +89,25 @@ def _estep_block(eb_shard, ids, wts, gamma0, alpha_arr, max_inner, tol):
     backend/layout choice lives in exactly one place.  Returns
     (sstats_shard [k, V/s] NOT yet psum-reduced over "data", gamma)."""
     if _resolve_gamma_backend("auto") == "pallas":
-        # VMEM-resident Pallas E-step in the [k, B, L] layout the gather
+        # VMEM-resident Pallas E-step in the [B, k, L] layout the gather
         # produces — measured ~4.5x over the XLA loop on TPU, and the
-        # layout choice avoids a slab transpose that costs more than the
-        # kernel (ops/pallas_estep.py layout notes).
-        from ..ops.lda_math import token_sstats_factors_kbl
-        from ..ops.pallas_estep import gamma_fixed_point_pallas_kbl
+        # layout is the one Mosaic's block constraints admit without any
+        # slab transpose (ops/pallas_estep.py layout notes).
+        from ..ops.lda_math import token_sstats_factors_bkl
+        from ..ops.pallas_estep import gamma_fixed_point_pallas_bkl
+        from ..parallel.collectives import (
+            gather_model_rows_bkl,
+            scatter_add_model_shard_bkl,
+        )
 
-        eb_tok = gather_model_rows_kbl(eb_shard, ids)    # [k, B, L]
-        gamma = gamma_fixed_point_pallas_kbl(
+        eb_tok = gather_model_rows_bkl(eb_shard, ids)    # [B, k, L]
+        gamma = gamma_fixed_point_pallas_bkl(
             eb_tok, wts, alpha_arr, gamma0,
             max_inner=max_inner, tol=tol,
             interpret=jax.default_backend() != "tpu",
         )
-        vals = token_sstats_factors_kbl(eb_tok, wts, gamma)
-        sstats_shard = scatter_add_model_shard_kbl(
+        vals = token_sstats_factors_bkl(eb_tok, wts, gamma)
+        sstats_shard = scatter_add_model_shard_bkl(
             ids, vals, eb_shard.shape[-1]
         )                                                # [k, V/s]
     else:
@@ -613,6 +618,135 @@ def make_online_packed_chunk(
     return packed_chunk
 
 
+def make_online_packed_tiles_chunk(
+    mesh: Mesh,
+    *,
+    alpha: float | np.ndarray,
+    eta: float,
+    tau0: float,
+    kappa: float,
+    k: int,
+    gamma_shape: float,
+    seed: int,
+    d: int,
+    max_inner: int = 100,
+    tol: float = 1e-3,
+    interpret: bool = False,
+):
+    """The packed chunk runner with the gamma loop on the PALLAS TILE
+    kernel (``ops.pallas_packed``) instead of the XLA segment fixed
+    point — the TPU default: the XLA lowering re-streams the gathered
+    eb slab from HBM every inner iteration (~4.5x measured on the padded
+    twin), the kernel keeps each tile's block VMEM-resident.
+
+    Minibatches arrive TILE-PLANNED (``plan_tile_pack_uniform``): ids /
+    cts / seg are [m, n_tiles, tt] with tile-local doc slots, doc_ids
+    [m, n_tiles, d] maps slots back to minibatch positions.  Tiles are
+    sharded over "data"; because no document straddles a tile, gamma
+    needs NO cross-shard reduction — only the M-step's sstats scatter
+    psums over "data", exactly like the flat packed path.  Same per-doc
+    gamma inits (keyed by global doc id), same M-step blend; parity with
+    the flat path is pinned by tests/test_packed_tiles_training.py.
+    """
+    from ..ops.lda_math import _PHI_EPS
+    from ..ops.pallas_packed import (
+        docs_gamma_to_tiles,
+        gamma_fixed_point_tiles,
+    )
+
+    alpha_arr = jnp.asarray(alpha, jnp.float32)
+    base_key = jax.random.PRNGKey(seed)
+
+    def _iter(lam_shard, step, ids_t, cts_t, seg_t, doc_t, pick,
+              batch_docs, corpus_sz):
+        from jax.scipy.special import digamma as _digamma
+
+        n_tiles_l, tt = ids_t.shape
+        flat_ids = ids_t.reshape(-1)
+        row_sum = model_row_sum(lam_shard)                # [k]
+        lam_tok = gather_model_rows_kbl(lam_shard, flat_ids)  # [k, T]
+        eb_kt = jnp.exp(
+            _digamma(jnp.maximum(lam_tok, 1e-30))
+            - _digamma(row_sum)[:, None]
+        )
+        key_it = jax.random.fold_in(base_key, step)
+        gamma0 = init_gamma_rows(key_it, pick, k, gamma_shape)  # [B, k]
+        # doc-ordered inits -> tile-slot order (pad slots read the
+        # all-ones overflow row; their gamma is discarded)
+        g0_tiles = docs_gamma_to_tiles(gamma0, doc_t)     # [k, nt*d]
+        gamma_tiles = gamma_fixed_point_tiles(
+            eb_kt, cts_t, seg_t, alpha_arr, g0_tiles,
+            d=d, max_inner=max_inner, tol=tol, interpret=interpret,
+        )                                                 # [k, nt*d]
+        # final responsibilities -> sstats ∘ eb, scattered V-shard-local
+        elog = _digamma(gamma_tiles) - _digamma(
+            gamma_tiles.sum(axis=0, keepdims=True)
+        )
+        exp_et_slots = jnp.exp(elog)                      # [k, nt*d]
+        tile_idx = jax.lax.broadcasted_iota(
+            jnp.int32, (n_tiles_l, tt), 0
+        )
+        slot = (
+            tile_idx * d + jnp.minimum(seg_t, d - 1)
+        ).reshape(-1)                                     # [T]
+        et_tok = exp_et_slots[:, slot]                    # [k, T]
+        phinorm = (eb_kt * et_tok).sum(axis=0) + _PHI_EPS
+        vals_kt = (
+            et_tok * (cts_t.reshape(-1) / phinorm)[None, :] * eb_kt
+        )
+        touched = psum_data(
+            scatter_add_model_shard_kbl(
+                flat_ids[None, :], vals_kt[:, None, :],
+                lam_shard.shape[-1],
+            )
+        )                                                 # sstats ∘ eb
+        rho = (tau0 + step.astype(jnp.float32) + 1.0) ** (-kappa)
+        scale = corpus_sz / jnp.maximum(batch_docs, 1.0)
+        lam_new = (1.0 - rho) * lam_shard + rho * eta + rho * scale * touched
+        lam_new = jnp.where(batch_docs > 0.0, lam_new, lam_shard)
+        return lam_new, step + 1
+
+    sharded = jax.shard_map(
+        _iter,
+        mesh=mesh,
+        in_specs=(
+            P(None, MODEL_AXIS),      # lam shard
+            P(),                      # step
+            P(DATA_AXIS, None),       # tile token ids
+            P(DATA_AXIS, None),       # tile token weights
+            P(DATA_AXIS, None),       # tile-local doc slots
+            P(DATA_AXIS, None),       # tile doc ids
+            P(),                      # pick (replicated)
+            P(),                      # true nonempty doc count
+            P(),                      # corpus size
+        ),
+        out_specs=(P(None, MODEL_AXIS), P()),
+        check_vma=False,
+    )
+
+    @jax.jit
+    def tiles_chunk(
+        state: TrainState, tile_ids, tile_cts, tile_seg, tile_doc,
+        picks, batch_docs, corpus_sz,
+    ) -> TrainState:
+        cs = jnp.asarray(corpus_sz, jnp.float32)
+
+        def body(st, xs):
+            ids_t, cts_t, seg_t, doc_t, pick, bd = xs
+            lam, step = sharded(
+                st.lam, st.step, ids_t, cts_t, seg_t, doc_t, pick, bd, cs
+            )
+            return TrainState(lam, step), None
+
+        state, _ = jax.lax.scan(
+            body, state,
+            (tile_ids, tile_cts, tile_seg, tile_doc, picks, batch_docs),
+        )
+        return state
+
+    return tiles_chunk
+
+
 class OnlineLDA:
     """Estimator: ``fit(rows) -> LDAModel`` (the ``lda.run(corpus)`` of the
     reference's online path, LDAClustering.scala:43,61).
@@ -644,10 +778,14 @@ class OnlineLDA:
         self._resident_fn = None
         self._resident_chunk_fn = None
         self._packed_chunk_fn = None
+        self._tiles_chunk_fns: dict = {}
         self.last_batch_size: Optional[int] = None
         self.last_row_len: Optional[int] = None
         self.last_layout: str = "padded"
         self.last_batch_cells: Optional[int] = None
+        # which gamma loop the last packed chunk ran: "xla" (segment
+        # fixed point) or "pallas_tiles" (VMEM-resident tile kernel)
+        self.last_gamma_backend: str = "xla"
 
     def _fit_packed(
         self, rows, vocab, p, n, v, k, alpha, eta, bsz, n_iters,
@@ -679,7 +817,13 @@ class OnlineLDA:
             )
         n_data = self.mesh.shape[DATA_AXIS]
         tok_spec = NamedSharding(self.mesh, P(None, DATA_AXIS))
+        tile_spec = NamedSharding(self.mesh, P(None, DATA_AXIS, None))
         rep = NamedSharding(self.mesh, P())
+        # TPU default: the tile kernel keeps each tile's eb block
+        # VMEM-resident across the fixed point; the XLA segment loop
+        # re-streams it from HBM per inner iteration.  Falls back to the
+        # flat XLA path when no tile geometry fits the VMEM budget.
+        use_tiles = _resolve_gamma_backend("auto") == "pallas"
 
         def pack(pick):
             """One minibatch -> (ids [t], cts [t], seg [t], nonempty)."""
@@ -704,18 +848,68 @@ class OnlineLDA:
             m = min(interval - (it % interval), n_iters - it)
             picks = np.stack([make_pick(i) for i in range(it, it + m)])
             packs = [pack(pk) for pk in picks]
+            bds = np.array([pp[3] for pp in packs], np.float32)
+            self.last_layout = "packed"
+
+            plan = None
+            if use_tiles:
+                from ..ops.pallas_packed import plan_tile_pack_uniform
+
+                plan = plan_tile_pack_uniform(
+                    [(i_, c_, s_) for i_, c_, s_, _ in packs],
+                    b=picks.shape[1], n_tiles_multiple=n_data, k=k,
+                )
+                if plan is None:
+                    use_tiles = False  # geometry over budget: whole fit
+                    #                    falls back to the flat XLA loop
+
+            if plan is not None:
+                self.last_gamma_backend = "pallas_tiles"
+                fn = self._tiles_chunk_fns.get(plan.d)
+                if fn is None:
+                    fn = make_online_packed_tiles_chunk(
+                        self.mesh, alpha=alpha, eta=eta, tau0=p.tau0,
+                        kappa=p.kappa, k=k, gamma_shape=p.gamma_shape,
+                        seed=p.seed, d=plan.d,
+                        interpret=jax.default_backend() != "tpu",
+                    )
+                    self._tiles_chunk_fns[plan.d] = fn
+                cells_sum += plan.n_tiles * plan.tt * m
+                iters_run += m
+                self.last_batch_cells = cells_sum // iters_run
+                timer.start()
+                state = fn(
+                    state,
+                    jax.device_put(plan.ids, tile_spec),
+                    jax.device_put(plan.cts, tile_spec),
+                    jax.device_put(plan.seg, tile_spec),
+                    jax.device_put(plan.doc_ids, tile_spec),
+                    jax.device_put(picks, rep),
+                    jax.device_put(bds, rep),
+                    float(n),
+                )
+                state.lam.block_until_ready()
+                timer.stop()
+                if m > 1:
+                    timer.split_last(m)
+                if verbose:
+                    print(f"iter {it}: {timer.times[-1]:.3f}s "
+                          "(packed/pallas-tiles)")
+                it += m
+                if ckpt_path and it % max(1, p.checkpoint_interval) == 0:
+                    save_checkpoint(it, state.lam)
+                continue
+
+            self.last_gamma_backend = "xla"
             t_pad = next_pow2(max(8, max(pp[0].size for pp in packs)))
             t_pad = ((t_pad + n_data - 1) // n_data) * n_data
             tok_ids = np.zeros((m, t_pad), np.int32)
             tok_cts = np.zeros((m, t_pad), np.float32)
             tok_seg = np.zeros((m, t_pad), np.int32)
-            bds = np.zeros((m,), np.float32)
             for j, (ids_t, cts_t, seg, bd) in enumerate(packs):
                 tok_ids[j, : ids_t.size] = ids_t
                 tok_cts[j, : cts_t.size] = cts_t
                 tok_seg[j, : seg.size] = seg
-                bds[j] = bd
-            self.last_layout = "packed"
             cells_sum += t_pad * m
             iters_run += m
             # iteration-weighted mean cells: chunks may land on different

@@ -44,7 +44,6 @@ from ..ops.lda_math import (
 from ..ops.sparse import DocTermBatch
 from ..parallel.collectives import (
     gather_model_rows,
-    gather_model_rows_kbl,
     psum_data,
     psum_model,
 )
@@ -80,13 +79,14 @@ def _sharded_gamma(eb_shard, ids, wts, gamma0, alpha_arr, max_inner, tol):
     """Gamma fixed point against a V-sharded exp(E[log beta]): gather the
     minibatch's token rows (one psum over "model"), then iterate locally.
     Backend dispatch mirrors ``online_lda._estep_block`` (Pallas kernel in
-    the [k, B, L] layout on TPU, XLA loop elsewhere) minus the sufficient
+    the [B, k, L] layout on TPU, XLA loop elsewhere) minus the sufficient
     statistics scoring never needs."""
     if _resolve_gamma_backend("auto") == "pallas":
-        from ..ops.pallas_estep import gamma_fixed_point_pallas_kbl
+        from ..ops.pallas_estep import gamma_fixed_point_pallas_bkl
+        from ..parallel.collectives import gather_model_rows_bkl
 
-        eb_tok = gather_model_rows_kbl(eb_shard, ids)      # [k, B, L]
-        return gamma_fixed_point_pallas_kbl(
+        eb_tok = gather_model_rows_bkl(eb_shard, ids)      # [B, k, L]
+        return gamma_fixed_point_pallas_bkl(
             eb_tok, wts, alpha_arr, gamma0,
             max_inner=max_inner, tol=tol,
             interpret=jax.default_backend() != "tpu",
@@ -187,20 +187,21 @@ def make_sharded_log_likelihood(
 
         # ONE gather of the batch's lambda rows serves both passes.
         if _resolve_gamma_backend("auto") == "pallas":
-            from ..ops.pallas_estep import gamma_fixed_point_pallas_kbl
+            from ..ops.pallas_estep import gamma_fixed_point_pallas_bkl
+            from ..parallel.collectives import gather_model_rows_bkl
 
-            lam_tok = gather_model_rows_kbl(lam_f, ids)     # [k, B, L]
+            lam_tok = gather_model_rows_bkl(lam_f, ids)     # [B, k, L]
             elog_tok = digamma(
                 jnp.maximum(lam_tok, _LAM_FLOOR)
-            ) - dig_row[:, None, None]
-            gamma = gamma_fixed_point_pallas_kbl(
+            ) - dig_row[None, :, None]
+            gamma = gamma_fixed_point_pallas_bkl(
                 jnp.exp(elog_tok), wts, alpha_arr, gamma0,
                 max_inner=max_inner, tol=tol,
                 interpret=jax.default_backend() != "tpu",
             )
             elog_theta = dirichlet_expectation(gamma)       # [B, k]
             lse = jax.nn.logsumexp(
-                elog_tok + elog_theta.T[:, :, None], axis=0
+                elog_tok + elog_theta[:, :, None], axis=1
             )                                               # [B, L]
         else:
             lam_tok = gather_model_rows(lam_f, ids)         # [B, L, k]

@@ -38,7 +38,7 @@ __all__ = [
     "dirichlet_expectation",
     "dirichlet_expectation_sharded",
     "token_sstats_factors",
-    "token_sstats_factors_kbl",
+    "token_sstats_factors_bkl",
     "token_sstats_factors_segments",
     "init_lambda",
     "init_gamma",
@@ -88,19 +88,19 @@ def token_sstats_factors(
     return exp_etheta, vals
 
 
-def token_sstats_factors_kbl(
-    eb_tok: jnp.ndarray,    # [k, B, L] gathered exp(E[log beta]) at tokens
+def token_sstats_factors_bkl(
+    eb_tok: jnp.ndarray,    # [B, k, L] gathered exp(E[log beta]) at tokens
     cts: jnp.ndarray,       # [B, L]
     gamma: jnp.ndarray,     # [B, k]
 ) -> jnp.ndarray:
-    """``token_sstats_factors`` for the [k, B, L] slab layout the Pallas
-    E-step path uses (k outer, tokens on lanes — see ops/pallas_estep.py's
-    layout notes): returns vals [k, B, L] for the per-topic-row scatter
-    (``scatter_add_model_shard_kbl``).  Same math, no big-slab relayout."""
+    """``token_sstats_factors`` for the [B, k, L] slab layout the Pallas
+    E-step kernel consumes (``gather_model_rows_bkl``): returns vals
+    [B, k, L] for ``scatter_add_model_shard_bkl``.  Same math, no
+    big-slab relayout."""
     exp_etheta = jnp.exp(dirichlet_expectation(gamma))        # [B, k]
-    et_k = exp_etheta.T[:, :, None]                           # [k, B, 1]
-    phinorm = (eb_tok * et_k).sum(axis=0) + _PHI_EPS          # [B, L]
-    return et_k * (cts / phinorm)[None]                       # [k, B, L]
+    et_k = exp_etheta[:, :, None]                             # [B, k, 1]
+    phinorm = (eb_tok * et_k).sum(axis=1) + _PHI_EPS          # [B, L]
+    return et_k * (cts / phinorm)[:, None]                    # [B, k, L]
 
 
 def init_lambda(

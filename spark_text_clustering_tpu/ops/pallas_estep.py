@@ -11,15 +11,24 @@ iterations, so HBM traffic drops from (iterations x slab) to (1 x slab) —
 measured ~4.5x faster (~20 ms) on that shape.
 
 Layout is everything here (measured: an in-jit [B, L, k] -> [B, k, L]
-transpose alone costs more than the whole kernel):
+minor-dim transpose alone costs more than the whole kernel):
 
-  * the slab arrives as ``eb [k, B, L]`` — exactly what the vocab-sharded
-    gather produces (``gather_model_rows_kbl``) with L on the 128-wide
-    lane dimension and the batch tile on sublanes; no transpose anywhere,
-  * gamma runs as [k, TB] inside the kernel so the per-iteration digamma/
+  * the slab arrives as ``eb [B, k, L]`` — the vocab-sharded gather emits
+    this directly (``gather_model_rows_bkl``: XLA folds the leading-axes
+    permutation into the gather's output layout) with L on the 128-wide
+    lane dimension, k on sublanes, and the batch tile on the looping
+    leading axis; no transpose anywhere,
+  * gamma runs as [TB, k] inside the kernel so the per-iteration digamma/
     update needs no relayout either,
-  * grid = (B / TILE_B,); per program the [k, TB, L] block (~1.6 MB at
+  * grid = (B / TILE_B,); per program the [TB, k, L] block (~1.6 MB at
     TB=8, k=20, L=2048) stays VMEM-resident across the whole while_loop.
+
+Mosaic's block constraint (the last two block dims must be divisible by
+(8, 128) or equal the array dims) forces this layout: the round-3
+[k, B, L] variant blocked gamma as (k, TILE_B) over [k, B] — an 8-wide
+lane tile Mosaic rejects (BENCH r4's first TPU child died on exactly
+that).  Here every block's trailing dims are either full (k, L) or
+8-divisible (TILE_B), verified compiling on a real v5e.
 
 ``digamma`` has NO Mosaic lowering (round 1 shipped this kernel assuming
 it did; it raises NotImplementedError on a real chip).  The kernel
@@ -48,6 +57,7 @@ from jax.experimental import pallas as pl
 
 __all__ = [
     "gamma_fixed_point_pallas",
+    "gamma_fixed_point_pallas_bkl",
     "gamma_fixed_point_pallas_kbl",
     "pallas_supported",
     "digamma_approx",
@@ -83,25 +93,25 @@ def digamma_approx(x: jnp.ndarray) -> jnp.ndarray:
 
 def _estep_kernel(eb_ref, cts_ref, alpha_ref, gamma0_ref, gamma_out_ref,
                   *, max_inner: int, tol: float):
-    """All per-doc state is [k, TB] (k on sublanes): no relayout inside
-    the loop."""
-    eb = eb_ref[:]          # [k, TB, L] — VMEM-resident across the loop
+    """All per-doc state is [TB, k] (k on lanes): no relayout inside
+    the loop, and every block's trailing dims are Mosaic-legal."""
+    eb = eb_ref[:]          # [TB, k, L] — VMEM-resident across the loop
     cts = cts_ref[:]        # [TB, L]
-    alpha = alpha_ref[:]    # [k, 1]
-    gamma0 = gamma0_ref[:]  # [k, TB]
+    alpha = alpha_ref[:]    # [1, k]
+    gamma0 = gamma0_ref[:]  # [TB, k]
 
     def body(carry):
-        gamma, _, it = carry                                       # [k, TB]
+        gamma, _, it = carry                                       # [TB, k]
         elog = digamma_approx(gamma) - digamma_approx(
-            gamma.sum(axis=0, keepdims=True)
+            gamma.sum(axis=1, keepdims=True)
         )
-        exp_etheta = jnp.exp(elog)                                 # [k, TB]
-        phinorm = (eb * exp_etheta[:, :, None]).sum(axis=0) + 1e-30
+        exp_etheta = jnp.exp(elog)                                 # [TB, k]
+        phinorm = (eb * exp_etheta[:, :, None]).sum(axis=1) + 1e-30
         ratio = cts / phinorm                                      # [TB, L]
         gamma_new = alpha + exp_etheta * (
-            eb * ratio[None, :, :]
-        ).sum(axis=2)                                              # [k, TB]
-        worst = jnp.abs(gamma_new - gamma).mean(axis=0).max()
+            eb * ratio[:, None, :]
+        ).sum(axis=2)                                              # [TB, k]
+        worst = jnp.abs(gamma_new - gamma).mean(axis=1).max()
         return gamma_new, worst, it + 1
 
     def cond(carry):
@@ -123,6 +133,51 @@ def _estep_kernel(eb_ref, cts_ref, alpha_ref, gamma0_ref, gamma_out_ref,
     # scalar there would be a captured constant pallas_call rejects
     static_argnames=("max_inner", "tol", "tile_b", "interpret"),
 )
+def gamma_fixed_point_pallas_bkl(
+    eb: jnp.ndarray,        # [B, k, L] gathered exp(E[log beta])
+    cts: jnp.ndarray,       # [B, L]
+    alpha: jnp.ndarray,     # [k] (or scalar broadcastable)
+    gamma0: jnp.ndarray,    # [B, k]
+    max_inner: int = 100,
+    tol: float = 1e-3,
+    tile_b: int = 8,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Gamma fixed point over a [B, k, L] slab (what
+    ``gather_model_rows_bkl`` emits); returns converged gamma [B, k]."""
+    b, k, l = eb.shape
+    alpha = jnp.broadcast_to(
+        jnp.asarray(alpha, jnp.float32), (k,)
+    ).reshape(1, k)
+    tb = min(tile_b, b)
+    if b % tb:  # pad batch to a tile multiple; pad docs have cts==0
+        pad = tb - b % tb
+        eb = jnp.pad(eb, ((0, pad), (0, 0), (0, 0)))
+        cts = jnp.pad(cts, ((0, pad), (0, 0)))
+        gamma0 = jnp.pad(gamma0, ((0, pad), (0, 0)), constant_values=1.0)
+    bp = eb.shape[0]
+
+    kernel = functools.partial(_estep_kernel, max_inner=max_inner, tol=tol)
+    gamma = pl.pallas_call(
+        kernel,
+        grid=(bp // tb,),
+        in_specs=[
+            pl.BlockSpec((tb, k, l), lambda i: (i, 0, 0)),
+            pl.BlockSpec((tb, l), lambda i: (i, 0)),
+            pl.BlockSpec((1, k), lambda i: (0, 0)),
+            pl.BlockSpec((tb, k), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((tb, k), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bp, k), jnp.float32),
+        interpret=interpret,
+    )(eb, cts, alpha, gamma0)
+    return gamma[:b]
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("max_inner", "tol", "tile_b", "interpret"),
+)
 def gamma_fixed_point_pallas_kbl(
     eb: jnp.ndarray,        # [k, B, L] gathered exp(E[log beta])
     cts: jnp.ndarray,       # [B, L]
@@ -133,36 +188,14 @@ def gamma_fixed_point_pallas_kbl(
     tile_b: int = 8,
     interpret: bool = False,
 ) -> jnp.ndarray:
-    """Gamma fixed point over a [k, B, L] slab (the layout the vocab-
-    sharded gather produces); returns converged gamma [B, k]."""
-    k, b, l = eb.shape
-    alpha = jnp.broadcast_to(
-        jnp.asarray(alpha, jnp.float32), (k,)
-    ).reshape(k, 1)
-    gamma0 = gamma0.T                                      # [k, B] (tiny)
-    tb = min(tile_b, b)
-    if b % tb:  # pad batch to a tile multiple; pad docs have cts==0
-        pad = tb - b % tb
-        eb = jnp.pad(eb, ((0, 0), (0, pad), (0, 0)))
-        cts = jnp.pad(cts, ((0, pad), (0, 0)))
-        gamma0 = jnp.pad(gamma0, ((0, 0), (0, pad)), constant_values=1.0)
-    bp = eb.shape[1]
-
-    kernel = functools.partial(_estep_kernel, max_inner=max_inner, tol=tol)
-    gamma = pl.pallas_call(
-        kernel,
-        grid=(bp // tb,),
-        in_specs=[
-            pl.BlockSpec((k, tb, l), lambda i: (0, i, 0)),
-            pl.BlockSpec((tb, l), lambda i: (i, 0)),
-            pl.BlockSpec((k, 1), lambda i: (0, 0)),
-            pl.BlockSpec((k, tb), lambda i: (0, i)),
-        ],
-        out_specs=pl.BlockSpec((k, tb), lambda i: (0, i)),
-        out_shape=jax.ShapeDtypeStruct((k, bp), jnp.float32),
-        interpret=interpret,
-    )(eb, cts, alpha, gamma0)
-    return gamma[:, :b].T
+    """Compat adapter for [k, B, L] slabs: leading-axes permutation to
+    [B, k, L] (cheaper than a minor-dim transpose — lanes stay L), then
+    the bkl kernel.  Hot paths should gather straight into [B, k, L]
+    via ``gather_model_rows_bkl`` instead."""
+    return gamma_fixed_point_pallas_bkl(
+        jnp.moveaxis(eb, 0, 1), cts, alpha, gamma0,
+        max_inner=max_inner, tol=tol, tile_b=tile_b, interpret=interpret,
+    )
 
 
 @functools.partial(
@@ -180,12 +213,13 @@ def gamma_fixed_point_pallas(
     interpret: bool = False,
 ) -> jnp.ndarray:
     """Drop-in for the gamma loop of ``lda_math._gamma_fixed_point``
-    (same [B, L, k] slab contract).  NOTE: the [B, L, k] -> [k, B, L]
-    relayout this wrapper performs is measured to cost more than the
-    kernel itself on TPU — hot paths should gather straight into
-    [k, B, L] (``gather_model_rows_kbl``) and call the _kbl variant; this
-    wrapper serves the scoring/eval paths where the slab is built once."""
-    return gamma_fixed_point_pallas_kbl(
-        jnp.transpose(eb, (2, 0, 1)), cts, alpha, gamma0,
+    (same [B, L, k] slab contract).  NOTE: the [B, L, k] -> [B, k, L]
+    minor-dim relayout this wrapper performs is measured to cost more
+    than the kernel itself on TPU — hot paths should gather straight
+    into [B, k, L] (``gather_model_rows_bkl``) and call the _bkl
+    variant; this wrapper serves the scoring/eval paths where the slab
+    is built once."""
+    return gamma_fixed_point_pallas_bkl(
+        jnp.transpose(eb, (0, 2, 1)), cts, alpha, gamma0,
         max_inner=max_inner, tol=tol, tile_b=tile_b, interpret=interpret,
     )

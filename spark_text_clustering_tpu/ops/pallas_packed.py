@@ -52,7 +52,9 @@ from .pallas_estep import digamma_approx
 
 __all__ = [
     "TilePlan",
+    "UniformTilePlan",
     "plan_tile_pack",
+    "plan_tile_pack_uniform",
     "gamma_fixed_point_tiles",
     "tile_gamma_to_docs",
     "docs_gamma_to_tiles",
@@ -62,6 +64,14 @@ __all__ = [
 # v5e cores have 16 MB VMEM less double-buffering headroom; 6 MB of
 # explicit blocks keeps Mosaic comfortable.
 _VMEM_TILE_BUDGET = 6 * 1024 * 1024
+
+# Mosaic block constraint: the last two dims of every block must be
+# (8, 128)-divisible or equal the full array dims.  gamma blocks are
+# (k, d) over [k, n_tiles*d], so the doc-slot width d must be a multiple
+# of 128 — also exactly the MXU contraction width the one-hot matmuls
+# ride (BENCH r4's first TPU child died on the padded kernel's 8-wide
+# gamma lane tile; this module never emits one).
+_MIN_TILE_DOCS = 128
 
 
 class TilePlan(NamedTuple):
@@ -93,6 +103,7 @@ def plan_tile_pack(
     b: int,
     tile_tokens: Optional[int] = None,
     max_docs: Optional[int] = None,
+    k: int = 0,
 ) -> Optional[TilePlan]:
     """Greedy first-fit of a doc-contiguous token stream into fixed
     [tt-token x d-doc] tiles with no document straddling a tile.
@@ -131,7 +142,7 @@ def plan_tile_pack(
         tiles.append((cur_docs, cur_tok))
     n_tiles = max(1, len(tiles))
     d = _pow2(max((len(dl) for dl, _ in tiles), default=1))
-    d = max(d, 8)  # sublane-friendly one-hot
+    d = max(d, _MIN_TILE_DOCS)  # Mosaic lane width for the gamma block
     # tiles with more docs than the pow2 rounding should carry are split
     # by the doc cap instead
     if max_docs is not None and d > max_docs:
@@ -150,8 +161,12 @@ def plan_tile_pack(
         if cur_docs:
             tiles.append((cur_docs, cur_tok))
         n_tiles = max(1, len(tiles))
-        d = max(8, _pow2(max((len(dl) for dl, _ in tiles), default=1)))
-    if (d + 2) * tt * 4 > _VMEM_TILE_BUDGET:
+        d = max(
+            _MIN_TILE_DOCS,
+            _pow2(max((len(dl) for dl, _ in tiles), default=1)),
+        )
+    # resident blocks: onehot [d, tt] + cts/seg + eb and et_tok [k, tt]
+    if (d + 2 + 2 * k) * tt * 4 > _VMEM_TILE_BUDGET:
         return None
 
     out_ids = np.zeros((n_tiles, tt), np.int32)
@@ -180,13 +195,109 @@ def plan_tile_pack(
     return TilePlan(out_ids, out_cts, out_seg, out_doc, tt, d, b)
 
 
+class UniformTilePlan(NamedTuple):
+    """``m`` minibatch tile plans sharing ONE static geometry
+    (tt, d, n_tiles) so a ``lax.scan`` training chunk compiles once.
+    Arrays are [m, n_tiles, tt] / [m, n_tiles, d]; pad tiles beyond a
+    batch's real tile count carry ``seg == d`` / ``doc_ids == b`` and
+    contribute exactly nothing."""
+
+    ids: np.ndarray      # [m, n_tiles, tt] int32
+    cts: np.ndarray      # [m, n_tiles, tt] float32
+    seg: np.ndarray      # [m, n_tiles, tt] int32 (== d for pad slots)
+    doc_ids: np.ndarray  # [m, n_tiles, d] int32 (== b for pad slots)
+    tt: int
+    d: int
+    n_tiles: int
+    b: int
+
+
+def plan_tile_pack_uniform(
+    batches,
+    b: int,
+    tile_tokens: Optional[int] = None,
+    n_tiles_multiple: int = 1,
+    k: int = 0,
+) -> Optional[UniformTilePlan]:
+    """Plan a CHUNK of packed minibatches with shared tile geometry.
+
+    ``batches`` is a sequence of (ids, cts, seg) doc-contiguous streams
+    over the same doc count ``b`` (one per training iteration of the
+    chunk).  Token width ``tt`` comes from the chunk's largest document,
+    the doc-slot width ``d`` from the fullest tile, and ``n_tiles`` from
+    the largest batch, rounded up to ``n_tiles_multiple`` (the data-shard
+    count, so the tile axis splits evenly over the mesh).  The per-tile
+    doc cap is pow2-floored to keep the kernel's one-hot inside the VMEM
+    budget even after ``plan_tile_pack``'s pow2-up rounding of d.
+
+    Returns None when no geometry fits (callers fall back to the XLA
+    segment loop for the whole fit).
+    """
+    batches = list(batches)
+    if not batches:
+        return None
+    max_nnz = 0
+    for ids, cts, seg in batches:
+        cts_a = np.asarray(cts)
+        seg_a = np.asarray(seg)
+        if cts_a.size:
+            counts = np.bincount(
+                seg_a[cts_a > 0].astype(np.int64), minlength=b
+            )
+            if counts.size:
+                max_nnz = max(max_nnz, int(counts.max()))
+    tt = tile_tokens or max(512, _pow2(max_nnz))
+    if max_nnz > tt:
+        return None
+    cap = _VMEM_TILE_BUDGET // (4 * tt) - 2 - 2 * k
+    if cap < _MIN_TILE_DOCS:
+        return None
+    cap = 1 << (cap.bit_length() - 1)  # pow2 floor: pow2-up(d) <= cap
+
+    plans = []
+    for ids, cts, seg in batches:
+        p = plan_tile_pack(
+            ids, cts, seg, b, tile_tokens=tt, max_docs=cap, k=k
+        )
+        if p is None:
+            return None
+        plans.append(p)
+
+    d = max(p.d for p in plans)
+    n_tiles = max(p.ids.shape[0] for p in plans)
+    n_tiles = (
+        (n_tiles + n_tiles_multiple - 1) // n_tiles_multiple
+    ) * n_tiles_multiple
+    if (d + 2 + 2 * k) * tt * 4 > _VMEM_TILE_BUDGET:
+        return None
+
+    m = len(plans)
+    out_ids = np.zeros((m, n_tiles, tt), np.int32)
+    out_cts = np.zeros((m, n_tiles, tt), np.float32)
+    out_seg = np.full((m, n_tiles, tt), d, np.int32)
+    out_doc = np.full((m, n_tiles, d), b, np.int32)
+    for j, p in enumerate(plans):
+        nt = p.ids.shape[0]
+        out_ids[j, :nt] = p.ids
+        out_cts[j, :nt] = p.cts
+        s = p.seg.copy()
+        s[s == p.d] = d  # re-point pad sentinel at the shared d
+        out_seg[j, :nt] = s
+        out_doc[j, :nt, : p.doc_ids.shape[1]] = p.doc_ids
+    return UniformTilePlan(out_ids, out_cts, out_seg, out_doc,
+                           tt, d, n_tiles, b)
+
+
 def _tiles_kernel(eb_ref, cts_ref, seg_ref, alpha_ref, gamma0_ref,
                   gamma_out_ref, *, d: int, max_inner: int, tol: float):
     """One tile: eb [k, tt] + the one-hot stay VMEM-resident across the
-    whole fixed point; segment ops are MXU matmuls against the one-hot."""
+    whole fixed point; segment ops are MXU matmuls against the one-hot.
+    cts/seg arrive as [1, 1, tt] blocks (the unit middle axis keeps the
+    trailing block dims Mosaic-legal: (1, tt) over a [n_tiles, 1, tt]
+    array has both trailing dims equal to the array's)."""
     eb = eb_ref[:]          # [k, tt]
-    cts = cts_ref[:]        # [1, tt]
-    seg = seg_ref[:]        # [1, tt] int32 (pad slots == d: no one-hot row)
+    cts = cts_ref[:].reshape(1, -1)  # [1, tt]
+    seg = seg_ref[:].reshape(1, -1)  # [1, tt] (pad slots == d)
     alpha = alpha_ref[:]    # [k, 1]
     gamma0 = gamma0_ref[:]  # [k, d]
 
@@ -266,15 +377,21 @@ def gamma_fixed_point_tiles(
         grid=(n_tiles,),
         in_specs=[
             pl.BlockSpec((k, tt), lambda i: (0, i)),
-            pl.BlockSpec((1, tt), lambda i: (i, 0)),
-            pl.BlockSpec((1, tt), lambda i: (i, 0)),
+            pl.BlockSpec((1, 1, tt), lambda i: (i, 0, 0)),
+            pl.BlockSpec((1, 1, tt), lambda i: (i, 0, 0)),
             pl.BlockSpec((k, 1), lambda i: (0, 0)),
             pl.BlockSpec((k, d), lambda i: (0, i)),
         ],
         out_specs=pl.BlockSpec((k, d), lambda i: (0, i)),
         out_shape=jax.ShapeDtypeStruct((k, n_tiles * d), jnp.float32),
         interpret=interpret,
-    )(eb_kt, cts, seg.astype(jnp.int32), alpha, gamma0)
+    )(
+        eb_kt,
+        cts.reshape(n_tiles, 1, tt),
+        seg.astype(jnp.int32).reshape(n_tiles, 1, tt),
+        alpha,
+        gamma0,
+    )
 
 
 def tile_gamma_to_docs(

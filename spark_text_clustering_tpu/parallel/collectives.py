@@ -29,8 +29,10 @@ __all__ = [
     "psum_model",
     "model_row_sum",
     "gather_model_rows",
+    "gather_model_rows_bkl",
     "gather_model_rows_kbl",
     "scatter_add_model_shard",
+    "scatter_add_model_shard_bkl",
     "scatter_add_model_shard_kbl",
     "data_shard_batch",
     "fetch_global",
@@ -97,6 +99,40 @@ def gather_model_rows_kbl(table_shard, ids):
     vals = jnp.take(table_shard, local, axis=1)           # [k, ...]
     vals = jnp.where(in_shard[None], vals, 0.0)
     return psum_model(vals)
+
+
+def gather_model_rows_bkl(table_shard, ids):
+    """``gather_model_rows`` in [B, k, L] layout for ids [B, L]: the
+    token axis stays LAST (128-lane dim on TPU), k rides sublanes, and
+    the batch axis leads — the block layout the Pallas E-step kernel
+    requires (Mosaic only accepts trailing block dims that are full or
+    (8, 128)-divisible; see ops/pallas_estep.py).  The leading-axes
+    permutation from the take's natural [k, B, L] folds into the
+    gather's output layout under XLA — unlike a minor-dim transpose it
+    costs no extra pass."""
+    shard_v = table_shard.shape[-1]
+    local, in_shard = _model_shard_local_ids(ids, shard_v)
+    local = jnp.clip(local, 0, shard_v - 1)
+    vals = jnp.take(table_shard, local, axis=1)           # [k, B, L]
+    vals = jnp.moveaxis(vals, 0, 1)                       # [B, k, L]
+    vals = jnp.where(in_shard[:, None, :], vals, 0.0)
+    return psum_model(vals)
+
+
+def scatter_add_model_shard_bkl(ids, vals, shard_v):
+    """``scatter_add_model_shard_kbl`` for [B, k, L] values (the Pallas
+    bkl layout): one scatter per topic row into [k, V/s]."""
+    k = vals.shape[1]
+    local, in_shard = _model_shard_local_ids(ids, shard_v)
+    local = jnp.where(in_shard, local, shard_v)           # overflow row
+    flat_ids = local.reshape(-1)
+    flat_vals = jnp.moveaxis(vals, 1, 0).reshape(k, -1)
+    out = jax.vmap(
+        lambda row: jnp.zeros((shard_v + 1,), jnp.float32)
+        .at[flat_ids]
+        .add(row)
+    )(flat_vals)
+    return out[:, :shard_v]
 
 
 def scatter_add_model_shard_kbl(ids, vals, shard_v):
