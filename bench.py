@@ -333,11 +333,12 @@ def _bench_em(lang: str = "EN", baseline: float = BASELINE_S_PER_ITER):
     params = Params(k=K, algorithm="em", max_iterations=ITERS, seed=0)
     opt = EMLDA(params, mesh=mesh)
 
-    # Warmup on the SAME optimizer instance with one full chunk (the fit
-    # loop scans checkpoint_interval=10 iterations per dispatch; warming
-    # the same static chunk length means the timed run hits the compile
-    # cache), then the timed 50-iter run.
-    opt.fit(rows, vocab, max_iterations=10)
+    # Warmup on the SAME optimizer instance with one FULL fit: the first
+    # pass pays jit compiles AND cold-transport costs (the chip sits
+    # behind a tunnel whose throughput ramps over the first few MB;
+    # measured: a first fit runs ~3-4x slower than the steady state the
+    # second reaches), then the timed 50-iter run hits both caches.
+    opt.fit(rows, vocab)
 
     t0 = time.perf_counter()
     model = opt.fit(rows, vocab)
@@ -408,10 +409,12 @@ def _bench_online():
     opt = OnlineLDA(params, mesh=mesh)
     vocab = [f"h{i}" for i in range(ONLINE_NUM_FEATURES)]
 
-    # Warmup one full scan chunk ON THE SAME INSTANCE (shares the cached
-    # jitted chunk fn, so the timed run hits the compile cache), then the
-    # timed run.
-    opt.fit(rows, vocab, max_iterations=10)
+    # Warmup ON THE SAME INSTANCE with one FULL fit: covers every chunk
+    # geometry, the packed-gamma autotune, jit compiles, and the
+    # tunnel's cold-transport ramp (measured ~3-4x slower first pass),
+    # then the timed run hits all caches — steady-state throughput, the
+    # regime the reference's long-running Spark jobs amortize into.
+    opt.fit(rows, vocab)
 
     t0 = time.perf_counter()
     model = opt.fit(rows, vocab)
@@ -540,6 +543,9 @@ def _bench_sklearn_baseline(rows, eval_rows, bsz: int):
         learning_decay=0.51,
         random_state=0,
     )
+    # symmetric warm-then-time protocol (our side warms compiles + the
+    # tunnel transport; sklearn warms BLAS threads + page cache)
+    lda.fit(x)
     t0 = time.perf_counter()
     lda.fit(x)
     t = time.perf_counter() - t0

@@ -94,6 +94,9 @@ def cmd_train(args: argparse.Namespace) -> int:
         data_shards=args.data_shards,
         model_shards=args.model_shards,
         keep_doc_topic_counts=bool(getattr(args, "export_mllib", False)),
+        record_iteration_times=bool(
+            getattr(args, "record_iteration_times", False)
+        ),
     )
 
     # ONE mesh shared by the device stages (IDF df-psum + LDA train):
@@ -459,9 +462,18 @@ def build_parser() -> argparse.ArgumentParser:
         "--algorithm", default="em", choices=["em", "online", "nmf"]
     )
     tr.add_argument(
-        "--sampling", default="fixed", choices=["fixed", "bernoulli", "epoch"],
-        help="online minibatch sampling: fixed-size round(f*N) or "
-             "MLlib's per-doc Bernoulli(f)",
+        "--sampling", default="bernoulli",
+        choices=["bernoulli", "fixed", "epoch"],
+        help="online minibatch sampling: MLlib's per-doc Bernoulli(f) "
+             "(default, semantics parity), fixed-size round(f*N), or "
+             "shuffled epochs",
+    )
+    tr.add_argument(
+        "--record-iteration-times", action="store_true",
+        help="force one dispatch + sync per iteration so the saved model "
+             "carries true per-iteration wall-time samples (MLlib "
+             "iterationTimes semantics) instead of interval means; costs "
+             "one host round trip per iteration",
     )
     tr.add_argument("--checkpoint-dir", default=None)
     tr.add_argument("--checkpoint-interval", type=int, default=10)

@@ -789,7 +789,10 @@ class EMLDA:
                 )
                 self._packed_fn_vocab = v
             run = self._packed_fn
-            interval = 1 if verbose else max(1, p.checkpoint_interval)
+            interval = (
+                1 if (verbose or p.record_iteration_times)
+                else max(1, p.checkpoint_interval)
+            )
             it = start_it
             while it < n_iters:
                 m = min(interval - (it % interval), n_iters - it)
@@ -871,7 +874,10 @@ class EMLDA:
                 (b.token_ids, b.token_weights) for b, _, _ in plan
             )
             n_dks = tuple(n_dk_list)
-            interval = max(1, p.checkpoint_interval)
+            interval = (
+                1 if p.record_iteration_times
+                else max(1, p.checkpoint_interval)
+            )
             it = start_it
             while it < n_iters:
                 m = min(interval - (it % interval), n_iters - it)

@@ -24,6 +24,7 @@ miniBatchFraction = 0.05 + 1/corpusSize (LDAClustering.scala:43).
 from __future__ import annotations
 
 import os
+import time
 from typing import Callable, List, NamedTuple, Optional, Sequence, Tuple
 
 import jax
@@ -779,6 +780,10 @@ class OnlineLDA:
         self._resident_chunk_fn = None
         self._packed_chunk_fn = None
         self._tiles_chunk_fns: dict = {}
+        # packed-path gamma loop choice: None until the first chunk's
+        # one-shot autotune (or an explicit STC_GAMMA_BACKEND) decides;
+        # then "tiles" | "xla" for every later chunk and repeat fit
+        self._packed_gamma_choice: Optional[str] = None
         self.last_batch_size: Optional[int] = None
         self.last_row_len: Optional[int] = None
         self.last_layout: str = "padded"
@@ -824,6 +829,11 @@ class OnlineLDA:
         # re-streams it from HBM per inner iteration.  Falls back to the
         # flat XLA path when no tile geometry fits the VMEM budget.
         use_tiles = _resolve_gamma_backend("auto") == "pallas"
+        # one corpus-wide token width: tt is a compile key of the tiles
+        # chunk, and per-chunk widths would recompile the scan whenever
+        # a chunk misses the longest document
+        doc_lens = offsets[1:] - offsets[:-1]
+        tile_tt = max(512, next_pow2(int(doc_lens.max() if n else 0)))
 
         def pack(pick):
             """One minibatch -> (ids [t], cts [t], seg [t], nonempty)."""
@@ -840,7 +850,10 @@ class OnlineLDA:
             return ids_t, cts_t, seg, float((lens > 0).sum())
 
         state = TrainState(lam, jnp.asarray(start_it, jnp.int32))
-        interval = 1 if verbose else max(1, p.checkpoint_interval)
+        interval = (
+            1 if (verbose or p.record_iteration_times)
+            else max(1, p.checkpoint_interval)
+        )
         it = start_it
         cells_sum = 0
         iters_run = 0
@@ -852,19 +865,19 @@ class OnlineLDA:
             self.last_layout = "packed"
 
             plan = None
-            if use_tiles:
+            if use_tiles and self._packed_gamma_choice != "xla":
                 from ..ops.pallas_packed import plan_tile_pack_uniform
 
                 plan = plan_tile_pack_uniform(
                     [(i_, c_, s_) for i_, c_, s_, _ in packs],
-                    b=picks.shape[1], n_tiles_multiple=n_data, k=k,
+                    b=picks.shape[1], tile_tokens=tile_tt,
+                    n_tiles_multiple=n_data, k=k,
                 )
                 if plan is None:
                     use_tiles = False  # geometry over budget: whole fit
                     #                    falls back to the flat XLA loop
 
-            if plan is not None:
-                self.last_gamma_backend = "pallas_tiles"
+            def dispatch_tiles(st):
                 fn = self._tiles_chunk_fns.get(plan.d)
                 if fn is None:
                     fn = make_online_packed_tiles_chunk(
@@ -874,12 +887,9 @@ class OnlineLDA:
                         interpret=jax.default_backend() != "tpu",
                     )
                     self._tiles_chunk_fns[plan.d] = fn
-                cells_sum += plan.n_tiles * plan.tt * m
-                iters_run += m
-                self.last_batch_cells = cells_sum // iters_run
-                timer.start()
-                state = fn(
-                    state,
+                t0 = time.perf_counter()
+                out = fn(
+                    st,
                     jax.device_put(plan.ids, tile_spec),
                     jax.device_put(plan.cts, tile_spec),
                     jax.device_put(plan.seg, tile_spec),
@@ -888,50 +898,86 @@ class OnlineLDA:
                     jax.device_put(bds, rep),
                     float(n),
                 )
-                state.lam.block_until_ready()
-                timer.stop()
+                out.lam.block_until_ready()
+                return out, time.perf_counter() - t0
+
+            def dispatch_flat(st):
+                t_pad = next_pow2(max(8, max(pp[0].size for pp in packs)))
+                t_pad = ((t_pad + n_data - 1) // n_data) * n_data
+                tok_ids = np.zeros((m, t_pad), np.int32)
+                tok_cts = np.zeros((m, t_pad), np.float32)
+                tok_seg = np.zeros((m, t_pad), np.int32)
+                for j, (ids_t, cts_t, seg, _) in enumerate(packs):
+                    tok_ids[j, : ids_t.size] = ids_t
+                    tok_cts[j, : cts_t.size] = cts_t
+                    tok_seg[j, : seg.size] = seg
+                t0 = time.perf_counter()
+                out = self._packed_chunk_fn(
+                    st,
+                    jax.device_put(tok_ids, tok_spec),
+                    jax.device_put(tok_cts, tok_spec),
+                    jax.device_put(tok_seg, tok_spec),
+                    jax.device_put(picks, rep),
+                    jax.device_put(bds, rep),
+                    float(n),
+                )
+                out.lam.block_until_ready()
+                return out, time.perf_counter() - t0, t_pad
+
+            if plan is not None and self._packed_gamma_choice is None:
+                env_forced = os.environ.get("STC_GAMMA_BACKEND", "")
+                if env_forced == "pallas":
+                    # explicit override: no autotune, always the kernel
+                    self._packed_gamma_choice = "tiles"
+                else:
+                    # One-shot autotune (platform default resolved to the
+                    # kernel): which gamma loop wins is workload-dependent
+                    # — the VMEM-resident tile kernel amortizes HBM
+                    # restreaming and wins on fat slabs, the XLA segment
+                    # loop wins on small latency-bound batches (measured
+                    # both ways on a v5e).  Run this chunk through both
+                    # paths — first dispatch warms the compile, second is
+                    # timed — and keep the faster for the rest of the fit.
+                    _, _ = dispatch_tiles(state)[:2]
+                    _t_st, t_tiles = dispatch_tiles(state)
+                    dispatch_flat(state)
+                    _f_st, t_flat, _ = dispatch_flat(state)
+                    self._packed_gamma_choice = (
+                        "tiles" if t_tiles <= t_flat else "xla"
+                    )
+                    if verbose:
+                        print(
+                            f"packed gamma autotune: tiles {t_tiles:.3f}s"
+                            f" vs xla {t_flat:.3f}s ->"
+                            f" {self._packed_gamma_choice}"
+                        )
+
+            if plan is not None and self._packed_gamma_choice == "tiles":
+                self.last_gamma_backend = "pallas_tiles"
+                cells_sum += plan.n_tiles * plan.tt * m
+                iters_run += m
+                self.last_batch_cells = cells_sum // iters_run
+                state, elapsed = dispatch_tiles(state)
+                timer.times.append(elapsed)
                 if m > 1:
                     timer.split_last(m)
                 if verbose:
                     print(f"iter {it}: {timer.times[-1]:.3f}s "
                           "(packed/pallas-tiles)")
-                it += m
-                if ckpt_path and it % max(1, p.checkpoint_interval) == 0:
-                    save_checkpoint(it, state.lam)
-                continue
-
-            self.last_gamma_backend = "xla"
-            t_pad = next_pow2(max(8, max(pp[0].size for pp in packs)))
-            t_pad = ((t_pad + n_data - 1) // n_data) * n_data
-            tok_ids = np.zeros((m, t_pad), np.int32)
-            tok_cts = np.zeros((m, t_pad), np.float32)
-            tok_seg = np.zeros((m, t_pad), np.int32)
-            for j, (ids_t, cts_t, seg, bd) in enumerate(packs):
-                tok_ids[j, : ids_t.size] = ids_t
-                tok_cts[j, : cts_t.size] = cts_t
-                tok_seg[j, : seg.size] = seg
-            cells_sum += t_pad * m
-            iters_run += m
-            # iteration-weighted mean cells: chunks may land on different
-            # pow2 budgets, and the bench's roofline must not scale the
-            # whole run by one chunk's width
-            self.last_batch_cells = cells_sum // iters_run
-            timer.start()
-            state = self._packed_chunk_fn(
-                state,
-                jax.device_put(tok_ids, tok_spec),
-                jax.device_put(tok_cts, tok_spec),
-                jax.device_put(tok_seg, tok_spec),
-                jax.device_put(picks, rep),
-                jax.device_put(bds, rep),
-                float(n),
-            )
-            state.lam.block_until_ready()
-            timer.stop()
-            if m > 1:
-                timer.split_last(m)
-            if verbose:
-                print(f"iter {it}: {timer.times[-1]:.3f}s (packed)")
+            else:
+                self.last_gamma_backend = "xla"
+                state, elapsed, t_pad = dispatch_flat(state)
+                cells_sum += t_pad * m
+                iters_run += m
+                # iteration-weighted mean cells: chunks may land on
+                # different pow2 budgets, and the bench's roofline must
+                # not scale the whole run by one chunk's width
+                self.last_batch_cells = cells_sum // iters_run
+                timer.times.append(elapsed)
+                if m > 1:
+                    timer.split_last(m)
+                if verbose:
+                    print(f"iter {it}: {timer.times[-1]:.3f}s (packed)")
             it += m
             if ckpt_path and it % max(1, p.checkpoint_interval) == 0:
                 save_checkpoint(it, state.lam)
@@ -1178,7 +1224,10 @@ class OnlineLDA:
                         kappa=p.kappa, k=k, gamma_shape=p.gamma_shape,
                         seed=p.seed,
                     )
-                interval = max(1, p.checkpoint_interval)
+                interval = (
+                    1 if p.record_iteration_times
+                    else max(1, p.checkpoint_interval)
+                )
                 it = start_it
                 while it < n_iters:
                     m = min(interval - (it % interval), n_iters - it)

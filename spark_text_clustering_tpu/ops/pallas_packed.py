@@ -265,6 +265,15 @@ def plan_tile_pack_uniform(
 
     d = max(p.d for p in plans)
     n_tiles = max(p.ids.shape[0] for p in plans)
+    # pow2-round the tile count, then the shard multiple: the tile axis
+    # is a jit compile key (the training chunk scans over [m, n_tiles,
+    # tt] tensors), and successive chunks drawing slightly different
+    # minibatches must land on ONE compiled executable, not a fresh
+    # ~seconds-long compile per chunk (measured: per-chunk recompiles
+    # cost 4x the whole online bench).  Pad tiles are all-pad-slot and
+    # early-exit after ~2 kernel iterations — the padding is cheap, the
+    # compile is not.
+    n_tiles = _pow2(n_tiles)
     n_tiles = (
         (n_tiles + n_tiles_multiple - 1) // n_tiles_multiple
     ) * n_tiles_multiple
