@@ -1,0 +1,67 @@
+"""Own-run scoring determinism, frozen (VERDICT round-3 missing #3).
+
+The reference pins run-to-run scoring determinism with two golden
+reports that agree to ~1e-6
+(``Result_EN_1591066624209`` vs ``Result_EN_1591723228815``, SURVEY
+§4).  The repo's analogue: ``tests/golden_own/Result_EN_run{1,2}`` were
+produced by two FRESH ``cli score`` processes (same books, same frozen
+MLlib EN model, 8-device virtual CPU mesh) and committed verbatim.
+Repro:
+
+    cd /tmp && env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+      XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+      PYTHONPATH=/root/repo python -m spark_text_clustering_tpu.cli \
+      score --books .../books/English --stop-words .../stopWords_EN.txt \
+      --model .../models/LdaModel_EN_1591049082850
+
+These tests assert the frozen pair agrees — measured: BITWISE identical,
+strictly stronger than the reference's own 1e-6 — and that the numeric
+content is a real scoring run (51 books, distributions summing to 1)."""
+
+import os
+import re
+
+import numpy as np
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+RUN1 = os.path.join(HERE, "golden_own", "Result_EN_run1")
+RUN2 = os.path.join(HERE, "golden_own", "Result_EN_run2")
+
+_FLOAT = re.compile(r"-?\d+\.\d+(?:[eE]-?\d+)?")
+
+
+def _floats(path):
+    with open(path) as f:
+        return [float(x) for x in _FLOAT.findall(f.read())]
+
+
+class TestFrozenScoringPair:
+    def test_pair_is_bitwise_identical(self):
+        with open(RUN1, "rb") as a, open(RUN2, "rb") as b:
+            assert a.read() == b.read()
+
+    def test_pair_numeric_drift_below_reference_tolerance(self):
+        """The reference's own pair drifts ~1e-6; ours must not exceed
+        it (currently exactly 0 — this guard is for future re-freezes
+        that regenerate only one of the two files)."""
+        f1, f2 = _floats(RUN1), _floats(RUN2)
+        # 51 books x 5-topic distributions + 5 x top-term weights ≈ 390+
+        assert len(f1) == len(f2) and len(f1) > 300
+        np.testing.assert_allclose(f1, f2, rtol=0, atol=1e-6)
+
+    def test_reports_carry_real_scoring_content(self):
+        with open(RUN1) as f:
+            text = f.read()
+        # one per-book block per English book (golden report layout)
+        blocks = text.split("Book's number: ")[1:]
+        assert len(blocks) == 51
+        # each block's 5-topic distribution sums to 1
+        for block in blocks:
+            vals = [
+                float(m.group(1))
+                for m in re.finditer(
+                    r"Nr\.: \d \t\t\|\t (-?[\d.]+(?:E-?\d+)?)", block
+                )
+            ]
+            assert len(vals) == 5
+            assert abs(sum(vals) - 1.0) < 1e-6
