@@ -104,6 +104,13 @@ class Params:
     # interval means.  Costs one host round trip per iteration (~85 ms
     # over a tunnel) — an observability switch, not a training default.
     record_iteration_times: bool = False
+    # Host-staging budget for one training dispatch.  With no
+    # checkpointing and no per-iteration observability the chunked loops
+    # scan the WHOLE remaining run in one dispatch (models/dispatch.py);
+    # paths that ship per-iteration input tensors (packed online
+    # minibatches) cap the chunk so the staged host block stays under
+    # this many bytes.  Corpus-resident loops ignore it.
+    dispatch_budget_bytes: int = 256 << 20
     # EM only: assemble and retain the full [n_docs, k] doc-topic counts
     # on the host after fit — needed by the MLlib-format export's doc
     # vertices (reference_export), costs one device->host fetch per

@@ -55,6 +55,18 @@ class LDAModel:
         default_factory=dict, init=False, repr=False, compare=False
     )
 
+    def ensure_host(self) -> None:
+        """Materialize ``lam`` to host numpy IN PLACE (idempotent).
+
+        Fits hand over a device-resident ``lam`` in single-process runs
+        (collectives.model_handoff) — the framework's training->scoring
+        pipelines then stay on-chip, and the one-time device->host
+        download happens here, on the first host-side consumer
+        (topics_matrix / save / export), not inside the timed fit.
+        """
+        if not isinstance(self.lam, np.ndarray):
+            self.lam = np.asarray(jax.device_get(self.lam))
+
     # ---- shape accessors (MLlib: model.k, model.vocabSize) -------------
     @property
     def k(self) -> int:
@@ -68,6 +80,7 @@ class LDAModel:
     def topics_matrix(self) -> np.ndarray:
         """Row-normalized topic-term distributions [k, V] (MLlib's
         ``topicsMatrix`` is column-major V x k; we keep [k, V])."""
+        self.ensure_host()
         lam = np.asarray(self.lam, np.float64)
         return lam / lam.sum(axis=1, keepdims=True)
 
@@ -102,16 +115,33 @@ class LDAModel:
     def _safe_lam(self) -> jnp.ndarray:
         return jnp.maximum(jnp.asarray(self.lam, jnp.float32), self._LAM_FLOOR)
 
+    def _lam_for_bound(self) -> jnp.ndarray:
+        """Lambda the VB bound is evaluated at.
+
+        Online-VB lambdas are Dirichlet parameters already (>= eta > 0).
+        MAP-EM count matrices contain exact zeros, where the bound's
+        E[log beta] terms diverge (digamma(floor) ~ -1e30; round-4 TPU
+        drive: ``logLikelihood`` on an EM model returned -7e32), so EM
+        models evaluate at the posterior Dirichlet parameter N_wk + eta
+        — the same eta-smoothing MLlib's computePTopic applies in
+        training.  Scoring (``topic_distribution``) is untouched: the
+        golden-report parity pins its unsmoothed behavior.
+        """
+        if self.algorithm == "em":
+            return jnp.asarray(self.lam, jnp.float32) + float(self.eta)
+        return self._safe_lam()
+
     def _exp_elog_beta(self) -> jnp.ndarray:
         return jnp.exp(dirichlet_expectation(self._safe_lam()))
 
-    def _lam_on_mesh(self, mesh) -> jnp.ndarray:
+    def _lam_on_mesh(self, mesh, smoothed: bool = False) -> jnp.ndarray:
         """lambda zero-padded to a model-shard multiple and placed V-sharded
         over "model" — the input every mesh-backed scoring/eval fn takes.
         Pad columns are masked out inside those fns (sharded_eval).  Cached
         per mesh: models are immutable after fit, and re-uploading [k, V]
-        per scoring bucket would dominate the scoring cost."""
-        key = ("lam_on_mesh", mesh)
+        per scoring bucket would dominate the scoring cost.  ``smoothed``
+        places ``_lam_for_bound()`` instead (EM bound evaluation)."""
+        key = ("lam_on_mesh", smoothed, mesh)
         lam_dev = self._fn_cache.get(key)
         if lam_dev is None:
             from ..parallel.mesh import MODEL_AXIS, model_sharding
@@ -119,10 +149,16 @@ class LDAModel:
             s = mesh.shape[MODEL_AXIS]
             v = self.vocab_size
             v_pad = ((v + s - 1) // s) * s
-            lam = np.asarray(self.lam, np.float32)
+            # jnp end-to-end: a device-backed lam (single-process fit
+            # handoff) pads and reshards on device, no host round trip
+            lam = (
+                self._lam_for_bound()
+                if smoothed
+                else jnp.asarray(self.lam, jnp.float32)
+            )
             if v_pad != v:
-                lam = np.pad(lam, ((0, 0), (0, v_pad - v)))
-            lam_dev = jax.device_put(jnp.asarray(lam), model_sharding(mesh))
+                lam = jnp.pad(lam, ((0, 0), (0, v_pad - v)))
+            lam_dev = jax.device_put(lam, model_sharding(mesh))
             self._fn_cache[key] = lam_dev
         return lam_dev
 
@@ -339,11 +375,14 @@ class LDAModel:
         key = None if seed is None else jax.random.PRNGKey(seed)
         gamma0 = init_gamma(key, batch.num_docs, self.k, self.gamma_shape)
         alpha = jnp.asarray(self.alpha, jnp.float32)
-        gamma = infer_gamma(batch, self._exp_elog_beta(), alpha, gamma0)
+        lam_b = self._lam_for_bound()
+        gamma = infer_gamma(
+            batch, jnp.exp(dirichlet_expectation(lam_b)), alpha, gamma0
+        )
         bound = approx_bound(
             batch,
             gamma,
-            self._safe_lam(),
+            lam_b,
             alpha,
             float(self.eta),
             corpus_size=n_docs,
@@ -359,7 +398,8 @@ class LDAModel:
         gamma0 = init_gamma(key, batch.num_docs, self.k, self.gamma_shape)
         sharded, gamma0 = self._pad_and_place_gamma0(mesh, batch, gamma0)
         bound = loglik(
-            self._lam_on_mesh(mesh), sharded, gamma0, n_docs, n_docs
+            self._lam_on_mesh(mesh, smoothed=self.algorithm == "em"),
+            sharded, gamma0, n_docs, n_docs,
         )
         return float(np.asarray(jax.device_get(bound)))
 
@@ -377,6 +417,7 @@ class LDAModel:
     def save(self, path: str) -> None:
         from .persistence import save_model
 
+        self.ensure_host()
         save_model(self, path)
 
     @classmethod

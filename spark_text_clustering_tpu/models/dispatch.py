@@ -1,0 +1,43 @@
+"""Dispatch-granularity policy for the chunked training loops.
+
+Every optimizer here runs its iterations as ``lax.scan`` chunks with one
+host dispatch + device sync per chunk (SURVEY.md §7 hard part 3: the
+reference's Spark driver pays a scheduler round trip per aggregate; ours
+pays a network round trip per dispatch when the accelerator sits behind
+a tunnel).  The chunk length used to be pinned to
+``Params.checkpoint_interval`` even when no checkpointing was active —
+measured on the round-4 TPU tunnel, the 60-iteration online bench fit
+spent ~7s of a 9-10s wall on the five extra round trips that pinning
+caused.  The policy:
+
+* per-iteration observability asked for (``verbose`` or
+  ``Params.record_iteration_times``) -> 1 iteration per dispatch;
+* checkpointing active -> ``checkpoint_interval``, still capped by the
+  staging budget (a budget-capped interval checkpoints MORE often than
+  asked — the loops' save guards key on the resolved interval);
+* otherwise -> the WHOLE remaining run as one dispatch, capped by
+  ``Params.dispatch_budget_bytes`` for loops that stage per-iteration
+  input tensors (the packed online path ships each chunk's minibatches
+  to the device; corpus-resident loops stage nothing and pass 0).
+"""
+
+from __future__ import annotations
+
+__all__ = ["resolve_dispatch_interval"]
+
+
+def resolve_dispatch_interval(
+    p,
+    *,
+    ckpt_path,
+    verbose: bool,
+    n_iters: int,
+    bytes_per_iter: int = 0,
+) -> int:
+    """Iterations one device dispatch should cover (>= 1)."""
+    if verbose or p.record_iteration_times:
+        return 1
+    cap = max(1, p.checkpoint_interval) if ckpt_path else max(1, n_iters)
+    if bytes_per_iter > 0:
+        cap = min(cap, max(1, p.dispatch_budget_bytes // bytes_per_iter))
+    return cap

@@ -57,6 +57,7 @@ from ..parallel.mesh import (
 )
 from ..utils.timing import IterationTimer
 from .base import LDAModel
+from .dispatch import resolve_dispatch_interval
 from .persistence import load_train_state, save_train_state
 
 __all__ = [
@@ -739,6 +740,9 @@ class EMLDA:
 
         timer = IterationTimer()
         self.last_layout = "padded"
+        # device dispatches this fit issued (tests pin the whole-run
+        # chunking: no checkpointing -> one dispatch per phase)
+        self.last_dispatches = 0
         if use_packed:
             # Token-packed sweeps (make_em_packed_runner): one scan
             # dispatch per interval over flat doc-contiguous token
@@ -789,14 +793,15 @@ class EMLDA:
                 )
                 self._packed_fn_vocab = v
             run = self._packed_fn
-            interval = (
-                1 if (verbose or p.record_iteration_times)
-                else max(1, p.checkpoint_interval)
+            # packed corpus is device-resident: dispatches stage nothing
+            interval = resolve_dispatch_interval(
+                p, ckpt_path=ckpt_path, verbose=verbose, n_iters=n_iters,
             )
             it = start_it
             while it < n_iters:
                 m = min(interval - (it % interval), n_iters - it)
                 timer.start()
+                self.last_dispatches += 1
                 n_wk, n_dk_dev = run(
                     n_wk, n_dk_dev, ids_dev, cts_dev, seg_dev, m
                 )
@@ -807,7 +812,7 @@ class EMLDA:
                 if verbose:
                     print(f"EM iter {it}: {timer.times[-1]:.3f}s (packed)")
                 it += m
-                if ckpt_path and it % max(1, p.checkpoint_interval) == 0:
+                if ckpt_path and it % interval == 0:
                     # layout-agnostic checkpoint: reorder packed rows
                     # back to global doc order
                     n_wk_host = fetch_global(n_wk)
@@ -874,14 +879,15 @@ class EMLDA:
                 (b.token_ids, b.token_weights) for b, _, _ in plan
             )
             n_dks = tuple(n_dk_list)
-            interval = (
-                1 if p.record_iteration_times
-                else max(1, p.checkpoint_interval)
+            # bucketed corpus already on device: dispatches stage nothing
+            interval = resolve_dispatch_interval(
+                p, ckpt_path=ckpt_path, verbose=False, n_iters=n_iters,
             )
             it = start_it
             while it < n_iters:
                 m = min(interval - (it % interval), n_iters - it)
                 timer.start()
+                self.last_dispatches += 1
                 n_wk, n_dks = run_chunk(n_wk, n_dks, bucket_arrays, m)
                 n_wk.block_until_ready()
                 timer.stop()

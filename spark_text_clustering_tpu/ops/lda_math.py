@@ -281,7 +281,7 @@ def token_sstats_factors_segments(
     return et_tok * (cts / phinorm)[:, None]
 
 
-@partial(jax.jit, static_argnames=("max_inner", "vocab_size", "backend"))
+@partial(jax.jit, static_argnames=("max_inner", "tol", "vocab_size", "backend"))
 def e_step(
     batch: DocTermBatch,
     exp_elog_beta: jnp.ndarray,   # [k, V]
@@ -311,7 +311,10 @@ def e_step(
     return EStepResult(gamma, sstats_vt.T, iters)
 
 
-@partial(jax.jit, static_argnames=("max_inner", "backend"))
+# tol static: it reaches the Pallas kernel closure on TPU, and a traced
+# scalar there is a captured constant pallas_call rejects (the CPU tests
+# run interpret mode, which tolerates it — only the real chip catches it)
+@partial(jax.jit, static_argnames=("max_inner", "tol", "backend"))
 def infer_gamma(
     batch: DocTermBatch,
     exp_elog_beta: jnp.ndarray,
@@ -330,7 +333,7 @@ def infer_gamma(
     return gamma
 
 
-@partial(jax.jit, static_argnames=("max_inner", "backend"))
+@partial(jax.jit, static_argnames=("max_inner", "tol", "backend"))
 def topic_inference(
     batch: DocTermBatch,
     exp_elog_beta: jnp.ndarray,

@@ -126,44 +126,36 @@ def plan_tile_pack(
     tt = tile_tokens or max(512, _pow2(max_nnz))
     if max_nnz > tt:
         return None
-    # greedy walk in doc order: close the tile when the next doc's
-    # tokens no longer fit
-    tiles: list = []  # (doc list, token count)
-    cur_docs: list = []
-    cur_tok = 0
-    for doc in range(b):
-        c = int(counts[doc])
-        if cur_docs and cur_tok + c > tt:
-            tiles.append((cur_docs, cur_tok))
-            cur_docs, cur_tok = [], 0
-        cur_docs.append(doc)
-        cur_tok += c
-    if cur_docs:
-        tiles.append((cur_docs, cur_tok))
-    n_tiles = max(1, len(tiles))
-    d = _pow2(max((len(dl) for dl, _ in tiles), default=1))
+    # greedy first-fit in doc order, one searchsorted per TILE (not per
+    # doc): tile ti takes the longest doc run whose token sum stays
+    # within tt — the last fence j with cum[j] - cum[i] <= tt
+    cum = np.zeros(b + 1, np.int64)
+    np.cumsum(counts, out=cum[1:])
+
+    def fences(doc_cap: Optional[int]) -> np.ndarray:
+        out = [0]
+        i = 0
+        while i < b:
+            j = int(np.searchsorted(cum, cum[i] + tt, side="right")) - 1
+            j = max(j, i + 1)  # max_nnz <= tt, so this only pads empties
+            if doc_cap is not None:
+                j = min(j, i + doc_cap)
+            out.append(j)
+            i = j
+        return np.asarray(out, np.int64)
+
+    fence = fences(None)
+    n_tiles = max(1, len(fence) - 1)
+    d = _pow2(int(np.diff(fence).max()) if len(fence) > 1 else 1)
     d = max(d, _MIN_TILE_DOCS)  # Mosaic lane width for the gamma block
     # tiles with more docs than the pow2 rounding should carry are split
     # by the doc cap instead
     if max_docs is not None and d > max_docs:
-        # re-plan with the doc cap active
-        tiles = []
-        cur_docs, cur_tok = [], 0
-        for doc in range(b):
-            c = int(counts[doc])
-            if cur_docs and (
-                cur_tok + c > tt or len(cur_docs) >= max_docs
-            ):
-                tiles.append((cur_docs, cur_tok))
-                cur_docs, cur_tok = [], 0
-            cur_docs.append(doc)
-            cur_tok += c
-        if cur_docs:
-            tiles.append((cur_docs, cur_tok))
-        n_tiles = max(1, len(tiles))
+        fence = fences(max_docs)
+        n_tiles = max(1, len(fence) - 1)
         d = max(
             _MIN_TILE_DOCS,
-            _pow2(max((len(dl) for dl, _ in tiles), default=1)),
+            _pow2(int(np.diff(fence).max()) if len(fence) > 1 else 1),
         )
     # resident blocks: onehot [d, tt] + cts/seg + eb and et_tok [k, tt]
     if (d + 2 + 2 * k) * tt * 4 > _VMEM_TILE_BUDGET:
@@ -174,24 +166,23 @@ def plan_tile_pack(
     out_seg = np.full((n_tiles, tt), d, np.int32)
     out_doc = np.full((n_tiles, d), b, np.int32)
 
-    # token ranges per doc in the (nondecreasing) input stream; zero-ct
-    # pad slots in the INPUT are dropped (their doc attribution is
-    # arbitrary by the packed-layout contract)
+    # zero-ct pad slots in the INPUT are dropped (their doc attribution
+    # is arbitrary by the packed-layout contract); the live stream stays
+    # doc-contiguous and nondecreasing, so each tile's tokens are ONE
+    # contiguous slice and its doc slots one arange
     live = cts > 0
     ids_l, cts_l, seg_l = ids[live], cts[live], seg[live]
-    starts = np.searchsorted(seg_l, np.arange(b), side="left")
-    ends = np.searchsorted(seg_l, np.arange(b), side="right")
+    tok_fence = np.searchsorted(seg_l, np.arange(b + 1), side="left")
 
-    for ti, (doc_list, _) in enumerate(tiles):
-        pos = 0
-        for li, doc in enumerate(doc_list):
-            out_doc[ti, li] = doc
-            s, e = int(starts[doc]), int(ends[doc])
-            n = e - s
-            out_ids[ti, pos:pos + n] = ids_l[s:e]
-            out_cts[ti, pos:pos + n] = cts_l[s:e]
-            out_seg[ti, pos:pos + n] = li
-            pos += n
+    # b == 0: fence is just [0] — the loop runs zero times and the
+    # single tile stays all-pad (the shape contract callers rely on)
+    for ti in range(len(fence) - 1):
+        f0, f1 = int(fence[ti]), int(fence[ti + 1])
+        s, e = int(tok_fence[f0]), int(tok_fence[f1])
+        out_ids[ti, : e - s] = ids_l[s:e]
+        out_cts[ti, : e - s] = cts_l[s:e]
+        out_seg[ti, : e - s] = seg_l[s:e] - f0
+        out_doc[ti, : f1 - f0] = np.arange(f0, f1)
     return TilePlan(out_ids, out_cts, out_seg, out_doc, tt, d, b)
 
 

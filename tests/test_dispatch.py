@@ -1,0 +1,148 @@
+"""Dispatch-granularity policy (models/dispatch.py).
+
+Every chunked training loop pays one host round trip per dispatch —
+behind the TPU tunnel a round trip is milliseconds-to-seconds, so with
+no checkpointing and no per-iteration observability the whole run must
+compile into ONE dispatch (round-4 measurement: the 60-iteration online
+bench fit spent ~7s of a 9-10s wall on checkpoint_interval-pinned
+chunking).  These tests pin the policy function and the end-to-end
+dispatch counts of both optimizers.
+"""
+
+import numpy as np
+import pytest
+
+from spark_text_clustering_tpu.config import Params
+from spark_text_clustering_tpu.models.dispatch import (
+    resolve_dispatch_interval,
+)
+
+
+class TestPolicy:
+    def test_verbose_forces_per_iteration(self):
+        p = Params()
+        assert resolve_dispatch_interval(
+            p, ckpt_path=None, verbose=True, n_iters=50
+        ) == 1
+
+    def test_record_iteration_times_forces_per_iteration(self):
+        p = Params(record_iteration_times=True)
+        assert resolve_dispatch_interval(
+            p, ckpt_path=None, verbose=False, n_iters=50
+        ) == 1
+
+    def test_checkpointing_pins_checkpoint_interval(self):
+        p = Params(checkpoint_interval=7)
+        assert resolve_dispatch_interval(
+            p, ckpt_path="/tmp/x.npz", verbose=False, n_iters=50
+        ) == 7
+
+    def test_no_observability_covers_whole_run(self):
+        p = Params(checkpoint_interval=10)
+        assert resolve_dispatch_interval(
+            p, ckpt_path=None, verbose=False, n_iters=50
+        ) == 50
+
+    def test_budget_caps_staged_bytes(self):
+        p = Params(dispatch_budget_bytes=1000)
+        assert resolve_dispatch_interval(
+            p, ckpt_path=None, verbose=False, n_iters=50,
+            bytes_per_iter=300,
+        ) == 3
+
+    def test_budget_never_below_one(self):
+        p = Params(dispatch_budget_bytes=10)
+        assert resolve_dispatch_interval(
+            p, ckpt_path=None, verbose=False, n_iters=50,
+            bytes_per_iter=1 << 20,
+        ) == 1
+
+
+def _rows(rng, n_docs=48, v=64):
+    rows = []
+    for _ in range(n_docs):
+        nnz = int(rng.integers(3, 9))
+        ids = rng.choice(v, size=nnz, replace=False).astype(np.int32)
+        cts = rng.integers(1, 4, size=nnz).astype(np.float32)
+        rows.append((ids, cts))
+    return rows
+
+
+@pytest.fixture(scope="module")
+def corpus():
+    rng = np.random.default_rng(7)
+    return _rows(rng), [f"t{i}" for i in range(64)]
+
+
+class TestFitDispatchCounts:
+    def test_online_packed_whole_run_is_one_dispatch(self, corpus):
+        from spark_text_clustering_tpu.models.online_lda import OnlineLDA
+
+        rows, vocab = corpus
+        p = Params(
+            k=3, algorithm="online", max_iterations=12,
+            checkpoint_interval=4, token_layout="packed", seed=0,
+        )
+        opt = OnlineLDA(p)
+        opt.fit(rows, vocab)
+        assert opt.last_layout == "packed"
+        assert opt.last_dispatches == 1
+
+    def test_online_resident_whole_run_is_one_dispatch(self, corpus):
+        from spark_text_clustering_tpu.models.online_lda import OnlineLDA
+
+        rows, vocab = corpus
+        p = Params(
+            k=3, algorithm="online", max_iterations=12,
+            checkpoint_interval=4, token_layout="padded",
+            device_resident=True, seed=0,
+        )
+        opt = OnlineLDA(p)
+        opt.fit(rows, vocab)
+        assert opt.last_dispatches == 1
+
+    def test_online_checkpointing_still_chunks(self, corpus, tmp_path):
+        from spark_text_clustering_tpu.models.online_lda import OnlineLDA
+
+        rows, vocab = corpus
+        p = Params(
+            k=3, algorithm="online", max_iterations=12,
+            checkpoint_interval=4, token_layout="packed", seed=0,
+            checkpoint_dir=str(tmp_path),
+        )
+        opt = OnlineLDA(p)
+        opt.fit(rows, vocab)
+        assert opt.last_dispatches == 3  # 12 iters / interval 4
+
+    def test_em_whole_run_is_one_dispatch(self, corpus):
+        from spark_text_clustering_tpu.models.em_lda import EMLDA
+
+        rows, vocab = corpus
+        for layout in ("padded", "packed"):
+            p = Params(
+                k=3, algorithm="em", max_iterations=12,
+                checkpoint_interval=4, token_layout=layout, seed=0,
+            )
+            opt = EMLDA(p)
+            opt.fit(rows, vocab)
+            assert opt.last_dispatches == 1, layout
+
+    def test_dispatch_chunking_does_not_change_the_model(self, corpus):
+        """One whole-run dispatch and per-checkpoint-interval chunking
+        must produce identical models (the scan body is the same)."""
+        from spark_text_clustering_tpu.models.online_lda import OnlineLDA
+
+        rows, vocab = corpus
+        lams = []
+        for budget in (None, 1):  # None -> 1 dispatch; 1 byte -> 12
+            kw = dict(
+                k=3, algorithm="online", max_iterations=12,
+                token_layout="packed", seed=0,
+            )
+            if budget is not None:
+                kw["dispatch_budget_bytes"] = budget
+            opt = OnlineLDA(Params(**kw))
+            m = opt.fit(rows, vocab)
+            lams.append(np.asarray(m.lam))
+        assert lams[0].shape == lams[1].shape
+        np.testing.assert_allclose(lams[0], lams[1], rtol=1e-5, atol=1e-6)

@@ -50,6 +50,22 @@ class TestEMLDA:
         assert (lo > 0.85).any() and (lo < 0.15).any()
         assert model.algorithm == "em"
 
+    def test_model_log_likelihood_finite_on_map_counts(
+        self, tiny_corpus_rows
+    ):
+        """MAP-EM count matrices contain exact zeros; the VB bound must
+        evaluate at the eta-smoothed posterior parameter, not at floored
+        zeros (round-4 TPU drive: the unsmoothed bound returned -7e32
+        and log_perplexity was meaningless)."""
+        rows, vocab = tiny_corpus_rows
+        # vocab terms that never occur produce exactly-zero count columns
+        model = _fit(rows, list(vocab) + ["neverseen0", "neverseen1"])
+        assert (np.asarray(model.lam) == 0).any()  # the hazard is real
+        ll = model.log_likelihood(rows)
+        assert np.isfinite(ll) and -1e6 < ll < 0
+        lp = model.log_perplexity(rows)
+        assert np.isfinite(lp) and 0 < lp < 100
+
     def test_log_likelihood_improves_with_iterations(self, tiny_corpus_rows):
         rows, vocab = tiny_corpus_rows
         _, opt3 = _fit(rows, vocab, max_iterations=2, return_opt=True)

@@ -102,6 +102,24 @@ class TestNumericParity:
         got = m.log_perplexity(rows, mesh=_mesh2())
         assert got == pytest.approx(ref, rel=1e-4)
 
+    def test_em_model_vb_bound_matches_unsharded(self, eight_devices):
+        """model.log_likelihood on an EM (MAP-count) model: the mesh and
+        local paths must apply the same eta-smoothing (_lam_for_bound)
+        and agree."""
+        m = _model()
+        m_em = LDAModel(
+            lam=np.asarray(m.lam),
+            vocab=list(m.vocab),
+            alpha=np.full((K,), 11.0, np.float32),
+            eta=1.1,
+            algorithm="em",
+        )
+        rows = _rows(10, seed=13)
+        ref = m_em.log_likelihood(rows)
+        got = m_em.log_likelihood(rows, mesh=_mesh2())
+        assert np.isfinite(ref)
+        assert got == pytest.approx(ref, rel=1e-4)
+
     def test_em_log_likelihood_matches_unsharded(self, eight_devices):
         rng = np.random.default_rng(3)
         rows = _rows(12, seed=9)
