@@ -90,6 +90,7 @@ def cmd_train(args: argparse.Namespace) -> int:
         checkpoint_dir=args.checkpoint_dir,
         checkpoint_interval=args.checkpoint_interval,
         sampling=args.sampling,
+        token_layout=getattr(args, "token_layout", "auto"),
         seed=args.seed,
         data_shards=args.data_shards,
         model_shards=args.model_shards,
@@ -467,6 +468,14 @@ def build_parser() -> argparse.ArgumentParser:
         help="online minibatch sampling: MLlib's per-doc Bernoulli(f) "
              "(default, semantics parity), fixed-size round(f*N), or "
              "shuffled epochs",
+    )
+    tr.add_argument(
+        "--token-layout", default="auto", dest="token_layout",
+        choices=["padded", "packed", "tiles", "auto"],
+        help="training token layout: padded [B, L] grids, packed flat "
+             "[T] token batches, tiles (device-resident tiled corpus, "
+             "online + --sampling epoch only), or auto (pick by padding "
+             "waste / platform; tiles on TPU when eligible)",
     )
     tr.add_argument(
         "--record-iteration-times", action="store_true",

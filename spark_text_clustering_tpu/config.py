@@ -91,12 +91,20 @@ class Params:
     # token arrays with per-token doc positions — FLOPs/bandwidth scale
     # with the true token count instead of B*L, the win when nnz spans
     # orders of magnitude (measured 10-20x padding waste on the 20NG
-    # shape; 27x EM speedup on the EN books, PERF.md).  "auto" picks
-    # packed when the padded grid would waste >= 4x (online — packed
-    # trades the resident corpus for per-iteration host packing) or
-    # >= 2x (EM — both layouts are one dispatch per sweep, so any cell
-    # reduction is pure win).
-    token_layout: str = "auto"  # "padded" | "packed" | "auto"
+    # shape; 27x EM speedup on the EN books, PERF.md).  "tiles" (online,
+    # sampling="epoch" only): the DEVICE-RESIDENT tiled path — corpus
+    # tiled once in doc order, resident sharded over "data", minibatch =
+    # a per-shard tile-index pick (block-stratified epoch: every doc
+    # exactly once per epoch, docs co-packed in a tile co-sampled); the
+    # per-iteration host->device input collapses to a few tile indices
+    # (measured 45k -> 134k docs/s on the TPU bench shape, PERF.md).
+    # "auto" picks tiles on TPU under epoch sampling when padding waste
+    # >= 4x, the tiled corpus fits resident_budget_bytes, and the tile
+    # granularity can honor the batch fraction; else packed when the
+    # padded grid would waste >= 4x (online) or >= 2x (EM — both EM
+    # layouts are one dispatch per sweep, so any cell reduction is pure
+    # win).
+    token_layout: str = "auto"  # "padded" | "packed" | "tiles" | "auto"
     # Record TRUE per-iteration wall times: forces one dispatch + device
     # sync per iteration instead of scanning whole checkpoint intervals,
     # so the model artifact carries MLlib-comparable ``iterationTimes``

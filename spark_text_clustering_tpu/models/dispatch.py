@@ -23,7 +23,7 @@ caused.  The policy:
 
 from __future__ import annotations
 
-__all__ = ["resolve_dispatch_interval"]
+__all__ = ["resolve_dispatch_interval", "save_cadence"]
 
 
 def resolve_dispatch_interval(
@@ -41,3 +41,21 @@ def resolve_dispatch_interval(
     if bytes_per_iter > 0:
         cap = min(cap, max(1, p.dispatch_budget_bytes // bytes_per_iter))
     return cap
+
+
+def save_cadence(p, interval: int) -> int:
+    """Checkpoint cadence (iterations between saves) for a loop running
+    ``interval``-iteration dispatches.
+
+    ``checkpoint_interval`` normally — including when observability
+    forced ``interval == 1`` (per-iteration dispatches must NOT mean
+    per-iteration [k, V] fetches + npz writes).  When the staging
+    budget shrank the dispatch interval to 1 < interval <
+    checkpoint_interval, chunk ends stop landing on
+    checkpoint_interval multiples, so saves follow the chunk cadence
+    instead (more often than asked, never less).
+    """
+    ck = max(1, p.checkpoint_interval)
+    if interval <= 1 or interval >= ck:
+        return ck
+    return interval

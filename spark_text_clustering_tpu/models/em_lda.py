@@ -57,7 +57,7 @@ from ..parallel.mesh import (
 )
 from ..utils.timing import IterationTimer
 from .base import LDAModel
-from .dispatch import resolve_dispatch_interval
+from .dispatch import resolve_dispatch_interval, save_cadence
 from .persistence import load_train_state, save_train_state
 
 __all__ = [
@@ -812,7 +812,7 @@ class EMLDA:
                 if verbose:
                     print(f"EM iter {it}: {timer.times[-1]:.3f}s (packed)")
                 it += m
-                if ckpt_path and it % interval == 0:
+                if ckpt_path and it % save_cadence(p, interval) == 0:
                     # layout-agnostic checkpoint: reorder packed rows
                     # back to global doc order
                     n_wk_host = fetch_global(n_wk)
@@ -858,6 +858,7 @@ class EMLDA:
                     n_dk_list[bi] = dk_new
                 n_wk = acc
                 n_wk.block_until_ready()
+                self.last_dispatches += 1  # one synced sweep per iter
                 timer.stop()
                 print(f"EM iter {it}: {timer.times[-1]:.3f}s")
                 if ckpt_path and (it + 1) % p.checkpoint_interval == 0:
@@ -893,7 +894,7 @@ class EMLDA:
                 timer.stop()
                 timer.split_last(m)
                 it += m
-                if ckpt_path and it % interval == 0:
+                if ckpt_path and it % save_cadence(p, interval) == 0:
                     save_checkpoint(it, n_wk, list(n_dks))
             n_dk_list = list(n_dks)
 

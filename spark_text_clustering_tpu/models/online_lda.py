@@ -23,6 +23,7 @@ miniBatchFraction = 0.05 + 1/corpusSize (LDAClustering.scala:43).
 
 from __future__ import annotations
 
+import hashlib
 import os
 import time
 from typing import Callable, List, NamedTuple, Optional, Sequence, Tuple
@@ -64,7 +65,7 @@ from ..parallel.mesh import (
 )
 from ..utils.timing import IterationTimer
 from .base import LDAModel
-from .dispatch import resolve_dispatch_interval
+from .dispatch import resolve_dispatch_interval, save_cadence
 from .persistence import load_train_state, save_train_state
 
 __all__ = [
@@ -77,6 +78,7 @@ __all__ = [
     "make_online_resident_chunk",
     "make_online_packed_chunk",
     "make_online_packed_tiles_chunk",
+    "make_online_tiles_resident_chunk",
 ]
 
 
@@ -750,6 +752,148 @@ def make_online_packed_tiles_chunk(
     return tiles_chunk
 
 
+def make_online_tiles_resident_chunk(
+    mesh: Mesh,
+    *,
+    alpha: float | np.ndarray,
+    eta: float,
+    tau0: float,
+    kappa: float,
+    k: int,
+    gamma_shape: float,
+    seed: int,
+    d: int,
+    n_docs: int,
+    max_inner: int = 100,
+    tol: float = 1e-3,
+    interpret: bool = False,
+):
+    """DEVICE-RESIDENT tiled training (``token_layout="tiles"``): the
+    corpus is tiled ONCE in doc order (``plan_corpus_tiles``), uploaded
+    sharded over "data", and each iteration gathers its minibatch as a
+    per-shard subset of resident tiles by LOCAL tile index — the host
+    ships only the tiny ``[m, shards, tiles/batch/shard]`` pick tensor
+    per dispatch instead of packing and transferring token slabs (the
+    host-streaming packed path's per-fit cost was ~0.5s of pack+plan+
+    25 MB transfer on the 20NG bench shape; here it is a one-time
+    ~10 MB upload).
+
+    Sampling semantics: a BLOCK-STRATIFIED epoch — each shard permutes
+    its own resident tiles per epoch and walks them in fixed-size
+    groups, so every tile (hence every document) is seen exactly once
+    per epoch, but documents co-packed into a tile are always
+    co-sampled.  A deliberate, documented divergence from the
+    host-streaming "epoch" stream (doc-level permutation); quality
+    equivalence on the bench protocol is pinned by
+    tests/test_tiles_resident.py.  Tile geometry (``d``, tt) comes from
+    the corpus plan; ``doc_ids`` carry GLOBAL doc ids (pad == n_docs),
+    so per-doc gamma inits stay keyed by global id exactly like every
+    other layout.
+    """
+    from ..ops.lda_math import _PHI_EPS
+    from ..ops.pallas_packed import gamma_fixed_point_tiles
+
+    alpha_arr = jnp.asarray(alpha, jnp.float32)
+    base_key = jax.random.PRNGKey(seed)
+    n_f = float(n_docs)
+
+    def _iter(lam_shard, step, ids_res, cts_res, seg_res, doc_res, pick,
+              corpus_sz):
+        from jax.scipy.special import digamma as _digamma
+
+        pick_l = pick[0]                                  # [tb_local]
+        ids_t = ids_res[pick_l]                           # [tb_l, tt]
+        cts_t = cts_res[pick_l]
+        seg_t = seg_res[pick_l]
+        doc_t = doc_res[pick_l]                           # [tb_l, d]
+        tb_l, tt = ids_t.shape
+
+        flat_ids = ids_t.reshape(-1)
+        row_sum = model_row_sum(lam_shard)                # [k]
+        lam_tok = gather_model_rows_kbl(lam_shard, flat_ids)  # [k, T]
+        eb_kt = jnp.exp(
+            _digamma(jnp.maximum(lam_tok, 1e-30))
+            - _digamma(row_sum)[:, None]
+        )
+        key_it = jax.random.fold_in(base_key, step)
+        # gamma inits drawn directly in tile-slot order, keyed by the
+        # GLOBAL doc id each slot holds (pad slots draw too — discarded)
+        g0_slots = init_gamma_rows(
+            key_it, doc_t.reshape(-1), k, gamma_shape
+        ).T                                               # [k, tb_l*d]
+        gamma_tiles = gamma_fixed_point_tiles(
+            eb_kt, cts_t, seg_t, alpha_arr, g0_slots,
+            d=d, max_inner=max_inner, tol=tol, interpret=interpret,
+        )                                                 # [k, tb_l*d]
+        elog = _digamma(gamma_tiles) - _digamma(
+            gamma_tiles.sum(axis=0, keepdims=True)
+        )
+        exp_et_slots = jnp.exp(elog)
+        tile_idx = jax.lax.broadcasted_iota(jnp.int32, (tb_l, tt), 0)
+        slot = (
+            tile_idx * d + jnp.minimum(seg_t, d - 1)
+        ).reshape(-1)                                     # [T]
+        et_tok = exp_et_slots[:, slot]                    # [k, T]
+        # pad token slots carry cts == 0 -> contribute nothing
+        phinorm = (eb_kt * et_tok).sum(axis=0) + _PHI_EPS
+        vals_kt = (
+            et_tok * (cts_t.reshape(-1) / phinorm)[None, :] * eb_kt
+        )
+        touched = psum_data(
+            scatter_add_model_shard_kbl(
+                flat_ids[None, :], vals_kt[:, None, :],
+                lam_shard.shape[-1],
+            )
+        )
+        # true drawn doc count, computed on device from the doc slots
+        batch_docs = psum_data(
+            (doc_t < n_docs).sum().astype(jnp.float32)
+        )
+        rho = (tau0 + step.astype(jnp.float32) + 1.0) ** (-kappa)
+        scale = corpus_sz / jnp.maximum(batch_docs, 1.0)
+        lam_new = (1.0 - rho) * lam_shard + rho * eta + rho * scale * touched
+        lam_new = jnp.where(batch_docs > 0.0, lam_new, lam_shard)
+        return lam_new, step + 1
+
+    sharded = jax.shard_map(
+        _iter,
+        mesh=mesh,
+        in_specs=(
+            P(None, MODEL_AXIS),      # lam shard
+            P(),                      # step
+            P(DATA_AXIS, None),       # resident tile token ids
+            P(DATA_AXIS, None),       # resident tile token weights
+            P(DATA_AXIS, None),       # resident tile-local doc slots
+            P(DATA_AXIS, None),       # resident tile doc ids (global)
+            P(DATA_AXIS, None),       # per-shard LOCAL tile picks
+            P(),                      # corpus size
+        ),
+        out_specs=(P(None, MODEL_AXIS), P()),
+        check_vma=False,
+    )
+
+    @jax.jit
+    def tiles_resident_chunk(
+        state: TrainState, ids_res, cts_res, seg_res, doc_res, picks,
+        corpus_sz,
+    ) -> TrainState:
+        """``picks``: [m, shards, tb_local] int32 of per-shard LOCAL
+        resident-tile indices, sharded over axis 1."""
+        cs = jnp.asarray(corpus_sz, jnp.float32)
+
+        def body(st, pick):
+            lam, step = sharded(
+                st.lam, st.step, ids_res, cts_res, seg_res, doc_res,
+                pick, cs,
+            )
+            return TrainState(lam, step), None
+
+        state, _ = jax.lax.scan(body, state, picks)
+        return state
+
+    return tiles_resident_chunk
+
+
 class OnlineLDA:
     """Estimator: ``fit(rows) -> LDAModel`` (the ``lda.run(corpus)`` of the
     reference's online path, LDAClustering.scala:43,61).
@@ -782,6 +926,9 @@ class OnlineLDA:
         self._resident_chunk_fn = None
         self._packed_chunk_fn = None
         self._tiles_chunk_fns: dict = {}
+        # tiled-resident runner, keyed by (d, n_docs) of the corpus plan
+        self._tiles_res_fn = None
+        self._tiles_res_key = None
         # packed-path gamma loop choice: None until the first chunk's
         # one-shot autotune (or an explicit STC_GAMMA_BACKEND) decides;
         # then "tiles" | "xla" for every later chunk and repeat fit
@@ -793,6 +940,205 @@ class OnlineLDA:
         # which gamma loop the last packed chunk ran: "xla" (segment
         # fixed point) or "pallas_tiles" (VMEM-resident tile kernel)
         self.last_gamma_backend: str = "xla"
+
+    def _fit_tiles_resident(
+        self, rows, vocab, p, n, v, k, alpha, eta, bsz, n_iters,
+        start_it, lam, timer, verbose, ckpt_path, save_checkpoint,
+        forced: bool = False,
+    ) -> Optional[LDAModel]:
+        """DEVICE-RESIDENT tiled epoch training (``token_layout="tiles"``
+        / auto on TPU): tile the corpus once, upload it sharded over
+        "data", and drive each iteration with a tiny per-shard tile-index
+        pick — see ``make_online_tiles_resident_chunk`` for semantics.
+        Returns None (caller falls back to the host-streaming packed
+        path) when no tile geometry fits VMEM or the tiled corpus
+        exceeds ``Params.resident_budget_bytes``."""
+        from ..ops.pallas_packed import plan_corpus_tiles
+
+        offsets = np.zeros(n + 1, np.int64)
+        np.cumsum([len(i) for i, _ in rows], out=offsets[1:])
+        n_data = self.mesh.shape[DATA_AXIS]
+        # Plan + resident upload cached across fits of the SAME corpus
+        # (repeat fits / warm bench runs): keyed by CONTENT — doc count,
+        # token total, and a hash of three sample rows.  Not id(rows):
+        # CPython reuses object ids after GC, which would silently serve
+        # corpus A's resident tiles to a same-shaped corpus B.
+        fp = hashlib.blake2b(digest_size=16)
+        fp.update(np.int64(n).tobytes())
+        fp.update(offsets[-1:].tobytes())
+        for i in ((0, n // 2, n - 1) if n else ()):
+            fp.update(np.asarray(rows[i][0], np.int32).tobytes())
+            fp.update(np.asarray(rows[i][1], np.float32).tobytes())
+        cache_key = (fp.hexdigest(), n, int(offsets[-1]), n_data, k)
+        cached = getattr(self, "_tiles_corpus_cache", None)
+        if cached is not None and cached[0] == cache_key:
+            plan, reals, resident = cached[1]
+        else:
+            flat_ids = (
+                np.concatenate([np.asarray(i, np.int32) for i, _ in rows])
+                if rows else np.zeros(0, np.int32)
+            )
+            flat_cts = (
+                np.concatenate(
+                    [np.asarray(w, np.float32) for _, w in rows]
+                )
+                if rows else np.zeros(0, np.float32)
+            )
+            plan = plan_corpus_tiles(
+                flat_ids, flat_cts, offsets, n_shards=n_data, k=k
+            )
+            reals = resident = None
+        if plan is None:
+            return None
+        resident_bytes = (
+            plan.ids.nbytes + plan.cts.nbytes + plan.seg.nbytes
+            + plan.doc_ids.nbytes
+        )
+        if resident_bytes > p.resident_budget_bytes:
+            return None
+        n_tiles = plan.ids.shape[0]
+        shard_rows = n_tiles // n_data
+        if reals is None:
+            # real (non-all-pad) tiles per shard: the doc-order plan puts
+            # pad tiles at the global END, so only trailing shards carry
+            # them
+            reals = np.array([
+                int(
+                    (
+                        plan.doc_ids[
+                            s * shard_rows:(s + 1) * shard_rows, 0
+                        ] < n
+                    ).sum()
+                )
+                for s in range(n_data)
+            ])
+        n_real = int(reals.sum())
+        if n_real == 0:
+            return None
+        # tiles per iteration: expectation-match the doc-level batch
+        # fraction (bsz docs of n), spread evenly over shards
+        tb_target = round(bsz / max(1, n) * n_real)
+        if not forced and tb_target < 2 * n_data:
+            # tile granularity too coarse to honor the batch fraction
+            # (tiny corpora tile into a handful of tiles, turning
+            # "minibatches" into near-full-batch sweeps — a different
+            # optimization schedule).  Auto mode declines; an explicit
+            # token_layout="tiles" still runs.
+            return None
+        tb = max(n_data, tb_target)
+        tb_l = max(1, -(-tb // n_data))
+
+        tile_spec = NamedSharding(self.mesh, P(DATA_AXIS, None))
+        pick_spec = NamedSharding(self.mesh, P(None, DATA_AXIS, None))
+        if resident is None:
+            resident = tuple(
+                jax.device_put(a, tile_spec)
+                for a in (plan.ids, plan.cts, plan.seg, plan.doc_ids)
+            )
+            self._tiles_corpus_cache = (
+                cache_key, (plan, reals, resident)
+            )
+        ids_res, cts_res, seg_res, doc_res = resident
+
+        key_fn = (plan.d, n)
+        if self._tiles_res_fn is None or self._tiles_res_key != key_fn:
+            self._tiles_res_fn = make_online_tiles_resident_chunk(
+                self.mesh, alpha=alpha, eta=eta, tau0=p.tau0,
+                kappa=p.kappa, k=k, gamma_shape=p.gamma_shape,
+                seed=p.seed, d=plan.d, n_docs=n,
+                interpret=jax.default_backend() != "tpu",
+            )
+            self._tiles_res_key = key_fn
+        run = self._tiles_res_fn
+
+        # Per-shard block-stratified epoch stream: each shard permutes
+        # its OWN real resident tiles per epoch and walks them tb_l at a
+        # time — every tile seen exactly once per shard-epoch.  Pure in
+        # (seed, shard, it): deterministic resume, like sample_pick.
+        perms: dict = {}
+
+        def _perm(s: int, epoch: int) -> np.ndarray:
+            pk = (s, epoch)
+            if pk not in perms:
+                if len(perms) > 2 * n_data:
+                    perms.clear()
+                perms[pk] = np.random.default_rng(
+                    (p.seed, 0x71E5, s, epoch)
+                ).permutation(int(reals[s])).astype(np.int32)
+            return perms[pk]
+
+        def tile_pick(it: int) -> np.ndarray:
+            out = np.empty((n_data, tb_l), np.int32)
+            for s in range(n_data):
+                r = int(reals[s])
+                if r == 0:
+                    # shard holds only pad tiles (possible on tiny
+                    # corpora): picking them contributes nothing
+                    out[s] = 0
+                    continue
+                filled = 0
+                start = it * tb_l
+                while filled < tb_l:
+                    epoch, off = divmod(start + filled, r)
+                    perm = _perm(s, epoch)
+                    take = min(tb_l - filled, r - off)
+                    out[s, filled:filled + take] = perm[off:off + take]
+                    filled += take
+            return out
+
+        self.tile_pick = tile_pick  # exposed for tests
+        # true average docs per tile iteration (every doc exactly once
+        # per n_real/tb_l-iteration epoch) — keeps docs/s accounting
+        # honest in bench.py
+        self.last_batch_size = int(round(n * n_data * tb_l / n_real))
+        self.last_layout = "tiles_resident"
+        self.last_gamma_backend = "pallas_tiles"
+        self.last_batch_cells = n_data * tb_l * plan.tt
+        self.last_tiles = {
+            "n_tiles": n_tiles, "tt": plan.tt, "d": plan.d,
+            "tiles_per_iter": n_data * tb_l,
+            "reals_per_shard": reals.tolist(),
+            "resident_bytes": resident_bytes,
+        }
+
+        state = TrainState(lam, jnp.asarray(start_it, jnp.int32))
+        interval = resolve_dispatch_interval(
+            p, ckpt_path=ckpt_path, verbose=verbose, n_iters=n_iters,
+            bytes_per_iter=4 * n_data * tb_l,
+        )
+        it = start_it
+        while it < n_iters:
+            m = min(interval - (it % interval), n_iters - it)
+            picks = np.stack([tile_pick(i) for i in range(it, it + m)])
+            timer.start()
+            state = run(
+                state, ids_res, cts_res, seg_res, doc_res,
+                jax.device_put(picks, pick_spec), float(n),
+            )
+            state.lam.block_until_ready()
+            timer.stop()
+            self.last_dispatches += 1
+            if m > 1:
+                timer.split_last(m)
+            if verbose:
+                print(
+                    f"iter {it}: {timer.times[-1]:.3f}s (tiles-resident)"
+                )
+            it += m
+            if ckpt_path and it % save_cadence(p, interval) == 0:
+                save_checkpoint(it, state.lam)
+        lam_out = model_handoff(state.lam, v)
+        return LDAModel(
+            lam=lam_out,
+            vocab=list(vocab),
+            alpha=alpha,
+            eta=float(eta),
+            gamma_shape=p.gamma_shape,
+            iteration_times=list(timer.times),
+            iteration_times_kind=timer.kind,
+            algorithm="online",
+            step=start_it + len(timer.times),
+        )
 
     def _fit_packed(
         self, rows, vocab, p, n, v, k, alpha, eta, bsz, n_iters,
@@ -1010,7 +1356,7 @@ class OnlineLDA:
                 if verbose:
                     print(f"iter {it}: {timer.times[-1]:.3f}s (packed)")
             it += m
-            if ckpt_path and it % interval == 0:
+            if ckpt_path and it % save_cadence(p, interval) == 0:
                 save_checkpoint(it, state.lam)
         lam_out = model_handoff(state.lam, v)
         return LDAModel(
@@ -1197,17 +1543,48 @@ class OnlineLDA:
         mean_nnz = max(
             1.0, sum(len(i) for i, _ in rows) / max(1, n)
         )
-        if p.token_layout not in ("padded", "packed", "auto"):
+        if p.token_layout not in ("padded", "packed", "tiles", "auto"):
             raise ValueError(
                 f"unknown token_layout {p.token_layout!r} "
-                "(use 'padded'|'packed'|'auto')"
+                "(use 'padded'|'packed'|'tiles'|'auto')"
+            )
+        if p.token_layout == "tiles" and p.sampling != "epoch":
+            raise ValueError(
+                "token_layout='tiles' requires sampling='epoch' (the "
+                "tiled-resident path walks a block-stratified epoch "
+                "stream over resident corpus tiles)"
             )
         self.last_batch_cells = bsz * row_len
+        # DEVICE-RESIDENT tiled epoch training: the TPU-native flagship
+        # path — corpus tiled once and resident, per-iteration input is
+        # a tiny tile-index pick.  Explicit token_layout="tiles" forces
+        # it (interpret-mode kernel off-TPU, for tests); "auto" takes it
+        # on TPU (resolved pallas backend) when padding waste says
+        # packed and the tiled corpus fits the resident budget.
+        if (
+            p.sampling == "epoch"
+            and p.device_resident is not False
+            and (
+                p.token_layout == "tiles"
+                or (
+                    p.token_layout == "auto"
+                    and row_len >= 4.0 * mean_nnz
+                    and _resolve_gamma_backend("auto") == "pallas"
+                )
+            )
+        ):
+            out = self._fit_tiles_resident(
+                rows, vocab, p, n, v, k, alpha, eta, bsz, n_iters,
+                start_it, lam, timer, verbose, ckpt_path,
+                save_checkpoint, forced=p.token_layout == "tiles",
+            )
+            if out is not None:
+                return out
         # an EXPLICIT device_resident=True wins over the auto layout
         # heuristic (the caller asked for one corpus upload + on-device
         # assembly, e.g. behind a slow tunnel); an explicit
         # token_layout="packed" wins over everything.
-        use_packed = p.token_layout == "packed" or (
+        use_packed = p.token_layout in ("packed", "tiles") or (
             p.token_layout == "auto"
             and p.device_resident is not True
             and row_len >= 4.0 * mean_nnz
@@ -1243,6 +1620,7 @@ class OnlineLDA:
                         jnp.asarray(make_pick(it)), float(n),
                     )
                     state.lam.block_until_ready()
+                    self.last_dispatches += 1
                     timer.stop()
                     print(f"iter {it}: {timer.times[-1]:.3f}s")
                     if ckpt_path and (it + 1) % p.checkpoint_interval == 0:
@@ -1279,7 +1657,7 @@ class OnlineLDA:
                     timer.stop()
                     timer.split_last(m)
                     it += m
-                    if ckpt_path and it % interval == 0:
+                    if ckpt_path and it % save_cadence(p, interval) == 0:
                         save_checkpoint(it, state.lam)
             lam_out = model_handoff(state.lam, v)
             return LDAModel(
@@ -1360,6 +1738,7 @@ class OnlineLDA:
                 count_acc = cnt if count_acc is None else count_acc + cnt
             lam = mstep_fn(lam, eb, sstats_acc, count_acc, it, float(n))
             lam.block_until_ready()
+            self.last_dispatches += 1  # one synced E+M group per iter
             timer.stop()
             if verbose:
                 print(f"iter {it}: {timer.times[-1]:.3f}s")

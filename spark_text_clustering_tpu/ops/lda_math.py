@@ -103,15 +103,43 @@ def token_sstats_factors_bkl(
     return et_k * (cts / phinorm)[:, None]                    # [B, k, L]
 
 
+# Elements per sampling block of a large lambda init.  jax.random.gamma
+# runs a rejection sampler that allocates tens of temporaries per element
+# — the one-shot draw at the CC-News config ([500, 10M]) asked the
+# allocator for 720 GB.  2^24 elements bound the block's temporaries to
+# ~2.5 GB; blocks are drawn sequentially (lax.map) and keyed per block.
+_INIT_LAMBDA_BLOCK = 1 << 24
+
+
 def init_lambda(
     key: jax.Array, k: int, vocab_size: int, gamma_shape: float = 100.0
 ) -> jnp.ndarray:
     """lambda ~ Gamma(gammaShape, 1/gammaShape), shape [k, V] — MLlib's init
-    (gammaShape=100 persisted in the reference's model metadata)."""
-    return (
-        jax.random.gamma(key, gamma_shape, (k, vocab_size), jnp.float32)
-        / gamma_shape
+    (gammaShape=100 persisted in the reference's model metadata).
+
+    Draws at or under ``_INIT_LAMBDA_BLOCK`` elements use the one-shot
+    sampler (the historical stream every existing seeded workload is on);
+    larger tables switch to the block-sequential draw with bounded
+    temporary memory (same law, different stream — documented scale
+    behavior, pinned by tests/test_ops.py::TestInitLambdaBlocked)."""
+    total = k * vocab_size
+    if total <= _INIT_LAMBDA_BLOCK:
+        return (
+            jax.random.gamma(key, gamma_shape, (k, vocab_size), jnp.float32)
+            / gamma_shape
+        )
+    n_blocks = -(-total // _INIT_LAMBDA_BLOCK)
+    keys = jax.vmap(lambda i: jax.random.fold_in(key, i))(
+        jnp.arange(n_blocks)
     )
+
+    def draw(kk):
+        return jax.random.gamma(
+            kk, gamma_shape, (_INIT_LAMBDA_BLOCK,), jnp.float32
+        )
+
+    flat = jax.lax.map(draw, keys).reshape(-1)[:total]
+    return flat.reshape(k, vocab_size) / gamma_shape
 
 
 def init_gamma(

@@ -77,6 +77,9 @@ def corpus():
 class TestFitDispatchCounts:
     def test_online_packed_whole_run_is_one_dispatch(self, corpus):
         from spark_text_clustering_tpu.models.online_lda import OnlineLDA
+        from spark_text_clustering_tpu.ops.lda_math import (
+            _resolve_gamma_backend,
+        )
 
         rows, vocab = corpus
         p = Params(
@@ -86,7 +89,12 @@ class TestFitDispatchCounts:
         opt = OnlineLDA(p)
         opt.fit(rows, vocab)
         assert opt.last_layout == "packed"
-        assert opt.last_dispatches == 1
+        # When the tile kernel is in play (TPU / forced pallas), the
+        # first chunk is capped at 8 iterations so the gamma autotune
+        # probes cheaply -> 8 + 4 = two dispatches; the XLA path (CPU
+        # default) runs the whole fit as one.
+        want = 1 if _resolve_gamma_backend("auto") == "xla" else 2
+        assert opt.last_dispatches == want
 
     def test_online_resident_whole_run_is_one_dispatch(self, corpus):
         from spark_text_clustering_tpu.models.online_lda import OnlineLDA
@@ -126,6 +134,42 @@ class TestFitDispatchCounts:
             opt = EMLDA(p)
             opt.fit(rows, vocab)
             assert opt.last_dispatches == 1, layout
+
+    def test_save_cadence_policy(self):
+        from spark_text_clustering_tpu.models.dispatch import save_cadence
+
+        p = Params(checkpoint_interval=10)
+        assert save_cadence(p, 1) == 10    # observability interval=1
+        assert save_cadence(p, 10) == 10   # normal
+        assert save_cadence(p, 7) == 7     # budget-capped chunks
+        assert save_cadence(p, 40) == 10   # big chunks still save at ck
+
+    def test_observability_does_not_checkpoint_every_iteration(
+        self, corpus, tmp_path, monkeypatch
+    ):
+        """record_iteration_times forces 1-iteration dispatches, but
+        checkpoint WRITES must stay on checkpoint_interval cadence —
+        not one [k, V] fetch + npz write per iteration."""
+        import spark_text_clustering_tpu.models.online_lda as ol
+
+        calls = []
+        real = ol.save_train_state
+
+        def counting(*a, **k):
+            calls.append(1)
+            return real(*a, **k)
+
+        monkeypatch.setattr(ol, "save_train_state", counting)
+        rows, vocab = corpus
+        p = Params(
+            k=3, algorithm="online", max_iterations=12,
+            checkpoint_interval=4, token_layout="packed", seed=0,
+            checkpoint_dir=str(tmp_path), record_iteration_times=True,
+        )
+        opt = ol.OnlineLDA(p)
+        opt.fit(rows, vocab)
+        assert opt.last_dispatches == 12   # per-iteration dispatches
+        assert len(calls) == 3             # saves at 4, 8, 12 only
 
     def test_dispatch_chunking_does_not_change_the_model(self, corpus):
         """One whole-run dispatch and per-checkpoint-interval chunking

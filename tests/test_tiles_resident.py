@@ -1,0 +1,175 @@
+"""Device-resident tiled epoch training (token_layout="tiles").
+
+The TPU-native flagship online path: corpus tiled once in doc order
+(`plan_corpus_tiles`), resident sharded over "data", minibatches drawn
+as per-shard tile-index picks (block-stratified epoch).  These tests run
+the REAL kernel in interpret mode on the CPU mesh.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from spark_text_clustering_tpu.config import Params
+from spark_text_clustering_tpu.models.online_lda import OnlineLDA
+from spark_text_clustering_tpu.parallel import make_mesh
+
+
+def _mesh(data=4, model=2):
+    cpu = jax.devices("cpu")
+    return make_mesh(
+        data_shards=data, model_shards=model,
+        devices=cpu[: data * model],
+    )
+
+
+def _topic_rows(rng, n_docs=160, v=200):
+    """Two planted topics over disjoint vocab halves."""
+    rows = []
+    for i in range(n_docs):
+        lo, hi = (0, v // 2) if i % 2 == 0 else (v // 2, v)
+        nnz = int(rng.integers(5, 14))
+        ids = rng.choice(np.arange(lo, hi), size=nnz, replace=False)
+        cts = rng.integers(1, 5, size=nnz).astype(np.float32)
+        rows.append((ids.astype(np.int32), cts))
+    return rows, [f"t{i}" for i in range(v)]
+
+
+@pytest.fixture(scope="module")
+def corpus():
+    return _topic_rows(np.random.default_rng(11))
+
+
+def _fit(rows, vocab, mesh=None, **kw):
+    defaults = dict(
+        k=2, algorithm="online", max_iterations=12, sampling="epoch",
+        token_layout="tiles", seed=0,
+    )
+    defaults.update(kw)
+    opt = OnlineLDA(Params(**defaults), mesh=mesh or _mesh())
+    model = opt.fit(rows, vocab)
+    return model, opt
+
+
+class TestTilesResident:
+    def test_fit_runs_resident_in_one_dispatch(self, corpus):
+        rows, vocab = corpus
+        model, opt = _fit(rows, vocab)
+        assert opt.last_layout == "tiles_resident"
+        assert opt.last_gamma_backend == "pallas_tiles"
+        assert opt.last_dispatches == 1
+        lam = np.asarray(model.lam)
+        assert lam.shape == (2, len(vocab))
+        assert np.isfinite(lam).all() and (lam > 0).all()
+
+    def test_recovers_planted_topics(self, corpus):
+        rows, vocab = corpus
+        model, _ = _fit(rows, vocab, max_iterations=40)
+        topics = model.topics_matrix()
+        v = len(vocab)
+        lo_mass = topics[:, : v // 2].sum(axis=1)
+        assert (lo_mass > 0.85).any() and (lo_mass < 0.15).any()
+
+    def test_quality_comparable_to_host_packed_epoch(self, corpus):
+        """Block-stratified tile epochs are a different sample stream
+        than doc-level epochs — quality, not trajectories, must match
+        (the bench's matched-perplexity gate rides on this).  On this
+        TOY corpus the whole corpus fits 4 tiles, so every tile batch is
+        near-full-batch — a coarser schedule (exactly why the AUTO gate
+        declines at this granularity, pinned below); 5%% covers the
+        schedule gap while still catching real math regressions."""
+        rows, vocab = corpus
+        m_tiles, _ = _fit(rows, vocab, max_iterations=30)
+        m_packed, opt_p = _fit(
+            rows, vocab, max_iterations=30, token_layout="packed"
+        )
+        assert opt_p.last_layout == "packed"
+        lp_t = m_tiles.log_perplexity(rows)
+        lp_p = m_packed.log_perplexity(rows)
+        assert abs(lp_t - lp_p) / abs(lp_p) < 0.05
+
+    def test_auto_gate_declines_coarse_tile_granularity(self, corpus):
+        """auto must NOT pick tiles when the batch fraction maps to
+        fewer than 2 tiles per shard (near-full-batch schedule): this
+        toy corpus packs into 4 tiles, so the un-forced path declines
+        before any device work."""
+        import jax.numpy as jnp
+
+        from spark_text_clustering_tpu.utils.timing import IterationTimer
+
+        rows, vocab = corpus
+        opt = OnlineLDA(
+            Params(
+                k=2, algorithm="online", max_iterations=4,
+                sampling="epoch", token_layout="auto", seed=0,
+            ),
+            mesh=_mesh(),
+        )
+        out = opt._fit_tiles_resident(
+            rows, vocab, opt.params, len(rows), len(vocab), 2,
+            np.full((2,), 0.5, np.float32), 0.5, 12, 4, 0,
+            jnp.ones((2, len(vocab)), jnp.float32),
+            IterationTimer(), False, None, lambda *_: None,
+            forced=False,
+        )
+        assert out is None
+
+    def test_deterministic_across_runs(self, corpus):
+        rows, vocab = corpus
+        m1, _ = _fit(rows, vocab)
+        m2, _ = _fit(rows, vocab)
+        np.testing.assert_array_equal(
+            np.asarray(m1.lam), np.asarray(m2.lam)
+        )
+
+    def test_checkpoint_resume_matches_uninterrupted(self, corpus, tmp_path):
+        rows, vocab = corpus
+        full, _ = _fit(rows, vocab, max_iterations=8)
+        ck = str(tmp_path / "ck")
+        _fit(
+            rows, vocab, max_iterations=4,
+            checkpoint_dir=ck, checkpoint_interval=4,
+        )
+        resumed, opt = _fit(
+            rows, vocab, max_iterations=8,
+            checkpoint_dir=ck, checkpoint_interval=4,
+        )
+        np.testing.assert_allclose(
+            np.asarray(resumed.lam), np.asarray(full.lam),
+            rtol=1e-5, atol=1e-6,
+        )
+
+    def test_epoch_covers_every_real_tile_per_shard(self, corpus):
+        rows, vocab = corpus
+        _, opt = _fit(rows, vocab, max_iterations=2)
+        tiles = opt.last_tiles
+        n_data = 4
+        tb_l = tiles["tiles_per_iter"] // n_data
+        for s, r in enumerate(tiles["reals_per_shard"]):
+            if r == 0:
+                continue
+            # stream positions [0, ceil(r/tb_l)*tb_l) cover epoch 0
+            iters = -(-r // tb_l)
+            seen = np.concatenate(
+                [opt.tile_pick(i)[s] for i in range(iters)]
+            )
+            assert set(seen[:r].tolist()) == set(range(r))
+            # all picks are valid real-local indices
+            assert (seen >= 0).all() and (seen < r).all()
+
+    def test_tiles_requires_epoch_sampling(self, corpus):
+        rows, vocab = corpus
+        with pytest.raises(ValueError, match="epoch"):
+            _fit(rows, vocab, sampling="fixed")
+
+    def test_budget_overflow_falls_back_to_packed(self, corpus):
+        rows, vocab = corpus
+        _, opt = _fit(rows, vocab, resident_budget_bytes=16)
+        assert opt.last_layout == "packed"
+
+    def test_device_resident_false_disables_tiles_auto(self, corpus):
+        rows, vocab = corpus
+        _, opt = _fit(rows, vocab, device_resident=False,
+                      token_layout="packed")
+        assert opt.last_layout == "packed"
