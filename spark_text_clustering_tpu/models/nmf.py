@@ -41,6 +41,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..config import Params
+from .dispatch import resolve_dispatch_interval
 from ..ops.sparse import DocTermBatch, batch_from_rows
 from ..parallel.collectives import (
     data_shard_batch,
@@ -254,6 +255,8 @@ class NMF:
         # Per-instance step cache (the EMLDA pattern): repeat fits on the
         # same vocab size skip shard_map construction + XLA retrace.
         self._step_fn = None
+        self._chunk_fn = None
+        self.last_dispatches = 0
 
     def fit(
         self,
@@ -297,14 +300,40 @@ class NMF:
             # one step fn per estimator; jit re-specializes per shape
             self._step_fn = make_nmf_train_step(self.mesh)
         step_fn = self._step_fn
+        if self._chunk_fn is None:
+            # whole-run lax.scan per dispatch (models/dispatch.py): NMF
+            # has no mid-run checkpointing, so with no per-iteration
+            # observability the fit is ONE host dispatch
+            @partial(jax.jit, static_argnames=("m",))
+            def run_chunk(state, batch, m: int):
+                def body(st, _):
+                    return step_fn(st, batch), None
+                st, _ = jax.lax.scan(body, state, None, length=m)
+                return st
+
+            self._chunk_fn = run_chunk
         timer = IterationTimer()
-        for it in range(p.max_iterations):
+        self.last_dispatches = 0
+        interval = resolve_dispatch_interval(
+            p, ckpt_path=None, verbose=verbose,
+            n_iters=p.max_iterations,
+        )
+        it = 0
+        while it < p.max_iterations:
+            m = min(interval, p.max_iterations - it)
             timer.start()
-            state = step_fn(state, batch)
+            state = (
+                self._chunk_fn(state, batch, m)
+                if m > 1 else step_fn(state, batch)
+            )
             state.h.block_until_ready()
             timer.stop()
+            self.last_dispatches += 1
+            if m > 1:
+                timer.split_last(m)
             if verbose:
                 print(f"nmf iter {it}: {timer.times[-1]:.3f}s")
+            it += m
 
         loss = float(frobenius_loss(batch, state.w, state.h))
         self.last_loss = loss

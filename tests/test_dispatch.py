@@ -122,6 +122,14 @@ class TestFitDispatchCounts:
         opt.fit(rows, vocab)
         assert opt.last_dispatches == 3  # 12 iters / interval 4
 
+    def test_nmf_whole_run_is_one_dispatch(self, corpus):
+        from spark_text_clustering_tpu.models.nmf import NMF
+
+        rows, vocab = corpus
+        opt = NMF(Params(k=3, algorithm="nmf", max_iterations=12, seed=0))
+        opt.fit(rows, vocab)
+        assert opt.last_dispatches == 1
+
     def test_em_whole_run_is_one_dispatch(self, corpus):
         from spark_text_clustering_tpu.models.em_lda import EMLDA
 
