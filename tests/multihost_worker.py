@@ -40,6 +40,17 @@ def make_online_toy_params():
                   batch_size=6, device_resident=True)
 
 
+def make_tiles_toy_params():
+    """Shared Params for the tiled-resident cross-process fit (same
+    one-factory rule): the corpus tiles to one real tile + per-shard
+    pads, so empty shards pick pad tiles — the degenerate-but-legal
+    stratification — while the sstats psum still crosses DCN."""
+    from spark_text_clustering_tpu.config import Params
+
+    return Params(k=2, max_iterations=4, algorithm="online", seed=0,
+                  batch_size=6, sampling="epoch", token_layout="tiles")
+
+
 def make_toy_token_docs():
     """Deterministic token documents for the DISTRIBUTED vocab build:
     term frequencies engineered so the top-V depends on counts from BOTH
@@ -169,6 +180,14 @@ def main() -> int:
     packed_lam = np.asarray(packed_est.fit(rows, vocab).lam)
     assert packed_est.last_layout == "packed"
 
+    # --- tiled-resident online fit across the process boundary ------------
+    # The resident tile arrays shard over a "data" axis spanning both
+    # processes; each iteration's pick tensor and the M-step psum cross
+    # DCN (interpret-mode tile kernel on the cpu platform).
+    tiles_est = OnlineLDA(make_tiles_toy_params(), mesh=mesh)
+    tiles_lam = np.asarray(tiles_est.fit(rows, vocab).lam)
+    assert tiles_est.last_layout == "tiles_resident"
+
     # --- distributed vocabulary build (cross-host reduceByKey) ------------
     # Each process counts ONLY its own document shard; the DCN merge must
     # reproduce the single-process global top-V on every process.
@@ -189,6 +208,7 @@ def main() -> int:
         assert ckpt_exists, "coordinator checkpoint missing"
         np.savez(out_path, n_wk=n_wk, total=float(total), fit_lam=lam,
                  online_lam=online_lam, packed_lam=packed_lam,
+                 tiles_lam=tiles_lam,
                  vocab_dist=np.asarray(vocab_dist))
     print(f"proc {pid}: ok devices={n_dev}")
     return 0

@@ -67,7 +67,7 @@ def test_multi_process_bringup_and_em_step(tmp_path, nproc):
     outputs = []
     for p in procs:
         try:
-            stdout, _ = p.communicate(timeout=300)
+            stdout, _ = p.communicate(timeout=420)
         except subprocess.TimeoutExpired:
             for q in procs:
                 q.kill()
@@ -141,6 +141,18 @@ def test_multi_process_bringup_and_em_step(tmp_path, nproc):
     expected_online = np.asarray(online.fit(rows, vocab).lam)
     np.testing.assert_allclose(
         data["online_lam"], expected_online, rtol=1e-4, atol=1e-5
+    )
+
+    # tiled-resident fit across the process boundary == the same fit on
+    # an identically-shaped single-process mesh (same corpus plan, same
+    # per-shard pick streams)
+    from multihost_worker import make_tiles_toy_params
+
+    tiles = OnlineLDA(make_tiles_toy_params(), mesh=mesh)
+    expected_tiles = np.asarray(tiles.fit(rows, vocab).lam)
+    assert tiles.last_layout == "tiles_resident"
+    np.testing.assert_allclose(
+        data["tiles_lam"], expected_tiles, rtol=1e-4, atol=1e-5
     )
 
     # distributed vocab build: the 2-process DCN merge reproduced the
