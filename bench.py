@@ -617,6 +617,13 @@ def _bench_sklearn_baseline(rows, eval_rows, bsz: int):
 
 
 def child_main() -> None:
+    # Ambient 1-min load BEFORE any bench work: on this 1-core sandbox
+    # the sklearn baseline (and our host-side packing) measured
+    # 938-2,266 docs/s purely with host contention, so every record
+    # carries the load the capture STARTED under (sampling at emission
+    # would mostly read the bench's own multi-minute footprint)
+    ambient_load = os.getloadavg()[0]
+
     import jax
 
     # Persistent XLA compile cache: repeat bench runs skip the 20-40s
@@ -696,6 +703,7 @@ def child_main() -> None:
                 "unit": "s/iter",
                 "vs_baseline": round(BASELINE_S_PER_ITER / s_per_iter, 2),
                 "platform": jax.default_backend(),
+                "host_load_1min": round(ambient_load, 2),
                 "roofline": em_roofline,
                 "em_ge": (
                     {
