@@ -632,27 +632,11 @@ def child_main() -> None:
     # hosts share node names across different microarchitectures, and a
     # stale AOT artifact compiled for the wrong machine dies with SIGILL,
     # taking the whole bench child with it).
-    import hashlib
-
-    import platform
-
-    try:
-        with open("/proc/cpuinfo") as f:
-            flags = next(
-                (ln for ln in f if ln.startswith(("flags", "Features"))), ""
-            )
-    except OSError:
-        flags = ""
-    # machine+node fallback keeps hosts distinct even where cpuinfo has
-    # no feature line (non-Linux) — never let two microarchitectures
-    # share one AOT cache on the empty digest
-    fp = hashlib.sha1(
-        f"{flags}|{platform.machine()}|{platform.node()}".encode()
-    ).hexdigest()[:12]
-    jax.config.update(
-        "jax_compilation_cache_dir",
-        os.path.join(CACHE, f"xla_cache_{jax.default_backend()}_{fp}"),
+    from spark_text_clustering_tpu.utils.env import (
+        enable_persistent_compile_cache,
     )
+
+    enable_persistent_compile_cache(cache_root=CACHE)
 
     s_per_iter, em_roofline = _bench_em("EN", BASELINE_S_PER_ITER)
     ge_s_per_iter = None

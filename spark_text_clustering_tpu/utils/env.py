@@ -18,7 +18,12 @@ import sys
 import time
 from typing import Mapping, MutableMapping, Optional
 
-__all__ = ["scrub_axon_env", "scrubbed_cpu_env", "probe_accelerator"]
+__all__ = [
+    "scrub_axon_env",
+    "scrubbed_cpu_env",
+    "probe_accelerator",
+    "enable_persistent_compile_cache",
+]
 
 
 def scrub_axon_env(env: MutableMapping[str, str]) -> None:
@@ -135,3 +140,40 @@ def probe_accelerator(
             )
     return {"ok": False, "backend": None, "version": None,
             "devices": 0, "error": last_err, "history": history}
+
+
+def enable_persistent_compile_cache(cache_root: Optional[str] = None) -> str:
+    """Point JAX at a persistent XLA compile cache so repeat invocations
+    skip the 20-60s cold compiles (a fresh `cli score` process pays ~65s
+    of jit compiles for the 51-book scoring buckets; warm execution is
+    0.3s).  The directory is keyed by backend + a digest of the host's
+    ACTUAL CPU feature flags: sandbox hosts share node names across
+    microarchitectures, and a stale AOT artifact compiled for the wrong
+    machine dies with SIGILL (bench.py round-3 post-mortem — this is the
+    same scheme, shared).  Call AFTER the backend is chosen (imports
+    jax).  Returns the cache dir.
+    """
+    import hashlib
+    import platform
+
+    import jax
+
+    try:
+        with open("/proc/cpuinfo") as f:
+            flags = next(
+                (ln for ln in f if ln.startswith(("flags", "Features"))), ""
+            )
+    except OSError:
+        flags = ""
+    fp = hashlib.sha1(
+        f"{flags}|{platform.machine()}|{platform.node()}".encode()
+    ).hexdigest()[:12]
+    root = cache_root or os.path.join(
+        os.path.expanduser("~"), ".cache", "spark_text_clustering_tpu"
+    )
+    path = os.path.join(
+        root, f"xla_cache_{jax.default_backend()}_{fp}"
+    )
+    os.makedirs(path, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", path)
+    return path
