@@ -348,10 +348,21 @@ def _bench_em(lang: str = "EN", baseline: float = BASELINE_S_PER_ITER):
     # second reaches), then the timed 50-iter run hits both caches.
     opt.fit(rows, vocab)
 
-    t0 = time.perf_counter()
-    model = opt.fit(rows, vocab)
-    total = time.perf_counter() - t0
-    s_per_iter = float(np.mean(model.iteration_times))
+    # Median of 3 timed fits: a warm EM fit is ONE device dispatch, so
+    # its wall carries exactly one tunnel round trip whose latency
+    # swings 100-500 ms between calls — single-capture EM numbers
+    # varied 83-97x on the same code.  The median keeps the number
+    # honest (a full fit, RTT included) while shedding per-call tail
+    # luck.
+    samples = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        model = opt.fit(rows, vocab)
+        samples.append(
+            (time.perf_counter() - t0, list(model.iteration_times))
+        )
+    total, iter_times = sorted(samples)[1]
+    s_per_iter = float(np.mean(iter_times))
     # last_cells is the cell count the sweep actually processed under the
     # layout the fit chose (padded grid vs true packed tokens); the record
     # names the layout so rooflines are comparable across captures
