@@ -345,7 +345,7 @@ def _bench_em(lang: str = "EN", baseline: float = BASELINE_S_PER_ITER):
     # pass pays jit compiles AND cold-transport costs (the chip sits
     # behind a tunnel whose throughput ramps over the first few MB;
     # measured: a first fit runs ~3-4x slower than the steady state the
-    # second reaches), then the timed 50-iter run hits both caches.
+    # second reaches), then the 3 timed fits hit both caches.
     opt.fit(rows, vocab)
 
     # Median of 3 timed fits: a warm EM fit is ONE device dispatch, so
