@@ -532,9 +532,12 @@ def make_online_packed_chunk(
     tests/test_resident_training.py).  Host->device per iteration is
     ~3*T scalars — the packed batches, not a resident corpus.
 
-    The gamma loop is the XLA segment fixed point (the Pallas kernel is
-    built for the padded [k, B, L] layout; with 10-20x fewer cells the
-    packed XLA loop still wins — a packed Pallas kernel is future work).
+    The gamma loop is the XLA segment fixed point: this host-streaming
+    variant keeps EXACT per-token layout (no tile padding), which the
+    Mosaic kernel cannot tile.  The kernelized packed path is
+    ``make_online_packed_tiles_chunk`` (``ops.pallas_packed``), the auto
+    default on TPU; this flat variant remains the fallback for corpora
+    whose nnz distribution makes tile padding wasteful.
 
     Returned fn: (state, tok_ids [m, T], tok_cts [m, T], tok_seg [m, T],
     picks [m, B], batch_docs [m], corpus_sz) -> state.
