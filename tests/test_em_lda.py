@@ -98,3 +98,22 @@ class TestEMLDA:
         frac = [(i, w * 0.37) for i, w in rows]
         model = _fit(frac, vocab)
         assert np.isfinite(model.lam).all()
+
+    def test_packed_segment_fallback_matches_onehot(
+        self, tiny_corpus_rows, monkeypatch
+    ):
+        """The packed sweep's doc-side ops have two formulations: one-hot
+        matmuls under the per-shard budget (every test corpus) and the
+        gather/segment_sum fallback above it (the 1M-doc sharded scale the
+        packed runner exists for).  Pin them against each other so the
+        fallback — unreachable by corpus size in any test — stays
+        covered."""
+        from spark_text_clustering_tpu.models import em_lda
+
+        rows, vocab = tiny_corpus_rows
+        fast = _fit(rows, vocab, token_layout="packed")
+        monkeypatch.setattr(em_lda, "_DK_ONEHOT_BUDGET", 0)
+        slow = _fit(rows, vocab, token_layout="packed")
+        np.testing.assert_allclose(
+            slow.lam, fast.lam, rtol=2e-3, atol=1e-5
+        )
