@@ -29,6 +29,7 @@ that convention.
 from __future__ import annotations
 
 import os
+import zlib
 from functools import partial
 from typing import Callable, List, NamedTuple, Optional, Sequence, Tuple
 
@@ -38,6 +39,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..config import Params
+from ..ops.lda_math import _resolve_gamma_backend
 from ..ops.sparse import DocTermBatch, batch_from_rows, next_pow2
 from ..parallel.collectives import (
     data_shard_batch,
@@ -189,13 +191,29 @@ _DK_ONEHOT_BUDGET = 128 * 1024 * 1024
 
 
 def make_em_packed_runner(
-    mesh: Mesh, *, alpha: float, eta: float, vocab_size: int
+    mesh: Mesh, *, alpha: float, eta: float, vocab_size: int,
+    scatter_plan=None, scatter_interpret: Optional[bool] = None,
 ):
     """TOKEN-PACKED EM sweeps: the corpus's edges live as flat per-shard
     token arrays (ids, weights, per-token LOCAL doc position) instead of
     padded [B, L] grids, so each sweep's FLOPs/bandwidth scale with the
     true edge count — the EN books pad 917k cells for 253k edges (3.6x
     waste) under the single-bucket grid (PERF.md round 3).
+
+    ``scatter_plan`` (an ``ops.pallas_emscatter.EmScatterPlan``) replaces
+    the per-sweep XLA scatter-add into N_wk with the vocab-tiled Pallas
+    one-hot accumulation.  CONTRACT: the token arrays passed to the
+    returned runner must already be in the plan's vocab-sorted tile
+    layout (``plan.sort_order`` applied host-side, as EMLDA.fit does) —
+    posteriors then leave the E-step in kernel order and no per-sweep
+    gather or transpose exists.  Sorted order drops doc-contiguity, so
+    a plan may only be used when the one-hot doc-side formulation is in
+    budget.  The plan's block maps are device_put here, sharded over
+    ("data", "model"), and baked into the returned runner: callers must
+    rebuild the runner when the corpus changes, not just the vocabulary
+    (EMLDA.fit keys its cache on a corpus fingerprint).
+    ``scatter_interpret`` defaults to interpreted execution off-TPU
+    (tests) and Mosaic on the chip.
 
     Sharding is DOC-CONTIGUOUS over "data": the host assigns whole
     documents to shards (greedy nnz balance), so every document's tokens
@@ -212,7 +230,57 @@ def make_em_packed_runner(
     initial counts the two layouts produce equal sweeps.
     """
 
-    def _sweep(n_wk_shard, n_dk, ids_t, cts_t, seg_t):
+    if scatter_plan is not None:
+        from ..ops.pallas_emscatter import scatter_add_vtiles
+
+        sp = scatter_plan
+        interp = (
+            jax.default_backend() != "tpu"
+            if scatter_interpret is None
+            else scatter_interpret
+        )
+        pair_spec3 = NamedSharding(mesh, P(DATA_AXIS, MODEL_AXIS, None))
+        pair_spec5 = NamedSharding(
+            mesh, P(DATA_AXIS, MODEL_AXIS, None, None, None)
+        )
+        plan_dev = (
+            jax.device_put(sp.lids, pair_spec5),
+            jax.device_put(sp.block_vtile, pair_spec3),
+            jax.device_put(sp.block_first, pair_spec3),
+        )
+
+        def _scatter(ids_t, wphi, shard_v, plan_args):
+            # wphi spans the data shard's whole sorted token axis (one
+            # nb*tb segment per model shard); this device's kernel runs
+            # on its own segment only.
+            lids, bv, bf = plan_args
+            seg_len = sp.nb * sp.tb
+            w_seg = jax.lax.dynamic_slice_in_dim(
+                wphi,
+                jax.lax.axis_index(MODEL_AXIS) * seg_len,
+                seg_len,
+                axis=0,
+            )
+            return scatter_add_vtiles(
+                w_seg, lids[0, 0], bv[0, 0], bf[0, 0],
+                n_vtiles=sp.n_vtiles, nb=sp.nb, vt=sp.vt, tb=sp.tb,
+                shard_v=shard_v, interpret=interp,
+            )
+
+        plan_specs = (
+            P(DATA_AXIS, MODEL_AXIS, None, None, None),
+            P(DATA_AXIS, MODEL_AXIS, None),
+            P(DATA_AXIS, MODEL_AXIS, None),
+        )
+    else:
+
+        def _scatter(ids_t, wphi, shard_v, plan_args):
+            return scatter_add_model_shard(ids_t, wphi, shard_v)
+
+        plan_dev = ()
+        plan_specs = ()
+
+    def _sweep(n_wk_shard, n_dk, ids_t, cts_t, seg_t, *plan_args):
         d_max = n_dk.shape[0]
         # Doc-side segment ops as ONE-HOT MATMULS when the one-hot fits:
         # TPU scatters/gathers serialize, so routing the per-token doc
@@ -252,7 +320,7 @@ def make_em_packed_runner(
                 wphi, seg_t, num_segments=d_max
             )
         n_wk_partial = psum_data(
-            scatter_add_model_shard(ids_t, wphi, n_wk_shard.shape[-1])
+            _scatter(ids_t, wphi, n_wk_shard.shape[-1], plan_args)
         )
         return n_wk_partial, n_dk_new
 
@@ -265,21 +333,27 @@ def make_em_packed_runner(
             P(DATA_AXIS),          # token ids (flat, doc-contiguous)
             P(DATA_AXIS),          # token weights
             P(DATA_AXIS),          # token LOCAL doc positions
-        ),
+        ) + plan_specs,
         out_specs=(P(None, MODEL_AXIS), P(DATA_AXIS, None)),
         check_vma=False,
     )
 
     @partial(jax.jit, static_argnames=("m",))
-    def run_chunk(n_wk, n_dk, ids_t, cts_t, seg_t, m: int):
+    def _run_chunk(n_wk, n_dk, ids_t, cts_t, seg_t, m: int, *plan_args):
         def body(carry, _):
             n_wk, n_dk = carry
-            return sharded(n_wk, n_dk, ids_t, cts_t, seg_t), None
+            return (
+                sharded(n_wk, n_dk, ids_t, cts_t, seg_t, *plan_args),
+                None,
+            )
 
         (n_wk, n_dk), _ = jax.lax.scan(
             body, (n_wk, n_dk), None, length=m
         )
         return n_wk, n_dk
+
+    def run_chunk(n_wk, n_dk, ids_t, cts_t, seg_t, m: int):
+        return _run_chunk(n_wk, n_dk, ids_t, cts_t, seg_t, m, *plan_dev)
 
     return run_chunk
 
@@ -479,6 +553,10 @@ class EMLDA:
         self._packed_init_fn = None
         self._packed_init_key = None
         self.last_layout: str = "padded"
+        # how the packed sweep aggregated N_wk: "xla" scatter, the
+        # vocab-tiled Pallas kernel ("pallas_vtiles"), or "none" when
+        # the fit did not run packed sweeps at all
+        self.last_scatter_backend: str = "none"
 
     def _init_state(
         self,
@@ -774,6 +852,7 @@ class EMLDA:
 
         timer = IterationTimer()
         self.last_layout = "padded"
+        self.last_scatter_backend = "none"
         # device dispatches this fit issued (tests pin the whole-run
         # chunking: no checkpointing -> one dispatch per phase)
         self.last_dispatches = 0
@@ -787,6 +866,60 @@ class EMLDA:
             (ids_f, cts_f, seg_f, doc_f, pos_f, slot, d_max,
              packed_cells) = self._packed_plan(rows, n)
             self.last_cells = packed_cells  # true cells processed
+            # The N_wk scatter kernel needs the corpus stored in its
+            # vocab-sorted tile layout (ops/pallas_emscatter: sorting
+            # the DATA once beats gathering posteriors every sweep);
+            # same auto/override switch as every kernel-vs-XLA choice
+            # in this package.  Sorting drops doc-contiguity, which
+            # only the one-hot doc-side formulation tolerates — so the
+            # plan is gated on the same budget.
+            n_data = self.mesh.shape[DATA_AXIS]
+            scatter_plan = None
+            # cheap pre-gate: the sorted layout can only SHRINK below
+            # the live token count by zero, so an over-budget live count
+            # rules the plan out without paying the per-pair argsort
+            live_max = int(
+                (cts_f.reshape(n_data, -1) > 0).sum(axis=1).max()
+            )
+            if (
+                _resolve_gamma_backend("auto") == "pallas"
+                and live_max * d_max * 4 <= _DK_ONEHOT_BUDGET
+            ):
+                from ..ops.pallas_emscatter import plan_em_scatter
+
+                scatter_plan = plan_em_scatter(
+                    ids_f.reshape(n_data, -1),
+                    cts_f.reshape(n_data, -1),
+                    p.model_shards,
+                    v_pad // p.model_shards,
+                )
+                if scatter_plan is not None:
+                    t_sorted = (
+                        p.model_shards * scatter_plan.nb
+                        * scatter_plan.tb
+                    )
+                    if t_sorted * d_max * 4 > _DK_ONEHOT_BUDGET:
+                        scatter_plan = None
+            if scatter_plan is not None:
+                so = scatter_plan.sort_order          # [S_d, T_sorted]
+
+                def _reorder(a, pad):
+                    a2 = a.reshape(n_data, -1)
+                    ext = np.concatenate(
+                        [a2, np.full((n_data, 1), pad, a2.dtype)],
+                        axis=1,
+                    )
+                    return np.take_along_axis(ext, so, axis=1).reshape(-1)
+
+                ids_f = _reorder(ids_f, 0)
+                cts_f = _reorder(cts_f, 0)
+                seg_f = _reorder(seg_f, 0)
+                doc_f = _reorder(doc_f, 0)
+                pos_f = _reorder(pos_f, 0)
+                self.last_cells = n_data * so.shape[1]
+                self.last_scatter_backend = "pallas_vtiles"
+            else:
+                self.last_scatter_backend = "xla"
             tok_spec = NamedSharding(self.mesh, P(DATA_AXIS))
             ids_dev = jax.device_put(ids_f, tok_spec)
             cts_dev = jax.device_put(cts_f, tok_spec)
@@ -821,11 +954,26 @@ class EMLDA:
                     jax.device_put(doc_f, tok_spec),
                     jax.device_put(pos_f, tok_spec),
                 )
-            if self._packed_fn is None or self._packed_fn_vocab != v:
-                self._packed_fn = make_em_packed_runner(
-                    self.mesh, alpha=alpha, eta=eta, vocab_size=v
+            # The runner cache key carries a corpus fingerprint when the
+            # scatter plan is active — the plan's block maps are baked
+            # into the runner, and a same-vocab different-corpus refit
+            # with a stale plan would scatter to the wrong columns.
+            fn_key = (
+                (v, False)
+                if scatter_plan is None
+                else (
+                    v,
+                    True,
+                    zlib.crc32(ids_f.tobytes()),
+                    zlib.crc32((cts_f > 0).tobytes()),
                 )
-                self._packed_fn_vocab = v
+            )
+            if self._packed_fn is None or self._packed_fn_vocab != fn_key:
+                self._packed_fn = make_em_packed_runner(
+                    self.mesh, alpha=alpha, eta=eta, vocab_size=v,
+                    scatter_plan=scatter_plan,
+                )
+                self._packed_fn_vocab = fn_key
             run = self._packed_fn
             # packed corpus is device-resident: dispatches stage nothing
             interval = resolve_dispatch_interval(

@@ -1,0 +1,239 @@
+"""Pallas TPU kernel for the packed EM sweep's N_wk token scatter.
+
+After the doc-side ops moved onto the MXU (em_lda round-4 one-hot
+matmuls), the packed EM sweep's remaining cost is the per-sweep
+``scatter-add`` of [T, k] token posteriors into the [k, V] term-topic
+table: XLA lowers it to a serialized scatter that measured 3.7 of the
+EN-books sweep's 8.5 ms on a v5e — bandwidth-idle, latency-bound
+(PERF.md round-4 EM sweep ablation).  MLlib pays the same aggregation as
+its GraphX ``aggregateMessages`` shuffle (SURVEY.md §2.2 EMLDAOptimizer);
+this module is its TPU-native replacement.
+
+Design — the CORPUS is stored vocab-sorted, so the kernel needs no
+gather at all:
+
+  * token ids are STATIC for a whole fit, so the sort happens ONCE on
+    the host (``plan_em_scatter``): tokens grouped by vocab tile of
+    ``vt`` columns, each tile's run padded to ``tb``-token blocks.  The
+    fit REORDERS the resident token arrays into this layout up front
+    (``plan.sort_order``) — legal because the packed sweep's doc-side
+    ops are one-hot matmuls, which never needed doc-contiguity.  Every
+    sweep's posteriors then come out of the E-step already in kernel
+    order.  The first cut of this kernel instead re-gathered
+    doc-ordered posteriors per sweep; that one XLA lane-axis gather
+    cost 4.7 ms — more than the scatter it replaced — while the kernel
+    itself ran 0.8 ms.  Sorting data beats sorting compute.
+  * the kernel walks a COMPACT 1-D grid over real blocks (vocab ids are
+    frequency-ranked, so per-tile block counts span orders of
+    magnitude; a dense [tile, max-blocks] grid measured 2x SLOWER than
+    the XLA scatter purely on ~2 us/step grid overhead at 86% sentinel
+    steps).  Each block's vocab tile comes from a scalar-prefetch map;
+    a tile's output block stays resident across its consecutive blocks,
+    initialized where the prefetch first-flag marks a tile's first
+    block.
+  * each program builds its block's [vt, tb] one-hot IN VMEM from an
+    iota compare (it never touches HBM) and contracts it with its
+    [tb, k] posterior block on the MXU.  MACs scale with T * vt * k —
+    INDEPENDENT of V, unlike a dense one-hot matmul over the
+    vocabulary (which loses to the scatter already at V=37k: 13.9 vs
+    3.7 ms measured).  Blocks keep k as the trailing dim end to end
+    ([tb, k] in, [vt, k] out) — the layout the E-step produces — so no
+    transpose exists on either side of the kernel.
+  * precision is HIGHEST: a one-hot matmul is an exact f32
+    selection/sum; the MXU's default bf16 passes drift EM counts by 1e4
+    over 50 sweeps (measured — same hazard as the doc-side matmuls).
+
+Like every kernel in this package it runs interpreted off-TPU, so CPU
+tests pin the identical program (tests/test_pallas_emscatter.py).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = [
+    "EmScatterPlan",
+    "plan_em_scatter",
+    "scatter_add_vtiles",
+]
+
+# Default geometry: 512-column vocab tiles x 1024-token blocks — the
+# in-VMEM one-hot is 2 MB f32, both matmul dims MXU-aligned, and the
+# block size halves the grid-step count relative to 512 (the kernel is
+# grid-overhead-bound, ~2 us/step).
+_VT = 512
+_TB = 1024
+
+
+class EmScatterPlan(NamedTuple):
+    """Static vocab-sorted token layout for one packed corpus.
+
+    ``sort_order`` maps each slot of the sorted-padded token axis to an
+    index into the ORIGINAL per-data-shard token axis (sentinel ==
+    t_local for pad slots): the fit applies it once, host-side, to every
+    per-token array before upload.  The sorted axis concatenates one
+    ``nb * tb``-slot segment per model shard (slots of model shard m
+    hold only ids owned by m, so the per-device kernel runs on its own
+    contiguous segment).  ``lids`` holds each slot's column offset
+    within its vocab tile (pad slots == -1, matching no iota row);
+    ``block_vtile`` maps each compact block to its vocab tile and
+    ``block_first`` marks a tile's first block (the kernel's
+    accumulator init).  The block axis is padded to the global max so
+    every (data, model) pair shares one geometry (shard_map needs
+    uniform shapes); pad blocks are all-pad and CONTINUE the pair's
+    last vocab tile, so the output walk stays consecutive and they
+    contribute exactly zero.  Every vocab tile owns >= 1 block (empty
+    tiles get one all-pad block) so every output block is initialized.
+    """
+
+    sort_order: np.ndarray   # [S_d, S_m * nb * tb] int64
+    lids: np.ndarray         # [S_d, S_m, nb, 1, tb] int32
+    block_vtile: np.ndarray  # [S_d, S_m, nb] int32
+    block_first: np.ndarray  # [S_d, S_m, nb] int32 (0/1)
+    n_vtiles: int
+    nb: int                  # compact blocks per pair (uniform, padded)
+    vt: int
+    tb: int
+
+
+def plan_em_scatter(
+    ids: np.ndarray,     # [S_d, T_local] int32 global vocab ids
+    cts: np.ndarray,     # [S_d, T_local] float32 (0 => pad slot)
+    n_model: int,
+    shard_v: int,
+    vt: int = _VT,
+    tb: int = _TB,
+) -> Optional[EmScatterPlan]:
+    """Sort each (data shard, model shard) pair's live tokens by vocab
+    tile and pack them into ``tb``-token blocks, one compact run per
+    tile.  Returns None for degenerate geometry (zero-width shards)."""
+    if shard_v <= 0 or ids.size == 0:
+        return None
+    s_d, t_local = ids.shape
+    n_vtiles = (shard_v + vt - 1) // vt
+
+    pair_data = []
+    nb_uniform = 0
+    for s in range(s_d):
+        live = np.nonzero(cts[s] > 0)[0]
+        gids = ids[s][live]
+        for m in range(n_model):
+            sel = (gids >= m * shard_v) & (gids < (m + 1) * shard_v)
+            tok_idx = live[sel].astype(np.int64)
+            lid = (gids[sel] - m * shard_v).astype(np.int64)
+            order = np.argsort(lid, kind="stable")
+            tok_idx, lid = tok_idx[order], lid[order]
+            cnt = np.bincount(lid // vt, minlength=n_vtiles)
+            nb_v = np.maximum(-(-cnt // tb), 1)   # ceil; empty tiles: 1
+            pair_data.append((s, m, tok_idx, lid, cnt, nb_v))
+            nb_uniform = max(nb_uniform, int(nb_v.sum()))
+
+    sort_order = np.full(
+        (s_d, n_model, nb_uniform * tb), t_local, np.int64
+    )
+    lids = np.full((s_d, n_model, nb_uniform, tb), -1, np.int32)
+    block_vtile = np.full(
+        (s_d, n_model, nb_uniform), n_vtiles - 1, np.int32
+    )
+    block_first = np.zeros((s_d, n_model, nb_uniform), np.int32)
+    for s, m, tok_idx, lid, cnt, nb_v in pair_data:
+        starts_v = np.zeros(n_vtiles, np.int64)
+        np.cumsum(nb_v[:-1], out=starts_v[1:])
+        block_vtile[s, m, : int(nb_v.sum())] = np.repeat(
+            np.arange(n_vtiles, dtype=np.int32), nb_v
+        )
+        # pad blocks beyond the pair's real run keep the default:
+        # they continue the LAST vocab tile with all-pad slots
+        block_first[s, m, starts_v] = 1
+        if tok_idx.size:
+            first_tok = np.zeros(n_vtiles + 1, np.int64)
+            np.cumsum(cnt, out=first_tok[1:])
+            vtile = lid // vt
+            slot = (
+                starts_v[vtile] * tb
+                + np.arange(tok_idx.size, dtype=np.int64)
+                - first_tok[vtile]
+            )
+            sort_order[s, m, slot] = tok_idx
+            lids[s, m].reshape(-1)[slot] = lid % vt
+    return EmScatterPlan(
+        sort_order.reshape(s_d, n_model * nb_uniform * tb),
+        lids.reshape(s_d, n_model, nb_uniform, 1, tb),
+        block_vtile,
+        block_first,
+        n_vtiles,
+        nb_uniform,
+        vt,
+        tb,
+    )
+
+
+def _scatter_kernel(bv_ref, bf_ref, lids_ref, wphi_ref, out_ref,
+                    *, vt: int):
+    del bv_ref  # consumed by the output index map
+    i = pl.program_id(0)
+
+    @pl.when(bf_ref[i] == 1)
+    def _init():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    lids = lids_ref[:].reshape(1, -1)                     # [1, tb]
+    onehot = (
+        jax.lax.broadcasted_iota(jnp.int32, (vt, lids.shape[1]), 0)
+        == lids
+    ).astype(jnp.float32)                                 # [vt, tb]
+    out_ref[:] += jax.lax.dot_general(
+        wphi_ref[:], onehot,
+        dimension_numbers=(((0,), (1,)), ((), ())),
+        precision=jax.lax.Precision.HIGHEST,
+        preferred_element_type=jnp.float32,
+    )                                                     # [k, vt]
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("n_vtiles", "nb", "vt", "tb", "shard_v",
+                     "interpret"),
+)
+def scatter_add_vtiles(
+    wphi_sorted: jnp.ndarray,  # [nb * tb, k] posteriors, kernel order
+    lids: jnp.ndarray,         # [nb, 1, tb] int32
+    block_vtile: jnp.ndarray,  # [nb] int32
+    block_first: jnp.ndarray,  # [nb] int32
+    *,
+    n_vtiles: int,
+    nb: int,
+    vt: int,
+    tb: int,
+    shard_v: int,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """``zeros([k, shard_v]).at[:, ids].add(wphi.T)`` for this device's
+    tokens, as a vocab-tiled one-hot accumulation over posteriors that
+    already live in the plan's sorted order (see module doc)."""
+    k = wphi_sorted.shape[1]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(nb,),
+        in_specs=[
+            pl.BlockSpec((1, 1, tb), lambda i, bv, bf: (i, 0, 0)),
+            pl.BlockSpec((tb, k), lambda i, bv, bf: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec(
+            (k, vt), lambda i, bv, bf: (0, bv[i])
+        ),
+    )
+    out = pl.pallas_call(
+        functools.partial(_scatter_kernel, vt=vt),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((k, n_vtiles * vt), jnp.float32),
+        interpret=interpret,
+    )(block_vtile, block_first, lids, wphi_sorted)
+    return out[:, :shard_v]
