@@ -126,6 +126,13 @@ def parse_stop_words(text_or_lines) -> frozenset:
 _SENT_SPLIT_RE = re.compile(r"(?<=[.!?])\s+")
 _WORD_RE = re.compile(r"[^\W\d_]+(?:['’][^\W\d_]+)?", re.UNICODE)
 
+
+def split_sentences(text: str) -> List[str]:
+    """Sentence boundaries for the lemmatizer's per-sentence dedup + NNP
+    evidence passes (the reference lemmatizes per CoreNLP sentence,
+    LDAClustering.scala:295-300).  Boundary = ``(?<=[.!?])\\s+``."""
+    return _SENT_SPLIT_RE.split(text)
+
 # Irregular-form table (frequent English irregulars; CoreNLP's Morphology
 # resolves these via its finite-state lexicon).  Entries whose source AND
 # target are both <= 3 chars are dropped by the lemma-length filter either
@@ -393,7 +400,7 @@ def lemmatize_text(
     lower_bases: set = set()
     noninitial_caps: set = set()
     sentence_parts: List[List[tuple]] = []
-    for sentence in _SENT_SPLIT_RE.split(text):
+    for sentence in split_sentences(text):
         words = _WORD_RE.findall(sentence)
         if fold_case:
             # NNP evidence pass runs BEFORE dedup: a capitalized form seen
