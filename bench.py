@@ -373,6 +373,7 @@ def _bench_em(lang: str = "EN", baseline: float = BASELINE_S_PER_ITER):
     )
     roofline["token_layout"] = opt.last_layout
     roofline["cells"] = int(opt.last_cells)
+    roofline["scatter_backend"] = opt.last_scatter_backend
     sys.stderr.write(
         f"# EM {lang}: {len(rows)} docs, V={vocab_len}, k={K}, {ITERS} "
         f"iters, total {total:.1f}s, logLik {opt.last_log_likelihood:.1f}, "

@@ -190,6 +190,7 @@ def make_em_chunk_runner(
 _DK_ONEHOT_BUDGET = 128 * 1024 * 1024
 
 
+
 def make_em_packed_runner(
     mesh: Mesh, *, alpha: float, eta: float, vocab_size: int,
     scatter_plan=None, scatter_interpret: Optional[bool] = None,
@@ -232,6 +233,7 @@ def make_em_packed_runner(
 
     if scatter_plan is not None:
         from ..ops.pallas_emscatter import scatter_add_vtiles
+        from ..ops.pallas_emsweep import MAX_FUSED_DOC_SLOTS
 
         sp = scatter_plan
         interp = (
@@ -272,7 +274,53 @@ def make_em_packed_runner(
             P(DATA_AXIS, MODEL_AXIS, None),
             P(DATA_AXIS, MODEL_AXIS, None),
         )
+
+        def _sweep_fused(n_wk_shard, n_dk, ids_t, cts_t, seg_t, *plan_args):
+            # The fully-fused Mosaic sweep (ops/pallas_emsweep): term
+            # gather, doc factor, phi, and BOTH count reductions in one
+            # kernel over this device's sorted token segment.  Each token
+            # is processed by exactly one (data, model) pair, so N_dk
+            # partials psum over "model" — the unfused paths instead
+            # replicate phi across model shards and need no such psum.
+            from ..ops.pallas_emsweep import em_sweep_fused
+
+            lids, bv, bf = plan_args
+            d_max = n_dk.shape[0]
+            d_pad = max(8, -(-d_max // 8) * 8)
+            k = n_wk_shard.shape[0]
+            n_k = model_row_sum(n_wk_shard)                    # [k]
+            inv_denom = 1.0 / (n_k + (eta * vocab_size - vocab_size))
+            docf_kd = (n_dk + (alpha - 1.0)).T
+            if d_pad != d_max:
+                docf_kd = jnp.pad(docf_kd, ((0, 0), (0, d_pad - d_max)))
+            seg_len = sp.nb * sp.tb
+            m_idx = jax.lax.axis_index(MODEL_AXIS)
+
+            def _segment(a, dtype):
+                return jax.lax.dynamic_slice_in_dim(
+                    a, m_idx * seg_len, seg_len, axis=0
+                ).astype(dtype).reshape(sp.nb, 1, sp.tb)
+
+            nwk_p, ndk_p = em_sweep_fused(
+                n_wk_shard,
+                docf_kd,
+                inv_denom,
+                lids[0, 0],
+                _segment(seg_t, jnp.int32),
+                _segment(cts_t, jnp.float32),
+                bv[0, 0],
+                bf[0, 0],
+                n_vtiles=sp.n_vtiles, nb=sp.nb, vt=sp.vt, tb=sp.tb,
+                d_pad=d_pad, shard_v=n_wk_shard.shape[-1],
+                eta_m1=eta - 1.0, interpret=interp,
+            )
+            from ..parallel.collectives import psum_model
+
+            return psum_data(nwk_p), psum_model(ndk_p[:d_max])
+
     else:
+        MAX_FUSED_DOC_SLOTS = 0  # no plan: the fused path cannot run
+
 
         def _scatter(ids_t, wphi, shard_v, plan_args):
             return scatter_add_model_shard(ids_t, wphi, shard_v)
@@ -282,6 +330,10 @@ def make_em_packed_runner(
 
     def _sweep(n_wk_shard, n_dk, ids_t, cts_t, seg_t, *plan_args):
         d_max = n_dk.shape[0]
+        if scatter_plan is not None and d_max <= MAX_FUSED_DOC_SLOTS:
+            return _sweep_fused(
+                n_wk_shard, n_dk, ids_t, cts_t, seg_t, *plan_args
+            )
         # Doc-side segment ops as ONE-HOT MATMULS when the one-hot fits:
         # TPU scatters/gathers serialize, so routing the per-token doc
         # gather and the N_dk segment reduction through the MXU instead
@@ -553,9 +605,9 @@ class EMLDA:
         self._packed_init_fn = None
         self._packed_init_key = None
         self.last_layout: str = "padded"
-        # how the packed sweep aggregated N_wk: "xla" scatter, the
-        # vocab-tiled Pallas kernel ("pallas_vtiles"), or "none" when
-        # the fit did not run packed sweeps at all
+        # how the packed sweep ran: "xla" scatter, the vocab-tiled
+        # scatter kernel ("pallas_vtiles"), the fully-fused Mosaic sweep
+        # ("pallas_fused"), or "none" when the fit ran no packed sweeps
         self.last_scatter_backend: str = "none"
 
     def _init_state(
@@ -886,6 +938,9 @@ class EMLDA:
                 and live_max * d_max * 4 <= _DK_ONEHOT_BUDGET
             ):
                 from ..ops.pallas_emscatter import plan_em_scatter
+                from ..ops.pallas_emsweep import (
+                    MAX_FUSED_DOC_SLOTS,
+                )
 
                 scatter_plan = plan_em_scatter(
                     ids_f.reshape(n_data, -1),
@@ -917,7 +972,11 @@ class EMLDA:
                 doc_f = _reorder(doc_f, 0)
                 pos_f = _reorder(pos_f, 0)
                 self.last_cells = n_data * so.shape[1]
-                self.last_scatter_backend = "pallas_vtiles"
+                self.last_scatter_backend = (
+                    "pallas_fused"
+                    if d_max <= MAX_FUSED_DOC_SLOTS
+                    else "pallas_vtiles"
+                )
             else:
                 self.last_scatter_backend = "xla"
             tok_spec = NamedSharding(self.mesh, P(DATA_AXIS))

@@ -124,11 +124,18 @@ def _fit(rows, vocab, mesh, ms, monkeypatch, backend):
     return np.asarray(model.lam), opt
 
 
+@pytest.mark.parametrize("mode", ["fused", "vtiles"])
 @pytest.mark.parametrize("ds,ms", [(1, 1), (2, 2), (4, 1)])
-def test_integrated_fit_parity(eight_devices, monkeypatch, ds, ms):
-    """Full packed fits: sorted-layout kernel scatter (forced pallas,
-    interpreted) vs doc-contiguous XLA scatter train to the same
-    model."""
+def test_integrated_fit_parity(eight_devices, monkeypatch, ds, ms, mode):
+    """Full packed fits: sorted-layout kernels (forced pallas,
+    interpreted; both the fused sweep and the two-stage scatter) vs
+    doc-contiguous XLA scatter train to the same model."""
+    from spark_text_clustering_tpu.ops import pallas_emsweep
+
+    if mode == "vtiles":
+        # force the two-stage path (scatter kernel + one-hot doc ops):
+        # the runner lazily imports the gate at construction time
+        monkeypatch.setattr(pallas_emsweep, "MAX_FUSED_DOC_SLOTS", 0)
     rng = np.random.default_rng(3)
     rows = []
     for _ in range(40):
@@ -145,7 +152,9 @@ def test_integrated_fit_parity(eight_devices, monkeypatch, ds, ms):
     lam_x, opt_x = _fit(rows, vocab, mesh, ms, monkeypatch, "xla")
     lam_p, opt_p = _fit(rows, vocab, mesh, ms, monkeypatch, "pallas")
     assert opt_x.last_scatter_backend == "xla"
-    assert opt_p.last_scatter_backend == "pallas_vtiles"
+    assert opt_p.last_scatter_backend == (
+        "pallas_fused" if mode == "fused" else "pallas_vtiles"
+    )
     np.testing.assert_allclose(lam_p, lam_x, rtol=2e-3, atol=1e-4)
     assert opt_p.last_log_likelihood == pytest.approx(
         opt_x.last_log_likelihood, rel=1e-4
