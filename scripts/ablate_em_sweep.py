@@ -1,0 +1,101 @@
+"""Ablation timing of the packed EM sweep pieces on the real chip.
+
+The round-4 measurement that drove the MXU sweep ladder (PERF.md
+"Packed EM sweep onto the MXU"): run on the v5e it splits one
+standalone 50-sweep scan into its serialized pieces.  Repro:
+    PYTHONPATH=/root/repo python scripts/ablate_em_sweep.py
+(requires the chip; CPU numbers are not meaningful here).
+
+Variants (m=50 sweeps in one scan dispatch, warm, median of 3):
+  full       — gather + phi + segment_sum(n_dk) + scatter_add(n_wk)
+  noscatter  — skip the n_wk scatter (n_wk carried unchanged)
+  nogather   — replace the gather with a broadcast row (keeps phi math)
+  nosegsum   — skip the n_dk segment_sum (n_dk carried unchanged)
+  matscatter — scatter via V-tiled one-hot matmul instead of .at[].add
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import bench  # repo-root bench module: reuses corpus loading
+
+import jax
+import jax.numpy as jnp
+
+rows, vocab_len = bench._load_rows("EN")
+K = 5
+ALPHA, ETA = 11.0, 1.1
+ids = np.concatenate([r[0] for r in rows]).astype(np.int32)
+cts = np.concatenate([r[1] for r in rows]).astype(np.float32)
+seg = np.concatenate([
+    np.full(len(r[0]), d, np.int32) for d, r in enumerate(rows)
+])
+D = len(rows)
+T = len(ids)
+print(f"platform={jax.default_backend()} T={T} D={D} V={vocab_len}", flush=True)
+
+rng = np.random.default_rng(0)
+n_wk0 = jnp.asarray(rng.random((K, vocab_len)).astype(np.float32) + 0.5)
+n_dk0 = jnp.asarray(rng.random((D, K)).astype(np.float32) + 0.5)
+ids_t = jnp.asarray(ids)
+cts_t = jnp.asarray(cts)
+seg_t = jnp.asarray(seg)
+
+
+def make_run(variant):
+    def _sweep(n_wk, n_dk):
+        n_k = n_wk.sum(-1)
+        if variant == "nogather":
+            term_f = jnp.broadcast_to(n_wk[:, 0], (T, K)) + (ETA - 1.0)
+        else:
+            term_f = n_wk[:, ids_t].T + (ETA - 1.0)
+        doc_f = (n_dk + (ALPHA - 1.0))[seg_t]
+        denom = n_k + (ETA * vocab_len - vocab_len)
+        phi = term_f * (doc_f / denom)
+        phi = phi / (phi.sum(-1, keepdims=True) + 1e-30)
+        wphi = cts_t[:, None] * phi
+        if variant == "nosegsum":
+            n_dk_new = n_dk
+        else:
+            n_dk_new = jax.ops.segment_sum(wphi, seg_t, num_segments=D)
+        if variant == "noscatter":
+            n_wk_new = n_wk
+        elif variant == "matscatter":
+            VT = 4096
+            n_pad = (vocab_len + VT - 1) // VT * VT
+            pieces = []
+            wT = wphi.T  # [K, T]
+            for v0 in range(0, n_pad, VT):
+                onehot = (ids_t[:, None] == (v0 + jnp.arange(VT))[None, :])
+                pieces.append(wT @ onehot.astype(jnp.float32))
+            n_wk_new = jnp.concatenate(pieces, axis=1)[:, :vocab_len]
+        else:
+            n_wk_new = jnp.zeros_like(n_wk).at[:, ids_t].add(wphi.T)
+        return n_wk_new, n_dk_new
+
+    @jax.jit
+    def run(n_wk, n_dk):
+        def body(c, _):
+            return _sweep(*c), None
+        (n_wk, n_dk), _ = jax.lax.scan(body, (n_wk, n_dk), None, length=50)
+        return n_wk, n_dk
+
+    return run
+
+
+for variant in ["full", "noscatter", "nogather", "nosegsum", "matscatter"]:
+    run = make_run(variant)
+    out = run(n_wk0, n_dk0)
+    jax.block_until_ready(out)
+    samples = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        out = run(n_wk0, n_dk0)
+        jax.block_until_ready(out)
+        samples.append(time.perf_counter() - t0)
+    med = sorted(samples)[1]
+    print(f"{variant:10s}: {med*1000:8.1f} ms total, {med/50*1000:6.2f} ms/sweep", flush=True)
