@@ -930,12 +930,20 @@ class EMLDA:
             # cheap pre-gate: the sorted layout can only SHRINK below
             # the live token count by zero, so an over-budget live count
             # rules the plan out without paying the per-pair argsort
-            live_max = int(
-                (cts_f.reshape(n_data, -1) > 0).sum(axis=1).max()
-            )
+            # Multi-process fits keep the XLA path for now: the plan's
+            # block maps are device_put with a mesh-wide ("data",
+            # "model") sharding, which assumes this process addresses
+            # every device (single-process semantics); a pod-scale
+            # kernel path needs per-process plan construction over the
+            # locally-addressable shards.  The live-token pre-gate
+            # (one host pass over the packed corpus) runs only when
+            # the cheaper checks admit the plan at all.
             if (
-                _resolve_gamma_backend("auto") == "pallas"
-                and live_max * d_max * 4 <= _DK_ONEHOT_BUDGET
+                jax.process_count() == 1
+                and _resolve_gamma_backend("auto") == "pallas"
+                and int(
+                    (cts_f.reshape(n_data, -1) > 0).sum(axis=1).max()
+                ) * d_max * 4 <= _DK_ONEHOT_BUDGET
             ):
                 from ..ops.pallas_emscatter import plan_em_scatter
                 from ..ops.pallas_emsweep import (
