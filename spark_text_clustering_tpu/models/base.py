@@ -84,12 +84,71 @@ class LDAModel:
         lam = np.asarray(self.lam, np.float64)
         return lam / lam.sum(axis=1, keepdims=True)
 
+    # Above this vocab width describe_topics stops materializing the
+    # host [k, V] f64 table (40 GB at the CC-News config) and runs a
+    # device top-k instead; below it the host argsort path is kept
+    # bit-for-bit (the golden scoring reports render its f64 digits).
+    _DEVICE_TOPK_MIN_V = 1_000_000
+
     def describe_topics(
-        self, max_terms_per_topic: int = 10
+        self, max_terms_per_topic: int = 10, mesh=None
     ) -> List[List[Tuple[int, float]]]:
         """Per-topic top-n (term_id, weight), weights normalized by topic
         totals — ``describeTopics`` (LDAClustering.scala:81-92,
-        LDALoader.scala:66-69)."""
+        LDALoader.scala:66-69).
+
+        With ``mesh``, candidates come from a V-sharded per-device
+        ``top_k`` + a k x (shards*n) host merge — nothing ever holds the
+        full [k, V] table (the training-scale guarantee extended to
+        topic description); a meshless device-resident lambda above
+        ``_DEVICE_TOPK_MIN_V`` takes a single-device ``top_k``."""
+        n = min(max_terms_per_topic, self.vocab_size or self.lam.shape[1])
+        if mesh is not None:
+            key = ("top_terms", mesh, n)
+            fn = self._fn_cache.get(key)
+            if fn is None:
+                from .sharded_eval import make_sharded_top_terms
+
+                fn = make_sharded_top_terms(mesh, self.vocab_size, n)
+                self._fn_cache[key] = fn
+            ids, vals, totals = fn(self._lam_on_mesh(mesh))
+            ids, vals = np.asarray(ids), np.asarray(vals, np.float64)
+            totals = np.asarray(totals, np.float64)
+            out = []
+            for t in range(ids.shape[0]):
+                # pad-column candidates from narrow shards carry -inf
+                live = np.nonzero(np.isfinite(vals[t]))[0]
+                order = live[np.argsort(-vals[t][live], kind="stable")][:n]
+                out.append([
+                    (int(ids[t][j]), float(vals[t][j] / totals[t]))
+                    for j in order
+                ])
+            return out
+        lam = self.lam
+        if (
+            isinstance(lam, jax.Array)
+            and lam.shape[1] >= self._DEVICE_TOPK_MIN_V
+        ):
+            key = ("device_topk", n)
+            fn = self._fn_cache.get(key)
+            if fn is None:
+                def _topk(x, _n=n):
+                    v, i = jax.lax.top_k(x, _n)
+                    return v, i, x.sum(axis=1)
+
+                fn = jax.jit(_topk)
+                self._fn_cache[key] = fn
+            vals, idx, totals = fn(jnp.asarray(lam, jnp.float32))
+            totals = np.asarray(totals, np.float64)
+            vals = np.asarray(vals, np.float64)
+            idx = np.asarray(idx)
+            return [
+                [
+                    (int(idx[t][j]), float(vals[t][j] / totals[t]))
+                    for j in range(idx.shape[1])
+                ]
+                for t in range(idx.shape[0])
+            ]
         mat = self.topics_matrix()
         out = []
         for row in mat:
@@ -98,13 +157,13 @@ class LDAModel:
         return out
 
     def describe_topics_terms(
-        self, max_terms_per_topic: int = 10
+        self, max_terms_per_topic: int = 10, mesh=None
     ) -> List[List[Tuple[str, float]]]:
         """Same, resolved through the vocabulary (the print loops at
         LDAClustering.scala:85-92)."""
         return [
             [(self.vocab[i], w) for i, w in topic]
-            for topic in self.describe_topics(max_terms_per_topic)
+            for topic in self.describe_topics(max_terms_per_topic, mesh=mesh)
         ]
 
     # ---- inference -----------------------------------------------------
