@@ -1,0 +1,113 @@
+"""Ablate the online tiles-resident iteration at the 20NG bench shape.
+
+Round-4 measurement (v5e, 28k-token minibatch, V=2^18, k=20) — the
+profile behind PERF.md's "Online iteration profile" note.  Repro:
+    PYTHONPATH=/root/repo python scripts/ablate_online_iter.py
+(requires the chip; CPU numbers are not meaningful here)."""
+import sys
+import time
+
+import os
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.scipy.special import digamma as _digamma
+
+from spark_text_clustering_tpu.ops.pallas_packed import gamma_fixed_point_tiles
+
+K = 20
+V = 262144
+D = 512          # doc slots per tile
+TT = 512         # tokens per tile
+NT = 55          # tiles per minibatch (~28k tokens)
+ALPHA = 1.0 / K
+ETA = 1.0 / K
+
+rng = np.random.default_rng(0)
+lam = jnp.asarray(rng.gamma(100.0, 0.01, (K, V)).astype(np.float32))
+ids_t = jnp.asarray(rng.integers(0, V, (NT, TT)).astype(np.int32))
+cts_t = jnp.asarray((rng.random((NT, TT)) * 3 + 0.5).astype(np.float32))
+seg_t = jnp.asarray(
+    np.sort(rng.integers(0, D, (NT, TT)), axis=1).astype(np.int32)
+)
+g0 = jnp.asarray(rng.gamma(100.0, 0.01, (K, NT * D)).astype(np.float32))
+alpha_arr = jnp.full((K,), ALPHA, jnp.float32)
+
+
+def make_run(variant, inner):
+    def _iter(lam_shard, step):
+        flat_ids = ids_t.reshape(-1)
+        row_sum = lam_shard.sum(axis=1)
+        if variant == "nogather_lam":
+            lam_tok = jnp.broadcast_to(
+                lam_shard[:, :1], (K, NT * TT)
+            )
+        else:
+            lam_tok = jnp.take(lam_shard, flat_ids, axis=1)
+        eb_kt = jnp.exp(
+            _digamma(jnp.maximum(lam_tok, 1e-30))
+            - _digamma(row_sum)[:, None]
+        )
+        if variant == "nokernel":
+            gamma_tiles = g0
+        else:
+            gamma_tiles = gamma_fixed_point_tiles(
+                eb_kt, cts_t, seg_t, alpha_arr, g0,
+                d=D, max_inner=inner, tol=1e-3,
+            )
+        elog = _digamma(gamma_tiles) - _digamma(
+            gamma_tiles.sum(axis=0, keepdims=True)
+        )
+        exp_et_slots = jnp.exp(elog)
+        tile_idx = jax.lax.broadcasted_iota(jnp.int32, (NT, TT), 0)
+        slot = (tile_idx * D + jnp.minimum(seg_t, D - 1)).reshape(-1)
+        if variant == "nogather_et":
+            et_tok = jnp.broadcast_to(
+                exp_et_slots[:, :1], (K, NT * TT)
+            )
+        else:
+            et_tok = jnp.take(exp_et_slots, slot, axis=1)
+        phinorm = (eb_kt * et_tok).sum(axis=0) + 1e-30
+        vals_kt = et_tok * (cts_t.reshape(-1) / phinorm)[None] * eb_kt
+        if variant == "noscatter":
+            touched = jnp.zeros_like(lam_shard)
+        else:
+            touched = (
+                jnp.zeros_like(lam_shard).at[:, flat_ids].add(vals_kt)
+            )
+        rho = (1024.0 + step + 1.0) ** (-0.51)
+        if variant == "noblend":
+            lam_new = lam_shard + rho * touched[:, :1]
+        else:
+            lam_new = (
+                (1.0 - rho) * lam_shard + rho * ETA
+                + rho * 2.0 * touched
+            )
+        return lam_new, step + 1.0
+
+    @jax.jit
+    def run(lam):
+        def body(c, _):
+            return _iter(*c), None
+        (lam, s), _ = jax.lax.scan(body, (lam, 0.0), None, length=30)
+        return lam
+
+    return run
+
+
+for inner in [8, 100]:
+    print(f"--- max_inner={inner}", flush=True)
+    for variant in ["full", "nokernel", "noscatter", "nogather_lam",
+                    "nogather_et", "noblend"]:
+        run = make_run(variant, inner)
+        out = run(lam)
+        jax.block_until_ready(out)
+        samples = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            jax.block_until_ready(run(lam))
+            samples.append(time.perf_counter() - t0)
+        med = sorted(samples)[1]
+        print(f"{variant:12s}: {med/30*1000:6.2f} ms/iter", flush=True)
