@@ -207,9 +207,15 @@ def make_em_packed_runner(
     returned runner must already be in the plan's vocab-sorted tile
     layout (``plan.sort_order`` applied host-side, as EMLDA.fit does) —
     posteriors then leave the E-step in kernel order and no per-sweep
-    gather or transpose exists.  Sorted order drops doc-contiguity, so
-    a plan may only be used when the one-hot doc-side formulation is in
-    budget.  The plan's block maps are device_put here, sharded over
+    gather or transpose exists.  Sorted order drops doc-contiguity,
+    which only the fused kernel (its doc one-hot lives per-block in
+    VMEM) or the XLA one-hot doc-side formulation tolerate EFFICIENTLY;
+    with a plan present but neither available, the two-stage branch
+    falls back to segment ops over the unsorted doc axis — correct but
+    slow, so EMLDA.fit only keeps a plan when the fused kernel is
+    eligible (``pallas_emsweep.fused_eligible``, the shared predicate)
+    or the [T, d] one-hot budget holds.  The plan's block maps are
+    device_put here, sharded over
     ("data", "model"), and baked into the returned runner: callers must
     rebuild the runner when the corpus changes, not just the vocabulary
     (EMLDA.fit keys its cache on a corpus fingerprint).
@@ -233,7 +239,7 @@ def make_em_packed_runner(
 
     if scatter_plan is not None:
         from ..ops.pallas_emscatter import scatter_add_vtiles
-        from ..ops.pallas_emsweep import MAX_FUSED_DOC_SLOTS
+        from ..ops.pallas_emsweep import fused_eligible
 
         sp = scatter_plan
         interp = (
@@ -282,7 +288,7 @@ def make_em_packed_runner(
             # is processed by exactly one (data, model) pair, so N_dk
             # partials psum over "model" — the unfused paths instead
             # replicate phi across model shards and need no such psum.
-            from ..ops.pallas_emsweep import em_sweep_fused
+            from ..ops.pallas_emsweep import em_sweep_fused, fused_d_pad
 
             lids, bv, bf = plan_args
             d_max = n_dk.shape[0]
@@ -330,7 +336,9 @@ def make_em_packed_runner(
 
     def _sweep(n_wk_shard, n_dk, ids_t, cts_t, seg_t, *plan_args):
         d_max = n_dk.shape[0]
-        if scatter_plan is not None and d_max <= MAX_FUSED_DOC_SLOTS:
+        if scatter_plan is not None and fused_eligible(
+            d_max, n_wk_shard.shape[0], sp.vt, sp.tb
+        ):
             return _sweep_fused(
                 n_wk_shard, n_dk, ids_t, cts_t, seg_t, *plan_args
             )
@@ -938,17 +946,23 @@ class EMLDA:
             # locally-addressable shards.  The live-token pre-gate
             # (one host pass over the packed corpus) runs only when
             # the cheaper checks admit the plan at all.
+            from ..ops.pallas_emsweep import fused_eligible
+
             if (
                 jax.process_count() == 1
                 and _resolve_gamma_backend("auto") == "pallas"
-                and int(
-                    (cts_f.reshape(n_data, -1) > 0).sum(axis=1).max()
-                ) * d_max * 4 <= _DK_ONEHOT_BUDGET
+                and (
+                    # fused builds its doc one-hot per block in VMEM
+                    # and has no [T, d] budget; the live-token budget
+                    # only limits the two-stage path's XLA one-hot
+                    fused_eligible(d_max, k)
+                    or int(
+                        (cts_f.reshape(n_data, -1) > 0)
+                        .sum(axis=1).max()
+                    ) * d_max * 4 <= _DK_ONEHOT_BUDGET
+                )
             ):
                 from ..ops.pallas_emscatter import plan_em_scatter
-                from ..ops.pallas_emsweep import (
-                    MAX_FUSED_DOC_SLOTS,
-                )
 
                 scatter_plan = plan_em_scatter(
                     ids_f.reshape(n_data, -1),
@@ -957,11 +971,21 @@ class EMLDA:
                     v_pad // p.model_shards,
                 )
                 if scatter_plan is not None:
+                    # the t_sorted budget models the TWO-STAGE path's
+                    # XLA [T, d] doc one-hot; the fused kernel never
+                    # needs it, so the check only applies when fused
+                    # is out (same predicate the runner traces with)
+                    fused = fused_eligible(
+                        d_max, k, scatter_plan.vt, scatter_plan.tb
+                    )
                     t_sorted = (
                         p.model_shards * scatter_plan.nb
                         * scatter_plan.tb
                     )
-                    if t_sorted * d_max * 4 > _DK_ONEHOT_BUDGET:
+                    if (
+                        not fused
+                        and t_sorted * d_max * 4 > _DK_ONEHOT_BUDGET
+                    ):
                         scatter_plan = None
             if scatter_plan is not None:
                 so = scatter_plan.sort_order          # [S_d, T_sorted]
@@ -981,9 +1005,7 @@ class EMLDA:
                 pos_f = _reorder(pos_f, 0)
                 self.last_cells = n_data * so.shape[1]
                 self.last_scatter_backend = (
-                    "pallas_fused"
-                    if d_max <= MAX_FUSED_DOC_SLOTS
-                    else "pallas_vtiles"
+                    "pallas_fused" if fused else "pallas_vtiles"
                 )
             else:
                 self.last_scatter_backend = "xla"

@@ -15,8 +15,11 @@ over the same vocab-sorted block layout (``plan_em_scatter``):
 
 Both one-hots are built IN VMEM from iota compares — the kernel's only
 HBM traffic is each token block once (ids/seg/cts) and each N_wk vocab
-tile once per sweep (in and out), ~5 MB total on the EN books where the
-unfused path moved ~25 MB through five XLA ops.  EM's posterior is pure
+tile once per sweep (in and out): a few MB on the EN books (geometry-
+dependent; ~15% tile padding) where the unfused path moved ~25 MB
+through five XLA ops.  The residual per-sweep cost is CONSTRUCTING the
+one-hots (vt x T VPU element-ops), which is why the default vocab tile
+narrowed to vt=256 (see pallas_emscatter geometry note).  EM's posterior is pure
 rational arithmetic (no exp/digamma), so the whole sweep rides the MXU:
 every matmul is HIGHEST precision (exact f32 one-hot selection; default
 bf16 passes drift EM counts by 1e4 over 50 sweeps — measured).
@@ -43,11 +46,55 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-__all__ = ["MAX_FUSED_DOC_SLOTS", "em_sweep_fused"]
+__all__ = [
+    "MAX_FUSED_DOC_SLOTS",
+    "em_sweep_fused",
+    "fused_d_pad",
+    "fused_eligible",
+    "fused_vmem_ok",
+]
 
 # The per-program doc one-hot is [d_pad, tb] f32 in VMEM: 512 x 1024 x 4
-# = 2 MB, alongside the 2 MB vocab one-hot and the N_wk tile.
+# = 2 MB, alongside the default 1 MB vocab one-hot (vt=256) and the
+# N_wk tile.
 MAX_FUSED_DOC_SLOTS = 512
+
+# Scoped-VMEM model for one program's live blocks (both one-hots, their
+# iota/compare intermediates, and the [k, *] working rows), calibrated
+# against a measured Mosaic stack OOM: geometry (vt=512, tb=2048,
+# d_pad=64, k=5) allocates 19.12 MB against the chip's 16 MB scoped
+# limit, and this model prices it at 18.0 MB; the default
+# (512, 1024, 64, 5) geometry prices at 9.0 MB and compiles with room.
+_FUSED_VMEM_BUDGET = 12 * 1024 * 1024
+
+
+def fused_vmem_ok(vt: int, tb: int, d_pad: int, k: int) -> bool:
+    """True when the fused kernel's per-program VMEM footprint fits the
+    scoped budget; callers fall back to the two-stage scatter kernel
+    (whose only big block is one [vt, tb] one-hot) beyond it."""
+    est = 5 * tb * (3 * vt + 3 * d_pad + 6 * k)
+    return est <= _FUSED_VMEM_BUDGET
+
+
+def fused_d_pad(d_max: int) -> int:
+    """Doc-slot axis padded to the sublane multiple the kernel blocks
+    need."""
+    return max(8, -(-d_max // 8) * 8)
+
+
+def fused_eligible(d_max: int, k: int, vt=None, tb=None) -> bool:
+    """THE fused-vs-two-stage predicate — the single source of truth
+    shared by plan-time gating/labeling (EMLDA.fit) and the runner's
+    trace-time kernel choice (make_em_packed_runner), so the two can
+    never desynchronize.  ``vt``/``tb`` default to the plan defaults
+    (for pre-plan eligibility checks)."""
+    from .pallas_emscatter import _TB, _VT
+
+    vt = _VT if vt is None else vt
+    tb = _TB if tb is None else tb
+    return d_max <= MAX_FUSED_DOC_SLOTS and fused_vmem_ok(
+        vt, tb, fused_d_pad(d_max), k
+    )
 
 
 def _sweep_kernel(bv_ref, bf_ref, lids_ref, seg_ref, cts_ref,
