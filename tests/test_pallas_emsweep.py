@@ -28,6 +28,8 @@ ALPHA, ETA = 11.0, 1.1
         (2, 512, 600, 5, 9),
         (1, 3000, 5000, 5, 40),
         (1, 100, 64, 7, 8),     # shard_v < vt, d == d_pad
+        (1, 700, 1200, 64, 13),  # wide k (sublane axis; on-chip smoke
+        #                          ran k=16/64/100 through Mosaic)
     ],
 )
 def test_fused_sweep_matches_reference_math(n_model, shard_v, t_local,
