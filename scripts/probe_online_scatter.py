@@ -1,0 +1,197 @@
+"""Probe: online lambda-scatter layout alternatives at the bench shape.
+
+Round-4 measured 1.86 ms of the 4.11 ms steady-state tiles-resident
+iteration in the serialized XLA scatter (`scatter_add_model_shard_kbl`,
+PERF.md "Online iteration profile").  The EM cure (static vocab-sort
+plan + Pallas one-hot accumulation) does not transfer: at the minibatch
+shape (T=28k tokens over V=262k) tokens spread ~27 per 256-wide vocab
+tile, so any vocab-tiled kernel pays >= populated-tile-count grid steps
+(~600 x 2 us) before doing work — grid overhead alone rivals the
+scatter it replaces.
+
+The structural lever this probe measures instead: XLA TPU scatter cost
+is dominated by the serialized index count.  The kbl layout vmaps a
+1-row scatter over k topic rows — k*T = 560k index ops.  A single
+row-scatter of [T, k] value rows into a [V, k] table needs T = 28k
+index ops — 20x fewer — at the price of (a) a small [k,T]->[T,k]
+transpose of the posteriors and (b) either a transposed read of the
+[V, k] result in the blend (v1) or keeping lambda resident in [V, k]
+layout for the whole fit (v2).
+
+Variants (all inside one 30-iteration jitted scan with a real data
+dependency lam -> gather -> vals -> scatter -> blend -> lam):
+  v0_kbl        current: vmap-over-k scatters, [k, V] lambda
+  v1_rowscatter [T,k] row scatter into [V+1,k], transposed-read blend,
+                lambda stays [k, V]
+  v2_vklayout   lambda resident [V, k]: row scatter + blend all in
+                [V, k]; only the small [T, k] slabs transpose
+  v3_sorted     v2 + device-side sort by vocab id with
+                indices_are_sorted/unique_indices hints after a
+                segment-sum over duplicate ids
+Repro: PYTHONPATH=/root/repo python scripts/probe_online_scatter.py
+(requires the chip; CPU numbers are not meaningful here)."""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+K = 20
+V = 262144
+T = 28160        # 55 tiles x 512 tokens
+N_ITERS = 30
+
+rng = np.random.default_rng(0)
+# frequency-ranked ids: zipf-ish draw so the id distribution matches a
+# real ranked vocabulary (head tiles dense, tail sparse)
+raw = rng.zipf(1.3, size=T * 2)
+ids_np = (raw[raw <= V][:T] - 1).astype(np.int32)
+assert ids_np.size == T
+lam0 = rng.gamma(100.0, 0.01, (K, V)).astype(np.float32)
+vals_seed = rng.random((K, T)).astype(np.float32)
+
+ids = jnp.asarray(ids_np)
+vals0 = jnp.asarray(vals_seed)
+RHO = 0.01
+ETA = 1.0 / K
+
+
+def _fake_estep(lam_kv_or_vk, layout):
+    """Cheap stand-in for gather+gamma+phi that still creates a real
+    dependency of vals on lam (so the scatter cannot be hoisted)."""
+    if layout == "kv":
+        g = jnp.take(lam_kv_or_vk, ids, axis=1)          # [k, T]
+        return vals0 * (1.0 + 1e-6 * g)
+    g = jnp.take(lam_kv_or_vk, ids, axis=0)              # [T, k]
+    return (vals0.T * (1.0 + 1e-6 * g))                  # [T, k]
+
+
+def make_v0():
+    def step(lam, _):
+        vals_kt = _fake_estep(lam, "kv")
+        flat_vals = vals_kt
+        touched = jax.vmap(
+            lambda row: jnp.zeros((V + 1,), jnp.float32)
+            .at[ids]
+            .add(row)
+        )(flat_vals)[:, :V]
+        lam = (1.0 - RHO) * lam + RHO * ETA + RHO * 2.0 * touched
+        return lam, None
+
+    @jax.jit
+    def run(lam):
+        lam, _ = jax.lax.scan(step, lam, None, length=N_ITERS)
+        return lam
+
+    return run, jnp.asarray(lam0)
+
+
+def make_v1():
+    def step(lam, _):
+        vals_kt = _fake_estep(lam, "kv")
+        vals_tk = vals_kt.T                               # [T, k]
+        touched_vk = (
+            jnp.zeros((V + 1, K), jnp.float32).at[ids].add(vals_tk)
+        )[:V]
+        lam = (1.0 - RHO) * lam + RHO * ETA + RHO * 2.0 * touched_vk.T
+        return lam, None
+
+    @jax.jit
+    def run(lam):
+        lam, _ = jax.lax.scan(step, lam, None, length=N_ITERS)
+        return lam
+
+    return run, jnp.asarray(lam0)
+
+
+def make_v2():
+    def step(lam_vk, _):
+        vals_tk = _fake_estep(lam_vk, "vk")               # [T, k]
+        touched_vk = (
+            jnp.zeros((V + 1, K), jnp.float32).at[ids].add(vals_tk)
+        )[:V]
+        lam_vk = (
+            (1.0 - RHO) * lam_vk + RHO * ETA + RHO * 2.0 * touched_vk
+        )
+        return lam_vk, None
+
+    @jax.jit
+    def run(lam):
+        lam, _ = jax.lax.scan(step, lam, None, length=N_ITERS)
+        return lam
+
+    return run, jnp.asarray(lam0.T.copy())
+
+
+def make_v3():
+    order = jnp.asarray(np.argsort(ids_np, kind="stable").astype(np.int32))
+    sorted_ids = jnp.asarray(np.sort(ids_np).astype(np.int32))
+    # segment ids over the sorted run: position of each token's id run
+    uniq, first = np.unique(np.sort(ids_np), return_index=True)
+    seg_of_tok = np.zeros(T, np.int32)
+    seg_of_tok[first] = 1
+    seg_of_tok = np.cumsum(seg_of_tok).astype(np.int32) - 1
+    n_uniq = int(uniq.size)
+    uniq_ids = jnp.asarray(uniq.astype(np.int32))
+    seg_of_tok = jnp.asarray(seg_of_tok)
+
+    def step(lam_vk, _):
+        vals_tk = _fake_estep(lam_vk, "vk")               # [T, k]
+        vals_sorted = vals_tk[order]                      # [T, k]
+        per_uniq = jax.ops.segment_sum(
+            vals_sorted, seg_of_tok, num_segments=n_uniq
+        )                                                 # [U, k]
+        touched_vk = (
+            jnp.zeros((V + 1, K), jnp.float32)
+            .at[uniq_ids]
+            .add(per_uniq, indices_are_sorted=True, unique_indices=True)
+        )[:V]
+        lam_vk = (
+            (1.0 - RHO) * lam_vk + RHO * ETA + RHO * 2.0 * touched_vk
+        )
+        return lam_vk, None
+
+    @jax.jit
+    def run(lam):
+        lam, _ = jax.lax.scan(step, lam, None, length=N_ITERS)
+        return lam
+
+    return run, jnp.asarray(lam0.T.copy())
+
+
+def main():
+    print(f"platform: {jax.devices()[0].platform}", flush=True)
+    results = {}
+    for name, mk in [
+        ("v0_kbl", make_v0),
+        ("v1_rowscatter", make_v1),
+        ("v2_vklayout", make_v2),
+        ("v3_sorted", make_v3),
+    ]:
+        run, lam = mk()
+        out = run(lam)
+        jax.block_until_ready(out)
+        samples = []
+        for _ in range(5):
+            t0 = time.perf_counter()
+            jax.block_until_ready(run(lam))
+            samples.append(time.perf_counter() - t0)
+        med = sorted(samples)[len(samples) // 2]
+        results[name] = med / N_ITERS * 1000
+        print(f"{name:14s}: {med / N_ITERS * 1000:6.3f} ms/iter", flush=True)
+    # numeric agreement across layouts (same math, different assoc order)
+    r0 = np.asarray(make_v0()[0](jnp.asarray(lam0)))
+    r2 = np.asarray(make_v2()[0](jnp.asarray(lam0.T.copy()))).T
+    print(
+        "v0 vs v2 max rel diff:",
+        float(np.max(np.abs(r0 - r2) / np.maximum(np.abs(r0), 1e-9))),
+        flush=True,
+    )
+
+
+if __name__ == "__main__":
+    main()

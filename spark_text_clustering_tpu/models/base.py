@@ -101,8 +101,23 @@ class LDAModel:
         ``top_k`` + a k x (shards*n) host merge — nothing ever holds the
         full [k, V] table (the training-scale guarantee extended to
         topic description); a meshless device-resident lambda above
-        ``_DEVICE_TOPK_MIN_V`` takes a single-device ``top_k``."""
+        ``_DEVICE_TOPK_MIN_V`` takes a single-device ``top_k``.
+
+        Mesh-path ranking precision: device candidates are scored and
+        ranked in f32, while the host path is f64 — near-ties can order
+        differently.  A HOST-resident lambda below
+        ``_DEVICE_TOPK_MIN_V`` therefore ignores ``mesh`` and takes the
+        host argsort path (bit-identical to the meshless call, no
+        device work); the f32 sharded path serves the cases where the
+        host table is the thing being avoided (device-resident lambda,
+        or V at the no-full-width-table scale)."""
         n = min(max_terms_per_topic, self.vocab_size or self.lam.shape[1])
+        if (
+            mesh is not None
+            and isinstance(self.lam, np.ndarray)
+            and self.lam.shape[1] < self._DEVICE_TOPK_MIN_V
+        ):
+            mesh = None
         if mesh is not None:
             key = ("top_terms", mesh, n)
             fn = self._fn_cache.get(key)

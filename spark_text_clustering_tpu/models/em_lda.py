@@ -28,8 +28,8 @@ that convention.
 
 from __future__ import annotations
 
+import hashlib
 import os
-import zlib
 from functools import partial
 from typing import Callable, List, NamedTuple, Optional, Sequence, Tuple
 
@@ -292,7 +292,7 @@ def make_em_packed_runner(
 
             lids, bv, bf = plan_args
             d_max = n_dk.shape[0]
-            d_pad = max(8, -(-d_max // 8) * 8)
+            d_pad = fused_d_pad(d_max)
             k = n_wk_shard.shape[0]
             n_k = model_row_sum(n_wk_shard)                    # [k]
             inv_denom = 1.0 / (n_k + (eta * vocab_size - vocab_size))
@@ -325,8 +325,8 @@ def make_em_packed_runner(
             return psum_data(nwk_p), psum_model(ndk_p[:d_max])
 
     else:
-        MAX_FUSED_DOC_SLOTS = 0  # no plan: the fused path cannot run
-
+        # no plan: the fused path is unreachable (_sweep short-circuits
+        # on ``scatter_plan is not None``)
 
         def _scatter(ids_t, wphi, shard_v, plan_args):
             return scatter_add_model_shard(ids_t, wphi, shard_v)
@@ -1047,16 +1047,18 @@ class EMLDA:
             # scatter plan is active — the plan's block maps are baked
             # into the runner, and a same-vocab different-corpus refit
             # with a stale plan would scatter to the wrong columns.
-            fn_key = (
-                (v, False)
-                if scatter_plan is None
-                else (
-                    v,
-                    True,
-                    zlib.crc32(ids_f.tobytes()),
-                    zlib.crc32((cts_f > 0).tobytes()),
-                )
-            )
+            if scatter_plan is None:
+                fn_key = (v, False)
+            else:
+                # Full sha1 over the token ids and presence mask: a
+                # fingerprint collision would silently reuse a stale
+                # baked plan and scatter counts to wrong columns, so
+                # pay the (host-sort-dominated) hash cost for a
+                # cryptographic-width key.
+                h = hashlib.sha1()
+                h.update(ids_f.tobytes())
+                h.update((cts_f > 0).tobytes())
+                fn_key = (v, True, h.hexdigest())
             if self._packed_fn is None or self._packed_fn_vocab != fn_key:
                 self._packed_fn = make_em_packed_runner(
                     self.mesh, alpha=alpha, eta=eta, vocab_size=v,

@@ -232,9 +232,18 @@ class TestShardedTopTerms:
     def test_matches_host_describe(self, eight_devices):
         """Sharded describe_topics (per-shard top_k + host candidate
         merge) reproduces the host argsort path — ids exactly, weights
-        to f32 resolution — on a pad-masked (prime V) mesh."""
+        to f32 resolution — on a pad-masked (prime V) mesh.  The model
+        carries a DEVICE-resident lambda: a host-resident small-V model
+        ignores ``mesh`` entirely (host fall-through, tested below), so
+        the sharded machinery must be driven through a device one."""
         model = _model()
         host = model.describe_topics(10)
+        model = LDAModel(
+            lam=jnp.asarray(model.lam),
+            vocab=model.vocab,
+            alpha=model.alpha,
+            eta=model.eta,
+        )
         for ds, ms in [(2, 2), (2, 4), (8, 1)]:
             mesh = make_mesh(
                 data_shards=ds, model_shards=ms,
@@ -259,6 +268,16 @@ class TestShardedTopTerms:
         assert [[t for t, _ in row] for row in sharded] == [
             [t for t, _ in row] for row in host
         ]
+
+    def test_host_resident_small_v_ignores_mesh(self, eight_devices):
+        """A host-resident lambda below _DEVICE_TOPK_MIN_V takes the
+        f64 host path even when a mesh is passed — bit-identical to the
+        meshless call (the f32 device ranking never runs)."""
+        model = _model()
+        host = model.describe_topics(10)
+        via_mesh = model.describe_topics(10, mesh=_mesh2())
+        assert via_mesh == host
+        assert not model._fn_cache  # the sharded fn was never built
 
     def test_device_topk_path_matches_host(self, monkeypatch):
         """The meshless device top_k path (large-V device-resident
@@ -307,16 +326,28 @@ class TestShardedTopTerms:
         """n > V: narrow shards pad candidates with -inf; the merge must
         drop them and match the host path's V-entry result."""
         rng = np.random.default_rng(0)
+        lam_np = rng.gamma(100.0, 0.01, size=(3, 7)).astype(np.float32)
+        # device-resident: a host-resident tiny lambda would fall
+        # through to the host path and never exercise the pad merge
         tiny = LDAModel(
-            lam=rng.gamma(100.0, 0.01, size=(3, 7)).astype(np.float32),
+            lam=jnp.asarray(lam_np),
             vocab=[f"t{i}" for i in range(7)],
             alpha=np.full((3,), 1 / 3, np.float32),
             eta=1 / 3,
         )
+        host_model = LDAModel(
+            lam=lam_np,
+            vocab=tiny.vocab,
+            alpha=tiny.alpha,
+            eta=tiny.eta,
+        )
         mesh = make_mesh(
             data_shards=1, model_shards=4, devices=jax.devices()[:4]
         )
-        host = tiny.describe_topics(10)
+        # host digits come from a separate host-resident twin: the host
+        # argsort path calls ensure_host(), which would pull tiny's
+        # lambda to the host and defeat the device-path gate below
+        host = host_model.describe_topics(10)
         sharded = tiny.describe_topics(10, mesh=mesh)
         assert [[i for i, _ in r] for r in sharded] == [
             [i for i, _ in r] for r in host
