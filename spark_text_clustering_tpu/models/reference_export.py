@@ -132,6 +132,20 @@ def _dense_vec(values: np.ndarray) -> dict:
     }
 
 
+def _job_uuid(dataset: str) -> str:
+    """Spark part files carry the write job's random UUID
+    (``part-00000-<uuid>-c000.snappy.parquet``).  Ours is DERIVED from
+    the dataset name so exports stay byte-stable across runs (the
+    frozen determinism pair in tests/golden_own relies on that) while
+    matching Spark's naming shape exactly."""
+    import hashlib
+
+    h = hashlib.sha1(dataset.encode()).hexdigest()
+    return (
+        f"{h[0:8]}-{h[8:12]}-{h[12:16]}-{h[16:20]}-{h[20:32]}"
+    )
+
+
 def _write_dataset(path: str, table, dataset: str) -> None:
     """One Spark-style dataset dir: part file + ``_SUCCESS`` marker."""
     pa = _pa()
@@ -146,7 +160,10 @@ def _write_dataset(path: str, table, dataset: str) -> None:
     table = table.cast(schema)
     pq.write_table(
         table,
-        os.path.join(path, "part-00000.snappy.parquet"),
+        os.path.join(
+            path,
+            f"part-00000-{_job_uuid(dataset)}-c000.snappy.parquet",
+        ),
         compression="snappy",
     )
     with open(os.path.join(path, "_SUCCESS"), "w"):

@@ -71,18 +71,78 @@ class TestTilesResident:
         lo_mass = topics[:, : v // 2].sum(axis=1)
         assert (lo_mass > 0.85).any() and (lo_mass < 0.15).any()
 
-    def test_quality_comparable_to_host_packed_epoch(self, corpus):
-        """Block-stratified tile epochs are a different sample stream
-        than doc-level epochs — quality, not trajectories, must match
-        (the bench's matched-perplexity gate rides on this).  On this
-        TOY corpus the whole corpus fits 4 tiles, so every tile batch is
-        near-full-batch — a coarser schedule (exactly why the AUTO gate
-        declines at this granularity, pinned below); 5%% covers the
-        schedule gap while still catching real math regressions."""
-        rows, vocab = corpus
-        m_tiles, _ = _fit(rows, vocab, max_iterations=30)
+    # (name, corpus geometry, fit overrides, tile-d shrink) — the
+    # round-4 VERDICT asked for the equivalence claim to hold across a
+    # GRID of (k, V, tile size d, skewed doc lengths), not one fixture.
+    _EQUIV_GRID = [
+        # the original fixture: 2 planted topics, uniform short docs
+        ("baseline_k2_v200", dict(), dict(k=2), None),
+        # wider vocab + more topics + SKEWED doc lengths (lognormal nnz:
+        # a few fat docs force a larger tt, hence different d)
+        ("skewed_k5_v1000", dict(n_docs=120, v=1000, skew=True),
+         dict(k=5), None),
+        # tiny vocab, many short docs — many docs co-packed per tile
+        ("dense_k3_v64", dict(n_docs=240, v=64), dict(k=3), None),
+        # shrunk VMEM tile budget -> d clamped to the Mosaic floor of
+        # 128 doc slots, with VERY short docs so the doc capacity (not
+        # the token capacity) is what closes each tile
+        ("small_d_k2_v400",
+         dict(n_docs=240, v=400, nnz=(3, 7)), dict(k=2), 1 << 19),
+    ]
+
+    @pytest.mark.parametrize(
+        "name,geom,fit_kw,budget", _EQUIV_GRID,
+        ids=[c[0] for c in _EQUIV_GRID],
+    )
+    def test_quality_matches_doc_level_epoch(
+        self, corpus, name, geom, fit_kw, budget, monkeypatch
+    ):
+        """Block-stratified tile epochs (docs co-packed in a tile are
+        co-sampled) are a different sample stream than doc-level
+        epochs — quality, not trajectories, must match across corpus
+        geometries (the bench's matched-perplexity gate rides on this).
+        On toy corpora every tile batch is near-full-batch — a coarser
+        schedule (exactly why the AUTO gate declines at this
+        granularity, pinned below); 5% covers the schedule gap while
+        still catching real math regressions."""
+        if budget is not None:
+            from spark_text_clustering_tpu.ops import pallas_packed
+
+            monkeypatch.setattr(
+                pallas_packed, "_VMEM_TILE_BUDGET", budget
+            )
+        if geom:
+            rng = np.random.default_rng(7)
+            n_docs, v = geom["n_docs"], geom["v"]
+            rows = []
+            for i in range(n_docs):
+                lo, hi = (0, v // 2) if i % 2 == 0 else (v // 2, v)
+                if geom.get("skew"):
+                    nnz = int(
+                        np.clip(rng.lognormal(2.0, 1.0), 3, hi - lo)
+                    )
+                else:
+                    nnz = int(rng.integers(*geom.get("nnz", (5, 14))))
+                ids = rng.choice(
+                    np.arange(lo, hi), size=nnz, replace=False
+                )
+                rows.append((
+                    ids.astype(np.int32),
+                    rng.integers(1, 5, size=nnz).astype(np.float32),
+                ))
+            vocab = [f"t{i}" for i in range(v)]
+        else:
+            rows, vocab = corpus
+        m_tiles, opt_t = _fit(rows, vocab, max_iterations=30, **fit_kw)
+        assert opt_t.last_layout == "tiles_resident"
+        if budget is not None:
+            # the shrunk budget must actually have clamped d to the
+            # Mosaic floor, and the short docs must make it BIND
+            assert opt_t.last_tiles["d"] == 128
+            assert opt_t.last_tiles["n_tiles"] >= 2
         m_packed, opt_p = _fit(
-            rows, vocab, max_iterations=30, token_layout="packed"
+            rows, vocab, max_iterations=30, token_layout="packed",
+            **fit_kw,
         )
         assert opt_p.last_layout == "packed"
         lp_t = m_tiles.log_perplexity(rows)
