@@ -1,0 +1,170 @@
+"""Per-book root-cause diagnosis of the 3/51 golden argmax mismatches.
+
+Round-4 VERDICT Missing #1: the raw-text scoring path reproduces the
+golden report's per-book argmax (Result_EN_1591066624209, written by
+LDALoader.scala:131-140) for 48/51 books, and the 3 divergers only had
+a class-level explanation.  This script isolates the factor per book:
+
+  (a) rescore the book from the reference's OWN frozen count vector
+      (the doc-term edges stored in the frozen model) — if the argmax
+      then matches golden, the flip is caused by PREPROCESSING deltas
+      (CoreNLP sentence splitting x the per-sentence dedup quirk);
+      if it still mismatches, the flip is inherent to VB inference on
+      this model (the reference computed its report with Spark's own
+      VB topicDistributions, so a frozen-vector mismatch means the
+      posterior is genuinely unstable).
+  (b) rescore OUR vector under N perturbed gamma-init seeds — if the
+      argmax flips across seeds, the posterior is MULTIMODAL and the
+      book sits on a knife edge no preprocessing fix can pin.
+
+Doc-id -> book-name mapping is POSITIONAL: the golden report's book
+order, our ``read_text_dir`` order, and plain ``sorted()`` order are
+all identical (verified here), and Spark's ``wholeTextFiles`` numbered
+docs in the same sorted-path order — so frozen doc id i IS the i-th
+book of the report.  (A nearest-distribution match was tried first and
+is NOT a bijection: the frozen doc vertices carry EM posteriors, the
+report carries VB posteriors, and they disagree on 7/51 dominant
+topics.)  Emits a per-book table; tests/test_golden_e2e.py pins the
+classification.
+
+Repro (CPU escape hatch):
+  env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+      PYTHONPATH=/root/repo python scripts/diagnose_golden_mismatches.py
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tests"
+))
+
+import numpy as np
+
+RES = "/root/reference/TextClustering/src/main/resources"
+EN_MODEL = os.path.join(RES, "models/LdaModel_EN_1591049082850")
+GOLDEN = os.path.join(RES, "TestOutput/Result_EN_1591066624209")
+BOOKS = os.path.join(RES, "books/English")
+SEEDS = list(range(10))
+
+
+def main():
+    from spark_text_clustering_tpu.models.reference_import import (
+        MLlibLDAArtifacts,
+        load_reference_model,
+        reference_doc_rows,
+    )
+    from spark_text_clustering_tpu.pipeline import (
+        TextPreprocessor,
+        make_vectorizer,
+    )
+    from spark_text_clustering_tpu.utils.readers import (
+        read_stop_word_file,
+        read_text_dir,
+    )
+    from spark_text_clustering_tpu.utils.textproc import parse_stop_words
+    from test_reference_parity import _golden_book_assignments
+
+    model = load_reference_model(EN_MODEL)
+    art = MLlibLDAArtifacts(EN_MODEL)
+    golden = _golden_book_assignments(GOLDEN)
+    assert len(golden) == 51
+
+    # ---- our raw-text scoring (the 48/51 path) ------------------------
+    stop_words = parse_stop_words(
+        read_stop_word_file(os.path.join(RES, "stopWords_EN.txt"))
+    )
+    docs = list(read_text_dir(BOOKS))
+    pre = TextPreprocessor(stop_words=stop_words)
+    tokens = pre.transform({"texts": [d.text for d in docs]})["tokens"]
+    rows = make_vectorizer(model.vocab)(tokens)
+    dist_ours = np.asarray(model.topic_distribution(rows))
+
+    golden_topic = {name: t for name, t, _, _ in golden}
+    golden_dist = {name: np.asarray(d) for name, _, _, d in golden}
+    names = [
+        os.path.basename(d.path).replace(",", "?") for d in docs
+    ]
+
+    mismatched = [
+        i for i, (n, dv) in enumerate(zip(names, dist_ours))
+        if int(dv.argmax()) != golden_topic[n]
+    ]
+    print(f"mismatched books ({len(mismatched)}/51):")
+    for i in mismatched:
+        print(f"  [{i}] {names[i]}")
+
+    # ---- map frozen doc ids -> golden book names (POSITIONAL) ---------
+    gnames = [n for n, _, _, _ in golden]
+    assert names == gnames, "read order != golden report order"
+    assert sorted(names) == names, "report order is not sorted-path order"
+    frozen_rows = {d: (ids, wts) for d, ids, wts in
+                   reference_doc_rows(art)}
+    doc_ids = sorted(frozen_rows)
+    assert len(doc_ids) == 51
+    doc_of_name = {n: doc_ids[i] for i, n in enumerate(names)}
+
+    # ---- diagnosis per mismatched book --------------------------------
+    print("\nbook | golden | ours(raw) | frozen-vector argmax | "
+          "seed-flip fraction | margin | verdict")
+    table = []
+    for i in mismatched:
+        name = names[i]
+        g = golden_topic[name]
+        ours = int(dist_ours[i].argmax())
+        top2 = np.sort(dist_ours[i])[-2:]
+        margin = float(top2[1] - top2[0])
+
+        # (a) reference's own count vector
+        fid = doc_of_name[name]
+        fdist = np.asarray(
+            model.topic_distribution([frozen_rows[fid]])
+        )[0]
+        frozen_argmax = int(fdist.argmax())
+
+        # (b) our vector under perturbed gamma seeds
+        seed_argmax = [
+            int(np.asarray(
+                model.topic_distribution([rows[i]], seed=s)
+            )[0].argmax())
+            for s in SEEDS
+        ]
+        flips = sum(1 for a in seed_argmax if a != ours) / len(SEEDS)
+
+        if frozen_argmax == g and flips == 0.0:
+            # the reference's own vector lands on golden and no seed
+            # moves it: OUR count vector is what flips the book
+            verdict = "preprocessing"
+        elif flips > 0.0:
+            verdict = "multimodal"
+        elif margin < 0.02:
+            # golden, frozen-vector VB, and our VB all land on
+            # different topics at a sub-2% margin: the posterior is
+            # unstable across inference variants, not fixable by
+            # preprocessing
+            verdict = "near-tie"
+        else:
+            verdict = "inference-delta"
+        table.append((name, g, ours, frozen_argmax, flips, margin,
+                      verdict))
+        print(f"{name} | {g} | {ours} | {frozen_argmax} | "
+              f"{flips:.1f} | {margin:.4f} | {verdict}")
+
+    # corpus-wide context: median argmax margin
+    margins = np.sort(dist_ours, axis=1)
+    med = float(np.median(margins[:, -1] - margins[:, -2]))
+    print(f"\ncorpus median argmax margin: {med:.3f}")
+
+    # frozen-vector scoring across ALL books: how many match golden?
+    all_frozen = model.topic_distribution(
+        [frozen_rows[doc_of_name[n]] for n in names]
+    )
+    agree = sum(
+        1 for n, dv in zip(names, np.asarray(all_frozen))
+        if int(dv.argmax()) == golden_topic[n]
+    )
+    print(f"frozen-vector argmax agreement: {agree}/51")
+
+
+if __name__ == "__main__":
+    main()

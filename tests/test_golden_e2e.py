@@ -129,6 +129,74 @@ def test_book_assignments_match_golden_report(
     assert agreement >= 0.88
 
 
+# Per-book root cause of each golden-argmax diverger, established by
+# scripts/diagnose_golden_mismatches.py (round-5; protocol in its
+# module doc): "preprocessing" = the reference's OWN frozen vector
+# scores to the golden topic and no gamma seed moves ours (the flip is
+# our count vector); "near-tie" = golden, frozen-vector VB, and our VB
+# land on THREE different topics at a sub-2% top-two margin.
+_MISMATCH_DIAGNOSIS = {
+    "Captains Courageous - Rudyard Kipling.txt": "preprocessing",
+    "Hunting of the Snark? The - Lewis Carroll.txt": "near-tie",
+    "Peter Pan - James Matthew Barrie.txt": "preprocessing",
+}
+
+
+def test_mismatch_diagnosis_holds(scored_corpus, reference_resources):
+    """The 3/51 golden divergers keep their diagnosed root cause: the
+    two preprocessing-flipped books still score to golden from the
+    reference's own frozen vectors with a seed-stable posterior, and
+    the near-tie book still sits under a 2% top-two margin.  Any book
+    drifting out of this set (fixed, or newly diverging) fails here so
+    the diagnosis table cannot go stale silently."""
+    from spark_text_clustering_tpu.models.reference_import import (
+        MLlibLDAArtifacts,
+        reference_doc_rows,
+    )
+
+    model, docs, _, dist = scored_corpus
+    golden = _golden_book_assignments(
+        os.path.join(reference_resources, GOLDEN_REPORT)
+    )
+    golden_topic = {name: t for name, t, _, _ in golden}
+    names = [
+        os.path.basename(d.path).replace(",", "?") for d in docs
+    ]
+    # doc ids are positional: report order == read order == sorted
+    assert names == [n for n, _, _, _ in golden]
+    mismatched = {
+        n for n, dv in zip(names, dist)
+        if int(dv.argmax()) != golden_topic[n]
+    }
+    assert mismatched == set(_MISMATCH_DIAGNOSIS)
+
+    art = MLlibLDAArtifacts(
+        os.path.join(reference_resources, EN_MODEL)
+    )
+    frozen = {d: (ids, wts) for d, ids, wts in
+              reference_doc_rows(art)}
+    doc_ids = sorted(frozen)
+    for name, why in _MISMATCH_DIAGNOSIS.items():
+        i = names.index(name)
+        if why == "preprocessing":
+            fdist = np.asarray(
+                model.topic_distribution([frozen[doc_ids[i]]])
+            )[0]
+            assert int(fdist.argmax()) == golden_topic[name], name
+            # seed-stable: the flip is the vector, not the init
+            ours = int(dist[i].argmax())
+            for seed in (1, 7):
+                rescored = np.asarray(model.topic_distribution(
+                    [(np.asarray(frozen[doc_ids[i]][0]),
+                      np.asarray(frozen[doc_ids[i]][1]))], seed=seed
+                ))[0]
+                assert int(rescored.argmax()) == golden_topic[name]
+            assert ours != golden_topic[name]
+        else:  # near-tie
+            top2 = np.sort(dist[i])[-2:]
+            assert float(top2[1] - top2[0]) < 0.02, name
+
+
 def test_multilingual_train_smoke(reference_resources, tmp_path):
     """The reference routes 8 languages through the same pipeline
     (LDALoader.scala:46-56); the Dutch shelf (5 books, non-English
