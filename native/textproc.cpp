@@ -25,6 +25,7 @@
 #include <unordered_set>
 #include <vector>
 
+#include "nnp_suffix_table.h"
 #include "unicode_tables.h"
 
 namespace {
@@ -763,29 +764,79 @@ SplitWord split_contraction(const U32s& w) {
 // anywhere in the document), lemma, keep len > min_len, clitic lemma after
 // its base.
 // ---------------------------------------------------------------------------
+// PTB-shaped word units (textproc._WORD_RE):
+//   (?:[^\W\d_]|\d)+(?:[-'’.,](?:[^\W\d_]|\d)+)*
+// alphanumeric runs joined by single internal hyphens / apostrophes /
+// periods / commas — "to-day", "310,000" and "1756" stay ONE unit
+// through the lemma + length filter, splitting only at the tokenize
+// step (this is how the frozen vocabularies hold pure numbers and
+// sub-4-char fragments).
+bool is_unit_char(u32 c) {
+  return (is_letter(c) || is_digit(c)) && c != '_';
+}
+
+bool is_unit_joiner(u32 c) {
+  return c == '-' || c == '\'' || c == 0x2019 || c == '.' || c == ',';
+}
+
 void words_of_sentence(const U32s& sent, vector<U32s>& out) {
   size_t i = 0, n = sent.size();
   while (i < n) {
-    if (!is_letter(sent[i]) || is_digit(sent[i]) || sent[i] == '_') {
+    if (!is_unit_char(sent[i])) {
       ++i;
       continue;
     }
     size_t j = i;
-    while (j < n && is_letter(sent[j]) && !is_digit(sent[j]) &&
-           sent[j] != '_')
+    while (j < n && is_unit_char(sent[j])) ++j;
+    while (j < n && is_unit_joiner(sent[j]) && j + 1 < n &&
+           is_unit_char(sent[j + 1])) {
       ++j;
-    // optional ['’] + letters
-    if (j < n && (sent[j] == '\'' || sent[j] == 0x2019) && j + 1 < n &&
-        is_letter(sent[j + 1])) {
-      size_t k = j + 1;
-      while (k < n && is_letter(sent[k])) ++k;
-      out.emplace_back(sent.begin() + (long)i, sent.begin() + (long)k);
-      i = k;
-      continue;
+      while (j < n && is_unit_char(sent[j])) ++j;
     }
     out.emplace_back(sent.begin() + (long)i, sent.begin() + (long)j);
     i = j;
   }
+}
+
+// ---------------------------------------------------------------------------
+// foreign-mode tagger emulation (textproc._foreign_fold): deterministic
+// per-occurrence fold of capitalized no-twin words in documents whose
+// no-twin capitalized TYPE ratio crosses the gate.  Rates come from the
+// generated per-suffix table; verdicts hash (word, sentence index).
+// ---------------------------------------------------------------------------
+constexpr double kForeignCapsGate = 0.25;
+
+uint64_t fnv1a64(const string& data, uint64_t h = 0xCBF29CE484222325ULL) {
+  for (unsigned char b : data) {
+    h ^= (uint64_t)b;
+    h *= 0x100000001B3ULL;
+  }
+  return h;
+}
+
+int suffix_fold_rate(const U32s& low) {
+  for (int ln = 4; ln >= 2; --ln) {
+    if ((int)low.size() > ln) {
+      U32s suf(low.end() - ln, low.end());
+      auto it = kNnpSuffixRates.find(encode_utf8(suf));
+      if (it != kNnpSuffixRates.end()) return it->second;
+    }
+  }
+  return 0;
+}
+
+bool foreign_fold(const U32s& base, const U32s& low, size_t sent_idx,
+                  int n_occ) {
+  int rate = suffix_fold_rate(low);
+  if (rate <= 0) return false;
+  if (rate >= 1000) return true;
+  if (n_occ <= 1) return rate >= 500;  // single sample: majority verdict
+  uint64_t h = fnv1a64(encode_utf8(base));
+  string idx(4, '\0');
+  for (int b = 0; b < 4; ++b)
+    idx[(size_t)b] = (char)((sent_idx >> (8 * b)) & 0xFF);
+  h = fnv1a64(idx, h);
+  return (int)(h % 1000) < rate;
 }
 
 U32s lemmatize_text(const U32s& text, int min_len_exclusive, bool dedup,
@@ -814,6 +865,8 @@ U32s lemmatize_text(const U32s& text, int min_len_exclusive, bool dedup,
   sent_parts.reserve(sentences.size());
   std::unordered_set<string> lower_bases;
   std::unordered_set<string> noninitial_caps;
+  std::unordered_set<string> all_bases;
+  std::unordered_map<string, int> caps_occ;
   std::unordered_set<string> seen;
   vector<U32s> words;
   for (auto& [s, e] : sentences) {
@@ -823,10 +876,14 @@ U32s lemmatize_text(const U32s& text, int min_len_exclusive, bool dedup,
     if (fold_case) {
       for (size_t wi = 0; wi < words.size(); ++wi) {
         U32s base = split_contraction(words[wi]).base;
-        if (base == simple_lower(base))
-          lower_bases.insert(encode_utf8(base));
-        else if (wi > 0)
-          noninitial_caps.insert(encode_utf8(base));
+        string key = encode_utf8(base);
+        all_bases.insert(key);
+        if (base == simple_lower(base)) {
+          lower_bases.insert(std::move(key));
+        } else {
+          ++caps_occ[key];
+          if (wi > 0) noninitial_caps.insert(std::move(key));
+        }
       }
     }
     seen.clear();
@@ -841,17 +898,39 @@ U32s lemmatize_text(const U32s& text, int min_len_exclusive, bool dedup,
     }
   }
 
+  // foreign-mode gate: distinct capitalized no-twin types / distinct
+  // types, computed after pass 1 (the no-twin test needs the complete
+  // lower_bases set) — mirrors textproc.lemmatize_text
+  bool foreign = false;
+  if (fold_case && !all_bases.empty()) {
+    size_t no_twin = 0;
+    for (const auto& c : noninitial_caps) {
+      U32s low = simple_lower(decode_utf8(c.data(), c.size()));
+      if (!lower_bases.count(encode_utf8(low))) ++no_twin;
+    }
+    foreign =
+        (double)no_twin / (double)all_bases.size() >= kForeignCapsGate;
+  }
+
   // pass 2: fold, lemma, emit (clitic lemma follows its base)
-  for (auto& parts : sent_parts) {
+  for (size_t si = 0; si < sent_parts.size(); ++si) {
+    auto& parts = sent_parts[si];
     for (auto& p : parts) {
       U32s base = p.base;
       bool is_nnp = false;
       if (fold_case) {
         U32s low = simple_lower(base);
         if (low != base) {
+          string key = encode_utf8(base);
+          auto occ = caps_occ.find(key);
           if (lower_bases.count(encode_utf8(low)))
             base = std::move(low);
-          else if (noninitial_caps.count(encode_utf8(base)))
+          else if (foreign &&
+                   foreign_fold(base, low, si,
+                                occ == caps_occ.end() ? 0 : occ->second))
+            // per-occurrence tagger emulation (see foreign_fold)
+            base = std::move(low);
+          else if (noninitial_caps.count(key))
             // NNP-ish: capitalized, no lowercase twin in the document,
             // and seen mid-sentence at least once — CoreNLP returns NNP
             // lemmas unchanged (no plural strip).  Sentence-initial-only

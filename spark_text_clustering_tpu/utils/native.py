@@ -50,7 +50,11 @@ def _build() -> bool:
     """Compile the shared library when missing or stale; False on failure."""
     if not os.path.exists(_SRC):
         return False
-    deps = [_SRC, os.path.join(os.path.dirname(_SRC), "unicode_tables.h")]
+    deps = [
+        _SRC,
+        os.path.join(os.path.dirname(_SRC), "unicode_tables.h"),
+        os.path.join(os.path.dirname(_SRC), "nnp_suffix_table.h"),
+    ]
     src_mtime = max(os.path.getmtime(p) for p in deps if os.path.exists(p))
     if os.path.exists(_LIB) and os.path.getmtime(_LIB) >= src_mtime:
         return True

@@ -124,7 +124,18 @@ def parse_stop_words(text_or_lines) -> frozenset:
 # first-occurrence order for determinism.
 # --------------------------------------------------------------------------
 _SENT_SPLIT_RE = re.compile(r"(?<=[.!?])\s+")
-_WORD_RE = re.compile(r"[^\W\d_]+(?:['’][^\W\d_]+)?", re.UNICODE)
+# Word units are PTB-shaped, like the reference's CoreNLP tokenizer:
+# alphanumeric runs JOINED by internal hyphens/apostrophes/periods/commas
+# stay ONE unit through the lemma + ``length > 3`` filter and are only
+# split apart later by filterSpecialCharacters + SimpleTokenizer.  This
+# is how the frozen vocabularies contain pure numbers ("1756", "310000")
+# and sub-4-char types ("day", "out", "sea"): "to-day" or "310,000"
+# passes the length filter WHOLE, then sheds its connectors at the
+# tokenize step.  A bare short token ("day", "52") still dies at the
+# lemma filter — exactly like the reference.
+_WORD_RE = re.compile(
+    r"(?:[^\W\d_]|\d)+(?:[-'’.,](?:[^\W\d_]|\d)+)*", re.UNICODE
+)
 
 
 def split_sentences(text: str) -> List[str]:
@@ -287,6 +298,64 @@ def _needs_e(stem_: str) -> bool:
     return True
 
 
+# ---- foreign-mode tagger emulation (see lemmatize_text docstring) --------
+try:
+    from .nnp_suffix_table import NNP_SUFFIX_RATES
+except ImportError:  # pragma: no cover - pre-generation bootstrap
+    NNP_SUFFIX_RATES = {}
+
+# German shelf doc minimum is 0.265; every other shelf's max (incl. the
+# Paradise Lost verse outlier and a name-dense Russian history) is 0.228
+# — measured in scripts/gen_nnp_suffix_table.py's round-5 calibration.
+_FOREIGN_CAPS_GATE = 0.25
+
+_FNV_OFFSET = 0xCBF29CE484222325
+_FNV_PRIME = 0x100000001B3
+_U64 = (1 << 64) - 1
+
+
+def _fnv1a64(data: bytes, h: int = _FNV_OFFSET) -> int:
+    for b in data:
+        h = ((h ^ b) * _FNV_PRIME) & _U64
+    return h
+
+
+def _suffix_fold_rate(low: str) -> int:
+    """Permille fold rate for a lowercase word — most specific suffix
+    wins (len 4, then 3, then 2; zero-rate entries override)."""
+    for ln in (4, 3, 2):
+        if len(low) > ln:
+            r = NNP_SUFFIX_RATES.get(low[-ln:])
+            if r is not None:
+                return r
+    return 0
+
+
+def _foreign_fold(
+    base: str, low: str, sent_idx: int, n_occ: int
+) -> bool:
+    """Deterministic per-occurrence fold verdict.
+
+    A word seen ONCE in the document takes its suffix's MAJORITY
+    verdict (a single tagger sample is matched best by the mode:
+    max(r, 1-r) >= r^2 + (1-r)^2 for every r); a word spanning several
+    occurrences folds where hash(word, sentence) lands under the
+    suffix's measured rate, reproducing the reference's both-case
+    outcome for frequent nouns.  The C++ twin (native/textproc.cpp)
+    mirrors this bit for bit."""
+    rate = _suffix_fold_rate(low)
+    if rate <= 0:
+        return False
+    if rate >= 1000:
+        return True
+    if n_occ <= 1:
+        return rate >= 500
+    h = _fnv1a64(
+        sent_idx.to_bytes(4, "little"), _fnv1a64(base.encode("utf-8"))
+    )
+    return h % 1000 < rate
+
+
 @lru_cache(maxsize=1 << 17)
 def _simple_lower(word: str) -> str:
     """1:1 per-code-point lowercase — parity twin of the native
@@ -375,6 +444,7 @@ def lemmatize_text(
     min_len_exclusive: int = 3,
     dedup_within_sentence: bool = True,
     fold_case: bool = True,
+    sentence_initial_fold: bool = False,
 ) -> str:
     """CoreNLP ``getLemmaText`` equivalent (LDAClustering.scala:293-309):
     sentence split -> contraction split -> case fold -> per-word lemma ->
@@ -396,9 +466,24 @@ def lemmatize_text(
     bark.") and takes the regular ``lemma()`` path.  With
     ``fold_case=False`` every word takes the regular ``lemma()`` path, so
     the -s rule may still rewrite capitalized forms ("Holmes"->"Holme").
+
+    FOREIGN-mode per-occurrence folds: when the document's no-twin
+    capitalized TYPE ratio crosses ``_FOREIGN_CAPS_GATE`` (every German
+    shelf doc is >= 0.265, every other shelf's max is 0.228 — noun
+    capitalization, not name density), capitalized no-twin words stop
+    being automatic NNPs: each occurrence folds with the per-suffix
+    probability the reference tagger exhibited on exactly this
+    population (``nnp_suffix_table``, measured from the frozen GE
+    vocabulary), decided by a deterministic hash of (word, sentence
+    index).  This reproduces the frozen vocabularies' signature
+    both-case stems: a noun spanning many sentences yields BOTH its
+    capitalized and folded types, a rare noun yields the majority
+    verdict for its suffix shape.
     """
     lower_bases: set = set()
     noninitial_caps: set = set()
+    all_bases: set = set()
+    caps_occ: dict = {}
     sentence_parts: List[List[tuple]] = []
     for sentence in split_sentences(text):
         words = _WORD_RE.findall(sentence)
@@ -409,10 +494,19 @@ def lemmatize_text(
             # bark." must still take the plural strip).
             for pos, w in enumerate(words):
                 base = _split_contraction(w)[0]
+                all_bases.add(base)
                 if base == _simple_lower(base):
                     lower_bases.add(base)
-                elif pos > 0:
-                    noninitial_caps.add(base)
+                else:
+                    caps_occ[base] = caps_occ.get(base, 0) + 1
+                    if pos > 0:
+                        noninitial_caps.add(base)
+        # Per-occurrence position, mirroring the reference's
+        # ``(words zip tags).toMap`` (LDAClustering.scala:298): a
+        # repeated word keeps its LAST occurrence's tag, so the
+        # position that decides the sentence-initial fold below is the
+        # last one too.
+        last_pos = {w: i for i, w in enumerate(words)}
         if dedup_within_sentence:
             seen = set()
             uniq = []
@@ -421,17 +515,46 @@ def lemmatize_text(
                     seen.add(w)
                     uniq.append(w)
             words = uniq
-        parts = [_split_contraction(w) for w in words]
+        parts = [
+            _split_contraction(w) + (last_pos[w],) for w in words
+        ]
         sentence_parts.append(parts)
 
+    # Foreign-mode gate: distinct capitalized no-twin types / distinct
+    # types.  Computed once per document, AFTER the evidence pass (the
+    # no-twin test needs the complete lower_bases set).
+    foreign = False
+    if fold_case and all_bases:
+        no_twin = sum(
+            1 for c in noninitial_caps
+            if _simple_lower(c) not in lower_bases
+        )
+        foreign = no_twin / len(all_bases) >= _FOREIGN_CAPS_GATE
+
     pieces: List[str] = []
-    for parts in sentence_parts:
-        for base, clitic in parts:
+    for sent_idx, parts in enumerate(sentence_parts):
+        for base, clitic, pos in parts:
             is_nnp = False
             if fold_case:
                 low = _simple_lower(base)
                 if low != base:
                     if low in lower_bases:
+                        base = low
+                    elif foreign and _foreign_fold(
+                        base, low, sent_idx, caps_occ.get(base, 0)
+                    ):
+                        # per-occurrence tagger emulation (module doc)
+                        base = low
+                    elif sentence_initial_fold and pos == 0:
+                        # CoreNLP's tagger discounts capitalization at
+                        # sentence starts: an unknown capitalized word
+                        # there usually draws a non-NNP tag, and
+                        # Morphology.lemma lowercases every non-NNP
+                        # lemma.  Folding ONLY the sentence-initial
+                        # occurrences reproduces the reference's
+                        # both-case vocabularies (the same stem appears
+                        # capitalized AND lowercased — 28,351 such stems
+                        # in the frozen GE vocab, 4,960 in EN).
                         base = low
                     elif base in noninitial_caps:
                         # NNP-ish: a capitalized word with no lowercase twin
@@ -462,6 +585,7 @@ def preprocess_document(
     min_lemma_len_exclusive: int = 3,
     dedup_within_sentence: bool = True,
     fold_case: bool = True,
+    sentence_initial_fold: bool = False,
 ) -> List[str]:
     if lemmatize:
         text = lemmatize_text(
@@ -469,6 +593,7 @@ def preprocess_document(
             min_len_exclusive=min_lemma_len_exclusive,
             dedup_within_sentence=dedup_within_sentence,
             fold_case=fold_case,
+            sentence_initial_fold=sentence_initial_fold,
         )
     text = filter_special_characters(text)
     out: List[str] = []

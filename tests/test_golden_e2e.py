@@ -85,7 +85,9 @@ def test_corpus_shape(scored_corpus):
 def test_vocabulary_agreement_with_reference(scored_corpus):
     """Our preprocessing's tokens land in the CoreNLP+Porter-built frozen
     vocabulary: occurrence coverage >= 98%, distinct-type coverage >= 88%
-    (measured 99.75% / 93.3%)."""
+    (round-5 measurement after PTB word units: 99.74% occurrence, 93.3%
+    of our types in-vocab; recall of the 39,380 reference stems rose
+    87.8% -> 90.9%)."""
     model, _, tokens, _ = scored_corpus
     vocab_set = set(model.vocab)
     occurrences = sum(len(t) for t in tokens)
@@ -246,5 +248,14 @@ def test_german_vocabulary_agreement(reference_resources):
     occ = sum(len(t) for t in tokens)
     hits = sum(1 for t in tokens for tok in t if tok in vocab_set)
     cov = hits / occ
-    print(f"\nGE token-occurrence coverage {cov:.4f} ({hits}/{occ})")
+    types = {tok for doc in tokens for tok in doc}
+    type_cov = len(types & vocab_set) / len(vocab_set)
+    print(f"\nGE token-occurrence coverage {cov:.4f} ({hits}/{occ}); "
+          f"type coverage {type_cov:.4f} "
+          f"({len(types & vocab_set)}/{len(vocab_set)})")
     assert cov >= 0.97
+    # round-5: PTB word units + the per-occurrence tagger emulation
+    # (nnp_suffix_table) lifted reproduction of the reference's 154,741
+    # GE stems from 73.0% to 82.7% of types; the bound leaves drift
+    # margin only (the frozen artifact cannot regress silently)
+    assert type_cov >= 0.80
