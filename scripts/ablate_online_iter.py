@@ -73,6 +73,14 @@ def make_run(variant, inner):
         vals_kt = et_tok * (cts_t.reshape(-1) / phinorm)[None] * eb_kt
         if variant == "noscatter":
             touched = jnp.zeros_like(lam_shard)
+        elif variant == "rowscatter":
+            # round-5 layout: ONE [T, k] row scatter (T index ops)
+            # instead of k vmapped row scatters (k*T index ops)
+            touched = (
+                jnp.zeros((V + 1, K), jnp.float32)
+                .at[flat_ids]
+                .add(vals_kt.T)
+            )[:V].T
         else:
             touched = (
                 jnp.zeros_like(lam_shard).at[:, flat_ids].add(vals_kt)
@@ -99,8 +107,8 @@ def make_run(variant, inner):
 
 for inner in [8, 100]:
     print(f"--- max_inner={inner}", flush=True)
-    for variant in ["full", "nokernel", "noscatter", "nogather_lam",
-                    "nogather_et", "noblend"]:
+    for variant in ["full", "rowscatter", "nokernel", "noscatter",
+                    "nogather_lam", "nogather_et", "noblend"]:
         run = make_run(variant, inner)
         out = run(lam)
         jax.block_until_ready(out)

@@ -1,0 +1,143 @@
+"""Seed-variance measurement behind the online quality band.
+
+Round-4 VERDICT Weak #2: the converged-quality gate was widened from
+x1.01 to x1.02 in the same round the measured gap landed at 1.06% —
+documented, but never justified against run variance.  This script
+measures exactly that: the 12-epoch converged logPerplexity of BOTH
+sides of the bench gate (our online VB fit and the sklearn stand-in)
+across >= 5 seeds on the identical corpus/protocol (bench.py constants
+imported, not copied), and writes the spread to
+scripts/records/quality_band_seeds_r5.json.
+
+If the cross-side spread covers the observed 1.06% gap, the 2% band is
+variance, and the bench gate cites this record; if it does not, the gap
+is real and the band must be closed instead.
+
+Our side runs token_layout="packed" + the XLA gamma loop (CPU-fast;
+tiles-resident quality equivalence is pinned separately by
+tests/test_tiles_resident.py's parametrized grid).
+
+Repro (CPU escape hatch):
+  env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+      XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+      PYTHONPATH=/root/repo python scripts/probe_quality_band_seeds.py
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+SEEDS = [0, 1, 2, 3, 4]
+
+
+def main():
+    import bench
+    from spark_text_clustering_tpu.config import Params
+    from spark_text_clustering_tpu.models.online_lda import OnlineLDA
+    from spark_text_clustering_tpu.parallel import make_mesh
+
+    import jax
+
+    rng = np.random.default_rng(20)
+    rows = bench._synthetic_20ng_rows(rng)
+    eval_rows = rows[:512]
+    mesh = make_mesh(data_shards=len(jax.devices()), model_shards=1)
+
+    ours, skl = [], []
+    for seed in SEEDS:
+        params = Params(
+            k=bench.ONLINE_K,
+            algorithm="online",
+            max_iterations=bench.ONLINE_CONV_ITERS,
+            sampling=bench.ONLINE_SAMPLING,
+            token_layout="packed",
+            seed=seed,
+        )
+        opt = OnlineLDA(params, mesh=mesh)
+        vocab = [f"h{i}" for i in range(bench.ONLINE_NUM_FEATURES)]
+        t0 = time.perf_counter()
+        model = opt.fit(rows, vocab)
+        dt = time.perf_counter() - t0
+        lp = bench._eval_log_perplexity(
+            np.asarray(model.lam), np.asarray(model.alpha), model.eta,
+            eval_rows,
+        )
+        ours.append(lp)
+        print(f"ours  seed={seed}: logPerp {lp:.4f}  ({dt:.0f}s)",
+              flush=True)
+
+    import scipy.sparse as sp
+    from sklearn.decomposition import LatentDirichletAllocation
+
+    bsz = 562
+    indptr = np.zeros(len(rows) + 1, np.int64)
+    np.cumsum([len(i) for i, _ in rows], out=indptr[1:])
+    indices = np.concatenate([ids for ids, _ in rows])
+    data = np.concatenate([cts for _, cts in rows])
+    x = sp.csr_matrix(
+        (data, indices, indptr),
+        shape=(len(rows), bench.ONLINE_NUM_FEATURES),
+    )
+    for seed in SEEDS:
+        lda_c = LatentDirichletAllocation(
+            n_components=bench.ONLINE_K,
+            learning_method="online",
+            batch_size=bsz,
+            max_iter=bench.ONLINE_CONV_PASSES,
+            total_samples=len(rows),
+            doc_topic_prior=1.0 / bench.ONLINE_K,
+            topic_word_prior=1.0 / bench.ONLINE_K,
+            learning_offset=1024.0,
+            learning_decay=0.51,
+            random_state=seed,
+        )
+        t0 = time.perf_counter()
+        lda_c.fit(x)
+        dt = time.perf_counter() - t0
+        lp = bench._eval_log_perplexity(
+            lda_c.components_,
+            np.full((bench.ONLINE_K,), 1.0 / bench.ONLINE_K),
+            1.0 / bench.ONLINE_K, eval_rows,
+        )
+        skl.append(lp)
+        print(f"skl   seed={seed}: logPerp {lp:.4f}  ({dt:.0f}s)",
+              flush=True)
+
+    ours_a, skl_a = np.asarray(ours), np.asarray(skl)
+    rec = {
+        "protocol": {
+            "conv_iters": bench.ONLINE_CONV_ITERS,
+            "conv_passes": bench.ONLINE_CONV_PASSES,
+            "corpus": "20ng-shaped-synthetic (bench rng seed 20)",
+            "seeds": SEEDS,
+            "our_layout": "packed+xla (CPU)",
+        },
+        "ours": [round(float(v), 4) for v in ours],
+        "sklearn": [round(float(v), 4) for v in skl],
+        "ours_mean": round(float(ours_a.mean()), 4),
+        "ours_spread_pct": round(
+            100 * float(ours_a.ptp() / ours_a.mean()), 3
+        ),
+        "sklearn_mean": round(float(skl_a.mean()), 4),
+        "sklearn_spread_pct": round(
+            100 * float(skl_a.ptp() / skl_a.mean()), 3
+        ),
+        "worst_ratio": round(float(ours_a.max() / skl_a.min()), 4),
+        "mean_ratio": round(float(ours_a.mean() / skl_a.mean()), 4),
+    }
+    out = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "records",
+        "quality_band_seeds_r5.json",
+    )
+    with open(out, "w") as f:
+        json.dump(rec, f, indent=1)
+    print(json.dumps(rec, indent=1), flush=True)
+    print(f"wrote {out}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

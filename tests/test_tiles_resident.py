@@ -175,6 +175,21 @@ class TestTilesResident:
         )
         assert out is None
 
+    def test_scatter_backends_agree(self, corpus, monkeypatch):
+        """The lambda-update scatter layouts (rows: one [T, k] row
+        scatter, 20x fewer serialized index ops; kbl: vmapped per-topic
+        scatters) train to the same model — only the f32 accumulation
+        order differs."""
+        rows, vocab = corpus
+        lams = {}
+        for backend in ("rows", "kbl"):
+            monkeypatch.setenv("STC_ONLINE_SCATTER", backend)
+            model, _ = _fit(rows, vocab, max_iterations=10)
+            lams[backend] = np.asarray(model.lam)
+        np.testing.assert_allclose(
+            lams["rows"], lams["kbl"], rtol=2e-3, atol=1e-4
+        )
+
     def test_deterministic_across_runs(self, corpus):
         rows, vocab = corpus
         m1, _ = _fit(rows, vocab)
