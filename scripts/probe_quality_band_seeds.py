@@ -9,9 +9,15 @@ across >= 5 seeds on the identical corpus/protocol (bench.py constants
 imported, not copied), and writes the spread to
 scripts/records/quality_band_seeds_r5.json.
 
-If the cross-side spread covers the observed 1.06% gap, the 2% band is
-variance, and the bench gate cites this record; if it does not, the gap
-is real and the band must be closed instead.
+Round-5 finding: the seed spreads (ours 0.28%, sklearn 0.07%) do NOT
+cover the 1.06% round-4 gap — but the gap was the stand-in's DTYPE,
+not the model: sklearn inherits its input dtype, and the f32 run
+converges 0.85% "better" on the training-subset eval than the f64 run
+that matches what the real baseline (Spark MLlib's OnlineLDAOptimizer,
+Breeze over Double) computes.  Against the f64 baseline our converged
+logPerp is within x1.006 on every seed, so bench.py's gate is restored
+to the original x1.01 with the f64 (MLlib-faithful) baseline; the f32
+numbers are recorded as the sensitivity line.
 
 Our side runs token_layout="packed" + the XLA gamma loop (CPU-fast;
 tiles-resident quality equivalence is pinned separately by
@@ -78,38 +84,55 @@ def main():
     np.cumsum([len(i) for i, _ in rows], out=indptr[1:])
     indices = np.concatenate([ids for ids, _ in rows])
     data = np.concatenate([cts for _, cts in rows])
-    x = sp.csr_matrix(
-        (data, indices, indptr),
-        shape=(len(rows), bench.ONLINE_NUM_FEATURES),
-    )
+    # BOTH dtypes: sklearn inherits the input dtype, and the f32/f64
+    # split turned out to be the whole round-4 "quality gap" — f64 is
+    # the MLlib-faithful (Breeze Double) baseline, f32 recorded as the
+    # sensitivity line.
+    xs = {
+        "f64": sp.csr_matrix(
+            (data.astype(np.float64), indices, indptr),
+            shape=(len(rows), bench.ONLINE_NUM_FEATURES),
+        ),
+        "f32": sp.csr_matrix(
+            (data.astype(np.float32), indices, indptr),
+            shape=(len(rows), bench.ONLINE_NUM_FEATURES),
+        ),
+    }
+    skl32 = []
     for seed in SEEDS:
-        lda_c = LatentDirichletAllocation(
-            n_components=bench.ONLINE_K,
-            learning_method="online",
-            batch_size=bsz,
-            max_iter=bench.ONLINE_CONV_PASSES,
-            total_samples=len(rows),
-            doc_topic_prior=1.0 / bench.ONLINE_K,
-            topic_word_prior=1.0 / bench.ONLINE_K,
-            learning_offset=1024.0,
-            learning_decay=0.51,
-            random_state=seed,
-        )
-        t0 = time.perf_counter()
-        lda_c.fit(x)
-        dt = time.perf_counter() - t0
-        lp = bench._eval_log_perplexity(
-            lda_c.components_,
-            np.full((bench.ONLINE_K,), 1.0 / bench.ONLINE_K),
-            1.0 / bench.ONLINE_K, eval_rows,
-        )
-        skl.append(lp)
-        print(f"skl   seed={seed}: logPerp {lp:.4f}  ({dt:.0f}s)",
-              flush=True)
+        for dtype, x in xs.items():
+            lda_c = LatentDirichletAllocation(
+                n_components=bench.ONLINE_K,
+                learning_method="online",
+                batch_size=bsz,
+                max_iter=bench.ONLINE_CONV_PASSES,
+                total_samples=len(rows),
+                doc_topic_prior=1.0 / bench.ONLINE_K,
+                topic_word_prior=1.0 / bench.ONLINE_K,
+                learning_offset=1024.0,
+                learning_decay=0.51,
+                random_state=seed,
+            )
+            t0 = time.perf_counter()
+            lda_c.fit(x)
+            dt = time.perf_counter() - t0
+            lp = bench._eval_log_perplexity(
+                lda_c.components_,
+                np.full((bench.ONLINE_K,), 1.0 / bench.ONLINE_K),
+                1.0 / bench.ONLINE_K, eval_rows,
+            )
+            (skl if dtype == "f64" else skl32).append(lp)
+            print(
+                f"skl-{dtype} seed={seed}: logPerp {lp:.4f}  ({dt:.0f}s)",
+                flush=True,
+            )
 
     ours_a, skl_a = np.asarray(ours), np.asarray(skl)
+    skl32_a = np.asarray(skl32)
     rec = {
         "protocol": {
+            "note": "sklearn f64 = MLlib Breeze-Double-faithful "
+                    "baseline; f32 = dtype sensitivity line",
             "conv_iters": bench.ONLINE_CONV_ITERS,
             "conv_passes": bench.ONLINE_CONV_PASSES,
             "corpus": "20ng-shaped-synthetic (bench rng seed 20)",
@@ -120,11 +143,16 @@ def main():
         "sklearn": [round(float(v), 4) for v in skl],
         "ours_mean": round(float(ours_a.mean()), 4),
         "ours_spread_pct": round(
-            100 * float(ours_a.ptp() / ours_a.mean()), 3
+            100 * float(np.ptp(ours_a) / ours_a.mean()), 3
         ),
         "sklearn_mean": round(float(skl_a.mean()), 4),
         "sklearn_spread_pct": round(
-            100 * float(skl_a.ptp() / skl_a.mean()), 3
+            100 * float(np.ptp(skl_a) / skl_a.mean()), 3
+        ),
+        "sklearn_f32": [round(float(v), 4) for v in skl32],
+        "sklearn_f32_mean": round(float(skl32_a.mean()), 4),
+        "dtype_sensitivity_pct": round(
+            100 * float(skl_a.mean() / skl32_a.mean() - 1.0), 3
         ),
         "worst_ratio": round(float(ours_a.max() / skl_a.min()), 4),
         "mean_ratio": round(float(ours_a.mean() / skl_a.mean()), 4),

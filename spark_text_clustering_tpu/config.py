@@ -112,6 +112,14 @@ class Params:
     # interval means.  Costs one host round trip per iteration (~85 ms
     # over a tunnel) — an observability switch, not a training default.
     record_iteration_times: bool = False
+    # E-step inner gamma loop: iterate until the worst per-doc
+    # mean|Δgamma| < estep_tol or estep_max_inner (Hoffman eq. 2-4;
+    # MLlib variationalTopicInference hardcodes 100 / 1e-3, and sklearn's
+    # max_doc_update_iter/mean_change match).  Exposed because the
+    # converged-quality protocol (bench.py) is sensitive to the E-step
+    # depth while throughput is sensitive to its cost.
+    estep_max_inner: int = 100
+    estep_tol: float = 1e-3
     # Host-staging budget for one training dispatch.  With no
     # checkpointing and no per-iteration observability the chunked loops
     # scan the WHOLE remaining run in one dispatch (models/dispatch.py);

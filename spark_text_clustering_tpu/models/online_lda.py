@@ -1047,6 +1047,7 @@ class OnlineLDA:
                 self.mesh, alpha=alpha, eta=eta, tau0=p.tau0,
                 kappa=p.kappa, k=k, gamma_shape=p.gamma_shape,
                 seed=p.seed, d=plan.d, n_docs=n,
+                max_inner=p.estep_max_inner, tol=p.estep_tol,
                 interpret=jax.default_backend() != "tpu",
             )
             self._tiles_res_key = key_fn
@@ -1168,6 +1169,7 @@ class OnlineLDA:
             self._packed_chunk_fn = make_online_packed_chunk(
                 self.mesh, alpha=alpha, eta=eta, tau0=p.tau0,
                 kappa=p.kappa, k=k, gamma_shape=p.gamma_shape, seed=p.seed,
+                max_inner=p.estep_max_inner, tol=p.estep_tol,
             )
         n_data = self.mesh.shape[DATA_AXIS]
         tok_spec = NamedSharding(self.mesh, P(None, DATA_AXIS))
@@ -1635,7 +1637,8 @@ class OnlineLDA:
                     self._resident_chunk_fn = make_online_resident_chunk(
                         self.mesh, alpha=alpha, eta=eta, tau0=p.tau0,
                         kappa=p.kappa, k=k, gamma_shape=p.gamma_shape,
-                        seed=p.seed,
+                        seed=p.seed, max_inner=p.estep_max_inner,
+                        tol=p.estep_tol,
                     )
                 # resident corpus: each dispatch stages only the pick
                 # indices, so the whole run can be one scan
@@ -1677,7 +1680,8 @@ class OnlineLDA:
             self._step_fn = (
                 make_online_eb(self.mesh),
                 make_online_estep(
-                    self.mesh, alpha=alpha, max_inner=100, tol=1e-3
+                    self.mesh, alpha=alpha,
+                    max_inner=p.estep_max_inner, tol=p.estep_tol,
                 ),
                 make_online_mstep(
                     self.mesh, eta=eta, tau0=p.tau0, kappa=p.kappa
