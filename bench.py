@@ -199,6 +199,7 @@ def em_bytes_sweep(padded_cells: int, k: int, v: int) -> float:
 # Parent: platform probing + child supervision (no jax import here).
 # =====================================================================
 
+from spark_text_clustering_tpu import telemetry
 from spark_text_clustering_tpu.utils.env import (
     probe_accelerator,
     scrubbed_cpu_env,
@@ -245,7 +246,48 @@ def _run_child(env: dict, timeout: int = 2400):
     return None
 
 
+def _bench_telemetry_path():
+    return os.environ.get("STC_BENCH_TELEMETRY") or os.path.join(
+        CACHE, "bench_events.jsonl"
+    )
+
+
+def _finish_bench_telemetry(record, probe) -> None:
+    """Emit this bench run through the shared telemetry schema: one
+    manifest + ``probe_attempt`` events (already buffered during the
+    probe) + one ``metric`` event per numeric leaf of the record — so
+    ``metrics diff``/``check`` work across bench rounds.  The stdout
+    BENCH tail JSON is unchanged: it is now the DERIVED view."""
+    try:
+        from spark_text_clustering_tpu.telemetry.metrics_cli import (
+            flatten_numeric,
+        )
+
+        telemetry.manifest(
+            kind="bench",
+            platform=(record or {}).get("platform"),
+            metric=(record or {}).get("metric"),
+            probe_ok=probe["ok"],
+        )
+        for name, value in sorted(
+            flatten_numeric(record or {}, "bench").items()
+        ):
+            telemetry.event("metric", name=name, value=value)
+    except Exception as exc:
+        sys.stderr.write(f"# bench telemetry emission failed: {exc!r}\n")
+    finally:
+        telemetry.shutdown()
+
+
 def main() -> None:
+    # telemetry stream opens BEFORE the probe so every probe attempt is
+    # captured as a structured event (manifest lands later; the writer
+    # buffers to keep it the first record)
+    try:
+        os.makedirs(CACHE, exist_ok=True)
+        telemetry.configure(_bench_telemetry_path())
+    except Exception as exc:
+        sys.stderr.write(f"# bench telemetry disabled: {exc!r}\n")
     probe = _probe_tpu()
     on_tpu = probe["ok"]
     record = None
@@ -269,6 +311,7 @@ def main() -> None:
             record["platform_fallback"] = True
             record["tpu_probe_history"] = probe["history"]
     if record is None:
+        _finish_bench_telemetry(None, probe)
         print(
             json.dumps(
                 {
@@ -281,6 +324,7 @@ def main() -> None:
             )
         )
         sys.exit(1)
+    _finish_bench_telemetry(record, probe)
     print(json.dumps(record))
 
 

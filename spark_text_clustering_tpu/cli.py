@@ -23,6 +23,7 @@ from typing import List, Optional
 
 import numpy as np
 
+from . import telemetry
 from .config import Params
 from .pipeline import (
     IDF,
@@ -73,6 +74,13 @@ def _init_distributed(args: argparse.Namespace) -> bool:
 
 def cmd_train(args: argparse.Namespace) -> int:
     coordinator = _init_distributed(args)
+    # telemetry run stream: coordinator-only (a worker opening the same
+    # file would truncate the coordinator's records, like --metrics-file)
+    own_telemetry = bool(
+        getattr(args, "telemetry_file", None) and coordinator
+    )
+    if own_telemetry:
+        telemetry.configure(args.telemetry_file)
     timer = PhaseTimer()
     sw = _load_stop_words(args.stop_words)
     with timer.phase("read"):
@@ -132,8 +140,14 @@ def cmd_train(args: argparse.Namespace) -> int:
         # transform would run it twice)
         ds: dict = {"texts": texts}
         for stage in feat_stages:
-            t = stage.fit(ds) if isinstance(stage, Estimator) else stage
-            ds = t.transform(ds)
+            with telemetry.span(
+                f"pipeline.fit.{type(stage).__name__}"
+            ):
+                t = (
+                    stage.fit(ds)
+                    if isinstance(stage, Estimator) else stage
+                )
+                ds = t.transform(ds)
     rows = ds["rows"]
     n_docs = sum(1 for i, _ in rows if len(i) > 0)
     # the reference's "token" count is DISTINCT terms per doc summed
@@ -143,6 +157,19 @@ def cmd_train(args: argparse.Namespace) -> int:
         len(ds["vocab"]) if ds.get("vocab") is not None
         else ds["num_features"]
     )
+    if own_telemetry:
+        # manifest (the stream's FIRST record — earlier spans were
+        # buffered): config hash, backend, mesh shape, vocab width,
+        # git rev — everything a later `metrics diff` needs to judge
+        # whether two runs are comparable
+        telemetry.manifest(
+            params=params, mesh=mesh, vocab_width=actual_v,
+            kind="train", books_dir=args.books,
+        )
+        telemetry.event(
+            "corpus", documents=n_docs, tokens=n_tokens,
+            vocab_width=actual_v,
+        )
 
     if coordinator:
         # corpus summary, reference format (LDAClustering.scala:28-34);
@@ -218,6 +245,16 @@ def cmd_train(args: argparse.Namespace) -> int:
             vocab_size=model.vocab_size,
             algorithm=params.algorithm,
         )
+        for name, seconds in timer.phases.items():
+            telemetry.event(
+                "phase", name=name, seconds=round(seconds, 6)
+            )
+        telemetry.event(
+            "model_saved", path=out_dir, k=model.k,
+            vocab_size=model.vocab_size, algorithm=params.algorithm,
+        )
+    if own_telemetry:
+        telemetry.shutdown()
     return 0
 
 
@@ -287,6 +324,13 @@ def cmd_stream_score(args: argparse.Namespace) -> int:
         return 2
     model = load_model(model_path)
     print(f"loaded model {model_path}: k={model.k}, V={model.vocab_size}")
+    own_telemetry = bool(getattr(args, "telemetry_file", None))
+    if own_telemetry:
+        telemetry.configure(args.telemetry_file)
+        telemetry.manifest(
+            kind="stream-score", model=model_path,
+            vocab_width=model.vocab_size, watch_dir=args.watch_dir,
+        )
 
     src = FileStreamSource(
         args.watch_dir,
@@ -313,6 +357,8 @@ def cmd_stream_score(args: argparse.Namespace) -> int:
     if scorer.results and not args.no_report:
         path = scorer.write_report(args.output_dir, args.lang)
         print(f"report written to {path}")
+    if own_telemetry:
+        telemetry.shutdown()
     return 0
 
 
@@ -338,6 +384,16 @@ def cmd_stream_train(args: argparse.Namespace) -> int:
     if args.vocab_from_model:
         vocab = load_model(args.vocab_from_model).vocab
         num_features = None
+    own_telemetry = bool(getattr(args, "telemetry_file", None))
+    if own_telemetry:
+        telemetry.configure(args.telemetry_file)
+        telemetry.manifest(
+            params=params, kind="stream-train",
+            vocab_width=(
+                len(vocab) if vocab is not None else num_features
+            ),
+            watch_dir=args.watch_dir,
+        )
 
     trainer = StreamingOnlineLDA(
         params,
@@ -372,6 +428,12 @@ def cmd_stream_train(args: argparse.Namespace) -> int:
     out_dir = model_dir_name(args.lang, base=args.models_dir)
     model.save(out_dir)
     print(f"model saved to {out_dir}")
+    if own_telemetry:
+        telemetry.event(
+            "model_saved", path=out_dir, k=model.k,
+            vocab_size=model.vocab_size, algorithm="online",
+        )
+        telemetry.shutdown()
     return 0
 
 
@@ -444,6 +506,9 @@ def _add_stream_args(p: argparse.ArgumentParser) -> None:
     p.add_argument("--lang", default="EN", choices=sorted(LANG_DIRS))
     p.add_argument("--no-lemmatize", action="store_true")
     p.add_argument("--include-all", action="store_true")
+    p.add_argument("--telemetry-file", default=None,
+                   help="telemetry run stream (manifest + per-micro-batch "
+                        "events) as JSONL — consumed by `metrics`")
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -496,6 +561,10 @@ def build_parser() -> argparse.ArgumentParser:
     tr.add_argument("--metrics-file", default=None,
                     help="append structured JSONL metrics (phases, "
                          "per-iteration times) to this file")
+    tr.add_argument("--telemetry-file", default=None,
+                    help="full telemetry run stream (manifest + spans + "
+                         "per-iteration events + registry snapshot) as "
+                         "JSONL — consumed by the `metrics` subcommand")
     tr.add_argument("--no-tfidf", action="store_true",
                     help="train on raw counts instead of TF-IDF pseudo-counts")
     tr.add_argument("--export-mllib", action="store_true",
@@ -565,6 +634,10 @@ def build_parser() -> argparse.ArgumentParser:
     )
     dr.add_argument("--probe-timeout", type=int, default=60)
     dr.set_defaults(fn=cmd_doctor)
+
+    from .telemetry.metrics_cli import add_metrics_subparser
+
+    add_metrics_subparser(sub)
     return ap
 
 
@@ -579,7 +652,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     # local backend, and jax.distributed.initialize must run BEFORE any
     # other jax call — mesh.initialize_distributed does that inside the
     # command).
-    if args.cmd != "doctor" and getattr(args, "coordinator", None) is None:
+    # `metrics` is a pure host-side reader: it must not import jax at all
+    if (
+        args.cmd not in ("doctor", "metrics")
+        and getattr(args, "coordinator", None) is None
+    ):
         from .utils.env import enable_persistent_compile_cache
 
         try:

@@ -38,6 +38,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from .. import telemetry
 from ..config import Params
 from ..ops.lda_math import _resolve_gamma_backend
 from ..ops.sparse import DocTermBatch, batch_from_rows, next_pow2
@@ -57,6 +58,7 @@ from ..parallel.mesh import (
     make_mesh,
     model_sharding,
 )
+from ..utils import jax_compat  # noqa: F401  (installs jax.shard_map shim)
 from ..utils.timing import IterationTimer
 from .base import LDAModel
 from .dispatch import resolve_dispatch_interval, save_cadence
@@ -1078,7 +1080,7 @@ class EMLDA:
                 n_wk, n_dk_dev = run(
                     n_wk, n_dk_dev, ids_dev, cts_dev, seg_dev, m
                 )
-                n_wk.block_until_ready()
+                telemetry.device_sync(n_wk, "em_packed")
                 timer.stop()
                 if m > 1:
                     timer.split_last(m)
@@ -1130,7 +1132,7 @@ class EMLDA:
                     acc = part if acc is None else acc + part
                     n_dk_list[bi] = dk_new
                 n_wk = acc
-                n_wk.block_until_ready()
+                telemetry.device_sync(n_wk, "em_verbose")
                 self.last_dispatches += 1  # one synced sweep per iter
                 timer.stop()
                 print(f"EM iter {it}: {timer.times[-1]:.3f}s")
@@ -1163,7 +1165,7 @@ class EMLDA:
                 timer.start()
                 self.last_dispatches += 1
                 n_wk, n_dks = run_chunk(n_wk, n_dks, bucket_arrays, m)
-                n_wk.block_until_ready()
+                telemetry.device_sync(n_wk, "em_chunk")
                 timer.stop()
                 timer.split_last(m)
                 it += m
@@ -1197,6 +1199,15 @@ class EMLDA:
                 # vertices of an MLlib-format export (reference_export);
                 # opt-in: costs one device->host fetch per bucket
                 self.last_doc_topic_counts = _assemble_n_dk(n_dk_list)
+        telemetry.emit_fit(
+            "em", timer.times, kind=timer.kind, start_iteration=start_it,
+            log_likelihood=self.last_log_likelihood,
+            layout=self.last_layout,
+            scatter_backend=self.last_scatter_backend,
+            cells=self.last_cells,
+            dispatches=self.last_dispatches,
+            k=k, vocab_width=v, docs=n,
+        )
         n_wk_full = fetch_global(n_wk)
         n_wk_np = n_wk_full[:, :v]
         return LDAModel(

@@ -40,6 +40,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from .. import telemetry
 from ..config import Params
 from .dispatch import resolve_dispatch_interval
 from ..ops.sparse import DocTermBatch, batch_from_rows
@@ -51,6 +52,7 @@ from ..parallel.collectives import (
     scatter_add_model_shard,
 )
 from ..parallel.mesh import DATA_AXIS, MODEL_AXIS, make_mesh, model_sharding
+from ..utils import jax_compat  # noqa: F401  (installs jax.shard_map shim)
 from ..utils.timing import IterationTimer
 
 __all__ = ["NMF", "NMFModel", "make_nmf_train_step", "frobenius_loss"]
@@ -326,7 +328,7 @@ class NMF:
                 self._chunk_fn(state, batch, m)
                 if m > 1 else step_fn(state, batch)
             )
-            state.h.block_until_ready()
+            telemetry.device_sync(state.h, "nmf")
             timer.stop()
             self.last_dispatches += 1
             if m > 1:
@@ -337,6 +339,12 @@ class NMF:
 
         loss = float(frobenius_loss(batch, state.w, state.h))
         self.last_loss = loss
+        telemetry.emit_fit(
+            "nmf", timer.times, kind=timer.kind,
+            loss=loss,
+            dispatches=self.last_dispatches,
+            k=k, vocab_width=v, docs=n_true,
+        )
         h_np = np.asarray(jax.device_get(state.h))[:, :v]
         return NMFModel(
             h=h_np,

@@ -33,6 +33,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from .. import telemetry
 from ..config import Params
 from ..ops.lda_math import (
     _resolve_gamma_backend,
@@ -63,6 +64,7 @@ from ..parallel.mesh import (
     make_mesh,
     model_sharding,
 )
+from ..utils import jax_compat  # noqa: F401  (installs jax.shard_map shim)
 from ..utils.timing import IterationTimer
 from .base import LDAModel
 from .dispatch import resolve_dispatch_interval, save_cadence
@@ -942,6 +944,20 @@ class OnlineLDA:
         # fixed point) or "pallas_tiles" (VMEM-resident tile kernel)
         self.last_gamma_backend: str = "xla"
 
+    def _emit_fit_telemetry(self, timer, start_it: int, n: int, v: int):
+        """One ``train_fit`` + per-iteration events, shared by every
+        online layout's return path."""
+        telemetry.emit_fit(
+            "online", timer.times, kind=timer.kind,
+            start_iteration=start_it,
+            layout=self.last_layout,
+            gamma_backend=self.last_gamma_backend,
+            batch_size=self.last_batch_size,
+            batch_cells=self.last_batch_cells,
+            dispatches=getattr(self, "last_dispatches", None),
+            k=self.params.k, vocab_width=v, docs=n,
+        )
+
     def _fit_tiles_resident(
         self, rows, vocab, p, n, v, k, alpha, eta, bsz, n_iters,
         start_it, lam, timer, verbose, ckpt_path, save_checkpoint,
@@ -1117,7 +1133,7 @@ class OnlineLDA:
                 state, ids_res, cts_res, seg_res, doc_res,
                 jax.device_put(picks, pick_spec), float(n),
             )
-            state.lam.block_until_ready()
+            telemetry.device_sync(state.lam, "online_tiles")
             timer.stop()
             self.last_dispatches += 1
             if m > 1:
@@ -1129,6 +1145,7 @@ class OnlineLDA:
             it += m
             if ckpt_path and it % save_cadence(p, interval) == 0:
                 save_checkpoint(it, state.lam)
+        self._emit_fit_telemetry(timer, start_it, n, v)
         lam_out = model_handoff(state.lam, v)
         return LDAModel(
             lam=lam_out,
@@ -1276,7 +1293,7 @@ class OnlineLDA:
                     jax.device_put(bds, rep),
                     float(n),
                 )
-                out.lam.block_until_ready()
+                telemetry.device_sync(out.lam, "online_tiles")
                 return out, time.perf_counter() - t0
 
             def dispatch_flat(st):
@@ -1299,7 +1316,7 @@ class OnlineLDA:
                     jax.device_put(bds, rep),
                     float(n),
                 )
-                out.lam.block_until_ready()
+                telemetry.device_sync(out.lam, "online_packed")
                 return out, time.perf_counter() - t0, t_pad
 
             if plan is not None and self._packed_gamma_choice is None:
@@ -1361,6 +1378,7 @@ class OnlineLDA:
             it += m
             if ckpt_path and it % save_cadence(p, interval) == 0:
                 save_checkpoint(it, state.lam)
+        self._emit_fit_telemetry(timer, start_it, n, v)
         lam_out = model_handoff(state.lam, v)
         return LDAModel(
             lam=lam_out,
@@ -1622,7 +1640,7 @@ class OnlineLDA:
                         state, ids_res, wts_res,
                         jnp.asarray(make_pick(it)), float(n),
                     )
-                    state.lam.block_until_ready()
+                    telemetry.device_sync(state.lam, "online_resident")
                     self.last_dispatches += 1
                     timer.stop()
                     print(f"iter {it}: {timer.times[-1]:.3f}s")
@@ -1657,12 +1675,13 @@ class OnlineLDA:
                         state, ids_res, wts_res, jnp.asarray(picks), float(n)
                     )
                     self.last_dispatches += 1
-                    state.lam.block_until_ready()
+                    telemetry.device_sync(state.lam, "online_resident")
                     timer.stop()
                     timer.split_last(m)
                     it += m
                     if ckpt_path and it % save_cadence(p, interval) == 0:
                         save_checkpoint(it, state.lam)
+            self._emit_fit_telemetry(timer, start_it, n, v)
             lam_out = model_handoff(state.lam, v)
             return LDAModel(
                 lam=lam_out,
@@ -1742,7 +1761,7 @@ class OnlineLDA:
                 sstats_acc = sstats if sstats_acc is None else sstats_acc + sstats
                 count_acc = cnt if count_acc is None else count_acc + cnt
             lam = mstep_fn(lam, eb, sstats_acc, count_acc, it, float(n))
-            lam.block_until_ready()
+            telemetry.device_sync(lam, "online_host")
             self.last_dispatches += 1  # one synced E+M group per iter
             timer.stop()
             if verbose:
@@ -1750,6 +1769,7 @@ class OnlineLDA:
             if ckpt_path and (it + 1) % p.checkpoint_interval == 0:
                 save_checkpoint(it + 1, lam)
 
+        self._emit_fit_telemetry(timer, start_it, n, v)
         lam_out = model_handoff(lam, v)
         return LDAModel(
             lam=lam_out,

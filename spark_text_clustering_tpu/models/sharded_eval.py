@@ -41,6 +41,7 @@ from ..ops.lda_math import (
     dirichlet_expectation,
     dirichlet_expectation_sharded,
 )
+from ..utils import jax_compat  # noqa: F401  (installs jax.shard_map shim)
 from ..ops.sparse import DocTermBatch
 from ..parallel.collectives import (
     gather_model_rows,

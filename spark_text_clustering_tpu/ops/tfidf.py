@@ -27,6 +27,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..utils import jax_compat  # noqa: F401  (installs jax.shard_map shim)
 from .sparse import DocTermBatch
 
 __all__ = [

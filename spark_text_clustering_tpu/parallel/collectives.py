@@ -22,7 +22,32 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from .. import telemetry
 from .mesh import DATA_AXIS, MODEL_AXIS
+
+
+def _acct(name: str, *arrays) -> None:
+    """Collective telemetry: call counts + bytes per call site.
+
+    Collectives run INSIDE jit/shard_map, so this fires at TRACE time —
+    the counters say how many collective call sites each compiled
+    program contains and how many bytes each moves per execution
+    (``collective.<name>.calls`` / ``.traced_bytes``), not a per-step
+    runtime total (multiply by dispatch counts for that).  Host-side
+    helpers (``fetch_global``, ``data_shard_batch``, ``model_handoff``)
+    call this per REAL transfer, so their counters are true totals.
+    Disabled telemetry short-circuits on one bool check.
+    """
+    if not telemetry.enabled():
+        return
+    nbytes = 0
+    for a in arrays:
+        try:
+            nbytes += int(a.size) * a.dtype.itemsize
+        except Exception:      # weak types / non-array operands
+            pass
+    telemetry.count(f"collective.{name}.calls")
+    telemetry.count(f"collective.{name}.traced_bytes", nbytes)
 
 __all__ = [
     "psum_data",
@@ -43,12 +68,14 @@ __all__ = [
 def psum_data(x):
     """Reduce across document shards — Spark's treeAggregate
     (SURVEY.md §3.3: 'the pair that becomes device_put + jax.lax.psum')."""
+    _acct("psum_data", x)
     return lax.psum(x, DATA_AXIS)
 
 
 def psum_model(x):
     """Reduce across vocab shards — combines per-shard partial terms (token
     phinorms, lambda row sums) in the vocab-sharded E-step."""
+    _acct("psum_model", x)
     return lax.psum(x, MODEL_AXIS)
 
 
@@ -81,6 +108,7 @@ def gather_model_rows(table_shard, ids):
     win whenever the token working set is smaller than the vocabulary
     (CC-News config: B*L*k ~ 1e8 vs k*V = 5e9).
     """
+    _acct("gather_model_rows", ids)
     shard_v = table_shard.shape[-1]
     local, in_shard = _model_shard_local_ids(ids, shard_v)
     local = jnp.clip(local, 0, shard_v - 1)
@@ -94,6 +122,7 @@ def gather_model_rows_kbl(table_shard, ids):
     with the token axis LAST (the 128-lane dimension on TPU).  The Pallas
     E-step consumes this directly — producing [..., k] and transposing
     later measurably costs more than the E-step kernel itself."""
+    _acct("gather_model_rows_kbl", ids)
     shard_v = table_shard.shape[-1]
     local, in_shard = _model_shard_local_ids(ids, shard_v)
     local = jnp.clip(local, 0, shard_v - 1)
@@ -111,6 +140,7 @@ def gather_model_rows_bkl(table_shard, ids):
     permutation from the take's natural [k, B, L] folds into the
     gather's output layout under XLA — unlike a minor-dim transpose it
     costs no extra pass."""
+    _acct("gather_model_rows_bkl", ids)
     shard_v = table_shard.shape[-1]
     local, in_shard = _model_shard_local_ids(ids, shard_v)
     local = jnp.clip(local, 0, shard_v - 1)
@@ -123,6 +153,7 @@ def gather_model_rows_bkl(table_shard, ids):
 def scatter_add_model_shard_bkl(ids, vals, shard_v):
     """``scatter_add_model_shard_kbl`` for [B, k, L] values (the Pallas
     bkl layout): one scatter per topic row into [k, V/s]."""
+    _acct("scatter_add_model_shard_bkl", vals)
     k = vals.shape[1]
     local, in_shard = _model_shard_local_ids(ids, shard_v)
     local = jnp.where(in_shard, local, shard_v)           # overflow row
@@ -146,6 +177,7 @@ def scatter_add_model_shard_kbl(ids, vals, shard_v):
     returns: [k, shard_v] partial stats (still to be psum-reduced over
     "data").
     """
+    _acct("scatter_add_model_shard_kbl", vals)
     k = vals.shape[0]
     local, in_shard = _model_shard_local_ids(ids, shard_v)
     local = jnp.where(in_shard, local, shard_v)           # overflow row
@@ -197,6 +229,7 @@ def scatter_add_model_shard(ids, vals, shard_v):
     returns: [k, shard_v] partial stats for this shard (still to be
     psum-reduced over "data").
     """
+    _acct("scatter_add_model_shard", vals)
     k = vals.shape[-1]
     local, in_shard = _model_shard_local_ids(ids, shard_v)
     local = jnp.where(in_shard, local, shard_v)           # overflow row
@@ -216,6 +249,7 @@ def fetch_global(x):
     it replaces each bare device_get on the train paths)."""
     import numpy as np
 
+    _acct("fetch_global", x)   # host-side: a TRUE per-transfer count
     if jax.process_count() == 1:
         return np.asarray(jax.device_get(x))
     from jax.experimental import multihost_utils
@@ -247,6 +281,8 @@ def data_shard_batch(mesh: Mesh, batch):
     n_data = mesh.shape[DATA_AXIS]
     b = batch.num_docs
     padded = batch.pad_rows_to(((b + n_data - 1) // n_data) * n_data)
+    # host->device staging: a TRUE per-transfer count (host-side call)
+    _acct("h2d_batch", padded.token_ids, padded.token_weights)
     spec = jax.sharding.NamedSharding(mesh, P(DATA_AXIS, None))
     return DocTermBatch(
         jax.device_put(padded.token_ids, spec),

@@ -26,6 +26,7 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
+from . import telemetry
 from .config import Params
 from .ops.sparse import batch_from_rows
 from .ops.tfidf import doc_freq, idf_from_df, idf_transform
@@ -368,8 +369,13 @@ class PipelineModel(Transformer):
         self.stages = list(stages)
 
     def transform(self, ds: Dict) -> Dict:
+        # per-stage phase spans: wall time per transformer, nested under
+        # any enclosing span/trace (telemetry no-ops when disabled)
         for s in self.stages:
-            ds = s.transform(ds)
+            with telemetry.span(
+                f"pipeline.transform.{type(s).__name__}", emit=False
+            ):
+                ds = s.transform(ds)
         return ds
 
 
@@ -383,8 +389,10 @@ class Pipeline(Estimator):
         fitted: List[Transformer] = []
         last = len(self.stages) - 1
         for i, s in enumerate(self.stages):
-            t = s.fit(ds) if isinstance(s, Estimator) else s
-            if i != last:  # the final model's transform output is unused here
-                ds = t.transform(ds)
+            with telemetry.span(f"pipeline.fit.{type(s).__name__}"):
+                t = s.fit(ds) if isinstance(s, Estimator) else s
+                if i != last:
+                    # the final model's transform output is unused here
+                    ds = t.transform(ds)
             fitted.append(t)
         return PipelineModel(fitted)

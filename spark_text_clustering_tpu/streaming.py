@@ -33,6 +33,7 @@ from typing import Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from . import telemetry
 from .config import Params
 from .ops.sparse import batch_from_rows, next_pow2, pad_rows
 from .pipeline import TextPreprocessor, is_hashed_vocab, make_vectorizer
@@ -109,6 +110,9 @@ class FileStreamSource:
         self._seen: set = set()
         self._pending: List[str] = []
         self._next_id = 0
+        # new-but-unconsumed files seen by the last poll() — the source's
+        # queue depth (telemetry gauge ``stream.queue_depth``)
+        self.last_queue_depth = 0
         if state_path and os.path.exists(state_path):
             with open(state_path, "r", encoding="utf-8") as f:
                 self._seen = {line.rstrip("\n") for line in f if line.strip()}
@@ -154,6 +158,8 @@ class FileStreamSource:
 
     def poll(self) -> Optional[MicroBatch]:
         new = self._list_new()
+        self.last_queue_depth = len(new)
+        telemetry.gauge("stream.queue_depth", len(new))
         if not new:
             return None
         if self.max_files is not None:
@@ -212,6 +218,7 @@ class MemoryStreamSource:
         self._queue: List[Tuple[str, str]] = []
         self._next_id = 0
         self._docs_added = 0    # monotonic: auto-names never collide
+        self.last_queue_depth = 0
 
     def add(self, texts: Sequence[str], names: Optional[Sequence[str]] = None):
         if names is None:
@@ -222,6 +229,8 @@ class MemoryStreamSource:
         self._queue.extend(zip(names, texts))
 
     def poll(self) -> Optional[MicroBatch]:
+        self.last_queue_depth = len(self._queue)
+        telemetry.gauge("stream.queue_depth", len(self._queue))
         if not self._queue:
             return None
         n = len(self._queue) if self.max_docs is None else self.max_docs
@@ -303,29 +312,41 @@ class StreamingScorer:
         return _vectorize_texts(self.pre, self._rows_for, mb.texts)
 
     def process(self, mb: MicroBatch) -> List[ScoredDoc]:
-        rows = self._vectorize(mb)
-        if self.row_len is None:
-            max_nnz = max((len(i) for i, _ in rows), default=1)
-            self.row_len = max(8, next_pow2(max_nnz))
-        out: List[ScoredDoc] = []
-        for at in range(0, len(rows), self.batch_capacity):
-            chunk = rows[at : at + self.batch_capacity]
-            names = mb.names[at : at + self.batch_capacity]
-            # grow row_len only when a longer doc arrives (rare recompile)
-            max_nnz = max((len(i) for i, _ in chunk), default=1)
-            if max_nnz > self.row_len:
-                self.row_len = next_pow2(max_nnz)
-            batch = batch_from_rows(
-                pad_rows(chunk, self.batch_capacity), row_len=self.row_len
-            )
-            dist = self.model.topic_distribution(batch)[: len(chunk)]
-            for name, d, row in zip(names, dist, chunk):
-                sd = ScoredDoc(name, int(np.argmax(d)), np.asarray(d), row)
-                self.tallies[sd.topic] += 1
-                out.append(sd)
-        if self.keep_results:
-            self.results.extend(out)
-        self.batches_seen += 1
+        t0 = time.perf_counter()
+        with telemetry.span("stream.score_batch", emit=False):
+            rows = self._vectorize(mb)
+            if self.row_len is None:
+                max_nnz = max((len(i) for i, _ in rows), default=1)
+                self.row_len = max(8, next_pow2(max_nnz))
+            out: List[ScoredDoc] = []
+            for at in range(0, len(rows), self.batch_capacity):
+                chunk = rows[at : at + self.batch_capacity]
+                names = mb.names[at : at + self.batch_capacity]
+                # grow row_len only when a longer doc arrives (rare
+                # recompile)
+                max_nnz = max((len(i) for i, _ in chunk), default=1)
+                if max_nnz > self.row_len:
+                    self.row_len = next_pow2(max_nnz)
+                batch = batch_from_rows(
+                    pad_rows(chunk, self.batch_capacity),
+                    row_len=self.row_len,
+                )
+                dist = self.model.topic_distribution(batch)[: len(chunk)]
+                for name, d, row in zip(names, dist, chunk):
+                    sd = ScoredDoc(
+                        name, int(np.argmax(d)), np.asarray(d), row
+                    )
+                    self.tallies[sd.topic] += 1
+                    out.append(sd)
+            if self.keep_results:
+                self.results.extend(out)
+            self.batches_seen += 1
+        dt = time.perf_counter() - t0
+        telemetry.observe("stream.score.micro_batch_seconds", dt)
+        telemetry.event(
+            "micro_batch", role="score", batch_id=mb.batch_id,
+            docs=len(mb), seconds=round(dt, 6),
+        )
         return out
 
     # -- terminal outputs ------------------------------------------------
@@ -453,21 +474,33 @@ class StreamingOnlineLDA:
         """Train on one micro-batch.  Returns True when this call wrote a
         model checkpoint — the caller's cue to commit source progress (see
         FileStreamSource.commit)."""
-        rows = [(i, w) for i, w in self._vectorize(mb) if len(i) > 0]
-        if not rows:
-            return False
-        self.docs_seen += len(rows)
-        for at in range(0, len(rows), self.batch_capacity):
-            self._update(rows[at : at + self.batch_capacity])
-        self.batches_seen += 1
-        if (
-            self._ckpt_path
-            and self.checkpoint_every
-            and self.batches_seen % self.checkpoint_every == 0
-        ):
-            self.checkpoint()
-            return True
-        return False
+        t0 = time.perf_counter()
+        with telemetry.span("stream.train_batch", emit=False):
+            rows = [(i, w) for i, w in self._vectorize(mb) if len(i) > 0]
+            if not rows:
+                return False
+            self.docs_seen += len(rows)
+            for at in range(0, len(rows), self.batch_capacity):
+                self._update(rows[at : at + self.batch_capacity])
+            self.batches_seen += 1
+            wrote_ckpt = bool(
+                self._ckpt_path
+                and self.checkpoint_every
+                and self.batches_seen % self.checkpoint_every == 0
+            )
+            if wrote_ckpt:
+                self.checkpoint()
+        dt = time.perf_counter() - t0
+        if telemetry.enabled():
+            # guarded: int(step) forces a device readback — disabled
+            # telemetry must not pay a sync per micro-batch
+            telemetry.observe("stream.train.micro_batch_seconds", dt)
+            telemetry.event(
+                "micro_batch", role="train", batch_id=mb.batch_id,
+                docs=len(rows), seconds=round(dt, 6),
+                docs_seen=self.docs_seen, step=int(self.state.step),
+            )
+        return wrote_ckpt
 
     def _update(self, chunk) -> None:
         import jax

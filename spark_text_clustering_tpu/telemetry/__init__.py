@@ -1,0 +1,252 @@
+"""End-to-end telemetry: metric registry, spans, run manifests, JSONL.
+
+The observability layer the ROADMAP's "production-scale" north star
+requires and the reference entirely lacks (println + ``iterationTimes``
+only): every hot path — pipeline phases, the EM/Online/NMF training
+loops, streaming micro-batches, cross-device collectives, the TPU
+probe — reports through this one facade, and the ``metrics`` CLI
+(summarize / diff / check) reads the emitted streams back.
+
+Usage (instrumented code)::
+
+    from .. import telemetry
+
+    with telemetry.span("train.em"):
+        ...
+    telemetry.count("collective.psum_data.calls")
+    telemetry.observe("stream.micro_batch_seconds", dt)
+    telemetry.event("micro_batch", batch_id=3, docs=8, seconds=dt)
+
+Usage (a driver that owns a run)::
+
+    telemetry.configure("run/telemetry.jsonl")
+    telemetry.manifest(params=params, mesh=mesh, vocab_width=v)
+    ... train ...
+    telemetry.shutdown()        # final registry snapshot + close
+
+**Disabled is the default and costs (almost) nothing**: every helper
+collapses to one module-global bool check; ``span()`` returns a shared
+no-op singleton (no allocation).  The registry object itself is always
+live so error counters (e.g. ``telemetry_write_errors``) work even when
+no run sink is configured.  ``scripts/check_telemetry_overhead.py``
+enforces the <2% disabled-mode budget on a real EM fit.
+
+Import is jax-free: the bench/probe parents use this before (or
+without) accelerator bring-up.
+"""
+
+from __future__ import annotations
+
+import time
+from contextlib import contextmanager
+from typing import Iterable, Optional
+
+from .events import (
+    SCHEMA_VERSION,
+    JsonlSink,
+    TelemetryWriter,
+    manifest_fields,
+    read_events,
+)
+from .registry import (
+    DEFAULT_SECONDS_BUCKETS,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricRegistry,
+)
+from .spans import NOOP_SPAN, Span, current_path
+
+__all__ = [
+    "SCHEMA_VERSION",
+    "MetricRegistry",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "DEFAULT_SECONDS_BUCKETS",
+    "TelemetryWriter",
+    "JsonlSink",
+    "read_events",
+    "manifest_fields",
+    "Span",
+    "current_path",
+    "get_registry",
+    "get_writer",
+    "enabled",
+    "configure",
+    "manifest",
+    "shutdown",
+    "span",
+    "event",
+    "count",
+    "gauge",
+    "observe",
+    "device_sync",
+    "emit_fit",
+]
+
+_registry = MetricRegistry()
+_writer: Optional[TelemetryWriter] = None
+_enabled = False
+
+
+def get_registry() -> MetricRegistry:
+    return _registry
+
+
+def get_writer() -> Optional[TelemetryWriter]:
+    return _writer
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def configure(
+    path: Optional[str] = None,
+    *,
+    run_id: Optional[str] = None,
+    fresh_registry: bool = True,
+) -> Optional[TelemetryWriter]:
+    """Enable telemetry for this process.
+
+    ``path`` is the run's JSONL stream (None = registry-only: spans and
+    metrics aggregate in memory, nothing is written).  Reconfiguring
+    closes any previous writer.  Returns the writer (or None).
+    """
+    global _writer, _enabled
+    if _writer is not None:
+        _writer.close()
+        _writer = None
+    if fresh_registry:
+        _registry.reset()
+    _writer = (
+        TelemetryWriter(path, registry=_registry, run_id=run_id)
+        if path
+        else None
+    )
+    _enabled = True
+    return _writer
+
+
+def manifest(**fields) -> None:
+    """Write the run manifest (see ``events.manifest_fields`` for the
+    ``params=``/``mesh=``/``vocab_width=`` conveniences)."""
+    if _writer is not None:
+        _writer.write_manifest(**manifest_fields(**fields))
+
+
+def shutdown() -> None:
+    """Disable telemetry; flush the final registry snapshot and close
+    the run stream."""
+    global _writer, _enabled
+    if _writer is not None:
+        _writer.close()
+        _writer = None
+    _enabled = False
+
+
+def span(name: str, emit: bool = True, **fields):
+    """Context manager; the no-op singleton when telemetry is off."""
+    if not _enabled:
+        return NOOP_SPAN
+    return Span(name, emit=emit, **fields)
+
+
+def _observe_span(path, seconds, emit, fields, error=False):
+    # Span.__exit__ hook (kept here so spans.py stays state-free)
+    if not _enabled:
+        return
+    _registry.histogram(f"span.{path}.seconds").observe(seconds)
+    if error:
+        _registry.counter(f"span.{path}.errors").inc()
+    if emit and _writer is not None:
+        _writer.emit(
+            "span", name=path, seconds=round(seconds, 6),
+            **({"error": True} if error else {}), **fields,
+        )
+
+
+def event(name: str, /, **fields) -> None:
+    # ``name`` is positional-only so events may carry a "name" field
+    if _enabled and _writer is not None:
+        _writer.emit(name, **fields)
+
+
+def count(name: str, n: int = 1) -> None:
+    if _enabled:
+        _registry.counter(name).inc(n)
+
+
+def gauge(name: str, v: float) -> None:
+    if _enabled:
+        _registry.gauge(name).set(v)
+
+
+def observe(
+    name: str, v: float, buckets: Optional[Iterable[float]] = None
+) -> None:
+    if _enabled:
+        _registry.histogram(name, buckets).observe(v)
+
+
+def device_sync(x, label: str = "train"):
+    """``block_until_ready`` with the wait ATTRIBUTED instead of smeared.
+
+    Device-sync cost is where tunnel round trips and dispatch pipelining
+    hide; routing every hot-loop sync through here gives it its own
+    histogram (``device_sync.<label>.seconds``) and call counter so a
+    profile can say "the chip was idle, the host was waiting" — the
+    attribution the BENCH probe hangs lacked.  Disabled mode is a bare
+    ``block_until_ready``.
+    """
+    if not _enabled:
+        x.block_until_ready()
+        return x
+    t0 = time.perf_counter()
+    x.block_until_ready()
+    dt = time.perf_counter() - t0
+    _registry.histogram(f"device_sync.{label}.seconds").observe(dt)
+    _registry.counter(f"device_sync.{label}.calls").inc()
+    return x
+
+
+def emit_fit(
+    optimizer: str,
+    times,
+    kind: str = "per_iteration",
+    start_iteration: int = 0,
+    **summary,
+) -> None:
+    """Per-iteration + fit-summary telemetry from a training loop.
+
+    One call at the end of each estimator's ``fit`` emits a
+    ``train_iteration`` event per recorded wall time (``kind`` says
+    whether they are true samples or chunk means — the
+    ``IterationTimer.kind`` distinction) and one ``train_fit`` event
+    carrying convergence/layout/roofline fields the caller passes
+    (log_likelihood, loss, layout, cells, dispatches, ...).
+    """
+    if not _enabled:
+        return
+    for i, s in enumerate(times):
+        _registry.histogram(
+            f"train.{optimizer}.iteration_seconds"
+        ).observe(float(s))
+        if _writer is not None:
+            _writer.emit(
+                "train_iteration",
+                optimizer=optimizer,
+                iteration=start_iteration + i,
+                seconds=round(float(s), 6),
+                kind=kind,
+            )
+    clean = {k: v for k, v in summary.items() if v is not None}
+    if _writer is not None:
+        _writer.emit(
+            "train_fit",
+            optimizer=optimizer,
+            iterations=len(list(times)),
+            kind=kind,
+            **clean,
+        )

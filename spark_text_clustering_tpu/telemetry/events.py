@@ -1,0 +1,242 @@
+"""Versioned JSONL event stream + run manifest.
+
+One run -> one ``.jsonl`` file whose FIRST record is a **manifest**
+(schema version, run id, config hash, backend, mesh shape, vocab width,
+git rev) and whose remaining records are flat events::
+
+    {"event": "manifest", "schema": 1, "run_id": "...", ...}
+    {"ts": 1700000000.1, "event": "train_iteration", "optimizer": "em",
+     "iteration": 3, "seconds": 0.21}
+
+The manifest-first invariant is load-bearing for the ``metrics`` CLI
+(summarize/diff/check key off it), so the writer BUFFERS events emitted
+before ``write_manifest`` and flushes them after it — call sites don't
+have to sequence their setup around when the vocab width becomes known.
+
+I/O failure policy (the old ``MetricsLogger`` silently lost records):
+every failed write increments the ``telemetry_write_errors`` counter on
+the process registry and the FIRST failure warns once — training is
+never aborted for a telemetry disk error, but the loss is visible.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import subprocess
+import time
+import warnings
+from typing import Dict, List, Optional
+
+from .registry import MetricRegistry
+
+__all__ = [
+    "SCHEMA_VERSION",
+    "JsonlSink",
+    "TelemetryWriter",
+    "read_events",
+    "manifest_fields",
+    "git_rev",
+]
+
+SCHEMA_VERSION = 1
+
+WRITE_ERRORS_COUNTER = "telemetry_write_errors"
+
+
+class JsonlSink:
+    """Append-only JSONL file with surfaced (never raised) I/O errors.
+
+    Shared by ``TelemetryWriter`` and the legacy ``MetricsLogger`` shim so
+    the error-surfacing policy lives in exactly one place.
+    """
+
+    def __init__(
+        self,
+        path: Optional[str],
+        *,
+        registry: Optional[MetricRegistry] = None,
+        truncate: bool = True,
+    ) -> None:
+        self.path = path
+        self._registry = registry
+        self._warned = False
+        if path:
+            try:
+                os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+                if truncate:
+                    # one run, one file
+                    with open(path, "w", encoding="utf-8"):
+                        pass
+            except OSError as exc:
+                self._surface(exc)
+
+    def _surface(self, exc: OSError) -> None:
+        if self._registry is None:
+            # late import: default registry lives in the package facade
+            from . import get_registry
+
+            self._registry = get_registry()
+        self._registry.counter(WRITE_ERRORS_COUNTER).inc()
+        if not self._warned:
+            self._warned = True
+            warnings.warn(
+                f"telemetry sink {self.path!r} is failing "
+                f"({exc!r}); records are being dropped (counted in "
+                f"{WRITE_ERRORS_COUNTER}) — this warning prints once",
+                RuntimeWarning,
+                stacklevel=3,
+            )
+
+    def write(self, rec: Dict) -> bool:
+        """Append one record; False (and a surfaced error) on failure."""
+        if not self.path:
+            return False
+        try:
+            with open(self.path, "a", encoding="utf-8") as f:
+                f.write(json.dumps(rec) + "\n")
+            return True
+        except (OSError, TypeError, ValueError) as exc:
+            # TypeError/ValueError: unserializable field — drop the
+            # record, keep the run alive, count the loss
+            self._surface(exc if isinstance(exc, OSError) else OSError(exc))
+            return False
+
+
+def git_rev(cwd: Optional[str] = None) -> Optional[str]:
+    """Best-effort short git revision of the running tree."""
+    try:
+        r = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            capture_output=True, text=True, timeout=5,
+            cwd=cwd or os.path.dirname(os.path.abspath(__file__)),
+        )
+        return r.stdout.strip() or None if r.returncode == 0 else None
+    except Exception:
+        return None
+
+
+def manifest_fields(
+    params=None,
+    mesh=None,
+    vocab_width: Optional[int] = None,
+    **extra,
+) -> Dict:
+    """Standard manifest payload from live objects.
+
+    ``params``: a ``config.Params`` (hashed canonically via its JSON
+    form).  ``mesh``: a jax Mesh (shape recorded as axis-name -> size).
+    Backend is read from jax ONLY if jax is already imported — building a
+    manifest never triggers accelerator bring-up.
+    """
+    import platform
+    import sys
+
+    out: Dict = {
+        "host": platform.node(),
+        "git_rev": git_rev(),
+    }
+    if params is not None:
+        cfg = json.loads(params.to_json())
+        out["config"] = cfg
+        out["config_hash"] = hashlib.sha1(
+            json.dumps(cfg, sort_keys=True).encode()
+        ).hexdigest()[:12]
+        out["algorithm"] = cfg.get("algorithm")
+    if mesh is not None:
+        try:
+            out["mesh_shape"] = {
+                str(k): int(v) for k, v in dict(mesh.shape).items()
+            }
+        except Exception:
+            pass
+    if vocab_width is not None:
+        out["vocab_width"] = int(vocab_width)
+    if "jax" in sys.modules:
+        try:
+            import jax
+
+            out["backend"] = jax.default_backend()
+            out["device_count"] = jax.device_count()
+        except Exception:
+            pass
+    out.update(extra)
+    return out
+
+
+class TelemetryWriter:
+    """Run-scoped event writer: manifest first, then the event stream.
+
+    ``emit`` before ``write_manifest`` buffers; ``close`` with no
+    manifest writes a minimal auto-manifest so the invariant holds for
+    consumers either way.
+    """
+
+    def __init__(
+        self,
+        path: str,
+        *,
+        registry: Optional[MetricRegistry] = None,
+        run_id: Optional[str] = None,
+    ) -> None:
+        self.run_id = run_id or (
+            time.strftime("%Y%m%d-%H%M%S", time.gmtime())
+            + f"-{os.getpid()}"
+        )
+        self._sink = JsonlSink(path, registry=registry)
+        self._registry = registry
+        self._pending: List[Dict] = []
+        self._manifest_written = False
+        self.path = path
+
+    def write_manifest(self, **fields) -> None:
+        rec = {
+            "event": "manifest",
+            "schema": SCHEMA_VERSION,
+            "run_id": self.run_id,
+            "ts": time.time(),
+        }
+        rec.update(fields)
+        self._sink.write(rec)
+        self._manifest_written = True
+        pending, self._pending = self._pending, []
+        for p in pending:
+            self._sink.write(p)
+
+    def emit(self, event: str, /, **fields) -> None:
+        rec = {"ts": time.time(), "event": event}
+        rec.update(fields)
+        if not self._manifest_written:
+            self._pending.append(rec)
+            return
+        self._sink.write(rec)
+
+    def close(self) -> None:
+        """Flush; emit a final registry snapshot when a registry is
+        attached (the ``registry`` event the CLI's diff/check read
+        counters from)."""
+        if not self._manifest_written:
+            self.write_manifest(auto=True)
+        if self._registry is not None:
+            self._sink.write({
+                "ts": time.time(),
+                "event": "registry",
+                "snapshot": self._registry.snapshot(),
+            })
+
+
+def read_events(path: str) -> List[Dict]:
+    """Parse a telemetry JSONL file; tolerates trailing partial lines
+    (a live run being summarized mid-write)."""
+    out: List[Dict] = []
+    with open(path, "r", encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                out.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue
+    return out

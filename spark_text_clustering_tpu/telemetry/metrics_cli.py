@@ -1,0 +1,386 @@
+"""``metrics`` CLI: summarize / diff / regression-check telemetry runs.
+
+Makes BENCH_* regression detection a first-class repo tool instead of
+ad-hoc JSON spelunking:
+
+    python -m spark_text_clustering_tpu.cli metrics summarize run.jsonl
+    python -m spark_text_clustering_tpu.cli metrics diff a.jsonl b.jsonl
+    python -m spark_text_clustering_tpu.cli metrics check run.jsonl \
+        --baseline base.json [--write-baseline] [--tolerance 0.25]
+
+Accepted inputs: a telemetry JSONL stream (manifest-first, the format
+``telemetry.TelemetryWriter`` emits) OR a plain one-object JSON file
+(e.g. a BENCH_rNN.json tail record) whose numeric leaves are flattened
+into dotted metric names under ``bench.`` — so ``metrics diff
+BENCH_r04.json BENCH_r05.json`` works on the existing artifacts today.
+
+Baseline format (``check``)::
+
+    {"schema": 1, "source": "<run path>", "default_tolerance": 0.25,
+     "metrics": {"train.em.s_per_iter_mean": {"value": 0.1,
+                                              "tolerance": 0.5}, ...}}
+
+A metric passes when ``|run - base| <= tolerance * max(|base|, 1e-12)``
+(relative band).  Timing-like metrics (``seconds``/``_ms``/``s_per_iter``
+in the name) capture with a wider default band — wall times on shared
+hosts jitter in ways counters and quality metrics don't.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import sys
+from typing import Dict, List, Optional, Tuple
+
+from .events import read_events
+
+__all__ = [
+    "load_run",
+    "run_metrics",
+    "flatten_numeric",
+    "cmd_summarize",
+    "cmd_diff",
+    "cmd_check",
+    "add_metrics_subparser",
+]
+
+_TIMING_HINTS = ("seconds", "_ms", "s_per_iter", "_s")
+_EPS = 1e-12
+
+
+def _is_num(v) -> bool:
+    return isinstance(v, (int, float)) and not isinstance(v, bool) \
+        and math.isfinite(v)
+
+
+def _flatten(obj, prefix: str, out: Dict[str, float]) -> None:
+    if isinstance(obj, dict):
+        for k, v in obj.items():
+            _flatten(v, f"{prefix}.{k}" if prefix else str(k), out)
+    elif isinstance(obj, (list, tuple)):
+        for i, v in enumerate(obj):
+            _flatten(v, f"{prefix}.{i}", out)
+    elif _is_num(obj):
+        out[prefix] = float(obj)
+
+
+def flatten_numeric(obj, prefix: str = "") -> Dict[str, float]:
+    """Numeric leaves of a nested record as dotted metric names — how a
+    BENCH tail JSON becomes diffable metrics."""
+    out: Dict[str, float] = {}
+    _flatten(obj, prefix, out)
+    return out
+
+
+def _pct(sorted_vals: List[float], q: float) -> float:
+    if not sorted_vals:
+        return math.nan
+    idx = max(0, min(len(sorted_vals) - 1,
+                     math.ceil(len(sorted_vals) * q / 100.0) - 1))
+    return sorted_vals[idx]
+
+
+def load_run(path: str) -> Tuple[Dict, List[Dict]]:
+    """(manifest, events) from a JSONL stream or a plain JSON object."""
+    # whole-file parse first: a (possibly pretty-printed) single JSON
+    # object with no "event" key is a BENCH-style tail record —
+    # synthesize a manifest + one bench_record event so the pipeline
+    # below is uniform
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            whole = json.load(f)
+    except (json.JSONDecodeError, UnicodeDecodeError):
+        whole = None
+    if isinstance(whole, dict) and "event" not in whole:
+        manifest = {"event": "manifest", "source_format": "plain_json",
+                    "path": path}
+        return manifest, [{"event": "bench_record", "record": whole}]
+    events = [e for e in read_events(path) if isinstance(e, dict)]
+    manifest = next(
+        (e for e in events if e.get("event") == "manifest"), {}
+    )
+    return manifest, [e for e in events if e.get("event") != "manifest"]
+
+
+def run_metrics(events: List[Dict]) -> Dict[str, float]:
+    """Flatten a run's events into scalar metrics (the unit summarize
+    prints, diff aligns, and check gates on)."""
+    out: Dict[str, float] = {}
+    counts: Dict[str, int] = {}
+    iter_secs: Dict[str, List[float]] = {}
+    batch_secs: Dict[str, List[float]] = {}
+    stream_docs = 0
+    probe_outcomes: Dict[str, int] = {}
+
+    for e in events:
+        name = e.get("event", "?")
+        counts[name] = counts.get(name, 0) + 1
+        if name == "train_iteration":
+            iter_secs.setdefault(
+                str(e.get("optimizer", "?")), []
+            ).append(float(e.get("seconds", math.nan)))
+        elif name == "train_fit":
+            opt = e.get("optimizer", "?")
+            for k, v in e.items():
+                if k in ("event", "ts", "optimizer", "kind"):
+                    continue
+                if _is_num(v):
+                    out[f"train.{opt}.{k}"] = float(v)
+        elif name == "micro_batch":
+            role = str(e.get("role", "stream"))
+            if _is_num(e.get("seconds")):
+                batch_secs.setdefault(role, []).append(
+                    float(e["seconds"])
+                )
+            stream_docs += int(e.get("docs", 0) or 0)
+        elif name == "phase":
+            if _is_num(e.get("seconds")):
+                out[f"phase.{e.get('name', '?')}.seconds"] = float(
+                    e["seconds"]
+                )
+        elif name == "probe_attempt":
+            oc = str(e.get("outcome", e.get("error_class", "?")))
+            probe_outcomes[oc] = probe_outcomes.get(oc, 0) + 1
+        elif name == "metric" and _is_num(e.get("value")):
+            out[str(e.get("name", "?"))] = float(e["value"])
+        elif name == "bench_record":
+            _flatten(e.get("record", {}), "bench", out)
+        elif name == "registry":
+            snap = e.get("snapshot", {})
+            for k, v in snap.get("counters", {}).items():
+                if _is_num(v):
+                    out[f"counter.{k}"] = float(v)
+            for k, v in snap.get("gauges", {}).items():
+                if _is_num(v):
+                    out[f"gauge.{k}"] = float(v)
+            for k, h in snap.get("histograms", {}).items():
+                for f in ("count", "mean", "p50", "p95", "max"):
+                    if _is_num(h.get(f)):
+                        out[f"hist.{k}.{f}"] = float(h[f])
+        elif name == "corpus":
+            for k, v in e.items():
+                if k not in ("event", "ts") and _is_num(v):
+                    out[f"corpus.{k}"] = float(v)
+
+    for name, c in counts.items():
+        out[f"events.{name}.count"] = float(c)
+    for opt, secs in iter_secs.items():
+        ss = sorted(s for s in secs if math.isfinite(s))
+        if not ss:
+            continue
+        out[f"train.{opt}.iterations"] = float(len(ss))
+        out[f"train.{opt}.s_per_iter_mean"] = sum(ss) / len(ss)
+        out[f"train.{opt}.s_per_iter_p50"] = _pct(ss, 50)
+        out[f"train.{opt}.s_per_iter_p95"] = _pct(ss, 95)
+        out[f"train.{opt}.seconds_total"] = sum(ss)
+    for role, secs in batch_secs.items():
+        ss = sorted(secs)
+        out[f"stream.{role}.batches"] = float(len(ss))
+        out[f"stream.{role}.batch_p50_ms"] = 1000 * _pct(ss, 50)
+        out[f"stream.{role}.batch_p95_ms"] = 1000 * _pct(ss, 95)
+    if stream_docs:
+        out["stream.docs"] = float(stream_docs)
+    for oc, c in probe_outcomes.items():
+        out[f"probe.{oc}"] = float(c)
+    return out
+
+
+def _print_manifest(manifest: Dict, file=None) -> None:
+    file = file if file is not None else sys.stdout
+    if not manifest:
+        print("  (no manifest record)", file=file)
+        return
+    keys = ("run_id", "schema", "algorithm", "backend", "device_count",
+            "mesh_shape", "vocab_width", "config_hash", "git_rev",
+            "host", "kind", "source_format")
+    for k in keys:
+        if k in manifest:
+            print(f"  {k}: {manifest[k]}", file=file)
+
+
+def cmd_summarize(args) -> int:
+    try:
+        return _cmd_summarize(args)
+    except BrokenPipeError:      # `... | head` closed the pipe
+        return 0
+
+
+def _cmd_summarize(args) -> int:
+    manifest, events = load_run(args.run)
+    metrics = run_metrics(events)
+    if getattr(args, "json", False):
+        print(json.dumps(
+            {"manifest": manifest, "metrics": metrics}, sort_keys=True
+        ))
+        return 0
+    print(f"run: {args.run}")
+    print("manifest:")
+    _print_manifest(manifest)
+    print(f"events: {len(events)}")
+    print("metrics:")
+    for k in sorted(metrics):
+        v = metrics[k]
+        vs = f"{v:.6g}" if abs(v) < 1e6 else f"{v:.4e}"
+        print(f"  {k} = {vs}")
+    return 0
+
+
+def cmd_diff(args) -> int:
+    try:
+        return _cmd_diff(args)
+    except BrokenPipeError:      # `... | head` closed the pipe
+        return 0
+
+
+def _cmd_diff(args) -> int:
+    _, ev_a = load_run(args.a)
+    _, ev_b = load_run(args.b)
+    ma, mb = run_metrics(ev_a), run_metrics(ev_b)
+    keys = sorted(set(ma) | set(mb))
+    rows = []
+    for k in keys:
+        a, b = ma.get(k), mb.get(k)
+        if a is None or b is None:
+            rows.append((k, a, b, None))
+            continue
+        ratio = b / a if abs(a) > _EPS else math.inf if b else 1.0
+        rows.append((k, a, b, ratio))
+    if getattr(args, "json", False):
+        print(json.dumps(
+            {k: {"a": a, "b": b, "ratio": r} for k, a, b, r in rows},
+            sort_keys=True,
+        ))
+        return 0
+    w = max((len(k) for k, *_ in rows), default=10)
+    print(f"{'metric'.ljust(w)}  {'a':>14}  {'b':>14}  {'b/a':>8}")
+    changed = 0
+    for k, a, b, r in rows:
+        fa = "-" if a is None else f"{a:.6g}"
+        fb = "-" if b is None else f"{b:.6g}"
+        fr = "-" if r is None else f"{r:.3f}"
+        mark = ""
+        if r is not None and abs(r - 1.0) > args.highlight:
+            mark = "  <<"
+            changed += 1
+        elif r is None:
+            mark = "  <<only-one-side"
+            changed += 1
+        print(f"{k.ljust(w)}  {fa:>14}  {fb:>14}  {fr:>8}{mark}")
+    print(f"# {len(rows)} metrics, {changed} changed beyond "
+          f"±{args.highlight:.0%} (or one-sided)")
+    return 0
+
+
+def _capture_baseline(
+    run_path: str, metrics: Dict[str, float], default_tol: float,
+    exclude: List[str],
+) -> Dict:
+    entries = {}
+    for k, v in sorted(metrics.items()):
+        if any(s in k for s in exclude):
+            continue
+        tol = default_tol
+        if any(h in k for h in _TIMING_HINTS):
+            tol = max(tol, 0.5)
+        entries[k] = {"value": v, "tolerance": tol}
+    return {
+        "schema": 1,
+        "source": run_path,
+        "default_tolerance": default_tol,
+        "metrics": entries,
+    }
+
+
+def cmd_check(args) -> int:
+    _, events = load_run(args.run)
+    metrics = run_metrics(events)
+    exclude = list(args.exclude or [])
+
+    if args.write_baseline:
+        base = _capture_baseline(
+            args.run, metrics, args.tolerance, exclude
+        )
+        with open(args.baseline, "w", encoding="utf-8") as f:
+            json.dump(base, f, indent=2, sort_keys=True)
+            f.write("\n")
+        print(f"baseline captured: {args.baseline} "
+              f"({len(base['metrics'])} metrics)")
+        return 0
+
+    try:
+        with open(args.baseline, "r", encoding="utf-8") as f:
+            base = json.load(f)
+    except (OSError, json.JSONDecodeError) as exc:
+        print(f"cannot read baseline {args.baseline}: {exc}",
+              file=sys.stderr)
+        return 2
+    failures = []
+    checked = 0
+    for k, spec in sorted(base.get("metrics", {}).items()):
+        if any(s in k for s in exclude):
+            continue
+        want = spec.get("value")
+        tol = spec.get(
+            "tolerance", base.get("default_tolerance", args.tolerance)
+        )
+        got = metrics.get(k)
+        checked += 1
+        if got is None:
+            failures.append((k, want, None, tol, "missing from run"))
+            continue
+        if abs(got - want) > tol * max(abs(want), _EPS):
+            failures.append((k, want, got, tol, "out of tolerance"))
+    for k, want, got, tol, why in failures:
+        gs = "-" if got is None else f"{got:.6g}"
+        print(f"FAIL {k}: baseline {want:.6g}, run {gs} "
+              f"(tolerance ±{tol:.0%}) — {why}")
+    status = "FAIL" if failures else "PASS"
+    print(f"{status}: {checked - len(failures)}/{checked} metrics "
+          f"within tolerance vs {args.baseline}")
+    return 1 if failures else 0
+
+
+def add_metrics_subparser(sub) -> None:
+    """Attach the ``metrics`` subcommand tree to the CLI's subparsers."""
+    mt = sub.add_parser(
+        "metrics",
+        help="summarize / diff / regression-check telemetry runs",
+    )
+    msub = mt.add_subparsers(dest="metrics_cmd", required=True)
+
+    sm = msub.add_parser("summarize", help="manifest + metrics of a run")
+    sm.add_argument("run", help="telemetry .jsonl (or a BENCH_*.json)")
+    sm.add_argument("--json", action="store_true")
+    sm.set_defaults(fn=cmd_summarize)
+
+    df = msub.add_parser("diff", help="align two runs metric-by-metric")
+    df.add_argument("a")
+    df.add_argument("b")
+    df.add_argument("--json", action="store_true")
+    df.add_argument(
+        "--highlight", type=float, default=0.1,
+        help="mark metrics whose ratio moved beyond this fraction",
+    )
+    df.set_defaults(fn=cmd_diff)
+
+    ck = msub.add_parser(
+        "check", help="gate a run against a baseline JSON"
+    )
+    ck.add_argument("run")
+    ck.add_argument("--baseline", required=True)
+    ck.add_argument(
+        "--tolerance", type=float, default=0.25,
+        help="default relative band for metrics without their own",
+    )
+    ck.add_argument(
+        "--write-baseline", action="store_true",
+        help="capture the run's metrics INTO --baseline instead of "
+             "checking (timing-like metrics get a wider default band)",
+    )
+    ck.add_argument(
+        "--exclude", action="append", default=[],
+        help="skip metrics whose name contains this substring "
+             "(repeatable)",
+    )
+    ck.set_defaults(fn=cmd_check)

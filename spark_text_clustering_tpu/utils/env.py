@@ -65,13 +65,20 @@ def probe_accelerator(
 
     Returns ``{"ok", "backend", "version", "devices", "error",
     "history"}`` — ``history`` is one entry per attempt
-    (``{"utc", "elapsed_s", "error_class", "error"}``) so artifacts
-    produced on a fallback path can carry the evidence of what was tried
-    and how it failed (round-3 VERDICT: the bench record itself must
-    document the environment when the chip never appears).  Shared by
-    bench.py's TPU gate and the CLI ``doctor`` subcommand so the two
-    health checks cannot drift apart.
+    (``{"utc", "attempt", "elapsed_s", "outcome", "error_class",
+    "error", "timeout_s"}``) so artifacts produced on a fallback path
+    can carry the evidence of what was tried and how it failed (round-3
+    VERDICT: the bench record itself must document the environment when
+    the chip never appears).  When process telemetry is configured, each
+    attempt is ALSO a span (``probe.accelerator``) plus a structured
+    ``probe_attempt`` event with an explicit ``hang``/``timeout``
+    outcome — the attributable replacement for the formerly opaque
+    ``tpu_probe_history`` blob in BENCH JSON.  Shared by bench.py's TPU
+    gate and the CLI ``doctor`` subcommand so the two health checks
+    cannot drift apart.
     """
+    from .. import telemetry
+
     code = (
         "import jax, json; d = jax.devices(); "
         "print('PROBE', json.dumps({'v': jax.__version__, "
@@ -81,30 +88,42 @@ def probe_accelerator(
     last_err = ""
     history: list = []
 
-    def _note(err_class: str, err: str, t0: float) -> None:
+    def _note(err_class: str, err: str, t0: float, attempt: int) -> None:
+        elapsed = round(time.monotonic() - t0, 1)
         history.append({
             "utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
-            "elapsed_s": round(time.monotonic() - t0, 1),
-            "error_class": err_class,
+            "attempt": attempt,
+            "elapsed_s": elapsed,
+            "outcome": err_class,
+            "error_class": err_class,   # legacy alias (BENCH_r0x tails)
             "error": err,
+            "timeout_s": probe_timeout,
         })
+        telemetry.count(f"probe.accelerator.{err_class}")
+        telemetry.event(
+            "probe_attempt", attempt=attempt, outcome=err_class,
+            elapsed_s=elapsed, timeout_s=probe_timeout, error=err,
+        )
 
     for i in range(attempts):
         delay = backoff[min(i, len(backoff) - 1)]
         if delay:
             time.sleep(delay)
         t0 = time.monotonic()
-        try:
-            r = subprocess.run(
-                [sys.executable, "-c", code],
-                capture_output=True,
-                text=True,
-                timeout=probe_timeout,
-                env=None if env is None else dict(env),
-            )
-        except subprocess.TimeoutExpired:
+        with telemetry.span("probe.accelerator", emit=False):
+            try:
+                r = subprocess.run(
+                    [sys.executable, "-c", code],
+                    capture_output=True,
+                    text=True,
+                    timeout=probe_timeout,
+                    env=None if env is None else dict(env),
+                )
+            except subprocess.TimeoutExpired:
+                r = None
+        if r is None:
             last_err = f"probe hung >{probe_timeout}s"
-            _note("hang", last_err, t0)
+            _note("hang", last_err, t0, i)
         else:
             line = next(
                 (ln for ln in r.stdout.splitlines()
@@ -115,9 +134,9 @@ def probe_accelerator(
                 info = json.loads(line[len("PROBE "):])
                 if require_accelerator and info["b"] == "cpu":
                     last_err = "jax fell back to the cpu platform"
-                    _note("cpu_fallback", last_err, t0)
+                    _note("cpu_fallback", last_err, t0, i)
                 else:
-                    _note("ok", "", t0)
+                    _note("ok", "", t0, i)
                     return {
                         "ok": True,
                         "backend": info["b"],
@@ -132,7 +151,7 @@ def probe_accelerator(
                     if r.stderr.strip() else ""
                 )
                 last_err = f"rc={r.returncode} {tail}".strip()
-                _note("init_error", last_err, t0)
+                _note("init_error", last_err, t0, i)
         if verbose:
             sys.stderr.write(
                 f"# accelerator probe attempt {i + 1}/{attempts}: "

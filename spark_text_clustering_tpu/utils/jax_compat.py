@@ -1,0 +1,40 @@
+"""jax API compatibility shims.
+
+The codebase targets the modern ``jax.shard_map`` entry point (with its
+``check_vma`` kwarg); older jax releases (<= 0.4.x, the pin some sandbox
+images carry) only ship ``jax.experimental.shard_map.shard_map`` whose
+equivalent kwarg is ``check_rep``.  Importing this module installs a
+forwarding ``jax.shard_map`` when the real one is absent, so every call
+site keeps the one modern spelling.  Import-order safe: every importer
+already imports jax itself, so this adds no new jax import to otherwise
+jax-free paths (utils/env.py, the bench parent).
+"""
+
+from __future__ import annotations
+
+import jax
+
+__all__ = ["ensure_shard_map"]
+
+
+def _make_shim():
+    from jax.experimental.shard_map import shard_map as _esm
+
+    def shard_map(f, mesh=None, in_specs=None, out_specs=None,
+                  check_vma=None, **kw):
+        if check_vma is not None:
+            kw.setdefault("check_rep", check_vma)
+        return _esm(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw
+        )
+
+    return shard_map
+
+
+def ensure_shard_map() -> None:
+    """Idempotent: install the forwarding shim once, only when needed."""
+    if not hasattr(jax, "shard_map"):
+        jax.shard_map = _make_shim()
+
+
+ensure_shard_map()

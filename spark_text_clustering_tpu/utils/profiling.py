@@ -1,31 +1,35 @@
-"""Tracing, profiling, and structured metrics.
+"""Tracing, profiling, and structured metrics (compat shims).
 
 The reference's only observability is ``System.nanoTime`` prints and
 MLlib's ``iterationTimes`` metadata (SURVEY.md §5 "Tracing / profiling",
 "Metrics / logging / observability": no structured logging, no metrics
-sink).  This module supplies the layer it lacks, TPU-style:
+sink).  The full replacement now lives in ``spark_text_clustering_tpu.
+telemetry`` (metric registry + spans + manifested JSONL runs + the
+``metrics`` CLI); this module keeps the original thin surface working:
 
   * ``trace(log_dir)``      — ``jax.profiler`` device trace (XLA ops, HBM,
                               fusion view in TensorBoard/xprof) around any
                               region; no-op fallback when the profiler is
-                              unavailable on a backend.
+                              unavailable on a backend.  ``telemetry.span``
+                              annotations nest inside an active trace.
   * ``annotate(name)``      — named sub-spans inside a trace (shows up on
                               the xprof timeline like a Spark stage name).
-  * ``MetricsLogger``       — append-only JSONL metrics sink: phase wall
-                              times, per-iteration times, corpus stats —
-                              the machine-readable twin of the reference's
-                              ~80 println call sites (LDAClustering.scala:
-                              28-34,60-92), persisted alongside the model
-                              like ``iterationTimes``.
+  * ``MetricsLogger``       — append-only JSONL metrics sink, now a shim
+                              over ``telemetry.events.JsonlSink``: same
+                              record schema, but I/O errors SURFACE (one
+                              warning + the ``telemetry_write_errors``
+                              counter) instead of silently dropping
+                              records.
 """
 
 from __future__ import annotations
 
-import json
 import os
 import time
 from contextlib import contextmanager
 from typing import Dict, Optional
+
+from ..telemetry.events import JsonlSink
 
 __all__ = ["trace", "annotate", "MetricsLogger"]
 
@@ -63,32 +67,31 @@ def annotate(name: str):
 
 
 class MetricsLogger:
-    """Append-only JSONL metrics sink.
+    """Append-only JSONL metrics sink (compat shim over
+    ``telemetry.events.JsonlSink``).
 
     Every record carries a wall-clock timestamp and an event name:
 
         {"ts": 1700000000.123, "event": "train_iteration",
          "iteration": 3, "seconds": 0.21}
 
-    ``path=None`` silently drops records, so instrumented code never has to
-    guard on whether metrics were requested.
+    ``path=None`` silently drops records, so instrumented code never has
+    to guard on whether metrics were requested.  A *requested* sink that
+    FAILS is not silent: the first failure warns, every failure counts
+    into the ``telemetry_write_errors`` registry counter.
     """
 
     def __init__(self, path: Optional[str]) -> None:
         self.path = path
-        if path:
-            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-            # truncate: one run, one metrics file
-            with open(path, "w", encoding="utf-8"):
-                pass
+        # truncate: one run, one metrics file
+        self._sink = JsonlSink(path, truncate=True)
 
     def log(self, event: str, **fields) -> None:
         if not self.path:
             return
         rec: Dict = {"ts": time.time(), "event": event}
         rec.update(fields)
-        with open(self.path, "a", encoding="utf-8") as f:
-            f.write(json.dumps(rec) + "\n")
+        self._sink.write(rec)
 
     def log_phases(self, phases: Dict[str, float]) -> None:
         for name, seconds in phases.items():

@@ -1,11 +1,14 @@
-"""Phase timing / iteration times.
+"""Phase timing / iteration times (now thin shims over ``telemetry``).
 
 The reference's only observability is ``System.nanoTime`` around
 preprocessing and training (LDAClustering.scala:22-34,58-64) plus MLlib's
 per-iteration wall times persisted into model metadata (``iterationTimes``).
 We keep both: a ``PhaseTimer`` for coarse phases and per-iteration times
 recorded by the optimizers and persisted in checkpoints (SURVEY.md §5
-"Tracing / profiling").
+"Tracing / profiling").  Both timers double-report into the process
+telemetry registry when it is enabled (``phase.<name>.seconds`` /
+``train_iteration_seconds`` histograms) so a configured run captures
+them without any call-site change; disabled mode is one bool check.
 """
 
 from __future__ import annotations
@@ -13,6 +16,8 @@ from __future__ import annotations
 import time
 from contextlib import contextmanager
 from typing import Dict, List
+
+from .. import telemetry
 
 
 class PhaseTimer:
@@ -23,7 +28,8 @@ class PhaseTimer:
     def phase(self, name: str):
         t0 = time.perf_counter()
         try:
-            yield
+            with telemetry.span(f"phase.{name}"):
+                yield
         finally:
             self.phases[name] = self.phases.get(name, 0.0) + (
                 time.perf_counter() - t0
@@ -47,7 +53,9 @@ class IterationTimer:
 
     def stop(self) -> None:
         if self._t0 is not None:
-            self.times.append(time.perf_counter() - self._t0)
+            dt = time.perf_counter() - self._t0
+            self.times.append(dt)
+            telemetry.observe("train_iteration_seconds", dt)
             self._t0 = None
 
     @property

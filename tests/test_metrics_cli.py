@@ -1,0 +1,228 @@
+"""``metrics`` CLI (summarize / diff / check) + the end-to-end
+acceptance flow: train via the CLI with telemetry on, summarize the
+emitted JSONL, capture a baseline, check passes, perturbed check fails."""
+
+import json
+
+import pytest
+
+from spark_text_clustering_tpu import telemetry
+from spark_text_clustering_tpu.cli import main
+from spark_text_clustering_tpu.telemetry.metrics_cli import (
+    flatten_numeric,
+    load_run,
+    run_metrics,
+)
+
+
+@pytest.fixture(autouse=True)
+def _telemetry_reset():
+    telemetry.shutdown()
+    telemetry.get_registry().reset()
+    yield
+    telemetry.shutdown()
+    telemetry.get_registry().reset()
+
+
+def _make_run(tmp_path, name="run.jsonl", s_per_iter=0.1, loglik=-500.0):
+    """A synthetic telemetry run file."""
+    p = str(tmp_path / name)
+    w = telemetry.TelemetryWriter(p, run_id="synth")
+    w.write_manifest(kind="synth", algorithm="em", vocab_width=10)
+    for i in range(4):
+        w.emit("train_iteration", optimizer="em", iteration=i,
+               seconds=s_per_iter, kind="per_iteration")
+    w.emit("train_fit", optimizer="em", iterations=4,
+           log_likelihood=loglik, layout="padded")
+    w.emit("micro_batch", role="train", batch_id=0, docs=8, seconds=0.05)
+    w.emit("probe_attempt", attempt=0, outcome="hang", elapsed_s=90.0,
+           timeout_s=90)
+    w.close()
+    return p
+
+
+class TestRunMetrics:
+    def test_extraction(self, tmp_path):
+        p = _make_run(tmp_path)
+        manifest, events = load_run(p)
+        assert manifest["run_id"] == "synth"
+        m = run_metrics(events)
+        assert m["train.em.iterations"] == 4
+        assert abs(m["train.em.s_per_iter_mean"] - 0.1) < 1e-12
+        assert m["train.em.log_likelihood"] == -500.0
+        assert m["stream.train.batches"] == 1
+        assert m["stream.docs"] == 8
+        assert m["probe.hang"] == 1
+        assert m["events.train_iteration.count"] == 4
+
+    def test_plain_json_record_flattens(self, tmp_path):
+        p = str(tmp_path / "bench.json")
+        with open(p, "w") as f:
+            json.dump(
+                {"metric": "em", "value": 0.5,
+                 "online": {"docs_per_sec": 100.0}},
+                f, indent=2,
+            )
+        manifest, events = load_run(p)
+        assert manifest["source_format"] == "plain_json"
+        m = run_metrics(events)
+        assert m["bench.value"] == 0.5
+        assert m["bench.online.docs_per_sec"] == 100.0
+
+    def test_flatten_numeric_skips_non_finite_and_bools(self):
+        m = flatten_numeric(
+            {"a": 1, "b": True, "c": float("nan"), "d": [2.0, "x"]}
+        )
+        assert m == {"a": 1.0, "d.0": 2.0}
+
+
+class TestMetricsCommands:
+    def test_summarize_smoke(self, tmp_path, capsys):
+        p = _make_run(tmp_path)
+        assert main(["metrics", "summarize", p]) == 0
+        out = capsys.readouterr().out
+        assert "run_id: synth" in out
+        assert "train.em.s_per_iter_mean" in out
+
+    def test_summarize_json_mode(self, tmp_path, capsys):
+        p = _make_run(tmp_path)
+        assert main(["metrics", "summarize", p, "--json"]) == 0
+        rec = json.loads(capsys.readouterr().out)
+        assert rec["manifest"]["run_id"] == "synth"
+        assert rec["metrics"]["train.em.iterations"] == 4
+
+    def test_diff_highlights_changes(self, tmp_path, capsys):
+        a = _make_run(tmp_path, "a.jsonl", s_per_iter=0.1)
+        b = _make_run(tmp_path, "b.jsonl", s_per_iter=0.3, loglik=-800.0)
+        assert main(["metrics", "diff", a, b]) == 0
+        out = capsys.readouterr().out
+        assert "train.em.s_per_iter_mean" in out
+        # 3x slower must be flagged beyond the default ±10% highlight
+        line = next(
+            ln for ln in out.splitlines()
+            if ln.startswith("train.em.s_per_iter_mean")
+        )
+        assert "<<" in line
+
+    def test_check_pass_and_perturbed_fail(self, tmp_path, capsys):
+        run = _make_run(tmp_path)
+        base = str(tmp_path / "base.json")
+        assert main([
+            "metrics", "check", run, "--baseline", base,
+            "--write-baseline",
+        ]) == 0
+        # fresh baseline vs the same run: must pass
+        assert main(["metrics", "check", run, "--baseline", base]) == 0
+        assert "PASS" in capsys.readouterr().out
+        # perturb one metric beyond its tolerance: must fail
+        with open(base) as f:
+            b = json.load(f)
+        b["metrics"]["train.em.log_likelihood"]["value"] *= 10
+        with open(base, "w") as f:
+            json.dump(b, f)
+        assert main(["metrics", "check", run, "--baseline", base]) == 1
+        out = capsys.readouterr().out
+        assert "FAIL train.em.log_likelihood" in out
+
+    def test_check_missing_metric_fails(self, tmp_path):
+        run = _make_run(tmp_path)
+        base = str(tmp_path / "base.json")
+        with open(base, "w") as f:
+            json.dump({
+                "schema": 1,
+                "metrics": {"no.such.metric": {"value": 1.0}},
+            }, f)
+        assert main(["metrics", "check", run, "--baseline", base]) == 1
+
+    def test_check_exclude(self, tmp_path):
+        run = _make_run(tmp_path)
+        base = str(tmp_path / "base.json")
+        with open(base, "w") as f:
+            json.dump({
+                "schema": 1,
+                "metrics": {"no.such.metric": {"value": 1.0}},
+            }, f)
+        assert main([
+            "metrics", "check", run, "--baseline", base,
+            "--exclude", "no.such",
+        ]) == 0
+
+    def test_timing_metrics_capture_wider_band(self, tmp_path):
+        run = _make_run(tmp_path)
+        base = str(tmp_path / "base.json")
+        main(["metrics", "check", run, "--baseline", base,
+              "--write-baseline"])
+        with open(base) as f:
+            b = json.load(f)
+        assert (
+            b["metrics"]["train.em.s_per_iter_mean"]["tolerance"] >= 0.5
+        )
+        assert (
+            b["metrics"]["train.em.iterations"]["tolerance"] == 0.25
+        )
+
+
+class TestEndToEnd:
+    """Acceptance: CLI train with telemetry on -> `metrics summarize`
+    reports manifest + per-iteration events -> `metrics check` passes
+    against a fresh baseline and fails when perturbed."""
+
+    @pytest.fixture()
+    def books(self, tmp_path):
+        d = tmp_path / "books"
+        d.mkdir()
+        texts = [
+            "piano violin orchestra symphony melody harmony rhythm",
+            "electron proton quantum particle physics energy atom",
+            "violin cello symphony opera melody chord orchestra",
+            "neutron fission atom reactor physics energy proton",
+        ]
+        for i, t in enumerate(texts):
+            (d / f"b{i}.txt").write_text(t * 5)
+        return d
+
+    @pytest.mark.parametrize("algorithm", ["em", "online"])
+    def test_train_summarize_check(
+        self, algorithm, books, tmp_path, capsys
+    ):
+        run = str(tmp_path / "run.jsonl")
+        rc = main([
+            "train", "--books", str(books), "--k", "2",
+            "--max-iterations", "3", "--algorithm", algorithm,
+            "--no-lemmatize",
+            "--models-dir", str(tmp_path / "models"),
+            "--telemetry-file", run,
+        ])
+        assert rc == 0
+        capsys.readouterr()
+
+        evs = telemetry.read_events(run)
+        assert evs[0]["event"] == "manifest"
+        assert evs[0]["config_hash"]
+        assert evs[0]["vocab_width"] > 0
+        assert evs[0]["mesh_shape"]["data"] >= 1
+        iters = [e for e in evs if e["event"] == "train_iteration"]
+        assert len(iters) == 3
+        assert all(e["optimizer"] == algorithm for e in iters)
+
+        assert main(["metrics", "summarize", run]) == 0
+        out = capsys.readouterr().out
+        assert "config_hash" in out
+        assert f"train.{algorithm}.iterations = 3" in out
+        assert "phase.train.seconds" in out
+
+        base = str(tmp_path / "base.json")
+        assert main([
+            "metrics", "check", run, "--baseline", base,
+            "--write-baseline",
+        ]) == 0
+        capsys.readouterr()
+        assert main(["metrics", "check", run, "--baseline", base]) == 0
+        capsys.readouterr()
+        with open(base) as f:
+            b = json.load(f)
+        key = f"train.{algorithm}.iterations"
+        b["metrics"][key]["value"] = 99
+        with open(base, "w") as f:
+            json.dump(b, f)
+        assert main(["metrics", "check", run, "--baseline", base]) == 1

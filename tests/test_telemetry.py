@@ -1,0 +1,330 @@
+"""Telemetry subsystem: registry math, span nesting, manifest/JSONL
+schema, disabled-mode cost model, sink error surfacing, and the
+EM/Online/NMF per-iteration emission contract."""
+
+import json
+import math
+import warnings
+
+import numpy as np
+import pytest
+
+from spark_text_clustering_tpu import telemetry
+from spark_text_clustering_tpu.config import Params
+from spark_text_clustering_tpu.telemetry.registry import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricRegistry,
+)
+
+
+@pytest.fixture(autouse=True)
+def _telemetry_reset():
+    """Telemetry state is process-global: every test starts and ends
+    disabled so no state leaks into unrelated tests."""
+    telemetry.shutdown()
+    telemetry.get_registry().reset()
+    yield
+    telemetry.shutdown()
+    telemetry.get_registry().reset()
+
+
+class TestRegistry:
+    def test_counter_and_gauge(self):
+        r = MetricRegistry()
+        c = r.counter("c")
+        c.inc()
+        c.inc(4)
+        assert r.counter("c").value == 5  # same object on re-get
+        r.gauge("g").set(2.5)
+        r.gauge("g").set(1.5)
+        assert r.gauge("g").value == 1.5
+
+    def test_kind_collision_raises(self):
+        r = MetricRegistry()
+        r.counter("x")
+        with pytest.raises(TypeError):
+            r.gauge("x")
+
+    def test_histogram_percentiles_fixed_buckets(self):
+        h = Histogram("h", buckets=[1.0, 2.0, 4.0, 8.0])
+        for v in (0.5, 1.5, 3.0, 7.0, 7.0, 7.0):
+            h.observe(v)
+        assert h.count == 6
+        assert h.min == 0.5 and h.max == 7.0
+        # rank-3 of 6 lands in the (2, 4] bucket -> upper bound 4
+        assert h.percentile(50) == 4.0
+        # percentiles clamp to the exact observed max, never the bucket
+        # upper bound above it
+        assert h.percentile(95) == 7.0
+        assert h.percentile(100) == 7.0
+        assert math.isclose(h.mean, 26.0 / 6)
+
+    def test_histogram_bounded_memory(self):
+        h = Histogram("h")
+        n_cells = len(h.counts)
+        for i in range(10_000):
+            h.observe(i * 0.01)
+        assert len(h.counts) == n_cells  # fixed buckets never grow
+        assert h.count == 10_000
+
+    def test_empty_histogram(self):
+        h = Histogram("h")
+        assert math.isnan(h.percentile(50))
+        snap = h.snapshot()
+        assert snap["count"] == 0 and snap["p50"] is None
+
+    def test_snapshot_groups_by_kind(self):
+        r = MetricRegistry()
+        r.counter("a").inc()
+        r.gauge("b").set(1)
+        r.histogram("c").observe(0.1)
+        s = r.snapshot()
+        assert set(s) == {"counters", "gauges", "histograms"}
+        assert s["counters"]["a"] == 1
+        assert s["histograms"]["c"]["count"] == 1
+
+
+class TestDisabledMode:
+    def test_disabled_by_default(self):
+        assert not telemetry.enabled()
+
+    def test_noop_span_is_shared_singleton(self):
+        # zero-allocation contract: disabled span() returns one object
+        s1 = telemetry.span("a")
+        s2 = telemetry.span("b", emit=False, extra=1)
+        assert s1 is s2
+        with s1:
+            with s2:
+                pass  # reentrant
+
+    def test_disabled_helpers_do_not_register(self):
+        telemetry.count("never")
+        telemetry.gauge("never", 1)
+        telemetry.observe("never", 1.0)
+        snap = telemetry.get_registry().snapshot()
+        assert not snap["counters"] and not snap["histograms"]
+
+    def test_device_sync_disabled_still_blocks(self):
+        import jax.numpy as jnp
+
+        x = jnp.ones((4,))
+        assert telemetry.device_sync(x, "t") is x
+        assert not telemetry.get_registry().snapshot()["counters"]
+
+
+class TestSpans:
+    def test_nesting_records_hierarchical_paths(self, tmp_path):
+        p = str(tmp_path / "r.jsonl")
+        telemetry.configure(p)
+        with telemetry.span("outer"):
+            with telemetry.span("inner"):
+                pass
+        with telemetry.span("outer"):
+            pass
+        telemetry.manifest(kind="test")
+        snap = telemetry.get_registry().snapshot()
+        assert "span.outer.seconds" in snap["histograms"]
+        assert "span.outer/inner.seconds" in snap["histograms"]
+        assert snap["histograms"]["span.outer.seconds"]["count"] == 2
+        telemetry.shutdown()
+        evs = telemetry.read_events(p)
+        names = [e.get("name") for e in evs if e["event"] == "span"]
+        # inner closes first, so it is emitted first
+        assert names == ["outer/inner", "outer", "outer"]
+
+    def test_span_exception_counted_and_stack_unwound(self):
+        telemetry.configure(None)
+        with pytest.raises(RuntimeError):
+            with telemetry.span("boom"):
+                raise RuntimeError("x")
+        from spark_text_clustering_tpu.telemetry.spans import current_path
+
+        assert current_path() == ""
+        snap = telemetry.get_registry().snapshot()
+        assert snap["counters"]["span.boom.errors"] == 1
+
+
+class TestManifestAndSchema:
+    def test_manifest_is_first_record_even_when_late(self, tmp_path):
+        p = str(tmp_path / "r.jsonl")
+        telemetry.configure(p, run_id="rid-1")
+        telemetry.event("early", x=1)  # buffered
+        telemetry.manifest(
+            params=Params(k=3, algorithm="online"), vocab_width=77,
+            kind="test",
+        )
+        telemetry.event("late", y=2)
+        telemetry.shutdown()
+        evs = telemetry.read_events(p)
+        assert [e["event"] for e in evs] == [
+            "manifest", "early", "late", "registry",
+        ]
+        man = evs[0]
+        assert man["schema"] == telemetry.SCHEMA_VERSION
+        assert man["run_id"] == "rid-1"
+        assert man["vocab_width"] == 77
+        assert man["algorithm"] == "online"
+        assert len(man["config_hash"]) == 12
+        assert man["config"]["k"] == 3
+        # backend present iff jax already imported (conftest imports it)
+        assert man["backend"] == "cpu"
+
+    def test_close_without_manifest_autowrites_one(self, tmp_path):
+        p = str(tmp_path / "r.jsonl")
+        telemetry.configure(p)
+        telemetry.event("only", a=1)
+        telemetry.shutdown()
+        evs = telemetry.read_events(p)
+        assert evs[0]["event"] == "manifest" and evs[0].get("auto")
+        assert evs[1]["event"] == "only"
+
+    def test_jsonl_round_trip(self, tmp_path):
+        p = str(tmp_path / "r.jsonl")
+        telemetry.configure(p)
+        telemetry.manifest(kind="rt")
+        telemetry.event("e1", i=3, f=0.5, s="txt", b=True, n=None)
+        telemetry.shutdown()
+        evs = telemetry.read_events(p)
+        e = next(x for x in evs if x["event"] == "e1")
+        assert e["i"] == 3 and e["f"] == 0.5 and e["s"] == "txt"
+        assert e["b"] is True and e["n"] is None and "ts" in e
+
+    def test_registry_snapshot_is_final_record(self, tmp_path):
+        p = str(tmp_path / "r.jsonl")
+        telemetry.configure(p)
+        telemetry.manifest(kind="t")
+        telemetry.count("my.counter", 7)
+        telemetry.shutdown()
+        evs = telemetry.read_events(p)
+        assert evs[-1]["event"] == "registry"
+        assert evs[-1]["snapshot"]["counters"]["my.counter"] == 7
+
+
+class TestSinkErrorSurfacing:
+    def test_write_errors_warn_once_and_count(self, tmp_path):
+        from spark_text_clustering_tpu.utils.profiling import MetricsLogger
+
+        target = tmp_path / "adir"
+        target.mkdir()  # opening a directory for write raises OSError
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            m = MetricsLogger(str(target))  # ctor truncate fails -> warns
+            m.log("a", x=1)
+            m.log("b", y=2)
+        runtime = [x for x in w if issubclass(x.category, RuntimeWarning)]
+        assert len(runtime) == 1, "exactly one warning for N failures"
+        assert "telemetry_write_errors" in str(runtime[0].message)
+        c = telemetry.get_registry().counter("telemetry_write_errors")
+        assert c.value == 3  # truncate + 2 failed appends
+
+    def test_none_path_stays_silent_noop(self):
+        from spark_text_clustering_tpu.utils.profiling import MetricsLogger
+
+        m = MetricsLogger(None)
+        m.log("anything", x=1)
+        assert (
+            telemetry.get_registry()
+            .counter("telemetry_write_errors").value == 0
+        )
+
+
+class TestTrainingEmission:
+    """EM, Online VB, and NMF training each emit per-iteration events."""
+
+    def _fit(self, algorithm, rows, vocab, tmp_path, **params_kw):
+        from spark_text_clustering_tpu.models.em_lda import EMLDA
+        from spark_text_clustering_tpu.models.nmf import NMF
+        from spark_text_clustering_tpu.models.online_lda import OnlineLDA
+        from spark_text_clustering_tpu.parallel.mesh import make_mesh
+
+        p = str(tmp_path / f"{algorithm}.jsonl")
+        telemetry.configure(p)
+        telemetry.manifest(kind="test", algorithm=algorithm)
+        cls = {"em": EMLDA, "online": OnlineLDA, "nmf": NMF}[algorithm]
+        params = Params(
+            k=2, algorithm=algorithm, max_iterations=3, seed=0,
+            **params_kw,
+        )
+        mesh = make_mesh(data_shards=4, model_shards=2)
+        cls(params, mesh=mesh).fit(rows, vocab)
+        telemetry.shutdown()
+        return telemetry.read_events(p)
+
+    @pytest.mark.parametrize("algorithm", ["em", "online", "nmf"])
+    def test_fit_emits_per_iteration_events(
+        self, algorithm, tiny_corpus_rows, tmp_path
+    ):
+        rows, vocab = tiny_corpus_rows
+        evs = self._fit(algorithm, rows, vocab, tmp_path)
+        iters = [e for e in evs if e["event"] == "train_iteration"]
+        assert len(iters) == 3
+        assert [e["iteration"] for e in iters] == [0, 1, 2]
+        assert all(e["optimizer"] == algorithm for e in iters)
+        assert all(
+            np.isfinite(e["seconds"]) and e["seconds"] >= 0
+            for e in iters
+        )
+        fits = [e for e in evs if e["event"] == "train_fit"]
+        assert len(fits) == 1
+        f = fits[0]
+        assert f["optimizer"] == algorithm and f["iterations"] == 3
+        assert f["k"] == 2 and f["vocab_width"] == len(vocab)
+        if algorithm == "em":
+            assert np.isfinite(f["log_likelihood"])
+            assert f["layout"] in ("padded", "packed")
+        if algorithm == "online":
+            assert f["layout"] in (
+                "padded", "packed", "tiles_resident"
+            )
+        if algorithm == "nmf":
+            assert np.isfinite(f["loss"])
+        # the final registry snapshot carries the collective accounting
+        snap = evs[-1]["snapshot"]
+        assert any(
+            k.startswith("collective.") for k in snap["counters"]
+        ), "collectives must be accounted during training"
+
+    def test_streaming_trainer_emits_micro_batch_events(self, tmp_path):
+        from spark_text_clustering_tpu.parallel.mesh import make_mesh
+        from spark_text_clustering_tpu.streaming import (
+            MemoryStreamSource,
+            StreamingOnlineLDA,
+        )
+
+        p = str(tmp_path / "stream.jsonl")
+        telemetry.configure(p)
+        telemetry.manifest(kind="stream-test")
+        trainer = StreamingOnlineLDA(
+            Params(k=2, algorithm="online", seed=0),
+            num_features=64,
+            mesh=make_mesh(data_shards=4, model_shards=2),
+            batch_capacity=4,
+            lemmatize=False,
+        )
+        src = MemoryStreamSource(max_docs_per_trigger=3)
+        words = ("piano violin cello opera tempo forte aria".split())
+        src.add([
+            " ".join(
+                (words[i % 7], words[(i + 1) % 7], words[(i + 2) % 7])
+            )
+            for i in range(6)
+        ])
+        while True:
+            mb = src.poll()
+            if mb is None:
+                break
+            trainer.process(mb)
+        telemetry.shutdown()
+        evs = telemetry.read_events(p)
+        mbs = [e for e in evs if e["event"] == "micro_batch"]
+        assert len(mbs) == 2
+        assert all(e["role"] == "train" and e["docs"] == 3 for e in mbs)
+        assert mbs[-1]["docs_seen"] == 6
+        snap = evs[-1]["snapshot"]
+        assert (
+            snap["histograms"]["stream.train.micro_batch_seconds"]["count"]
+            == 2
+        )
+        assert "stream.queue_depth" in snap["gauges"]
