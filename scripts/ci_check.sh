@@ -1,0 +1,94 @@
+#!/usr/bin/env bash
+# CI gate (ROADMAP "CI wiring"): every check here FAILS the build via
+# exit code instead of merely being recorded.
+#
+#   1. tier-1 test suite (CPU, 8 virtual devices)
+#   2. disabled-mode telemetry overhead budget (<2%)
+#   3. metrics regression gate: a tiny deterministic training run's
+#      telemetry checked against the committed tolerance baseline
+#      (scripts/records/ci_metrics_baseline.json) — counter drift
+#      (iterations, events, retries, quarantines) gates; wall-time
+#      metrics are excluded (machine-dependent)
+#
+# Usage:
+#   scripts/ci_check.sh                 # run all three gates
+#   scripts/ci_check.sh --rebaseline    # recapture the metrics baseline
+#                                       # (commit the result deliberately)
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS=cpu
+# pin the virtual device count: collective byte/call counters in the
+# metrics gate depend on mesh width, so the baseline is only comparable
+# at the same topology (the tier-1 8-device harness)
+export XLA_FLAGS="--xla_force_host_platform_device_count=8"
+BASELINE=scripts/records/ci_metrics_baseline.json
+# exclude machine-dependent wall-time metrics from the gate; counters and
+# event counts must stay exact across machines
+EXCLUDES=(--exclude seconds --exclude _ms --exclude _s_ --exclude
+          s_per_iter --exclude duration_s --exclude docs_per_s)
+
+run_ci_train() {
+    # tiny deterministic corpus + train: same flags as the baseline was
+    # captured with, so the emitted counters are machine-independent
+    local workdir="$1"
+    python - "$workdir" <<'EOF'
+import os, sys
+import numpy as np
+
+workdir = sys.argv[1]
+books = os.path.join(workdir, "books")
+os.makedirs(books, exist_ok=True)
+rng = np.random.default_rng(0)
+pools = [[f"apple{i}" for i in range(12)], [f"stone{i}" for i in range(12)]]
+for d in range(10):
+    text = " ".join(rng.choice(pools[d % 2], size=40))
+    with open(os.path.join(books, f"doc{d}.txt"), "w") as f:
+        f.write(text)
+EOF
+    python -m spark_text_clustering_tpu.cli train \
+        --books "$workdir/books" --models-dir "$workdir/models" \
+        --algorithm online --k 2 --max-iterations 6 \
+        --vocab-size 64 --seed 3 --no-lemmatize \
+        --telemetry-file "$workdir/run.jsonl" >/dev/null
+}
+
+if [[ "${1:-}" == "--rebaseline" ]]; then
+    work=$(mktemp -d)
+    trap 'rm -rf "$work"' EXIT
+    run_ci_train "$work" || exit 1
+    python -m spark_text_clustering_tpu.cli metrics check "$work/run.jsonl" \
+        --baseline "$BASELINE" --write-baseline --tolerance 0.0 \
+        "${EXCLUDES[@]}"
+    exit $?
+fi
+
+fail=0
+
+echo "== [1/3] tier-1 tests =="
+timeout -k 10 870 python -m pytest tests/ -q -m 'not slow' \
+    --continue-on-collection-errors -p no:cacheprovider \
+    -p no:xdist -p no:randomly
+if [[ $? -ne 0 ]]; then echo "FAIL: tier-1"; fail=1; fi
+
+echo "== [2/3] telemetry overhead budget =="
+python scripts/check_telemetry_overhead.py
+if [[ $? -ne 0 ]]; then echo "FAIL: telemetry overhead"; fail=1; fi
+
+echo "== [3/3] metrics regression gate =="
+work=$(mktemp -d)
+trap 'rm -rf "$work"' EXIT
+if run_ci_train "$work"; then
+    python -m spark_text_clustering_tpu.cli metrics check "$work/run.jsonl" \
+        --baseline "$BASELINE" "${EXCLUDES[@]}"
+    if [[ $? -ne 0 ]]; then echo "FAIL: metrics check"; fail=1; fi
+else
+    echo "FAIL: CI training run"
+    fail=1
+fi
+
+if [[ $fail -ne 0 ]]; then
+    echo "ci_check: FAILED"
+    exit 1
+fi
+echo "ci_check: OK"

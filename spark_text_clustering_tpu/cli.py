@@ -33,7 +33,19 @@ from .pipeline import (
     TextPreprocessor,
 )
 from .models.base import LDAModel
-from .models.persistence import latest_model_dir, load_model, model_dir_name
+from .models.persistence import (
+    latest_model_dir,
+    load_model,
+    model_dir_name,
+    train_state_valid,
+)
+from .resilience import (
+    CorruptArtifactError,
+    ResumeMismatchError,
+    validate_resume_meta,
+    vocab_fingerprint,
+    write_resume_meta,
+)
 from .utils.readers import read_stop_word_file, read_text_dir
 from .utils.report import format_scoring_report, write_scoring_report
 from .utils.textproc import parse_stop_words
@@ -56,6 +68,54 @@ def _load_stop_words(path: Optional[str]) -> frozenset:
     if not path:
         return frozenset()
     return parse_stop_words(read_stop_word_file(path))
+
+
+def _resume_gate(
+    params: Params,
+    vocab,
+    coordinator: bool,
+    resume_requested: bool,
+    state_name: Optional[str] = None,
+) -> Optional[int]:
+    """Checkpoint-dir compatibility gate (resilience.resume).
+
+    Validates any recorded ``resume_meta.json`` against this run's config
+    hash + vocab fingerprint (a mismatch is fatal WHETHER OR NOT --resume
+    was passed — silently continuing from misaligned state trains a
+    different model), announces the resume point when --resume asked for
+    one, and records this run's envelope for the next resume.  Returns an
+    exit code to abort with, or None to proceed.
+    """
+    if not params.checkpoint_dir:
+        if resume_requested:
+            print("--resume requires --checkpoint-dir", file=sys.stderr)
+            return 2
+        return None
+    vocab_fp = vocab_fingerprint(vocab) if vocab is not None else None
+    try:
+        validate_resume_meta(params.checkpoint_dir, params, vocab_fp)
+    except ResumeMismatchError as exc:
+        print(f"error: {exc}", file=sys.stderr)
+        return 2
+    if resume_requested:
+        if state_name is None:
+            state_name = {
+                "em": "em_state.npz", "online": "train_state.npz"
+            }.get(params.algorithm)
+        state = (
+            os.path.join(params.checkpoint_dir, state_name)
+            if state_name else None
+        )
+        if state and train_state_valid(state):
+            print(f"resuming from checkpoint {state}")
+        else:
+            print(
+                f"--resume: no valid checkpoint under "
+                f"{params.checkpoint_dir}; starting fresh"
+            )
+    if coordinator:
+        write_resume_meta(params.checkpoint_dir, params, vocab_fp)
+    return None
 
 
 def _init_distributed(args: argparse.Namespace) -> bool:
@@ -157,6 +217,12 @@ def cmd_train(args: argparse.Namespace) -> int:
         len(ds["vocab"]) if ds.get("vocab") is not None
         else ds["num_features"]
     )
+    rc = _resume_gate(
+        params, ds.get("vocab"), coordinator,
+        bool(getattr(args, "resume", False)),
+    )
+    if rc is not None:
+        return rc
     if own_telemetry:
         # manifest (the stream's FIRST record — earlier spans were
         # buffered): config hash, backend, mesh shape, vocab width,
@@ -266,7 +332,13 @@ def cmd_score(args: argparse.Namespace) -> int:
         return 2
     # Generic loader: scoring works with whichever estimator trained the
     # artifact (LDA or NMF) — both expose topic_distribution/describe_topics.
-    model = load_model(model_path)
+    # A truncated/uncommitted artifact fails HERE with a typed error and a
+    # non-zero exit — never a partial/garbage report.
+    try:
+        model = load_model(model_path)
+    except CorruptArtifactError as exc:
+        print(f"error: {exc}", file=sys.stderr)
+        return 2
     print(f"loaded model {model_path}: k={model.k}, V={model.vocab_size}")
 
     books_dir = args.books
@@ -322,7 +394,11 @@ def cmd_stream_score(args: argparse.Namespace) -> int:
         print(f"no model for lang {args.lang} under {args.models_dir}",
               file=sys.stderr)
         return 2
-    model = load_model(model_path)
+    try:
+        model = load_model(model_path)
+    except CorruptArtifactError as exc:
+        print(f"error: {exc}", file=sys.stderr)
+        return 2
     print(f"loaded model {model_path}: k={model.k}, V={model.vocab_size}")
     own_telemetry = bool(getattr(args, "telemetry_file", None))
     if own_telemetry:
@@ -345,6 +421,7 @@ def cmd_stream_score(args: argparse.Namespace) -> int:
         batch_capacity=args.batch_capacity,
         # endless streams must not retain every doc's result in memory
         keep_results=not args.no_report,
+        quarantine_dir=args.quarantine_dir,
     )
     for mb in src.stream(
         poll_interval=args.poll_interval, idle_timeout=args.idle_timeout
@@ -382,8 +459,23 @@ def cmd_stream_train(args: argparse.Namespace) -> int:
     vocab = None
     num_features = args.hash_features
     if args.vocab_from_model:
-        vocab = load_model(args.vocab_from_model).vocab
+        try:
+            vocab = load_model(args.vocab_from_model).vocab
+        except CorruptArtifactError as exc:
+            print(f"error: {exc}", file=sys.stderr)
+            return 2
         num_features = None
+    # the gate must run BEFORE the trainer constructor auto-restores
+    # from any existing stream_state.npz
+    rc = _resume_gate(
+        params,
+        vocab if vocab is not None else [f"h{i}" for i in range(num_features)],
+        True,
+        bool(getattr(args, "resume", False)),
+        state_name="stream_state.npz",
+    )
+    if rc is not None:
+        return rc
     own_telemetry = bool(getattr(args, "telemetry_file", None))
     if own_telemetry:
         telemetry.configure(args.telemetry_file)
@@ -404,6 +496,7 @@ def cmd_stream_train(args: argparse.Namespace) -> int:
         batch_capacity=args.batch_capacity,
         corpus_size_hint=args.corpus_size_hint,
         checkpoint_every=args.checkpoint_interval,
+        quarantine_dir=args.quarantine_dir,
     )
     src = FileStreamSource(
         args.watch_dir,
@@ -509,6 +602,10 @@ def _add_stream_args(p: argparse.ArgumentParser) -> None:
     p.add_argument("--telemetry-file", default=None,
                    help="telemetry run stream (manifest + per-micro-batch "
                         "events) as JSONL — consumed by `metrics`")
+    p.add_argument("--quarantine-dir", default=None,
+                   help="dead-letter dir for per-document failures: the "
+                        "offending doc + a structured .error.json sidecar "
+                        "land here instead of killing the stream")
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -551,6 +648,10 @@ def build_parser() -> argparse.ArgumentParser:
     )
     tr.add_argument("--checkpoint-dir", default=None)
     tr.add_argument("--checkpoint-interval", type=int, default=10)
+    tr.add_argument("--resume", action="store_true",
+                    help="continue from the newest VALID checkpoint in "
+                         "--checkpoint-dir (config-hash + vocab-fingerprint "
+                         "validated; starts fresh when none is found)")
     tr.add_argument("--seed", type=int, default=0)
     tr.add_argument("--data-shards", type=int, default=None)
     tr.add_argument("--model-shards", type=int, default=1)
@@ -623,6 +724,10 @@ def build_parser() -> argparse.ArgumentParser:
     st.add_argument("--corpus-size-hint", type=int, default=None)
     st.add_argument("--checkpoint-dir", default=None)
     st.add_argument("--checkpoint-interval", type=int, default=10)
+    st.add_argument("--resume", action="store_true",
+                    help="continue from the newest VALID stream checkpoint "
+                         "in --checkpoint-dir (config-hash + "
+                         "vocab-fingerprint validated)")
     st.add_argument("--seed", type=int, default=0)
     st.add_argument("--data-shards", type=int, default=None)
     st.add_argument("--model-shards", type=int, default=1)

@@ -875,7 +875,7 @@ class EMLDA:
         start_it = 0
         ckpt_n_dk_host = None
         if resuming:
-            st = load_train_state(ckpt_path)
+            st = load_train_state(ckpt_path, require=("n_wk", "n_dk"))
             start_it = st["step"]
             if st["n_wk"].shape != (k, v_pad) or st["n_dk"].shape != (n, k):
                 raise ValueError(

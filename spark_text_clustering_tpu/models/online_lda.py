@@ -1530,7 +1530,7 @@ class OnlineLDA:
         start_it = 0
         base_key = jax.random.PRNGKey(p.seed)
         if agree_checkpoint_exists(ckpt_path):
-            st = load_train_state(ckpt_path)
+            st = load_train_state(ckpt_path, require=("lam",))
             lam_np, start_it = st["lam"], st["step"]
             if lam_np.shape != (k, v_pad):
                 raise ValueError(

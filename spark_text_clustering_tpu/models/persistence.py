@@ -1,4 +1,4 @@
-"""Model persistence: ONE self-contained artifact directory.
+"""Model persistence: ONE self-contained, integrity-checked artifact dir.
 
 The reference splits a model across a Parquet graph dump + JSON metadata +
 an out-of-band comma-joined vocabulary sidecar (SURVEY.md §3.5) — lose the
@@ -6,14 +6,23 @@ sidecar and the model is unusable (LDALoader.scala:43).  We fold everything
 into a single directory (SURVEY.md §5 "Checkpoint / resume"):
 
     <path>/
-      meta.json     — k, vocab_size, alpha, eta, gamma_shape, step,
-                      algorithm, iteration_times, format version
-      arrays.npz    — lam [k, V] float32 (+ alpha)
-      vocab.txt     — one term per line (utf-8)
+      meta.json      — k, vocab_size, alpha, eta, gamma_shape, step,
+                       algorithm, iteration_times, format version
+      arrays.npz     — lam [k, V] float32 (+ alpha)
+      vocab.txt      — one term per line (utf-8)
+      MANIFEST.json  — per-file SHA256 (format v2, resilience/integrity)
+      COMMIT         — terminal marker: written LAST, via tmp+rename
 
-``save_train_state``/``load_train_state`` additionally persist the optimizer
-step for mid-training resume — the capability the reference's RDD
-checkpointing (intra-run lineage cuts only) does not provide.
+A crash mid-save leaves a dir with no COMMIT; ``latest_model_dir`` skips
+it and ``load_model`` raises a typed ``CorruptArtifactError`` instead of
+raw KeyError/zipfile noise.  Pre-v2 dirs (payload but no MANIFEST) stay
+loadable as "legacy".
+
+``save_train_state``/``load_train_state`` additionally persist the
+optimizer step for mid-training resume — the capability the reference's
+RDD checkpointing (intra-run lineage cuts only) does not provide.  The
+state file is written atomically (tmp + rename) with a checksum sidecar
+and the write is retried under the shared I/O policy.
 """
 
 from __future__ import annotations
@@ -21,11 +30,24 @@ from __future__ import annotations
 import json
 import os
 import time
-from typing import List, Optional, Tuple
+import zipfile
+from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
-FORMAT_VERSION = 1
+from .. import telemetry
+from ..resilience import (
+    CorruptArtifactError,
+    artifact_status,
+    atomic_write_text,
+    faultinject,
+    file_sha256,
+    finalize_artifact_dir,
+    retry_call,
+    verify_artifact,
+)
+
+FORMAT_VERSION = 2
 
 __all__ = [
     "save_model",
@@ -33,6 +55,7 @@ __all__ = [
     "load_model",
     "save_train_state",
     "load_train_state",
+    "train_state_valid",
     "model_dir_name",
     "latest_model_dir",
 ]
@@ -45,37 +68,71 @@ def model_dir_name(lang: str, base: str = "models") -> str:
 
 
 def latest_model_dir(base: str, lang: str) -> Optional[str]:
-    """Newest saved model for a language — the reference takes the LAST
-    entry of an UNSORTED listFiles (LDALoader.scala:25-37), which is
-    filesystem-order dependent; we sort by the embedded timestamp so
-    'latest' actually means newest."""
+    """Newest VALID saved model for a language.
+
+    The reference takes the LAST entry of an UNSORTED listFiles
+    (LDALoader.scala:25-37), which is filesystem-order dependent; we sort
+    by the embedded timestamp so 'latest' actually means newest.  Dirs
+    whose suffix is not a timestamp are ignored (not ranked at -1), and
+    uncommitted/partial dirs — a crashed save — are skipped with a
+    structured ``artifact_skipped`` telemetry event rather than selected
+    for scoring.
+    """
     if not os.path.isdir(base):
         return None
     prefix = f"LdaModel_{lang}_"
-    cands = [d for d in os.listdir(base) if d.startswith(prefix)]
-
-    def ts(d: str) -> int:
+    cands = []
+    for d in os.listdir(base):
+        if not d.startswith(prefix):
+            continue
         try:
-            return int(d.rsplit("_", 1)[-1])
+            ts = int(d.rsplit("_", 1)[-1])
         except ValueError:
-            return -1
-
-    if not cands:
-        return None
-    return os.path.join(base, max(cands, key=ts))
+            continue                # stray dir, not a model artifact
+        cands.append((ts, d))
+    for _, d in sorted(cands, reverse=True):
+        path = os.path.join(base, d)
+        status = artifact_status(path)
+        if status in ("committed", "legacy"):
+            return path
+        telemetry.count("resilience.artifacts_skipped")
+        telemetry.event(
+            "artifact_skipped", path=path, status=status, lang=lang,
+        )
+    if cands:
+        # every candidate was partial/uncommitted — worth a record even
+        # though the events above already name each one
+        telemetry.event(
+            "artifact_none_valid", base=base, lang=lang,
+            candidates=len(cands),
+        )
+    return None
 
 
 def _write_artifact(path: str, meta: dict, arrays: dict, vocab) -> None:
-    """The single artifact layout (meta.json + arrays.npz + vocab.txt)."""
+    """The single artifact layout, sealed with a manifest + COMMIT.
+
+    Payload files land first (with a fault-injection point between them
+    so chaos tests can model a crash mid-save), then
+    ``finalize_artifact_dir`` writes the SHA256 manifest and the terminal
+    COMMIT marker via tmp+rename.  Readers treat a COMMIT-less dir as
+    uncommitted garbage, so partial saves are never selected or loaded.
+    """
     os.makedirs(path, exist_ok=True)
     with open(os.path.join(path, "meta.json"), "w") as f:
         json.dump({"format_version": FORMAT_VERSION, **meta}, f, indent=2)
+    faultinject.check("artifact.file")
     np.savez(
         os.path.join(path, "arrays.npz"),
         **{k: np.asarray(v, np.float32) for k, v in arrays.items()},
     )
+    faultinject.check("artifact.file")
     with open(os.path.join(path, "vocab.txt"), "w", encoding="utf-8") as f:
         f.write("\n".join(vocab))
+    faultinject.corrupt("artifact.file", os.path.join(path, "arrays.npz"))
+    finalize_artifact_dir(
+        path, files=("meta.json", "arrays.npz", "vocab.txt")
+    )
 
 
 def save_model(model, path: str) -> None:
@@ -127,45 +184,125 @@ def save_nmf_model(model, path: str) -> None:
 def save_train_state(path: str, step: int, **arrays: np.ndarray) -> None:
     """Mid-training checkpoint (named state arrays + optimizer step), written
     atomically (tmp + rename) so a crash mid-write never corrupts the resume
-    point.  The sampling/init streams are re-derived from (seed, iteration)
-    at resume, so no RNG state needs persisting."""
-    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-    tmp = path + ".tmp.npz"
-    np.savez(
-        tmp,
-        step=np.int64(step),
-        # float arrays normalize to float32 (device dtype); integer state
-        # (counters like docs_seen) keeps its own dtype — float32 would
-        # silently lose precision past 2^24
-        **{
-            k: (
-                a
-                if np.issubdtype((a := np.asarray(v)).dtype, np.integer)
-                else a.astype(np.float32)
+    point, with a ``<path>.sha256`` sidecar for load-time integrity and a
+    bounded retry absorbing transient I/O errors.  The sampling/init streams
+    are re-derived from (seed, iteration) at resume, so no RNG state needs
+    persisting."""
+
+    def _write() -> None:
+        faultinject.check("ckpt.write")
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        tmp = path + ".tmp.npz"
+        np.savez(
+            tmp,
+            step=np.int64(step),
+            # float arrays normalize to float32 (device dtype); integer
+            # state (counters like docs_seen) keeps its own dtype —
+            # float32 would silently lose precision past 2^24
+            **{
+                k: (
+                    a
+                    if np.issubdtype((a := np.asarray(v)).dtype, np.integer)
+                    else a.astype(np.float32)
+                )
+                for k, v in arrays.items()
+            },
+        )
+        digest = file_sha256(tmp)
+        os.replace(tmp, path)
+        # the sidecar trails the rename by design: a crash in between
+        # leaves a stale sidecar, which load_train_state reports as
+        # corrupt — re-training one interval is the safe failure mode
+        atomic_write_text(
+            path + ".sha256",
+            json.dumps({"sha256": digest, "step": int(step)}) + "\n",
+        )
+
+    retry_call(_write, site="ckpt.write")
+
+
+def _corrupt_state(path: str, reason: str, exc=None) -> CorruptArtifactError:
+    err = CorruptArtifactError(path, reason)
+    if exc is not None:
+        err.__cause__ = exc
+    return err
+
+
+def train_state_valid(path: str) -> bool:
+    """Cheap validity probe for a checkpoint file (exists + checksum
+    sidecar agrees when present) — the coordinator's resume decision in
+    multi-host runs (parallel.mesh.agree_checkpoint_exists)."""
+    if not os.path.exists(path):
+        return False
+    sidecar = path + ".sha256"
+    if os.path.exists(sidecar):
+        try:
+            with open(sidecar, encoding="utf-8") as f:
+                want = json.load(f).get("sha256")
+            return want == file_sha256(path)
+        except (OSError, json.JSONDecodeError, ValueError):
+            return False
+    return True
+
+
+def load_train_state(
+    path: str, require: Sequence[str] = ()
+) -> dict:
+    """Returns {'step': int, <array name>: np.ndarray, ...}.
+
+    Every failure mode — missing file, checksum mismatch, truncated npz,
+    missing required keys — raises ``CorruptArtifactError`` carrying the
+    checkpoint path instead of raw KeyError/zipfile noise.
+    """
+    if not os.path.exists(path):
+        raise _corrupt_state(path, "checkpoint file does not exist")
+    sidecar = path + ".sha256"
+    if os.path.exists(sidecar):
+        try:
+            with open(sidecar, encoding="utf-8") as f:
+                want = json.load(f).get("sha256")
+        except (OSError, json.JSONDecodeError, ValueError) as exc:
+            raise _corrupt_state(path, f"unreadable checksum sidecar: {exc}",
+                                 exc)
+        got = file_sha256(path)
+        if want != got:
+            raise _corrupt_state(
+                path,
+                f"checksum mismatch (sidecar {str(want)[:12]}…, "
+                f"file {got[:12]}…)",
             )
-            for k, v in arrays.items()
-        },
-    )
-    os.replace(tmp, path)
-
-
-def load_train_state(path: str) -> dict:
-    """Returns {'step': int, <array name>: np.ndarray, ...}."""
     out = {}
-    with np.load(path) as z:
-        for k in z.files:
-            out[k] = int(z[k]) if k == "step" else z[k]
+    try:
+        with np.load(path) as z:
+            for k in z.files:
+                out[k] = int(z[k]) if k == "step" else z[k]
+    except (OSError, ValueError, zipfile.BadZipFile, EOFError) as exc:
+        raise _corrupt_state(
+            path, f"unreadable/truncated state file: {exc!r}", exc
+        )
+    missing = [k for k in ("step", *require) if k not in out]
+    if missing:
+        raise _corrupt_state(
+            path, f"state file is missing required keys {missing}"
+        )
     return out
 
 
 def load_model(path: str):
     """Load a saved model from ``path`` — ours (meta.json + arrays.npz +
-    vocab.txt) or, transparently, a reference-format MLlib
-    DistributedLDAModel (Parquet datasets + ``metadata/part-00000``,
-    SURVEY.md §3.5): users migrating from the reference can point
-    ``score`` straight at their existing frozen model directories."""
+    vocab.txt, v2 dirs verified against their SHA256 manifest) or,
+    transparently, a reference-format MLlib DistributedLDAModel (Parquet
+    datasets + ``metadata/part-00000``, SURVEY.md §3.5): users migrating
+    from the reference can point ``score`` straight at their existing
+    frozen model directories.
+
+    Any integrity failure — uncommitted dir, checksum mismatch, bad
+    JSON, truncated npz, missing keys — raises ``CorruptArtifactError``
+    naming the artifact, never a partial/garbage model.
+    """
     from .base import LDAModel
 
+    verify_artifact(path)
     if not os.path.exists(os.path.join(path, "meta.json")) and os.path.exists(
         os.path.join(path, "metadata", "part-00000")
     ):
@@ -173,49 +310,73 @@ def load_model(path: str):
 
         return load_reference_model(path, placeholder_vocab_ok=False)
 
-    with open(os.path.join(path, "meta.json")) as f:
-        meta = json.load(f)
+    try:
+        with open(os.path.join(path, "meta.json")) as f:
+            meta = json.load(f)
+    except (OSError, json.JSONDecodeError) as exc:
+        raise CorruptArtifactError(
+            path, f"unreadable meta.json: {exc}"
+        ) from exc
     if meta.get("format_version", 0) > FORMAT_VERSION:
         raise ValueError(
             f"checkpoint format {meta['format_version']} newer than "
             f"supported {FORMAT_VERSION}"
         )
-    arrays = np.load(os.path.join(path, "arrays.npz"))
-    with open(os.path.join(path, "vocab.txt"), encoding="utf-8") as f:
-        vocab = f.read().split("\n")
-    if meta.get("class", "").endswith("NMFModel"):
-        from .nmf import NMFModel
+    try:
+        arrays = np.load(os.path.join(path, "arrays.npz"))
+    except (OSError, ValueError, zipfile.BadZipFile, EOFError) as exc:
+        raise CorruptArtifactError(
+            path, f"unreadable/truncated arrays.npz: {exc!r}"
+        ) from exc
+    try:
+        with open(os.path.join(path, "vocab.txt"), encoding="utf-8") as f:
+            vocab = f.read().split("\n")
+    except OSError as exc:
+        raise CorruptArtifactError(
+            path, f"unreadable vocab.txt: {exc}"
+        ) from exc
+    try:
+        if meta.get("class", "").endswith("NMFModel"):
+            from .nmf import NMFModel
 
-        model = NMFModel(
-            h=arrays["h"],
+            model = NMFModel(
+                h=arrays["h"],
+                vocab=vocab,
+                loss=float(meta.get("loss", float("nan"))),
+                iteration_times=list(meta.get("iteration_times", [])),
+                iteration_times_kind=meta.get(
+                    "iteration_times_kind", "per_iteration"
+                ),
+                step=int(meta.get("step", 0)),
+            )
+            if model.vocab_size != len(vocab):
+                raise CorruptArtifactError(
+                    path,
+                    f"vocab length {len(vocab)} != h vocab axis "
+                    f"{model.vocab_size}",
+                )
+            return model
+        model = LDAModel(
+            lam=arrays["lam"],
             vocab=vocab,
-            loss=float(meta.get("loss", float("nan"))),
+            alpha=arrays["alpha"],
+            eta=float(meta["eta"]),
+            gamma_shape=float(meta.get("gamma_shape", 100.0)),
             iteration_times=list(meta.get("iteration_times", [])),
             iteration_times_kind=meta.get(
                 "iteration_times_kind", "per_iteration"
             ),
+            algorithm=meta.get("algorithm", "online"),
             step=int(meta.get("step", 0)),
         )
-        if model.vocab_size != len(vocab):
-            raise ValueError(
-                f"vocab length {len(vocab)} != h vocab axis {model.vocab_size}"
-            )
-        return model
-    model = LDAModel(
-        lam=arrays["lam"],
-        vocab=vocab,
-        alpha=arrays["alpha"],
-        eta=float(meta["eta"]),
-        gamma_shape=float(meta.get("gamma_shape", 100.0)),
-        iteration_times=list(meta.get("iteration_times", [])),
-        iteration_times_kind=meta.get(
-            "iteration_times_kind", "per_iteration"
-        ),
-        algorithm=meta.get("algorithm", "online"),
-        step=int(meta.get("step", 0)),
-    )
+    except KeyError as exc:
+        raise CorruptArtifactError(
+            path, f"artifact is missing required field {exc}"
+        ) from exc
     if model.vocab_size != len(vocab):
-        raise ValueError(
-            f"vocab length {len(vocab)} != lam vocab axis {model.vocab_size}"
+        raise CorruptArtifactError(
+            path,
+            f"vocab length {len(vocab)} != lam vocab axis "
+            f"{model.vocab_size}",
         )
     return model

@@ -102,6 +102,11 @@ def is_coordinator() -> bool:
 def agree_checkpoint_exists(path: Optional[str]) -> bool:
     """Whether a fit should resume from ``path``, agreed across processes.
 
+    "Exists" means "is a VALID resume point": a checkpoint whose checksum
+    sidecar disagrees with the file (a torn write that survived a crash)
+    is treated as absent — every process agrees to start fresh instead of
+    half the pod loading garbage (resilience.train_state_valid).
+
     Checkpoints are written by the coordinator only, so multi-host resume
     requires checkpoint_dir to be ONE shared filesystem.  If processes
     disagree about the file's existence they would take different branches
@@ -110,7 +115,14 @@ def agree_checkpoint_exists(path: Optional[str]) -> bool:
     clear error instead."""
     if not path:
         return False
-    exists = os.path.exists(path)
+    from ..models.persistence import train_state_valid
+
+    exists = train_state_valid(path)
+    if os.path.exists(path) and not exists:
+        from .. import telemetry
+
+        telemetry.count("resilience.checkpoints_rejected")
+        telemetry.event("checkpoint_rejected", path=path)
     if jax.process_count() > 1:
         from jax.experimental import multihost_utils
 

@@ -1,0 +1,84 @@
+"""Fault-tolerance layer: checksummed atomic artifacts, auto-resume,
+retry/backoff, dead-letter quarantine, and a fault-injection chaos
+harness.
+
+The reference inherits durability from Spark (DistributedLDAModel
+save/load, file-source commit logs); our TPU-native stack provides the
+equivalent here and threads it through persistence (manifest + COMMIT
+sealed artifact dirs, checksummed checkpoints), streaming (retried
+polls, per-doc quarantine, bounded at-least-once replay), the CLI
+(``--resume`` with config-hash/vocab-fingerprint validation, typed
+``CorruptArtifactError`` exits), and telemetry (``resilience.retries``
+/ ``resilience.giveups`` / ``resilience.quarantined`` counters).
+
+``faultinject`` is the chaos side: deterministic seed-driven I/O
+errors, partial writes, and kill-points armed via ``STC_FAULTS`` — the
+test suite uses it to kill training mid-checkpoint and prove resumed
+runs converge to the uninterrupted model.
+
+See docs/RESILIENCE.md for the artifact format, resume semantics,
+quarantine layout, and the fault-spec grammar.
+"""
+
+from . import faultinject
+from .errors import (
+    CorruptArtifactError,
+    ResilienceError,
+    ResumeMismatchError,
+)
+from .integrity import (
+    COMMIT_NAME,
+    MANIFEST_NAME,
+    artifact_status,
+    atomic_write_text,
+    file_sha256,
+    finalize_artifact_dir,
+    verify_artifact,
+)
+from .quarantine import QUARANTINED_COUNTER, Quarantine
+from .resume import (
+    RESUME_META_NAME,
+    config_hash,
+    validate_resume_meta,
+    vocab_fingerprint,
+    write_resume_meta,
+)
+from .retry import (
+    GIVEUPS_COUNTER,
+    IO_POLICY,
+    RETRIES_COUNTER,
+    TELEMETRY_POLICY,
+    RetryGiveUp,
+    RetryPolicy,
+    backoff_delays,
+    retry_call,
+)
+
+__all__ = [
+    "faultinject",
+    "ResilienceError",
+    "CorruptArtifactError",
+    "ResumeMismatchError",
+    "MANIFEST_NAME",
+    "COMMIT_NAME",
+    "file_sha256",
+    "atomic_write_text",
+    "finalize_artifact_dir",
+    "artifact_status",
+    "verify_artifact",
+    "Quarantine",
+    "QUARANTINED_COUNTER",
+    "RESUME_META_NAME",
+    "config_hash",
+    "vocab_fingerprint",
+    "write_resume_meta",
+    "validate_resume_meta",
+    "RetryPolicy",
+    "RetryGiveUp",
+    "retry_call",
+    "backoff_delays",
+    "IO_POLICY",
+    "TELEMETRY_POLICY",
+    "RETRIES_COUNTER",
+    "GIVEUPS_COUNTER",
+]

@@ -1,0 +1,48 @@
+"""Typed failure taxonomy for the fault-tolerance layer.
+
+The reference delegates durability to Spark and surfaces corruption as
+whatever the underlying reader throws (KeyError from a missing Parquet
+column, zipfile noise from a truncated archive).  Scoring against a
+half-written artifact must instead fail with ONE typed error carrying
+the artifact path, so drivers can distinguish "this artifact is damaged"
+(pick another / re-train) from a programming bug.
+"""
+
+from __future__ import annotations
+
+__all__ = [
+    "ResilienceError",
+    "CorruptArtifactError",
+    "ResumeMismatchError",
+]
+
+
+class ResilienceError(Exception):
+    """Base class for every failure the resilience layer raises."""
+
+
+class CorruptArtifactError(ResilienceError):
+    """A model/checkpoint artifact is unreadable, truncated, uncommitted,
+    or fails checksum verification.
+
+    ``path`` is always the artifact (file or directory) that failed, and
+    it is embedded in the message — the first question an operator asks
+    is *which* artifact died.
+    """
+
+    def __init__(self, path: str, reason: str) -> None:
+        self.path = path
+        self.reason = reason
+        super().__init__(f"corrupt artifact {path!r}: {reason}")
+
+
+class ResumeMismatchError(ResilienceError):
+    """``--resume`` found a checkpoint written by an INCOMPATIBLE run
+    (different config hash or vocabulary fingerprint) — continuing would
+    silently train a different model on misaligned state."""
+
+    def __init__(self, checkpoint_dir: str, reason: str) -> None:
+        self.checkpoint_dir = checkpoint_dir
+        super().__init__(
+            f"cannot resume from {checkpoint_dir!r}: {reason}"
+        )

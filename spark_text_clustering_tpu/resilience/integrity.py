@@ -1,0 +1,164 @@
+"""Integrity-checked artifact directories: per-file SHA256 manifest +
+terminal COMMIT marker (artifact format v2).
+
+Write protocol (``finalize_artifact_dir``, called by the persistence
+layer after the payload files land)::
+
+    <dir>/meta.json  arrays.npz  vocab.txt     (payload, any order)
+    <dir>/MANIFEST.json                        (sha256 per payload file,
+                                                written via tmp+rename)
+    <dir>/COMMIT                               (terminal marker, tmp+rename
+                                                — the LAST thing written)
+
+A reader (``verify_artifact`` / ``artifact_status``) therefore sees one
+of four states and never has to guess:
+
+    committed    COMMIT present, manifest hashes verify
+    legacy       pre-v2 dir (no MANIFEST): complete payload, unverifiable
+    uncommitted  MANIFEST present but no COMMIT, or payload missing —
+                 a crash mid-save; never select or load it
+    missing      not an artifact dir at all
+
+The reference has no equivalent — a crashed ``save`` leaves a partial
+Parquet dir that its loader's ``listFiles.last`` happily picks up.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from typing import Dict, Iterable, Optional
+
+from .errors import CorruptArtifactError
+from . import faultinject
+
+__all__ = [
+    "MANIFEST_NAME",
+    "COMMIT_NAME",
+    "file_sha256",
+    "atomic_write_text",
+    "finalize_artifact_dir",
+    "artifact_status",
+    "verify_artifact",
+]
+
+MANIFEST_NAME = "MANIFEST.json"
+COMMIT_NAME = "COMMIT"
+
+# the payload every v1 model artifact dir carries (persistence.py)
+LEGACY_PAYLOAD = ("meta.json", "arrays.npz", "vocab.txt")
+
+
+def file_sha256(path: str, chunk: int = 1 << 20) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        while True:
+            b = f.read(chunk)
+            if not b:
+                return h.hexdigest()
+            h.update(b)
+
+
+def atomic_write_text(path: str, text: str) -> None:
+    """tmp + fsync + rename: the file either exists complete or not at
+    all (the COMMIT-marker write discipline, reused for any small
+    metadata file)."""
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        f.write(text)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def finalize_artifact_dir(
+    path: str, files: Optional[Iterable[str]] = None
+) -> Dict[str, str]:
+    """Seal an artifact dir: manifest (per-file sha256) then COMMIT.
+
+    ``files`` defaults to every regular file already in the dir.  Returns
+    the hash map.  A crash anywhere before the final rename leaves the
+    dir visibly uncommitted.
+    """
+    names = sorted(
+        files
+        if files is not None
+        else (
+            n for n in os.listdir(path)
+            if os.path.isfile(os.path.join(path, n))
+            and n not in (MANIFEST_NAME, COMMIT_NAME)
+        )
+    )
+    hashes = {n: file_sha256(os.path.join(path, n)) for n in names}
+    atomic_write_text(
+        os.path.join(path, MANIFEST_NAME),
+        json.dumps({"version": 2, "files": hashes}, indent=2, sort_keys=True),
+    )
+    faultinject.check("artifact.commit")
+    atomic_write_text(os.path.join(path, COMMIT_NAME), "committed\n")
+    return hashes
+
+
+def artifact_status(path: str) -> str:
+    """'committed' | 'legacy' | 'uncommitted' | 'missing' (see module
+    docstring; no hashing — this is the cheap selection-time check)."""
+    if not os.path.isdir(path):
+        return "missing"
+    has_manifest = os.path.exists(os.path.join(path, MANIFEST_NAME))
+    has_commit = os.path.exists(os.path.join(path, COMMIT_NAME))
+    if has_manifest and has_commit:
+        return "committed"
+    if has_manifest or has_commit:
+        return "uncommitted"        # crashed between payload and seal
+    # pre-v2 dir: complete payload = loadable legacy, else a torn write.
+    # MLlib-format dirs (metadata/part-00000) count as legacy too — the
+    # reference importer owns their validation.
+    if os.path.exists(os.path.join(path, "metadata", "part-00000")):
+        return "legacy"
+    missing = [
+        n for n in LEGACY_PAYLOAD
+        if not os.path.exists(os.path.join(path, n))
+    ]
+    return "uncommitted" if missing else "legacy"
+
+
+def verify_artifact(path: str) -> str:
+    """Full integrity check; raises ``CorruptArtifactError`` unless the
+    dir is loadable.  Returns the status ('committed' or 'legacy').
+
+    Committed dirs get every manifest hash re-verified; legacy dirs have
+    nothing to verify beyond payload presence (loaders still wrap their
+    own parse failures).
+    """
+    status = artifact_status(path)
+    if status == "missing":
+        raise CorruptArtifactError(path, "no such artifact directory")
+    if status == "uncommitted":
+        raise CorruptArtifactError(
+            path,
+            "artifact is uncommitted (no terminal COMMIT marker — "
+            "a save crashed mid-write, or files are missing)",
+        )
+    if status == "committed":
+        with open(os.path.join(path, MANIFEST_NAME), encoding="utf-8") as f:
+            try:
+                manifest = json.load(f)
+            except json.JSONDecodeError as exc:
+                raise CorruptArtifactError(
+                    path, f"unreadable manifest: {exc}"
+                ) from exc
+        for name, want in sorted(manifest.get("files", {}).items()):
+            fp = os.path.join(path, name)
+            if not os.path.exists(fp):
+                raise CorruptArtifactError(
+                    path, f"manifest file {name!r} is missing"
+                )
+            got = file_sha256(fp)
+            if got != want:
+                raise CorruptArtifactError(
+                    path,
+                    f"checksum mismatch for {name!r} "
+                    f"(manifest {want[:12]}…, file {got[:12]}…)",
+                )
+    return status

@@ -1,0 +1,86 @@
+"""Dead-letter quarantine for per-document streaming failures.
+
+A malformed document must not kill a long-running stream (graceful
+degradation): the streaming scorer/trainer route the offending doc here
+— raw text plus a structured ``.error.json`` sidecar — emit a
+``quarantine`` telemetry event, count it in ``resilience.quarantined``,
+and keep going.  The quarantine dir is a replayable dead-letter queue:
+once the bug is fixed, the ``.txt`` payloads can be dropped straight
+back into the watch directory.
+
+Layout::
+
+    <dir>/q-<seq>-<safe name>.txt          the document text
+    <dir>/q-<seq>-<safe name>.error.json   {name, stage, error, batch_id}
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from typing import Optional
+
+from .integrity import atomic_write_text
+
+__all__ = ["Quarantine", "QUARANTINED_COUNTER"]
+
+QUARANTINED_COUNTER = "resilience.quarantined"
+
+_SAFE = re.compile(r"[^A-Za-z0-9._-]+")
+
+
+class Quarantine:
+    """Append-only dead-letter dir; ``None``-safe construction so call
+    sites can hold an always-usable handle (``Quarantine(None)`` drops
+    documents with only the telemetry trace)."""
+
+    def __init__(self, directory: Optional[str]) -> None:
+        self.directory = directory
+        self.count = 0
+
+    def put(
+        self,
+        name: str,
+        text: str,
+        error: BaseException,
+        *,
+        stage: str,
+        batch_id: Optional[int] = None,
+    ) -> Optional[str]:
+        """Quarantine one document; returns the payload path (None when
+        no directory is configured).  Never raises — a failing quarantine
+        disk must not take the stream down with it."""
+        from .. import telemetry
+
+        self.count += 1
+        telemetry.count(QUARANTINED_COUNTER)
+        telemetry.event(
+            "quarantine",
+            doc=name, stage=stage, error=repr(error),
+            **({} if batch_id is None else {"batch_id": batch_id}),
+        )
+        if not self.directory:
+            return None
+        safe = _SAFE.sub("_", os.path.basename(name))[:80] or "doc"
+        stem = os.path.join(
+            self.directory, f"q-{self.count:06d}-{safe}"
+        )
+        try:
+            os.makedirs(self.directory, exist_ok=True)
+            atomic_write_text(stem + ".txt", text)
+            atomic_write_text(
+                stem + ".error.json",
+                json.dumps(
+                    {
+                        "name": name,
+                        "stage": stage,
+                        "error": repr(error),
+                        "batch_id": batch_id,
+                    },
+                    indent=2,
+                ),
+            )
+        except OSError:
+            return None
+        return stem + ".txt"

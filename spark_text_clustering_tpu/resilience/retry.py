@@ -1,0 +1,159 @@
+"""Reusable retry/backoff primitive (deadline + jittered exponential
+backoff + telemetry).
+
+One policy object serves every transient-I/O call site — streaming
+source polls, checkpoint/report writes, telemetry sink appends, and the
+accelerator probe's bring-up attempts (utils/env.py used to hand-roll
+its own ``[0, 10, 30]`` schedule; it now derives the same delays from a
+``RetryPolicy`` so the backoff rules cannot drift apart).
+
+Retries are OBSERVABLE: every absorbed failure increments
+``resilience.retries`` and every exhausted policy increments
+``resilience.giveups`` on the process metric registry (plus a ``retry``
+telemetry event when a run sink is configured), so a run that survived
+on retries is distinguishable from one that never faulted.
+
+Jitter is DETERMINISTIC per call site: the jitter stream is seeded from
+the site name, so chaos tests replay identically while distinct sites
+still decorrelate.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+import zlib
+from dataclasses import dataclass
+from typing import Callable, Iterator, Optional, Tuple, Type
+
+from .errors import ResilienceError
+
+__all__ = [
+    "RetryPolicy",
+    "RetryGiveUp",
+    "backoff_delays",
+    "retry_call",
+]
+
+RETRIES_COUNTER = "resilience.retries"
+GIVEUPS_COUNTER = "resilience.giveups"
+
+
+class RetryGiveUp(ResilienceError):
+    """A retry policy exhausted its attempts/deadline; ``last`` is the
+    final underlying exception (also chained as ``__cause__``)."""
+
+    def __init__(self, site: str, attempts: int, last: BaseException) -> None:
+        self.site = site
+        self.attempts = attempts
+        self.last = last
+        super().__init__(
+            f"{site}: gave up after {attempts} attempt(s): {last!r}"
+        )
+
+
+@dataclass(frozen=True)
+class RetryPolicy:
+    """Jittered exponential backoff with an optional wall-clock deadline.
+
+    Delay before attempt ``i`` (0-based; attempt 0 is immediate)::
+
+        min(max_delay, base_delay * multiplier**(i-1)) * (1 ± jitter)
+    """
+
+    attempts: int = 4
+    base_delay: float = 0.05
+    max_delay: float = 2.0
+    multiplier: float = 2.0
+    jitter: float = 0.25            # fraction of the delay, uniform ±
+    deadline_s: Optional[float] = None
+    retry_on: Tuple[Type[BaseException], ...] = (OSError,)
+    # False: count retries in the registry but emit no ``retry`` run
+    # event — REQUIRED for the telemetry sink's own retries (an event
+    # would re-enter the failing sink and recurse)
+    emit_events: bool = True
+
+    def delay(self, attempt: int, rng: Optional[random.Random] = None) -> float:
+        if attempt <= 0:
+            return 0.0
+        d = min(
+            self.max_delay, self.base_delay * self.multiplier ** (attempt - 1)
+        )
+        if self.jitter and rng is not None:
+            d *= 1.0 + self.jitter * (2.0 * rng.random() - 1.0)
+        return d
+
+
+# I/O micro-retry: absorbs transient filesystem hiccups without making a
+# genuinely-dead disk stall the caller for more than ~a second.
+IO_POLICY = RetryPolicy(attempts=4, base_delay=0.05, max_delay=0.5)
+# Telemetry writes are best-effort: one quick second chance, never a
+# stall, and no retry events (they would re-enter the failing sink).
+TELEMETRY_POLICY = RetryPolicy(
+    attempts=2, base_delay=0.01, max_delay=0.01, emit_events=False
+)
+
+
+def _site_rng(site: str) -> random.Random:
+    # deterministic per-site jitter stream (replayable chaos runs)
+    return random.Random(zlib.crc32(site.encode("utf-8")))
+
+
+def backoff_delays(policy: RetryPolicy, site: str = "") -> Iterator[float]:
+    """The policy's delay schedule (one entry per attempt, first is 0) —
+    for callers that drive their own loop (the accelerator probe)."""
+    rng = _site_rng(site)
+    for i in range(policy.attempts):
+        yield policy.delay(i, rng)
+
+
+def _count(name: str, **event_fields) -> None:
+    # late import: telemetry's own sink retries route through this module
+    from .. import telemetry
+
+    telemetry.count(name)
+    if event_fields:
+        telemetry.event("retry", **event_fields)
+
+
+def retry_call(
+    fn: Callable,
+    *args,
+    site: str,
+    policy: RetryPolicy = IO_POLICY,
+    sleep: Callable[[float], None] = time.sleep,
+    **kwargs,
+):
+    """Call ``fn(*args, **kwargs)`` under ``policy``.
+
+    Exceptions in ``policy.retry_on`` are absorbed (counted in
+    ``resilience.retries``) until attempts or the deadline run out, then
+    ``RetryGiveUp`` is raised (counted in ``resilience.giveups``) with
+    the last error chained.  Other exception types propagate immediately.
+    """
+    rng = _site_rng(site)
+    t0 = time.monotonic()
+    last: Optional[BaseException] = None
+    for attempt in range(policy.attempts):
+        d = policy.delay(attempt, rng)
+        if d:
+            sleep(d)
+        if (
+            policy.deadline_s is not None
+            and time.monotonic() - t0 > policy.deadline_s
+        ):
+            break
+        try:
+            return fn(*args, **kwargs)
+        except policy.retry_on as exc:
+            last = exc
+            if policy.emit_events:
+                _count(
+                    RETRIES_COUNTER,
+                    site=site, attempt=attempt, error=repr(exc),
+                )
+            else:
+                _count(RETRIES_COUNTER)
+    assert last is not None
+    _count(GIVEUPS_COUNTER)
+    raise RetryGiveUp(site, policy.attempts, last) from last

@@ -37,6 +37,7 @@ from . import telemetry
 from .config import Params
 from .ops.sparse import batch_from_rows, next_pow2, pad_rows
 from .pipeline import TextPreprocessor, is_hashed_vocab, make_vectorizer
+from .resilience import Quarantine, RetryGiveUp, faultinject, retry_call
 from .utils.report import format_scoring_report, write_scoring_report
 
 __all__ = [
@@ -118,15 +119,26 @@ class FileStreamSource:
                 self._seen = {line.rstrip("\n") for line in f if line.strip()}
 
     def commit(self) -> None:
-        """Durably record every path staged since the last commit."""
+        """Durably record every path staged since the last commit.
+
+        The append is retried under the shared I/O policy — a transient
+        disk error must not widen the at-least-once replay window; a
+        persistent one raises (the commit log is the one write that MUST
+        be durable before the staged paths can be forgotten)."""
         if not self.state_path or not self._pending:
             return
-        os.makedirs(os.path.dirname(self.state_path) or ".", exist_ok=True)
-        with open(self.state_path, "a", encoding="utf-8") as f:
-            for p in self._pending:
-                f.write(p + "\n")
-            f.flush()
-            os.fsync(f.fileno())
+
+        def _append() -> None:
+            os.makedirs(
+                os.path.dirname(self.state_path) or ".", exist_ok=True
+            )
+            with open(self.state_path, "a", encoding="utf-8") as f:
+                for p in self._pending:
+                    f.write(p + "\n")
+                f.flush()
+                os.fsync(f.fileno())
+
+        retry_call(_append, site="source.commit")
         self._pending.clear()
 
     def _list_new(self) -> List[str]:
@@ -157,7 +169,20 @@ class FileStreamSource:
         return out
 
     def poll(self) -> Optional[MicroBatch]:
-        new = self._list_new()
+        # directory listing is the poll's I/O edge: transient errors
+        # (flaky NFS, an injected fault) are absorbed by the retry layer
+        # (resilience.retries); a poll that exhausts the policy yields an
+        # empty trigger — the NEXT trigger retries from scratch, so a
+        # long-lived stream survives a briefly-dead source dir
+        def _list() -> List[str]:
+            faultinject.check("stream.poll")
+            return self._list_new()
+
+        try:
+            new = retry_call(_list, site="stream.poll")
+        except RetryGiveUp:
+            telemetry.event("stream_poll_giveup", directory=self.directory)
+            return None
         self.last_queue_depth = len(new)
         telemetry.gauge("stream.queue_depth", len(new))
         if not new:
@@ -247,16 +272,44 @@ def _vectorize_texts(pre: TextPreprocessor, rows_for, texts: Sequence[str]):
     return rows_for(pre.transform({"texts": list(texts)})["tokens"])
 
 
-def _vocab_fingerprint(vocab: Sequence[str]) -> int:
-    """Stable 32-bit fingerprint of a vocabulary, persisted with streaming
-    checkpoints: a resumed run whose vocab merely has the same SIZE would
-    otherwise silently map term columns to different terms."""
-    import zlib
+def _vectorize_quarantined(
+    pre: TextPreprocessor,
+    rows_for,
+    mb: MicroBatch,
+    quarantine: Quarantine,
+    stage: str,
+):
+    """Vectorize a micro-batch with per-document fault isolation.
 
-    h = 0
-    for t in vocab:
-        h = zlib.crc32(t.encode("utf-8"), h)
-    return h
+    Fast path: one whole-batch transform (the common case — no
+    per-doc overhead).  If it throws, fall back to per-document
+    vectorization and route each failing doc to the dead-letter
+    quarantine instead of killing the stream.  Returns aligned
+    ``(names, texts, rows)`` for the surviving documents.
+    """
+    try:
+        rows = _vectorize_texts(pre, rows_for, mb.texts)
+        return list(mb.names), list(mb.texts), rows
+    except Exception:
+        names, texts, rows = [], [], []
+        for name, text in zip(mb.names, mb.texts):
+            try:
+                (row,) = _vectorize_texts(pre, rows_for, [text])
+            except Exception as exc:
+                quarantine.put(
+                    name, text, exc, stage=stage, batch_id=mb.batch_id
+                )
+                continue
+            names.append(name)
+            texts.append(text)
+            rows.append(row)
+        return names, texts, rows
+
+
+# canonical definition lives in the resilience layer (shared with the
+# CLI's --resume compatibility gate); re-exported here for existing
+# importers
+from .resilience.resume import vocab_fingerprint as _vocab_fingerprint
 
 
 # ---------------------------------------------------------------------------
@@ -290,9 +343,13 @@ class StreamingScorer:
         batch_capacity: int = 8,
         row_len: Optional[int] = None,
         keep_results: bool = True,
+        quarantine_dir: Optional[str] = None,
     ) -> None:
         self.model = model
         self.pre = TextPreprocessor(stop_words=stop_words, lemmatize=lemmatize)
+        # dead-letter routing for per-doc failures (graceful degradation:
+        # one malformed doc must not kill an endless scoring stream)
+        self.quarantine = Quarantine(quarantine_dir)
         # make_vectorizer auto-dispatches: hash-trained models (synthetic
         # h0..hN vocab) get murmur3 bucketing; exact vocabs get lookup.
         self.hashed = is_hashed_vocab(model.vocab)
@@ -314,14 +371,16 @@ class StreamingScorer:
     def process(self, mb: MicroBatch) -> List[ScoredDoc]:
         t0 = time.perf_counter()
         with telemetry.span("stream.score_batch", emit=False):
-            rows = self._vectorize(mb)
+            all_names, all_texts, rows = _vectorize_quarantined(
+                self.pre, self._rows_for, mb, self.quarantine, "vectorize"
+            )
             if self.row_len is None:
                 max_nnz = max((len(i) for i, _ in rows), default=1)
                 self.row_len = max(8, next_pow2(max_nnz))
             out: List[ScoredDoc] = []
             for at in range(0, len(rows), self.batch_capacity):
                 chunk = rows[at : at + self.batch_capacity]
-                names = mb.names[at : at + self.batch_capacity]
+                names = all_names[at : at + self.batch_capacity]
                 # grow row_len only when a longer doc arrives (rare
                 # recompile)
                 max_nnz = max((len(i) for i, _ in chunk), default=1)
@@ -331,7 +390,19 @@ class StreamingScorer:
                     pad_rows(chunk, self.batch_capacity),
                     row_len=self.row_len,
                 )
-                dist = self.model.topic_distribution(batch)[: len(chunk)]
+                try:
+                    dist = self.model.topic_distribution(batch)[: len(chunk)]
+                except Exception as exc:
+                    # score-time failure: quarantine the chunk's docs and
+                    # keep the stream alive
+                    for name, text in zip(
+                        names, all_texts[at : at + self.batch_capacity]
+                    ):
+                        self.quarantine.put(
+                            name, text, exc,
+                            stage="score", batch_id=mb.batch_id,
+                        )
+                    continue
                 for name, d, row in zip(names, dist, chunk):
                     sd = ScoredDoc(
                         name, int(np.argmax(d)), np.asarray(d), row
@@ -397,6 +468,7 @@ class StreamingOnlineLDA:
         row_len: int = 1024,
         corpus_size_hint: Optional[int] = None,
         checkpoint_every: Optional[int] = None,
+        quarantine_dir: Optional[str] = None,
     ) -> None:
         import jax
         import jax.numpy as jnp
@@ -419,6 +491,7 @@ class StreamingOnlineLDA:
         self._pspec = P
 
         self.pre = TextPreprocessor(stop_words=stop_words, lemmatize=lemmatize)
+        self.quarantine = Quarantine(quarantine_dir)
         if vocab is not None:
             self.vocab = list(vocab)
             self.num_features = None
@@ -476,7 +549,10 @@ class StreamingOnlineLDA:
         FileStreamSource.commit)."""
         t0 = time.perf_counter()
         with telemetry.span("stream.train_batch", emit=False):
-            rows = [(i, w) for i, w in self._vectorize(mb) if len(i) > 0]
+            _, _, raw_rows = _vectorize_quarantined(
+                self.pre, self._rows_for, mb, self.quarantine, "vectorize"
+            )
+            rows = [(i, w) for i, w in raw_rows if len(i) > 0]
             if not rows:
                 return False
             self.docs_seen += len(rows)
@@ -581,7 +657,7 @@ class StreamingOnlineLDA:
         from .models.persistence import load_train_state
         from .parallel.mesh import model_sharding
 
-        st = load_train_state(self._ckpt_path)
+        st = load_train_state(self._ckpt_path, require=("lam",))
         lam = st["lam"]
         if lam.shape != (self.params.k, self._v_pad):
             raise ValueError(

@@ -90,17 +90,37 @@ class JsonlSink:
             )
 
     def write(self, rec: Dict) -> bool:
-        """Append one record; False (and a surfaced error) on failure."""
+        """Append one record; False (and a surfaced error) on failure.
+
+        Transient I/O errors get one quick retry (resilience
+        TELEMETRY_POLICY — telemetry must never stall the training loop
+        it observes); exhausted retries surface as before."""
         if not self.path:
             return False
-        try:
+        # lazy import: resilience.retry counts into THIS package's
+        # registry, so the import edge must stay one-way at module level
+        from ..resilience import TELEMETRY_POLICY, RetryGiveUp, faultinject
+        from ..resilience import retry_call
+
+        def _append() -> None:
+            faultinject.check("telemetry.write")
             with open(self.path, "a", encoding="utf-8") as f:
                 f.write(json.dumps(rec) + "\n")
+
+        try:
+            retry_call(_append, site="telemetry.write",
+                       policy=TELEMETRY_POLICY)
             return True
-        except (OSError, TypeError, ValueError) as exc:
-            # TypeError/ValueError: unserializable field — drop the
-            # record, keep the run alive, count the loss
-            self._surface(exc if isinstance(exc, OSError) else OSError(exc))
+        except RetryGiveUp as exc:
+            last = exc.last
+            self._surface(
+                last if isinstance(last, OSError) else OSError(last)
+            )
+            return False
+        except (TypeError, ValueError) as exc:
+            # unserializable field — drop the record, keep the run
+            # alive, count the loss
+            self._surface(OSError(exc))
             return False
 
 

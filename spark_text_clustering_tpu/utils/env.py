@@ -78,13 +78,21 @@ def probe_accelerator(
     cannot drift apart.
     """
     from .. import telemetry
+    from ..resilience import RetryPolicy, backoff_delays
 
     code = (
         "import jax, json; d = jax.devices(); "
         "print('PROBE', json.dumps({'v': jax.__version__, "
         "'b': jax.default_backend(), 'n': len(d)}))"
     )
-    backoff = [0, 10, 30]
+    # the shared backoff primitive (resilience.retry) drives the delay
+    # schedule — 0, 10, 30, 30, ... seconds, the bring-up cadence the
+    # probe has always used, now derived instead of hand-rolled
+    probe_policy = RetryPolicy(
+        attempts=attempts, base_delay=10.0, multiplier=3.0,
+        max_delay=30.0, jitter=0.0,
+    )
+    delays = list(backoff_delays(probe_policy, site="probe.accelerator"))
     last_err = ""
     history: list = []
 
@@ -106,7 +114,7 @@ def probe_accelerator(
         )
 
     for i in range(attempts):
-        delay = backoff[min(i, len(backoff) - 1)]
+        delay = delays[i]
         if delay:
             time.sleep(delay)
         t0 = time.monotonic()

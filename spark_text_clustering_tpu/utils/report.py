@@ -183,10 +183,21 @@ def write_scoring_report(
     lang: str,
     timestamp_millis: Optional[int] = None,
 ) -> str:
-    """Write to ``<output_dir>/Result_<lang>_<millis>`` (LDALoader.scala:210-212)."""
+    """Write to ``<output_dir>/Result_<lang>_<millis>`` (LDALoader.scala:210-212).
+
+    Atomic (tmp + rename) and retried under the shared I/O policy: a
+    report either exists complete or not at all — a crash mid-write must
+    never leave a partial report a downstream consumer mistakes for the
+    real thing."""
+    from ..resilience import atomic_write_text, faultinject, retry_call
+
     ts = timestamp_millis if timestamp_millis is not None else int(time.time() * 1000)
-    os.makedirs(output_dir, exist_ok=True)
     path = os.path.join(output_dir, f"Result_{lang}_{ts}")
-    with open(path, "w", encoding="utf-8") as f:
-        f.write(text)
+
+    def _write() -> None:
+        faultinject.check("report.write")
+        os.makedirs(output_dir, exist_ok=True)
+        atomic_write_text(path, text)
+
+    retry_call(_write, site="report.write")
     return path

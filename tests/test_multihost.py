@@ -16,6 +16,7 @@ import socket
 import subprocess
 import sys
 
+import jax
 import numpy as np
 import pytest
 
@@ -43,6 +44,13 @@ def test_partial_distributed_args_rejected():
     initialize_distributed()  # no args: single-process no-op
 
 
+@pytest.mark.xfail(
+    jax.__version__.startswith("0.4."),
+    reason="jaxlib 0.4.x: 'Multiprocess computations aren't implemented "
+           "on the CPU backend' (ROADMAP: environment limit — the DCN "
+           "bring-up path needs a modern jaxlib or real TPU hosts)",
+    strict=False,
+)
 @pytest.mark.parametrize("nproc", [2, 4])
 def test_multi_process_bringup_and_em_step(tmp_path, nproc):
     """2- and 4-process DCN bring-up: the 4-way variant (VERDICT round-3

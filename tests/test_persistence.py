@@ -88,11 +88,31 @@ class TestPersistence:
         # (LDALoader.scala:25-37); we pick by embedded timestamp
         base = str(tmp_path)
         for ts in (1591049082850, 1602586875372, 159):
-            os.makedirs(os.path.join(base, f"LdaModel_EN_{ts}"))
-        os.makedirs(os.path.join(base, "LdaModel_GE_9999999999999"))
+            _model().save(os.path.join(base, f"LdaModel_EN_{ts}"))
+        _model().save(os.path.join(base, "LdaModel_GE_9999999999999"))
         got = latest_model_dir(base, "EN")
         assert got.endswith("LdaModel_EN_1602586875372")
         assert latest_model_dir(base, "FR") is None
+
+    def test_latest_model_dir_skips_uncommitted_and_junk(self, tmp_path):
+        """Partial dirs (crashed save: no COMMIT marker) and dirs whose
+        suffix is not a timestamp must be skipped, not ranked (the old
+        ``ts -> -1`` fallback ranked junk dirs as candidates)."""
+        base = str(tmp_path)
+        _model().save(os.path.join(base, "LdaModel_EN_1591049082850"))
+        # newer but uncommitted: payload only, no MANIFEST/COMMIT seal
+        partial = os.path.join(base, "LdaModel_EN_1602586875372")
+        os.makedirs(partial)
+        with open(os.path.join(partial, "meta.json"), "w") as f:
+            f.write("{}")
+        # junk suffix: never a candidate
+        os.makedirs(os.path.join(base, "LdaModel_EN_backup"))
+        got = latest_model_dir(base, "EN")
+        assert got.endswith("LdaModel_EN_1591049082850")
+        # an all-partial candidate set yields None, not a garbage pick
+        assert latest_model_dir(base, "GE") is None
+        os.makedirs(os.path.join(base, "LdaModel_GE_100"))
+        assert latest_model_dir(base, "GE") is None
 
     def test_model_dir_name_scheme(self, tmp_path):
         name = model_dir_name("EN", base=str(tmp_path))

@@ -1,0 +1,632 @@
+"""Fault-tolerance layer tests: fault-spec parsing, retry/backoff,
+artifact integrity (manifest + COMMIT), typed corruption errors,
+quarantine, resume compatibility, crash-window semantics, and the
+subprocess chaos drill (kill at an injected kill-point, resume, compare
+against an uninterrupted run)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from spark_text_clustering_tpu import telemetry
+from spark_text_clustering_tpu.models.base import LDAModel
+from spark_text_clustering_tpu.models.persistence import (
+    latest_model_dir,
+    load_model,
+    load_train_state,
+    save_train_state,
+    train_state_valid,
+)
+from spark_text_clustering_tpu.resilience import (
+    GIVEUPS_COUNTER,
+    RETRIES_COUNTER,
+    CorruptArtifactError,
+    Quarantine,
+    ResumeMismatchError,
+    RetryGiveUp,
+    RetryPolicy,
+    artifact_status,
+    backoff_delays,
+    config_hash,
+    faultinject,
+    finalize_artifact_dir,
+    retry_call,
+    validate_resume_meta,
+    verify_artifact,
+    vocab_fingerprint,
+    write_resume_meta,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults_and_registry():
+    """Every test starts with no armed fault plan and a fresh registry."""
+    faultinject.reset()
+    telemetry.get_registry().reset()
+    yield
+    faultinject.reset()
+    telemetry.shutdown()
+    telemetry.get_registry().reset()
+
+
+def _model(seed=0, v=6):
+    rng = np.random.default_rng(seed)
+    return LDAModel(
+        lam=rng.random((2, v)).astype(np.float32) + 0.1,
+        vocab=[f"term{i}" for i in range(v)],
+        alpha=np.full(2, 0.5, np.float32),
+        eta=0.1,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Fault-spec grammar / determinism
+# ---------------------------------------------------------------------------
+class TestFaultSpec:
+    def test_bad_rules_rejected(self):
+        for bad in ("no-colon", "a:b:c", "site:unknownkind"):
+            with pytest.raises(ValueError):
+                faultinject.FaultPlan(bad)
+
+    def test_fail_fires_on_nth_hit_only(self):
+        faultinject.configure("s:fail@2")
+        faultinject.check("s")                      # hit 1: clean
+        with pytest.raises(faultinject.InjectedIOError):
+            faultinject.check("s")                  # hit 2: fires
+        faultinject.check("s")                      # hit 3: clean again
+
+    def test_ioerror_stream_is_seed_deterministic(self):
+        def draw(seed):
+            faultinject.configure("s:ioerror@0.5", seed=seed)
+            fired = []
+            for _ in range(32):
+                try:
+                    faultinject.check("s")
+                    fired.append(0)
+                except faultinject.InjectedIOError:
+                    fired.append(1)
+            return fired
+
+        a, b, c = draw(7), draw(7), draw(8)
+        assert a == b                   # same seed replays exactly
+        assert a != c                   # different seed decorrelates
+        assert 0 < sum(a) < 32          # actually probabilistic
+
+    def test_partial_truncates_via_corrupt(self, tmp_path):
+        p = str(tmp_path / "f.bin")
+        with open(p, "wb") as f:
+            f.write(b"x" * 100)
+        faultinject.configure("w:partial@1")
+        faultinject.check("w")          # partial rules never raise here
+        faultinject.corrupt("w", p)
+        assert os.path.getsize(p) == 50
+
+    def test_env_arming(self, tmp_path, monkeypatch):
+        monkeypatch.setenv(faultinject.ENV_SPEC, "e:fail@1")
+        faultinject.reset()             # force env re-read
+        with pytest.raises(faultinject.InjectedIOError):
+            faultinject.check("e")
+
+
+# ---------------------------------------------------------------------------
+# Retry / backoff
+# ---------------------------------------------------------------------------
+class TestRetry:
+    def test_backoff_schedule_shape(self):
+        pol = RetryPolicy(
+            attempts=5, base_delay=1.0, multiplier=2.0, max_delay=3.0,
+            jitter=0.0,
+        )
+        assert list(backoff_delays(pol, site="x")) == [0, 1.0, 2.0, 3.0, 3.0]
+
+    def test_jitter_deterministic_per_site(self):
+        pol = RetryPolicy(attempts=4, base_delay=1.0, jitter=0.25)
+        a = list(backoff_delays(pol, site="same"))
+        b = list(backoff_delays(pol, site="same"))
+        c = list(backoff_delays(pol, site="other"))
+        assert a == b and a != c
+
+    def test_absorbs_transient_and_counts(self):
+        telemetry.configure(None)       # registry-only
+        calls = {"n": 0}
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] < 3:
+                raise OSError("transient")
+            return "ok"
+
+        got = retry_call(flaky, site="t", sleep=lambda _: None)
+        assert got == "ok" and calls["n"] == 3
+        snap = telemetry.get_registry().snapshot()
+        assert snap["counters"][RETRIES_COUNTER] == 2
+        assert GIVEUPS_COUNTER not in snap["counters"]
+
+    def test_giveup_raises_typed_with_cause(self):
+        telemetry.configure(None)
+
+        def dead():
+            raise OSError("disk gone")
+
+        with pytest.raises(RetryGiveUp) as ei:
+            retry_call(
+                dead, site="t",
+                policy=RetryPolicy(attempts=3), sleep=lambda _: None,
+            )
+        assert isinstance(ei.value.__cause__, OSError)
+        assert ei.value.attempts == 3
+        snap = telemetry.get_registry().snapshot()
+        assert snap["counters"][RETRIES_COUNTER] == 3
+        assert snap["counters"][GIVEUPS_COUNTER] == 1
+
+    def test_non_retryable_propagates_immediately(self):
+        calls = {"n": 0}
+
+        def broken():
+            calls["n"] += 1
+            raise KeyError("bug, not I/O")
+
+        with pytest.raises(KeyError):
+            retry_call(broken, site="t", sleep=lambda _: None)
+        assert calls["n"] == 1
+
+    def test_retry_events_visible_in_run_stream(self, tmp_path):
+        """Acceptance: absorbed faults are visible in the telemetry
+        stream — a ``retry`` event with the site, plus the
+        ``resilience.retries`` counter in the final registry snapshot."""
+        p = str(tmp_path / "run.jsonl")
+        telemetry.configure(p)
+        faultinject.configure("r:fail@1")
+
+        def op():
+            faultinject.check("r")
+            return 1
+
+        retry_call(op, site="r", sleep=lambda _: None)
+        telemetry.shutdown()
+        with open(p) as f:
+            events = [json.loads(line) for line in f]
+        (retry,) = [e for e in events if e.get("event") == "retry"]
+        assert retry["site"] == "r" and "attempt" in retry
+        (snap,) = [e for e in events if e.get("event") == "registry"]
+        assert snap["snapshot"]["counters"][RETRIES_COUNTER] == 1
+
+    def test_injected_faults_count_as_oserror(self):
+        """InjectedIOError subclasses OSError, so the default policy
+        absorbs injected faults exactly like real ones."""
+        telemetry.configure(None)
+        faultinject.configure("r:fail@1")
+
+        def op():
+            faultinject.check("r")
+            return 42
+
+        assert retry_call(op, site="r", sleep=lambda _: None) == 42
+        snap = telemetry.get_registry().snapshot()
+        assert snap["counters"][RETRIES_COUNTER] == 1
+
+
+# ---------------------------------------------------------------------------
+# Artifact integrity (manifest + COMMIT) and typed load failures
+# ---------------------------------------------------------------------------
+class TestArtifactIntegrity:
+    def test_save_seals_and_verifies(self, tmp_path):
+        d = str(tmp_path / "LdaModel_EN_1000")
+        _model().save(d)
+        assert artifact_status(d) == "committed"
+        assert verify_artifact(d) == "committed"
+        with open(os.path.join(d, "MANIFEST.json")) as f:
+            manifest = json.load(f)
+        assert set(manifest["files"]) == {
+            "meta.json", "arrays.npz", "vocab.txt"
+        }
+
+    def test_uncommitted_dir_rejected(self, tmp_path):
+        d = str(tmp_path / "LdaModel_EN_1000")
+        _model().save(d)
+        os.remove(os.path.join(d, "COMMIT"))
+        assert artifact_status(d) == "uncommitted"
+        with pytest.raises(CorruptArtifactError, match="uncommitted"):
+            load_model(d)
+
+    def test_checksum_mismatch_rejected(self, tmp_path):
+        d = str(tmp_path / "LdaModel_EN_1000")
+        _model().save(d)
+        with open(os.path.join(d, "arrays.npz"), "r+b") as f:
+            f.truncate(10)
+        with pytest.raises(CorruptArtifactError, match="checksum mismatch"):
+            load_model(d)
+
+    def test_legacy_dir_still_loads(self, tmp_path):
+        """Pre-v2 artifacts (payload, no MANIFEST/COMMIT) stay loadable."""
+        d = str(tmp_path / "LdaModel_EN_1000")
+        m = _model()
+        m.save(d)
+        os.remove(os.path.join(d, "MANIFEST.json"))
+        os.remove(os.path.join(d, "COMMIT"))
+        assert artifact_status(d) == "legacy"
+        got = load_model(d)
+        np.testing.assert_allclose(got.lam, m.lam)
+
+    def test_bad_meta_json_is_typed(self, tmp_path):
+        d = str(tmp_path / "LdaModel_EN_1000")
+        _model().save(d)
+        with open(os.path.join(d, "meta.json"), "w") as f:
+            f.write("{not json")
+        finalize_artifact_dir(d)        # reseal so checksums agree
+        with pytest.raises(CorruptArtifactError) as ei:
+            load_model(d)
+        assert d in str(ei.value)
+
+    def test_train_state_failure_modes_are_typed(self, tmp_path):
+        p = str(tmp_path / "state.npz")
+        with pytest.raises(CorruptArtifactError, match="does not exist"):
+            load_train_state(p)
+        save_train_state(p, 5, lam=np.ones((2, 3)))
+        assert train_state_valid(p)
+        assert load_train_state(p)["step"] == 5
+        with pytest.raises(CorruptArtifactError, match="missing required"):
+            load_train_state(p, require=("no_such_key",))
+        with open(p, "r+b") as f:
+            f.truncate(24)              # torn write that survived
+        assert not train_state_valid(p)
+        with pytest.raises(CorruptArtifactError) as ei:
+            load_train_state(p)
+        assert p in str(ei.value)
+
+    def test_checkpoint_write_fault_absorbed(self, tmp_path):
+        """A transient I/O error mid-checkpoint is retried away; the
+        final state file is intact (acceptance: no change in output)."""
+        telemetry.configure(None)
+        faultinject.configure("ckpt.write:fail@1")
+        p = str(tmp_path / "state.npz")
+        save_train_state(p, 7, lam=np.ones((2, 3)))
+        st = load_train_state(p, require=("lam",))
+        assert st["step"] == 7
+        snap = telemetry.get_registry().snapshot()
+        assert snap["counters"][RETRIES_COUNTER] >= 1
+
+
+class TestLatestModelDir:
+    def test_prefers_newest_committed(self, tmp_path):
+        base = str(tmp_path)
+        _model().save(os.path.join(base, "LdaModel_EN_100"))
+        _model().save(os.path.join(base, "LdaModel_EN_300"))
+        # newest is a crashed save: payload, no COMMIT
+        newest = os.path.join(base, "LdaModel_EN_900")
+        _model().save(newest)
+        os.remove(os.path.join(newest, "COMMIT"))
+        got = latest_model_dir(base, "EN")
+        assert got.endswith("LdaModel_EN_300")
+
+    def test_junk_suffixes_not_ranked(self, tmp_path):
+        base = str(tmp_path)
+        os.makedirs(os.path.join(base, "LdaModel_EN_backup"))
+        assert latest_model_dir(base, "EN") is None
+
+    def test_skip_emits_telemetry(self, tmp_path):
+        telemetry.configure(None)
+        base = str(tmp_path)
+        partial = os.path.join(base, "LdaModel_EN_500")
+        os.makedirs(partial)
+        with open(os.path.join(partial, "meta.json"), "w") as f:
+            f.write("{}")
+        assert latest_model_dir(base, "EN") is None
+        snap = telemetry.get_registry().snapshot()
+        assert snap["counters"]["resilience.artifacts_skipped"] == 1
+
+
+# ---------------------------------------------------------------------------
+# Quarantine
+# ---------------------------------------------------------------------------
+class TestQuarantine:
+    def test_put_writes_payload_and_sidecar(self, tmp_path):
+        q = Quarantine(str(tmp_path / "dlq"))
+        p = q.put(
+            "weird/../doc name.txt", "the text", ValueError("boom"),
+            stage="vectorize", batch_id=3,
+        )
+        assert p and os.path.exists(p)
+        with open(p) as f:
+            assert f.read() == "the text"
+        with open(p.replace(".txt", ".txt.error.json")
+                  if p.endswith(".txt.error.json") else
+                  p[: -len(".txt")] + ".error.json") as f:
+            side = json.load(f)
+        assert side["stage"] == "vectorize" and side["batch_id"] == 3
+        assert "boom" in side["error"]
+
+    def test_none_dir_counts_but_drops(self):
+        telemetry.configure(None)
+        q = Quarantine(None)
+        assert q.put("d", "t", RuntimeError("x"), stage="score") is None
+        assert q.count == 1
+        snap = telemetry.get_registry().snapshot()
+        assert snap["counters"]["resilience.quarantined"] == 1
+
+    def test_streaming_scorer_survives_poison_doc(self, tmp_path):
+        """One malformed document must not kill the scoring stream: the
+        poison doc lands in the dead-letter dir, the rest score."""
+        from spark_text_clustering_tpu.streaming import (
+            MicroBatch, StreamingScorer,
+        )
+
+        telemetry.configure(None)
+        dlq = str(tmp_path / "dlq")
+        scorer = StreamingScorer(
+            _model(v=8), lemmatize=False, quarantine_dir=dlq,
+        )
+
+        # per-doc vectorize failure on one specific text
+        real_rows_for = scorer._rows_for
+
+        def poisoned(tokens):
+            for t in tokens:
+                if any("poison" in w for w in t):
+                    raise ValueError("malformed document")
+            return real_rows_for(tokens)
+
+        scorer._rows_for = poisoned
+        out = scorer.process(MicroBatch(
+            0,
+            ["a.txt", "bad.txt", "c.txt"],
+            ["term0 term1 term2", "poison", "term3 term4 term5"],
+        ))
+        assert [d.name for d in out] == ["a.txt", "c.txt"]
+        assert scorer.quarantine.count == 1
+        (payload,) = [
+            f for f in os.listdir(dlq) if f.endswith(".txt")
+        ]
+        assert "bad.txt" in payload
+
+
+# ---------------------------------------------------------------------------
+# Resume compatibility gate
+# ---------------------------------------------------------------------------
+class TestResumeGate:
+    def _params(self, **kw):
+        from spark_text_clustering_tpu.config import Params
+
+        base = dict(input="x", k=4, max_iterations=10, seed=0)
+        base.update(kw)
+        return Params(**base)
+
+    def test_config_hash_ignores_run_length(self):
+        a = self._params(max_iterations=10, input="dir_a")
+        b = self._params(max_iterations=99, input="dir_b")
+        c = self._params(k=5)
+        assert config_hash(a) == config_hash(b)
+        assert config_hash(a) != config_hash(c)
+
+    def test_meta_roundtrip_and_mismatch(self, tmp_path):
+        d = str(tmp_path)
+        vocab = ["alpha", "beta", "gamma"]
+        fp = vocab_fingerprint(vocab)
+        write_resume_meta(d, self._params(), fp)
+        # compatible run: validates clean
+        meta = validate_resume_meta(d, self._params(max_iterations=50), fp)
+        assert meta["config_hash"] == config_hash(self._params())
+        # structural change: typed mismatch
+        with pytest.raises(ResumeMismatchError, match="config"):
+            validate_resume_meta(d, self._params(k=9), fp)
+        # same-size different vocab: typed mismatch
+        with pytest.raises(ResumeMismatchError, match="vocabulary"):
+            validate_resume_meta(
+                d, self._params(), vocab_fingerprint(["x", "y", "z"])
+            )
+
+    def test_no_meta_is_not_an_error(self, tmp_path):
+        assert validate_resume_meta(str(tmp_path), self._params()) is None
+
+
+# ---------------------------------------------------------------------------
+# Streaming: poll retry + crash-window (at-least-once) semantics
+# ---------------------------------------------------------------------------
+class TestStreamingResilience:
+    def test_poll_absorbs_transient_fault(self, tmp_path):
+        from spark_text_clustering_tpu.streaming import FileStreamSource
+
+        telemetry.configure(None)
+        (tmp_path / "a.txt").write_text("hello world")
+        faultinject.configure("stream.poll:fail@1")
+        src = FileStreamSource(str(tmp_path))
+        mb = src.poll()
+        assert mb is not None and len(mb) == 1
+        snap = telemetry.get_registry().snapshot()
+        assert snap["counters"][RETRIES_COUNTER] >= 1
+
+    def test_poll_giveup_yields_empty_trigger_not_crash(self, tmp_path):
+        from spark_text_clustering_tpu.streaming import FileStreamSource
+
+        telemetry.configure(None)
+        (tmp_path / "a.txt").write_text("hello world")
+        faultinject.configure("stream.poll:ioerror@1.0")
+        src = FileStreamSource(str(tmp_path))
+        assert src.poll() is None       # survived; next trigger retries
+        snap = telemetry.get_registry().snapshot()
+        assert snap["counters"][GIVEUPS_COUNTER] == 1
+        faultinject.reset()
+        assert len(src.poll()) == 1     # source recovered with the disk
+
+    def test_crash_window_bounded_to_one_checkpoint_interval(self, tmp_path):
+        """Documents streaming.py's at-least-once claim: the trainer
+        commits source progress after each model checkpoint, so a crash
+        in the checkpoint→commit window (or anywhere since the last
+        commit) re-emits at most one checkpoint interval of files."""
+        from spark_text_clustering_tpu.streaming import FileStreamSource
+
+        watch = tmp_path / "incoming"
+        watch.mkdir()
+        for i in range(6):
+            (watch / f"doc{i:02d}.txt").write_text(f"text {i}")
+        state = str(tmp_path / "seen_files.txt")
+        ckpt_every = 2                  # batches per checkpoint
+        src = FileStreamSource(
+            str(watch), max_files_per_trigger=1, state_path=state,
+        )
+        consumed = []
+        for batch_no in range(1, 6):    # 5 of the 6 files
+            mb = src.poll()
+            consumed.extend(mb.names)
+            if batch_no % ckpt_every == 0:
+                # model checkpoint would land here, then the commit; the
+                # crash happens AFTER the last checkpoint, BEFORE commit
+                if batch_no < 4:
+                    src.commit()
+        # process dies here: batches 3,4 checkpointed-but... batch 4's
+        # commit never ran, batch 5 neither — 3 files uncommitted? No:
+        # commits ran after batch 2 only ⇒ batches 3..5 replay.  Bound
+        # the window the way the trainer does: commit after batch 4 ran
+        # the checkpoint but crashed pre-commit ⇒ replay = batches 5 plus
+        # the checkpoint interval 3..4.
+        src2 = FileStreamSource(
+            str(watch), max_files_per_trigger=10, state_path=state,
+        )
+        replayed = src2.poll().names
+        # at-least-once: everything consumed-but-uncommitted re-emits,
+        # nothing committed does, and nothing is LOST
+        committed = consumed[: 2]
+        uncommitted = consumed[2:]
+        assert [os.path.basename(p) for p in replayed] == sorted(
+            [os.path.basename(p) for p in uncommitted] + ["doc05.txt"]
+        )
+        assert not set(replayed) & set(committed)
+        # the replay window is bounded: ≤ (uncommitted batches since the
+        # last commit) ≤ one checkpoint interval + in-flight trigger
+        assert len(set(replayed) & set(consumed)) <= ckpt_every + 1
+
+
+# ---------------------------------------------------------------------------
+# Subprocess chaos drill: kill at an injected kill-point, resume, compare
+# ---------------------------------------------------------------------------
+def _run_cli(args, tmp, faults=None, seed=0):
+    env = dict(os.environ)
+    env.pop(faultinject.ENV_SPEC, None)
+    if faults:
+        env[faultinject.ENV_SPEC] = faults
+        env[faultinject.ENV_SEED] = str(seed)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    return subprocess.run(
+        [sys.executable, "-m", "spark_text_clustering_tpu.cli", *args],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=300,
+    )
+
+
+class TestChaosKillResume:
+    def _corpus(self, tmp_path):
+        books = tmp_path / "books"
+        books.mkdir()
+        rng = np.random.default_rng(0)
+        words_a = [f"apple{i}" for i in range(12)]
+        words_b = [f"stone{i}" for i in range(12)]
+        for d in range(10):
+            pool = words_a if d % 2 == 0 else words_b
+            text = " ".join(rng.choice(pool, size=40))
+            (books / f"doc{d}.txt").write_text(text)
+        return str(books)
+
+    def _train_args(self, books, models, ckpt, resume=False):
+        return [
+            "train", "--books", books, "--models-dir", models,
+            "--algorithm", "online", "--k", "2", "--max-iterations", "6",
+            "--checkpoint-dir", ckpt, "--checkpoint-interval", "2",
+            "--seed", "3", "--no-lemmatize", "--vocab-size", "64",
+            *(["--resume"] if resume else []),
+        ]
+
+    def test_kill_resume_matches_uninterrupted(self, tmp_path):
+        books = self._corpus(tmp_path)
+
+        # run A: uninterrupted reference
+        models_a = str(tmp_path / "models_a")
+        ra = _run_cli(
+            self._train_args(books, models_a, str(tmp_path / "ckpt_a")),
+            tmp_path,
+        )
+        assert ra.returncode == 0, ra.stderr[-2000:]
+        lam_a = load_model(latest_model_dir(models_a, "EN")).lam
+
+        # run B: SIGKILL-equivalent at the 2nd checkpoint write
+        models_b = str(tmp_path / "models_b")
+        ckpt_b = str(tmp_path / "ckpt_b")
+        rb = _run_cli(
+            self._train_args(books, models_b, ckpt_b),
+            tmp_path, faults="ckpt.write:kill@2",
+        )
+        assert rb.returncode == 137, (rb.returncode, rb.stderr[-2000:])
+        # the crash left NO committed model, but a valid first checkpoint
+        assert latest_model_dir(models_b, "EN") is None
+        state = os.path.join(ckpt_b, "train_state.npz")
+        assert train_state_valid(state)
+        assert load_train_state(state)["step"] == 2
+
+        # run B resumed: same flags + --resume
+        rb2 = _run_cli(
+            self._train_args(books, models_b, ckpt_b, resume=True),
+            tmp_path,
+        )
+        assert rb2.returncode == 0, rb2.stderr[-2000:]
+        assert "resuming from checkpoint" in rb2.stdout
+        lam_b = load_model(latest_model_dir(models_b, "EN")).lam
+
+        # killed + resumed ≡ uninterrupted (seed-derived batch streams
+        # re-derive from (seed, iteration), so the runs are bit-stable
+        # up to float reduction order)
+        np.testing.assert_allclose(lam_a, lam_b, rtol=1e-5, atol=1e-5)
+
+    def test_resume_refuses_incompatible_config(self, tmp_path):
+        books = self._corpus(tmp_path)
+        models = str(tmp_path / "models")
+        ckpt = str(tmp_path / "ckpt")
+        r1 = _run_cli(self._train_args(books, models, ckpt), tmp_path)
+        assert r1.returncode == 0, r1.stderr[-2000:]
+        args = self._train_args(books, models, ckpt, resume=True)
+        args[args.index("--k") + 1] = "3"       # structural change
+        r2 = _run_cli(args, tmp_path)
+        assert r2.returncode == 2
+        assert "cannot resume" in r2.stderr
+
+    def test_kill_mid_artifact_save_leaves_no_committed_model(
+        self, tmp_path
+    ):
+        """Crash between the payload files of the final model save: the
+        dir must be visibly uncommitted and never selected."""
+        books = self._corpus(tmp_path)
+        models = str(tmp_path / "models")
+        r = _run_cli(
+            self._train_args(books, models, str(tmp_path / "ckpt")),
+            tmp_path, faults="artifact.file:kill@1",
+        )
+        assert r.returncode == 137
+        (d,) = os.listdir(models)
+        assert artifact_status(os.path.join(models, d)) == "uncommitted"
+        assert latest_model_dir(models, "EN") is None
+
+
+class TestScoreCorruptArtifact:
+    def test_score_fails_typed_never_partial_report(self, tmp_path):
+        """Acceptance: scoring a truncated artifact exits non-zero with
+        CorruptArtifactError on stderr and writes NO report."""
+        from spark_text_clustering_tpu.cli import main
+
+        d = str(tmp_path / "models" / "LdaModel_EN_1000")
+        m = _model(v=8)
+        m.save(d)
+        with open(os.path.join(d, "arrays.npz"), "r+b") as f:
+            f.truncate(16)
+        books = tmp_path / "books"
+        books.mkdir()
+        (books / "a.txt").write_text("term0 term1 term2")
+        out = str(tmp_path / "out")
+        rc = main([
+            "score", "--books", str(books), "--model", d,
+            "--output-dir", out, "--no-lemmatize",
+        ])
+        assert rc == 2
+        assert not os.path.exists(out) or not os.listdir(out)
