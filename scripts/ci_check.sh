@@ -2,18 +2,26 @@
 # CI gate (ROADMAP "CI wiring"): every check here FAILS the build via
 # exit code instead of merely being recorded.
 #
-#   1. tier-1 test suite (CPU, 8 virtual devices)
-#   2. disabled-mode telemetry overhead budget (<2%)
-#   3. metrics regression gate: a tiny deterministic training run's
+#   1. stc lint — project-native static analysis (AST invariant rules +
+#      jaxpr purity/dtype audit of every registered jitted entry point;
+#      docs/STATIC_ANALYSIS.md); exits non-zero on any unwaived finding
+#   2. ruff — generic-Python tier (unused imports, logging f-strings,
+#      mutable defaults; config in pyproject.toml); SKIPPED when no
+#      ruff binary exists (hermetic containers): the native STC101/102/
+#      006 rules in stage 1 mirror the same selection
+#   3. tier-1 test suite (CPU, 8 virtual devices)
+#   4. disabled-mode telemetry overhead budget (<2%)
+#   5. metrics regression gate: a tiny deterministic training run's
 #      telemetry checked against the committed tolerance baseline
 #      (scripts/records/ci_metrics_baseline.json) — counter drift
 #      (iterations, events, retries, quarantines) gates; wall-time
 #      metrics are excluded (machine-dependent)
 #
 # Usage:
-#   scripts/ci_check.sh                 # run all three gates
-#   scripts/ci_check.sh --rebaseline    # recapture the metrics baseline
-#                                       # (commit the result deliberately)
+#   scripts/ci_check.sh                 # run all five gates
+#   scripts/ci_check.sh --rebaseline    # recapture BOTH baselines
+#                                       # (metrics + lint waivers;
+#                                       # commit the result deliberately)
 set -uo pipefail
 cd "$(dirname "$0")/.."
 
@@ -54,6 +62,7 @@ EOF
 }
 
 if [[ "${1:-}" == "--rebaseline" ]]; then
+    python -m spark_text_clustering_tpu.cli lint --rebaseline || exit 1
     work=$(mktemp -d)
     trap 'rm -rf "$work"' EXIT
     run_ci_train "$work" || exit 1
@@ -65,17 +74,29 @@ fi
 
 fail=0
 
-echo "== [1/3] tier-1 tests =="
+echo "== [1/5] stc lint (AST rules + jaxpr audit) =="
+python -m spark_text_clustering_tpu.cli lint
+if [[ $? -ne 0 ]]; then echo "FAIL: stc lint"; fail=1; fi
+
+echo "== [2/5] ruff (generic-Python tier) =="
+if command -v ruff >/dev/null 2>&1; then
+    ruff check spark_text_clustering_tpu
+    if [[ $? -ne 0 ]]; then echo "FAIL: ruff"; fail=1; fi
+else
+    echo "ruff not installed — skipped (stc lint STC101/102/006 cover it)"
+fi
+
+echo "== [3/5] tier-1 tests =="
 timeout -k 10 870 python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors -p no:cacheprovider \
     -p no:xdist -p no:randomly
 if [[ $? -ne 0 ]]; then echo "FAIL: tier-1"; fail=1; fi
 
-echo "== [2/3] telemetry overhead budget =="
+echo "== [4/5] telemetry overhead budget =="
 python scripts/check_telemetry_overhead.py
 if [[ $? -ne 0 ]]; then echo "FAIL: telemetry overhead"; fail=1; fi
 
-echo "== [3/3] metrics regression gate =="
+echo "== [5/5] metrics regression gate =="
 work=$(mktemp -d)
 trap 'rm -rf "$work"' EXIT
 if run_ci_train "$work"; then

@@ -1,0 +1,34 @@
+"""Project-native static analysis (``stc lint``).
+
+Two layers guard the conventions the telemetry (PR 1) and resilience
+(PR 2) subsystems introduced, plus the jit-compilation discipline the
+TPU hot paths depend on:
+
+  * **AST invariant checkers** (``ast_rules``) — named STC0xx/STC1xx
+    rules over the package source: sleep routing, exception taxonomy,
+    fault-site and metric-name registries, host-sync freedom of
+    jit-reachable code, persistence determinism, and a generic-Python
+    tier (unused imports, logging f-strings) that mirrors the ruff
+    config in ``pyproject.toml`` for containers without ruff.
+  * **jaxpr audit** (``jaxpr_audit`` + ``entrypoints``) — every
+    registered jitted entry point traced at representative shapes and
+    checked for float64/weak-type leaks, host-callback primitives,
+    oversized closure constants, and (multichip entries) sharding
+    annotations.
+
+Waivers: inline ``# stc-lint: disable=RULE -- reason`` pragmas or the
+committed ``scripts/records/lint_baseline.json`` allowlist; both require
+a reason string.  CI gates on a clean run (``scripts/ci_check.sh``).
+Rule catalog and registration guides: docs/STATIC_ANALYSIS.md.
+"""
+
+from .findings import Baseline, Finding, apply_waivers
+from .cli import add_lint_subparser, run_lint
+
+__all__ = [
+    "Finding",
+    "Baseline",
+    "apply_waivers",
+    "run_lint",
+    "add_lint_subparser",
+]

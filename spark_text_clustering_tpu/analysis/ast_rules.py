@@ -1,0 +1,705 @@
+"""Layer 1: AST invariant checkers over the package source.
+
+Project-native rules (the conventions PRs 1-2 introduced, enforced
+mechanically so later PRs cannot erode them silently):
+
+  STC001  no raw ``time.sleep`` outside ``resilience/retry.py`` — every
+          wall-clock wait routes through the injectable ``retry.sleep``
+          so chaos tests can drive a simulated clock.
+  STC002  no bare/broad ``except`` that swallows the error: the handler
+          must re-raise, reference the bound exception (re-wrap it,
+          quarantine it, surface it), or carry a waiver.
+  STC003  fault-injection site strings <-> ``faultinject.SITES``
+          registry, both directions.
+  STC004  telemetry metric names: literal, dotted snake.case, declared
+          once in ``telemetry/names.py`` (dynamic families must match a
+          declared prefix), both directions.
+  STC005  no host syncs (``block_until_ready``/``.item()``/
+          ``np.asarray``/``jax.device_get``/``float(arg)``) inside
+          functions reachable from jit-decorated steps.
+  STC006  no mutable default arguments; persistence-layer
+          ``json.dump(s)`` must pass ``sort_keys=True`` (manifest bytes
+          must not depend on dict build order).
+
+Generic-Python tier (the ruff-equivalent checks, native so the gate
+works in hermetic containers without ruff installed):
+
+  STC101  unused module-level imports (``# noqa`` on the import line is
+          honored — the repo already marks side-effect imports that way).
+  STC102  f-string passed straight to a logging call (defeats lazy
+          formatting).
+
+The engine parses every module once, runs all rules over the shared
+index, and applies inline-pragma waivers at construction time (the
+baseline is applied later by ``findings.apply_waivers``).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .findings import Finding, pragma_disables
+
+__all__ = ["LintIndex", "run_ast_rules", "AST_RULES"]
+
+PACKAGE = "spark_text_clustering_tpu"
+
+AST_RULES = (
+    "STC001", "STC002", "STC003", "STC004", "STC005", "STC006",
+    "STC101", "STC102",
+)
+
+# rule-specific scoping -----------------------------------------------------
+SLEEP_OWNER = f"{PACKAGE}/resilience/retry.py"
+# the telemetry package owns the facade's dynamic name families and the
+# registry internals — STC004 checks its CALLERS, not the facade itself
+METRIC_EXEMPT_DIR = f"{PACKAGE}/telemetry"
+PERSISTENCE_FILES = {
+    f"{PACKAGE}/models/persistence.py",
+    f"{PACKAGE}/resilience/integrity.py",
+    f"{PACKAGE}/resilience/resume.py",
+}
+# Spark-compat export writes key order the REFERENCE format dictates
+SORTKEYS_EXEMPT = {f"{PACKAGE}/models/reference_export.py"}
+
+_HOST_SYNC_ATTRS = {"block_until_ready", "item"}
+_NP_SYNC_FUNCS = {"asarray", "array", "asanyarray", "frombuffer"}
+_LOG_METHODS = {
+    "debug", "info", "warning", "warn", "error", "exception", "critical",
+}
+
+
+@dataclass
+class ModuleInfo:
+    relpath: str                 # repo-relative posix path
+    tree: ast.Module
+    lines: List[str]
+
+
+@dataclass
+class LintIndex:
+    """Parsed package + cheap cross-module lookup tables."""
+
+    root: str
+    modules: Dict[str, ModuleInfo] = field(default_factory=dict)
+
+    # ---- construction --------------------------------------------------
+    @classmethod
+    def build(cls, root: str, rel_package: str = PACKAGE) -> "LintIndex":
+        idx = cls(root=root)
+        pkg_dir = os.path.join(root, rel_package)
+        for dirpath, dirnames, filenames in os.walk(pkg_dir):
+            dirnames[:] = [
+                d for d in dirnames
+                if d not in ("__pycache__", ".git")
+            ]
+            for name in sorted(filenames):
+                if not name.endswith(".py"):
+                    continue
+                path = os.path.join(dirpath, name)
+                rel = os.path.relpath(path, root).replace(os.sep, "/")
+                with open(path, "r", encoding="utf-8") as f:
+                    src = f.read()
+                idx.modules[rel] = ModuleInfo(
+                    relpath=rel,
+                    tree=ast.parse(src, filename=rel),
+                    lines=src.splitlines(),
+                )
+        return idx
+
+    # ---- helpers -------------------------------------------------------
+    def line(self, rel: str, lineno: int) -> str:
+        lines = self.modules[rel].lines
+        return lines[lineno - 1] if 0 < lineno <= len(lines) else ""
+
+    def finding(
+        self, rule: str, rel: str, lineno: int, message: str
+    ) -> Finding:
+        snippet = self.line(rel, lineno) if lineno else ""
+        f = Finding(
+            rule=rule, path=rel, line=lineno, message=message,
+            snippet=snippet,
+        )
+        pragma = pragma_disables(snippet) if snippet else None
+        if pragma is not None and rule in pragma[0]:
+            f.waived = True
+            f.waived_by = "pragma"
+            f.reason = pragma[1]
+        # noqa compatibility: the repo predates stc-lint and marks
+        # intentional side-effect imports with ``# noqa`` — honor it for
+        # the unused-import rule only
+        if rule == "STC101" and "# noqa" in snippet:
+            f.waived = True
+            f.waived_by = "pragma"
+            f.reason = "noqa-marked import (side-effect / re-export)"
+        return f
+
+
+def _call_name(func: ast.AST) -> Tuple[Optional[str], Optional[str]]:
+    """(base, attr) for ``base.attr(...)`` calls, (None, name) for bare
+    ``name(...)`` calls, (None, None) otherwise."""
+    if isinstance(func, ast.Attribute) and isinstance(func.value, ast.Name):
+        return func.value.id, func.attr
+    if isinstance(func, ast.Name):
+        return None, func.id
+    return None, None
+
+
+def _const_str(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+# ---------------------------------------------------------------------------
+# STC001 — raw sleeps
+# ---------------------------------------------------------------------------
+def _check_sleep(idx: LintIndex) -> List[Finding]:
+    out = []
+    for rel, mod in idx.modules.items():
+        if rel == SLEEP_OWNER:
+            continue
+        # did this module do ``from time import sleep``?
+        bare_sleep_is_time = False
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.ImportFrom) and node.module == "time":
+                for a in node.names:
+                    if a.name == "sleep":
+                        bare_sleep_is_time = True
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            base, attr = _call_name(node.func)
+            hit = (base == "time" and attr == "sleep") or (
+                base is None and attr == "sleep" and bare_sleep_is_time
+            )
+            if hit:
+                out.append(idx.finding(
+                    "STC001", rel, node.lineno,
+                    "raw time.sleep — route delays through "
+                    "resilience.retry.sleep / RetryPolicy so chaos "
+                    "tests control the clock",
+                ))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# STC002 — broad excepts that swallow
+# ---------------------------------------------------------------------------
+def _is_broad(handler_type: Optional[ast.AST]) -> bool:
+    if handler_type is None:
+        return True
+    names = []
+    if isinstance(handler_type, ast.Tuple):
+        names = [
+            e.id for e in handler_type.elts if isinstance(e, ast.Name)
+        ]
+    elif isinstance(handler_type, ast.Name):
+        names = [handler_type.id]
+    return any(n in ("Exception", "BaseException") for n in names)
+
+
+def _check_excepts(idx: LintIndex) -> List[Finding]:
+    out = []
+    for rel, mod in idx.modules.items():
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if not _is_broad(node.type):
+                continue
+            # compliant when the handler re-raises or actually USES the
+            # caught exception (wraps it into the typed taxonomy,
+            # quarantines it with the error attached, surfaces it)
+            reraises = any(
+                isinstance(n, ast.Raise) for n in ast.walk(node)
+            )
+            uses_exc = node.name is not None and any(
+                isinstance(n, ast.Name) and n.id == node.name
+                for child in node.body for n in ast.walk(child)
+            )
+            if reraises or uses_exc:
+                continue
+            out.append(idx.finding(
+                "STC002", rel, node.lineno,
+                "broad except swallows the error — narrow the type, "
+                "re-wrap it in the resilience.errors taxonomy, or waive "
+                "a genuine last-resort guard",
+            ))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# STC003 — fault-injection site registry, both directions
+# ---------------------------------------------------------------------------
+def _check_fault_sites(idx: LintIndex) -> List[Finding]:
+    from ..resilience.faultinject import SITES
+
+    out: List[Finding] = []
+    used: Set[str] = set()
+    for rel, mod in idx.modules.items():
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            base, attr = _call_name(node.func)
+            if base != "faultinject" or attr not in ("check", "corrupt"):
+                continue
+            if not node.args:
+                continue
+            site = _const_str(node.args[0])
+            if site is None:
+                out.append(idx.finding(
+                    "STC003", rel, node.lineno,
+                    "fault site must be a string literal (a computed "
+                    "site can silently never match an armed plan)",
+                ))
+                continue
+            used.add(site)
+            if site not in SITES:
+                out.append(idx.finding(
+                    "STC003", rel, node.lineno,
+                    f"fault site {site!r} is not registered in "
+                    f"resilience.faultinject.SITES — register it in the "
+                    f"same commit",
+                ))
+    registry_rel = f"{PACKAGE}/resilience/faultinject.py"
+    for site in sorted(SITES - used):
+        out.append(idx.finding(
+            "STC003", registry_rel, 0,
+            f"registered fault site {site!r} has no check()/corrupt() "
+            f"call site left in the package — stale chaos coverage",
+        ))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# STC004 — telemetry metric names, both directions
+# ---------------------------------------------------------------------------
+def _module_str_consts(mod: ModuleInfo) -> Dict[str, str]:
+    """Module-level ``NAME = "literal"`` assignments."""
+    consts: Dict[str, str] = {}
+    for node in mod.tree.body:
+        if (
+            isinstance(node, ast.Assign)
+            and len(node.targets) == 1
+            and isinstance(node.targets[0], ast.Name)
+        ):
+            v = _const_str(node.value)
+            if v is not None:
+                consts[node.targets[0].id] = v
+    return consts
+
+
+def _check_metric_names(idx: LintIndex) -> List[Finding]:
+    from ..telemetry import names as metric_names
+
+    out: List[Finding] = []
+    used: Set[str] = set()
+    for rel, mod in idx.modules.items():
+        if rel.startswith(METRIC_EXEMPT_DIR + "/"):
+            continue
+        consts = _module_str_consts(mod)
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            base, attr = _call_name(node.func)
+            if base != "telemetry" or attr not in (
+                "count", "gauge", "observe",
+            ):
+                continue
+            if not node.args:
+                continue
+            arg = node.args[0]
+            name = _const_str(arg)
+            if name is None and isinstance(arg, ast.Name):
+                name = consts.get(arg.id)
+            if name is not None:
+                used.add(name)
+                if not metric_names.is_valid_name(name):
+                    out.append(idx.finding(
+                        "STC004", rel, node.lineno,
+                        f"metric name {name!r} is not dotted snake.case",
+                    ))
+                elif not metric_names.declared(name):
+                    out.append(idx.finding(
+                        "STC004", rel, node.lineno,
+                        f"metric name {name!r} is not declared in "
+                        f"telemetry/names.py — declare it once there",
+                    ))
+                continue
+            if isinstance(arg, ast.JoinedStr):
+                lead = ""
+                if arg.values and isinstance(arg.values[0], ast.Constant):
+                    lead = str(arg.values[0].value)
+                prefix = next(
+                    (
+                        p for p in metric_names.PREFIXES
+                        if lead.startswith(p)
+                    ),
+                    None,
+                )
+                if prefix is None:
+                    out.append(idx.finding(
+                        "STC004", rel, node.lineno,
+                        f"dynamic metric name (leading text {lead!r}) "
+                        f"matches no declared prefix family in "
+                        f"telemetry/names.py",
+                    ))
+                continue
+            out.append(idx.finding(
+                "STC004", rel, node.lineno,
+                "metric name is neither a literal nor a module-level "
+                "string constant — STC004 cannot verify it",
+            ))
+    # reverse: every declared literal must still appear SOMEWHERE in the
+    # package (any string constant — covers facade-internal constants in
+    # the exempt telemetry dir too)
+    names_rel = f"{PACKAGE}/telemetry/names.py"
+    all_strs: Set[str] = set()
+    for rel, mod in idx.modules.items():
+        if rel == names_rel:
+            continue  # the declarations themselves don't count as use
+        for node in ast.walk(mod.tree):
+            s = _const_str(node)
+            if s is not None:
+                all_strs.add(s)
+    for name in sorted(set(metric_names.METRICS) - all_strs - used):
+        out.append(idx.finding(
+            "STC004", names_rel, 0,
+            f"declared metric {name!r} is no longer written anywhere — "
+            f"remove the declaration or restore the instrumentation",
+        ))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# STC005 — host syncs reachable from jitted steps
+# ---------------------------------------------------------------------------
+@dataclass
+class _FnEntry:
+    rel: str
+    node: ast.AST          # FunctionDef / AsyncFunctionDef
+    params: Set[str]
+
+
+def _collect_functions(mod: ModuleInfo) -> Dict[str, _FnEntry]:
+    fns: Dict[str, _FnEntry] = {}
+    for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            args = node.args
+            params = {
+                a.arg
+                for a in (
+                    args.posonlyargs + args.args + args.kwonlyargs
+                )
+            }
+            fns.setdefault(node.name, _FnEntry(mod.relpath, node, params))
+    return fns
+
+
+def _unwrap_jit_target(value: ast.AST) -> Optional[str]:
+    """``jax.jit(X)`` / ``jax.shard_map(X, ...)`` / ``partial(X, ...)``
+    -> the simple name of X (one level of Name indirection is resolved
+    by the caller)."""
+    if not isinstance(value, ast.Call):
+        return None
+    base, attr = _call_name(value.func)
+    wrapper = attr if base in ("jax", "functools", None) else None
+    if wrapper not in ("jit", "shard_map", "partial", "pjit"):
+        return None
+    if not value.args:
+        return None
+    first = value.args[0]
+    if isinstance(first, ast.Name):
+        return first.id
+    return _unwrap_jit_target(first)
+
+
+def _is_jit_decorator(dec: ast.AST) -> bool:
+    # @jax.jit / @partial(jax.jit, ...) / @functools.partial(jax.jit, ..)
+    base, attr = _call_name(dec) if not isinstance(dec, ast.Call) else (
+        _call_name(dec.func)
+    )
+    if attr in ("jit", "pjit") and base in ("jax", None):
+        return True
+    if isinstance(dec, ast.Call) and attr == "partial":
+        return bool(dec.args) and _is_jit_decorator(dec.args[0])
+    return False
+
+
+def _check_host_syncs(idx: LintIndex) -> List[Finding]:
+    out: List[Finding] = []
+    # package-wide function table keyed (module, simple name)
+    fn_tables = {
+        rel: _collect_functions(mod) for rel, mod in idx.modules.items()
+    }
+    # per-module import map: local name -> (target module rel, orig name)
+    import_maps: Dict[str, Dict[str, Tuple[str, str]]] = {}
+    for rel, mod in idx.modules.items():
+        imap: Dict[str, Tuple[str, str]] = {}
+        pkg_parts = rel.split("/")[:-1]  # dirs of this module
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.ImportFrom) or not node.level:
+                continue
+            base_parts = pkg_parts[: len(pkg_parts) - (node.level - 1)]
+            target = "/".join(
+                base_parts + (node.module or "").split(".")
+            ) + ".py"
+            if target not in idx.modules:
+                continue
+            for a in node.names:
+                imap[a.asname or a.name] = (target, a.name)
+        import_maps[rel] = imap
+
+    # roots: decorated jitted fns + fns wrapped via jax.jit(...) chains
+    roots: List[Tuple[str, str]] = []
+    for rel, mod in idx.modules.items():
+        assigns: Dict[str, ast.AST] = {}
+        for node in ast.walk(mod.tree):
+            if (
+                isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+            ):
+                assigns[node.targets[0].id] = node.value
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if any(
+                    _is_jit_decorator(d) for d in node.decorator_list
+                ):
+                    roots.append((rel, node.name))
+        # jax.jit(X) value expressions anywhere in the module
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            base, attr = _call_name(node.func)
+            if base == "jax" and attr in ("jit", "pjit") and node.args:
+                tgt = node.args[0]
+                seen = 0
+                while isinstance(tgt, ast.Name) and seen < 4:
+                    nxt = assigns.get(tgt.id)
+                    if nxt is None:
+                        break
+                    tgt = nxt
+                    seen += 1
+                name = None
+                if isinstance(tgt, ast.Name):
+                    name = tgt.id
+                else:
+                    name = _unwrap_jit_target(tgt)
+                    # shard_map(partial(F, ...)) resolves through args
+                if name and name in fn_tables[rel]:
+                    roots.append((rel, name))
+                # shard_map assigned then jitted: jax.jit(sharded) where
+                # sharded = jax.shard_map(_step, ...) — handled by the
+                # assignment-chase + _unwrap_jit_target above
+
+    # BFS reachability over same-module defs + package-relative imports
+    reached: Set[Tuple[str, str]] = set()
+    frontier = [r for r in roots if r[1] in fn_tables[r[0]]]
+    while frontier:
+        rel, name = frontier.pop()
+        if (rel, name) in reached:
+            continue
+        reached.add((rel, name))
+        entry = fn_tables[rel].get(name)
+        if entry is None:
+            continue
+        assigns: Dict[str, ast.AST] = {}
+        for node in ast.walk(entry.node):
+            if (
+                isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+            ):
+                assigns[node.targets[0].id] = node.value
+        for node in ast.walk(entry.node):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = None
+            if isinstance(node.func, ast.Name):
+                callee = node.func.id
+            if callee is None:
+                continue
+            # chase one local assignment (sharded = shard_map(_f, ...))
+            if callee not in fn_tables[rel] and callee in assigns:
+                callee = _unwrap_jit_target(assigns[callee]) or callee
+            if callee in fn_tables[rel]:
+                frontier.append((rel, callee))
+            elif callee in import_maps[rel]:
+                t_rel, t_name = import_maps[rel][callee]
+                if t_name in fn_tables.get(t_rel, {}):
+                    frontier.append((t_rel, t_name))
+
+    for rel, name in sorted(reached):
+        entry = fn_tables[rel][name]
+        for node in ast.walk(entry.node):
+            if not isinstance(node, ast.Call):
+                continue
+            base, attr = _call_name(node.func)
+            msg = None
+            if attr in _HOST_SYNC_ATTRS and isinstance(
+                node.func, ast.Attribute
+            ):
+                msg = f".{attr}() forces a host sync"
+            elif base in ("np", "numpy") and attr in _NP_SYNC_FUNCS:
+                msg = f"np.{attr} materializes on host"
+            elif base == "jax" and attr == "device_get":
+                msg = "jax.device_get forces a device->host transfer"
+            elif (
+                base is None
+                and attr in ("float", "int", "bool")
+                and len(node.args) == 1
+                and isinstance(node.args[0], ast.Name)
+                and node.args[0].id in entry.params
+            ):
+                msg = (
+                    f"{attr}() of a traced argument forces a host sync "
+                    f"(use jnp casts inside jit)"
+                )
+            if msg:
+                out.append(idx.finding(
+                    "STC005", rel, node.lineno,
+                    f"{msg} — {name} is reachable from a jitted step",
+                ))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# STC006 — mutable defaults + persistence key order
+# ---------------------------------------------------------------------------
+def _check_defaults_and_manifests(idx: LintIndex) -> List[Finding]:
+    out: List[Finding] = []
+    for rel, mod in idx.modules.items():
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                defaults = list(node.args.defaults) + [
+                    d for d in node.args.kw_defaults if d is not None
+                ]
+                for d in defaults:
+                    mutable = isinstance(
+                        d, (ast.List, ast.Dict, ast.Set)
+                    ) or (
+                        isinstance(d, ast.Call)
+                        and isinstance(d.func, ast.Name)
+                        and d.func.id in ("list", "dict", "set")
+                    )
+                    if mutable:
+                        out.append(idx.finding(
+                            "STC006", rel, d.lineno,
+                            f"mutable default argument in {node.name}() "
+                            f"— shared across calls; default to None",
+                        ))
+        if rel in PERSISTENCE_FILES:
+            for node in ast.walk(mod.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                base, attr = _call_name(node.func)
+                if base != "json" or attr not in ("dump", "dumps"):
+                    continue
+                sorted_kw = any(
+                    kw.arg == "sort_keys"
+                    and isinstance(kw.value, ast.Constant)
+                    and kw.value.value is True
+                    for kw in node.keywords
+                )
+                if not sorted_kw:
+                    out.append(idx.finding(
+                        "STC006", rel, node.lineno,
+                        "persistence-layer json write without "
+                        "sort_keys=True — manifest bytes would depend "
+                        "on dict build order",
+                    ))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# STC101 — unused imports
+# ---------------------------------------------------------------------------
+def _check_unused_imports(idx: LintIndex) -> List[Finding]:
+    out: List[Finding] = []
+    for rel, mod in idx.modules.items():
+        if rel.endswith("/__init__.py"):
+            continue  # re-export surface; __all__ governs
+        bindings: List[Tuple[str, int]] = []
+        for node in mod.tree.body:
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    local = (a.asname or a.name).split(".")[0]
+                    bindings.append((local, node.lineno))
+            elif isinstance(node, ast.ImportFrom):
+                if node.module == "__future__":
+                    continue
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    bindings.append((a.asname or a.name, node.lineno))
+        if not bindings:
+            continue
+        used: Set[str] = set()
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Name):
+                used.add(node.id)
+            elif isinstance(node, ast.Constant) and isinstance(
+                node.value, str
+            ):
+                used.add(node.value)  # __all__ entries and friends
+        for name, lineno in bindings:
+            if name not in used:
+                out.append(idx.finding(
+                    "STC101", rel, lineno,
+                    f"import {name!r} is unused",
+                ))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# STC102 — f-string into logging
+# ---------------------------------------------------------------------------
+def _check_fstring_logging(idx: LintIndex) -> List[Finding]:
+    out: List[Finding] = []
+    log_bases = {"logging", "logger", "log", "LOG", "LOGGER"}
+    for rel, mod in idx.modules.items():
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            base, attr = _call_name(node.func)
+            if attr not in _LOG_METHODS or base not in log_bases:
+                continue
+            if node.args and isinstance(node.args[0], ast.JoinedStr):
+                out.append(idx.finding(
+                    "STC102", rel, node.lineno,
+                    "f-string evaluated eagerly in a logging call — "
+                    "pass a %-format string and args instead",
+                ))
+    return out
+
+
+_CHECKS = (
+    _check_sleep,
+    _check_excepts,
+    _check_fault_sites,
+    _check_metric_names,
+    _check_host_syncs,
+    _check_defaults_and_manifests,
+    _check_unused_imports,
+    _check_fstring_logging,
+)
+
+
+def run_ast_rules(
+    root: str,
+    rules: Optional[Sequence[str]] = None,
+) -> List[Finding]:
+    """Run layer 1 over the package under ``root``; returns findings
+    with inline-pragma waivers already applied."""
+    idx = LintIndex.build(root)
+    out: List[Finding] = []
+    for check in _CHECKS:
+        out.extend(check(idx))
+    if rules:
+        keep = set(rules)
+        out = [f for f in out if f.rule in keep]
+    return out

@@ -1,0 +1,180 @@
+"""The ``stc lint`` CLI verb (wired as ``cli.py lint``).
+
+Usage::
+
+    python -m spark_text_clustering_tpu.cli lint                # both layers
+    python -m spark_text_clustering_tpu.cli lint --format json  # machine-readable
+    python -m spark_text_clustering_tpu.cli lint --no-jaxpr     # AST layer only
+    python -m spark_text_clustering_tpu.cli lint --rebaseline   # regenerate waivers
+
+Exit codes mirror ``metrics check``: 0 = clean (no unwaived findings),
+1 = findings, 2 = usage/config error.  Every run mirrors its outcome
+into the telemetry registry (``lint.findings`` / ``lint.waived``) and —
+with ``--telemetry-file`` — into a run stream the ``metrics`` verbs can
+diff, so analysis drift is observable the same way perf drift is.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+from typing import List, Optional
+
+from .findings import (
+    DEFAULT_BASELINE_PATH,
+    Baseline,
+    apply_waivers,
+    render_json,
+    render_text,
+)
+
+__all__ = ["add_lint_subparser", "cmd_lint", "run_lint"]
+
+
+def _repo_root() -> str:
+    # the package's parent directory — where scripts/ and the baseline
+    # live; lint is source-tree tooling, not an installed-dist feature
+    return os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+
+
+def run_lint(
+    root: Optional[str] = None,
+    *,
+    jaxpr: bool = True,
+    rules: Optional[List[str]] = None,
+    baseline_path: Optional[str] = None,
+):
+    """Run both layers; returns (findings, audited names, baseline).
+
+    Findings come back with pragma AND baseline waivers applied, plus
+    any STC000 meta-findings (reasonless/stale waivers).
+    """
+    from .ast_rules import run_ast_rules
+
+    root = root or _repo_root()
+    findings = run_ast_rules(root, rules=rules)
+    audited: List[str] = []
+    if jaxpr:
+        from .jaxpr_audit import run_jaxpr_audit
+
+        jf, audited = run_jaxpr_audit()
+        if rules:
+            keep = set(rules)
+            jf = [f for f in jf if f.rule in keep]
+        findings.extend(jf)
+    bl_path = baseline_path or os.path.join(root, DEFAULT_BASELINE_PATH)
+    baseline = Baseline.load(bl_path)
+    findings = apply_waivers(findings, baseline)
+    return findings, audited, baseline
+
+
+def cmd_lint(args: argparse.Namespace) -> int:
+    from .. import telemetry
+
+    own_telemetry = bool(getattr(args, "telemetry_file", None))
+    if own_telemetry:
+        telemetry.configure(args.telemetry_file)
+        telemetry.manifest(kind="lint")
+
+    root = _repo_root()
+    bl_path = args.baseline or os.path.join(root, DEFAULT_BASELINE_PATH)
+    rules = args.rules.split(",") if args.rules else None
+
+    findings, audited, baseline = run_lint(
+        root,
+        jaxpr=not args.no_jaxpr,
+        rules=rules,
+        baseline_path=bl_path,
+    )
+
+    if args.rebaseline:
+        # keep reasons for entries that still match; new findings get an
+        # explicit review-me reason (a waiver must NEVER be reasonless)
+        import datetime
+
+        stamp = datetime.date.today().isoformat()
+        new_waivers = []
+        for f in findings:
+            if f.rule == "STC000":
+                continue
+            if f.waived and f.waived_by == "pragma":
+                continue  # pragmas live in source, not the baseline
+            if f.waived and f.waived_by == "baseline":
+                new_waivers.append({
+                    "rule": f.rule, "path": f.path,
+                    "match": f.snippet.strip()[:80],
+                    "reason": f.reason,
+                })
+            elif not f.waived:
+                new_waivers.append({
+                    "rule": f.rule, "path": f.path,
+                    "match": f.snippet.strip()[:80],
+                    "reason": (
+                        f"auto-rebaselined {stamp}; review before merge"
+                    ),
+                })
+        Baseline(new_waivers).save(bl_path)
+        print(
+            f"lint baseline rewritten: {bl_path} "
+            f"({len(new_waivers)} waiver(s))"
+        )
+        return 0
+
+    unwaived = [f for f in findings if not f.waived]
+    waived = [f for f in findings if f.waived]
+    telemetry.count("lint.findings", len(unwaived))
+    telemetry.count("lint.waived", len(waived))
+    if own_telemetry:
+        telemetry.event(
+            "lint_run",
+            findings=len(unwaived),
+            waived=len(waived),
+            entrypoints=len(audited),
+        )
+        telemetry.shutdown()
+
+    out = (
+        render_json(findings, audited)
+        if args.format == "json"
+        else render_text(findings, audited)
+    )
+    print(out)
+    return 1 if unwaived else 0
+
+
+def add_lint_subparser(sub) -> None:
+    p = sub.add_parser(
+        "lint",
+        help="project-native static analysis: AST invariant rules + "
+             "jaxpr purity/dtype audit (docs/STATIC_ANALYSIS.md)",
+    )
+    p.add_argument(
+        "--format", default="text", choices=["text", "json"],
+        help="report format (json is the machine-readable CI artifact)",
+    )
+    p.add_argument(
+        "--rules", default=None,
+        help="comma-separated rule subset (e.g. STC001,STC005)",
+    )
+    p.add_argument(
+        "--no-jaxpr", action="store_true",
+        help="skip layer 2 (no jax import; pure-AST runs are ~instant)",
+    )
+    p.add_argument(
+        "--baseline", default=None,
+        help=f"waiver allowlist (default {DEFAULT_BASELINE_PATH})",
+    )
+    p.add_argument(
+        "--rebaseline", action="store_true",
+        help="rewrite the baseline to waive every current finding "
+             "(commit the result deliberately — mirrors `metrics check "
+             "--write-baseline`)",
+    )
+    p.add_argument(
+        "--telemetry-file", default=None,
+        help="emit a lint run stream (lint.findings / lint.waived) "
+             "consumable by the `metrics` verbs",
+    )
+    p.set_defaults(fn=cmd_lint)

@@ -1,0 +1,251 @@
+"""Registered jitted entry points for the jaxpr audit (layer 2).
+
+Every jit-compiled function a production driver dispatches — the
+EM/Online-VB/NMF step functions, the Pallas kernel wrappers in ``ops/``,
+and the sharded scoring/eval paths — is registered here with a builder
+that returns ``(fn, representative args)``.  Shapes are TINY (k=4, V=64,
+B=8, L=8): the audit only traces, so shapes need to be representative in
+RANK and DTYPE, not size, and small shapes keep ``stc lint`` fast enough
+for CI.
+
+**Register new jitted entry points here in the same PR that adds them**
+(docs/STATIC_ANALYSIS.md "Registering a jitted entry point"): an
+unregistered step function is invisible to the dtype/callback audit, and
+the audit self-test pins the minimum registry width so the table cannot
+silently shrink.
+
+Builders import lazily (jax comes up once, under whatever platform the
+caller pinned — ``run_jaxpr_audit`` defaults it to cpu) and build their
+own 1x1 mesh: tracing ``shard_map`` needs a mesh object, not devices.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, List, Sequence, Tuple
+
+__all__ = ["EntryPoint", "ENTRYPOINTS", "entrypoint_names"]
+
+# audit geometry — small, rank-faithful
+K = 4          # topics
+V = 64         # vocab (also the model-shard-padded width at 1 shard)
+B = 8          # docs per batch
+L = 8          # row length (distinct terms per doc)
+T = 32         # packed token count
+
+
+@dataclass(frozen=True)
+class EntryPoint:
+    name: str                      # dotted id used in reports/baselines
+    multichip: bool                # must carry sharding annotations
+    build: Callable[[], Tuple[Callable, Sequence]]
+
+
+def _mesh():
+    import jax
+
+    from ..parallel.mesh import make_mesh
+
+    # one explicit device: the audit's 1x1 mesh must build identically
+    # under the CLI (1 cpu device) and the 8-device test harness
+    return make_mesh(
+        data_shards=1, model_shards=1, devices=jax.devices()[:1]
+    )
+
+
+def _batch():
+    import numpy as np
+
+    from ..ops.sparse import DocTermBatch
+
+    ids = np.zeros((B, L), np.int32)
+    wts = np.ones((B, L), np.float32)
+    return DocTermBatch(ids, wts)
+
+
+def _f32(shape):
+    import numpy as np
+
+    return np.ones(shape, np.float32)
+
+
+# ---------------------------------------------------------------------------
+# builders
+# ---------------------------------------------------------------------------
+def _build_em_bucket_step():
+    from ..models.em_lda import make_em_bucket_step
+
+    fn = make_em_bucket_step(_mesh(), alpha=0.1, eta=0.1, vocab_size=V)
+    return fn, (_f32((K, V)), _f32((B, K)), _batch())
+
+
+def _build_em_train_step():
+    import numpy as np
+
+    from ..models.em_lda import EMState, make_em_train_step
+
+    fn = make_em_train_step(_mesh(), alpha=0.1, eta=0.1, vocab_size=V)
+    state = EMState(_f32((K, V)), _f32((B, K)), np.int32(0))
+    return fn, (state, _batch())
+
+
+def _build_em_packed_loglik():
+    import numpy as np
+
+    from ..models.em_lda import make_em_packed_loglik
+
+    fn = make_em_packed_loglik(_mesh(), alpha=0.1, eta=0.1, vocab_size=V)
+    ids_t = np.zeros((T,), np.int32)
+    cts_t = np.ones((T,), np.float32)
+    seg_t = np.zeros((T,), np.int32)
+    return fn, (_f32((K, V)), _f32((B, K)), ids_t, cts_t, seg_t)
+
+
+def _build_online_train_step():
+    import numpy as np
+
+    from ..models.online_lda import TrainState, make_online_train_step
+
+    fn = make_online_train_step(
+        _mesh(), alpha=0.1, eta=0.01, tau0=1024.0, kappa=0.51,
+        corpus_size=None,
+    )
+    state = TrainState(_f32((K, V)), np.int32(0))
+    return fn, (state, _batch(), _f32((B, K)), np.float32(1000.0))
+
+
+def _build_online_estep():
+    from ..models.online_lda import make_online_estep
+
+    fn = make_online_estep(_mesh(), alpha=0.1)
+    return fn, (_f32((K, V)), _batch(), _f32((B, K)))
+
+
+def _build_online_mstep():
+    import numpy as np
+
+    from ..models.online_lda import make_online_mstep
+
+    fn = make_online_mstep(_mesh(), eta=0.01, tau0=1024.0, kappa=0.51)
+    return fn, (
+        _f32((K, V)), _f32((K, V)), _f32((K, V)),
+        np.float32(B), np.int32(3), np.float32(1000.0),
+    )
+
+
+def _build_nmf_train_step():
+    from ..models.nmf import NMFTrainState, make_nmf_train_step
+
+    fn = make_nmf_train_step(_mesh())
+    state = NMFTrainState(_f32((B, K)), _f32((K, V)))
+    return fn, (state, _batch())
+
+
+def _build_sharded_topic_inference():
+    import numpy as np
+
+    from ..models.sharded_eval import make_sharded_topic_inference
+
+    alpha = np.full((K,), 0.1, np.float32)
+    fn = make_sharded_topic_inference(
+        _mesh(), alpha=alpha, vocab_size=V
+    )
+    return fn, (_f32((K, V)), _batch(), _f32((B, K)))
+
+
+def _build_sharded_log_likelihood():
+    import numpy as np
+
+    from ..models.sharded_eval import make_sharded_log_likelihood
+
+    alpha = np.full((K,), 0.1, np.float32)
+    fn = make_sharded_log_likelihood(
+        _mesh(), alpha=alpha, eta=0.01, vocab_size=V
+    )
+    return fn, (
+        _f32((K, V)), _batch(), _f32((B, K)),
+        np.float32(1000.0), np.float32(B),
+    )
+
+
+def _build_pallas_estep_bkl():
+    import functools
+
+    import numpy as np
+
+    from ..ops.pallas_estep import gamma_fixed_point_pallas_bkl
+
+    # interpret=True: tracing is platform-independent, but the audit
+    # must register the wrapper exactly as the CPU test path runs it
+    fn = functools.partial(
+        gamma_fixed_point_pallas_bkl,
+        max_inner=5, tol=1e-3, interpret=True,
+    )
+    alpha = np.full((K,), 0.1, np.float32)
+    return fn, (_f32((B, K, L)), _f32((B, L)), alpha, _f32((B, K)))
+
+
+def _build_pallas_packed_tiles():
+    import functools
+
+    import numpy as np
+
+    from ..ops.pallas_packed import gamma_fixed_point_tiles
+
+    n_tiles, tt, d = 2, 16, 4
+    fn = functools.partial(
+        gamma_fixed_point_tiles, d=d, max_inner=5, tol=1e-3,
+        interpret=True,
+    )
+    eb_kt = _f32((K, n_tiles * tt))
+    cts = _f32((n_tiles, tt))
+    seg = np.zeros((n_tiles, tt), np.int32)
+    alpha = np.full((K,), 0.1, np.float32)
+    gamma0 = _f32((K, n_tiles * d))
+    return fn, (eb_kt, cts, seg, alpha, gamma0)
+
+
+def _build_lda_math_e_step():
+    import functools
+
+    import numpy as np
+
+    from ..ops.lda_math import e_step
+
+    fn = functools.partial(
+        e_step, vocab_size=V, max_inner=5, tol=1e-3, backend="xla"
+    )
+    alpha = np.full((K,), 0.1, np.float32)
+    return fn, (_batch(), _f32((K, V)), alpha, _f32((B, K)))
+
+
+ENTRYPOINTS: Tuple[EntryPoint, ...] = (
+    EntryPoint("em_lda.bucket_step", True, _build_em_bucket_step),
+    EntryPoint("em_lda.train_step", True, _build_em_train_step),
+    EntryPoint("em_lda.packed_loglik", True, _build_em_packed_loglik),
+    EntryPoint("online_lda.train_step", True, _build_online_train_step),
+    EntryPoint("online_lda.estep", True, _build_online_estep),
+    EntryPoint("online_lda.mstep", True, _build_online_mstep),
+    EntryPoint("nmf.train_step", True, _build_nmf_train_step),
+    EntryPoint(
+        "sharded_eval.topic_inference", True,
+        _build_sharded_topic_inference,
+    ),
+    EntryPoint(
+        "sharded_eval.log_likelihood", True,
+        _build_sharded_log_likelihood,
+    ),
+    EntryPoint(
+        "ops.pallas_estep.gamma_fixed_point_bkl", False,
+        _build_pallas_estep_bkl,
+    ),
+    EntryPoint(
+        "ops.pallas_packed.gamma_fixed_point_tiles", False,
+        _build_pallas_packed_tiles,
+    ),
+    EntryPoint("ops.lda_math.e_step", False, _build_lda_math_e_step),
+)
+
+
+def entrypoint_names() -> List[str]:
+    return [ep.name for ep in ENTRYPOINTS]

@@ -1,0 +1,258 @@
+"""Layer 2: jaxpr audit of the registered jitted entry points.
+
+Each entry point in ``analysis.entrypoints.ENTRYPOINTS`` is traced with
+``jax.make_jaxpr`` at representative abstract shapes (CPU platform, tiny
+dims — tracing never executes device code), **with x64 enabled** so
+dtype discipline is checked the hard way: code that spells every dtype
+explicitly (``jnp.float32(...)``, ``np.zeros(..., np.int32)``) traces
+identically under either flag, while code that leans on the global
+``jax_enable_x64=False`` default leaks ``float64`` the moment a config,
+a caller, or a future jax version flips it — on TPU that leak is a
+silent 2x memory + bandwidth regression (or a Mosaic lowering error).
+
+Rules (STC2xx; same waiver machinery as layer 1, baseline ``path`` is
+``jaxpr:<entry name>``):
+
+  STC201  float64/complex128 value anywhere in the traced program
+  STC202  weak-typed entry-point OUTPUT (weak outputs re-promote at the
+          next op and can fork the jit cache downstream)
+  STC203  host callback primitive (pure/io/debug callback) in a
+          compiled path — a hidden per-step host round trip
+  STC204  oversized closure constant (captured array > 1 MiB rides
+          along with every executable instead of being an argument)
+  STC205  multichip entry point whose jaxpr carries no sharding
+          annotation (no shard_map / collective / sharding constraint)
+
+The audit is pure tracing: no compile, no execution, no device state.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Iterable, List, Sequence, Tuple
+
+from .findings import Finding
+
+__all__ = ["audit_entry", "run_jaxpr_audit", "CONST_BUDGET_BYTES"]
+
+CONST_BUDGET_BYTES = 1 << 20  # 1 MiB
+
+_CALLBACK_MARK = "callback"
+_SHARDING_PRIMS = (
+    "shard_map",
+    "sharding_constraint",
+    "psum",
+    "ppermute",
+    "all_gather",
+    "all_to_all",
+    "reduce_scatter",
+)
+
+
+def _iter_eqns(jaxpr) -> Iterable:
+    """Depth-first over every equation, descending into sub-jaxprs
+    (pjit bodies, scan/while bodies, shard_map bodies, ...)."""
+    for eqn in jaxpr.eqns:
+        yield eqn
+        for sub in _sub_jaxprs(eqn):
+            yield from _iter_eqns(sub)
+
+
+def _sub_jaxprs(eqn) -> Iterable:
+    import jax.core as core
+
+    for v in eqn.params.values():
+        for item in v if isinstance(v, (tuple, list)) else (v,):
+            if isinstance(item, core.ClosedJaxpr):
+                yield item.jaxpr
+            elif isinstance(item, core.Jaxpr):
+                yield item
+
+
+def _all_consts(closed) -> Iterable:
+    """Closure constants at every nesting level — jit captures land in
+    the pjit sub-ClosedJaxpr's consts, not the top-level ones."""
+    import jax.core as core
+
+    seen = [closed]
+    while seen:
+        cj = seen.pop()
+        yield from cj.consts
+        for eqn in cj.jaxpr.eqns:
+            for v in eqn.params.values():
+                for item in v if isinstance(v, (tuple, list)) else (v,):
+                    if isinstance(item, core.ClosedJaxpr):
+                        seen.append(item)
+
+
+def _aval_of(var):
+    return getattr(var, "aval", None)
+
+
+def _wide_dtype(aval) -> bool:
+    dt = str(getattr(aval, "dtype", ""))
+    return dt in ("float64", "complex128")
+
+
+def audit_entry(
+    name: str,
+    fn,
+    args: Sequence,
+    *,
+    multichip: bool = False,
+    enable_x64: bool = True,
+) -> Tuple[List[Finding], int]:
+    """Trace ``fn(*args)`` and run the STC2xx checks.
+
+    Returns (findings, traced equation count).  ``enable_x64=True`` is
+    the production audit mode (see module docstring); the self-tests
+    also use it to make planted float64 literals representable.
+    """
+    import contextlib
+
+    import jax
+    import numpy as np
+    from jax.experimental import enable_x64 as _enable_x64
+
+    findings: List[Finding] = []
+    path = f"jaxpr:{name}"
+
+    ctx = _enable_x64() if enable_x64 else contextlib.nullcontext()
+    with ctx:
+        closed = jax.make_jaxpr(fn)(*args)
+
+    # ---- STC201: float64 / complex128 anywhere ------------------------
+    seen_prims = set()
+    n_eqns = 0
+    has_sharding = False
+    for eqn in _iter_eqns(closed.jaxpr):
+        n_eqns += 1
+        prim = eqn.primitive.name
+        if any(prim.startswith(p) or prim == p for p in _SHARDING_PRIMS):
+            has_sharding = True
+        if _CALLBACK_MARK in prim:
+            findings.append(Finding(
+                rule="STC203", path=path, line=0,
+                message=(
+                    f"host callback primitive {prim!r} inside the "
+                    f"compiled path — a per-dispatch host round trip"
+                ),
+                snippet=prim,
+            ))
+        if prim in seen_prims:
+            continue
+        for var in list(eqn.outvars) + list(eqn.invars):
+            aval = _aval_of(var)
+            if aval is not None and _wide_dtype(aval):
+                seen_prims.add(prim)
+                findings.append(Finding(
+                    rule="STC201", path=path, line=0,
+                    message=(
+                        f"{getattr(aval, 'dtype', '?')} value produced "
+                        f"by primitive {prim!r} — an implicit-dtype op "
+                        f"is leaning on jax_enable_x64=False; spell the "
+                        f"dtype explicitly"
+                    ),
+                    snippet=f"{prim} -> {aval}",
+                ))
+                break
+
+    # ---- STC202: weak-typed outputs -----------------------------------
+    for i, var in enumerate(closed.jaxpr.outvars):
+        aval = _aval_of(var)
+        if aval is not None and getattr(aval, "weak_type", False):
+            findings.append(Finding(
+                rule="STC202", path=path, line=0,
+                message=(
+                    f"output {i} is weak-typed ({aval}) — downstream "
+                    f"promotion depends on the consumer; anchor it with "
+                    f"an explicit dtype"
+                ),
+                snippet=f"out[{i}] {aval}",
+            ))
+
+    # ---- STC204: oversized closure constants --------------------------
+    for c in _all_consts(closed):
+        try:
+            nbytes = int(np.asarray(c).nbytes)
+        except (TypeError, ValueError):
+            continue
+        if nbytes > CONST_BUDGET_BYTES:
+            findings.append(Finding(
+                rule="STC204", path=path, line=0,
+                message=(
+                    f"closure constant of {nbytes} bytes baked into the "
+                    f"traced program — pass it as an argument (donated "
+                    f"or sharded) instead of capturing it"
+                ),
+                snippet=f"const {type(c).__name__} {nbytes}B",
+            ))
+
+    # ---- STC205: multichip entries must carry sharding ----------------
+    if multichip and not has_sharding:
+        findings.append(Finding(
+            rule="STC205", path=path, line=0,
+            message=(
+                "entry point is registered multichip=True but its jaxpr "
+                "contains no shard_map / collective / sharding "
+                "constraint — it would silently run replicated"
+            ),
+            snippet="no sharding primitive",
+        ))
+
+    return findings, n_eqns
+
+
+def run_jaxpr_audit(
+    entries=None,
+) -> Tuple[List[Finding], List[str]]:
+    """Audit every registered entry point (or an explicit subset).
+
+    Forces the CPU platform for the whole process when jax has not been
+    initialized yet (the audit must never touch — or hang on — an
+    accelerator; tracing is platform-independent anyway).
+
+    Returns (findings, audited entry names).  A builder/trace crash is
+    itself a finding (rule STC200) rather than an exception: a broken
+    registration must fail lint, not the linter.
+    """
+    import sys
+
+    if "jax" not in sys.modules:
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    else:
+        # jax is already imported (the CLI pulls it in transitively);
+        # its lazy backend bring-up has NOT happened yet unless someone
+        # called jax.devices() — pin the platform before tracing does,
+        # or a wedged TPU tunnel would hang the linter (the round-1
+        # failure mode the env-scrub machinery exists for)
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+    from .entrypoints import ENTRYPOINTS
+
+    if entries is None:
+        entries = ENTRYPOINTS
+    findings: List[Finding] = []
+    audited: List[str] = []
+    for ep in entries:
+        try:
+            fn, args = ep.build()
+            f, _ = audit_entry(
+                ep.name, fn, args, multichip=ep.multichip
+            )
+        except Exception as exc:
+            # a broken registration must FAIL LINT (as a finding), not
+            # kill the linter mid-report; the error rides in the message
+            findings.append(Finding(
+                rule="STC200", path=f"jaxpr:{ep.name}", line=0,
+                message=(
+                    f"entry point failed to build/trace: "
+                    f"{type(exc).__name__}: {exc}"
+                ),
+            ))
+            continue
+        findings.extend(f)
+        audited.append(ep.name)
+    return findings, audited

@@ -18,10 +18,7 @@ from __future__ import annotations
 import argparse
 import os
 import sys
-import time
 from typing import List, Optional
-
-import numpy as np
 
 from . import telemetry
 from .config import Params
@@ -743,6 +740,10 @@ def build_parser() -> argparse.ArgumentParser:
     from .telemetry.metrics_cli import add_metrics_subparser
 
     add_metrics_subparser(sub)
+
+    from .analysis.cli import add_lint_subparser
+
+    add_lint_subparser(sub)
     return ap
 
 
@@ -758,8 +759,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     # other jax call — mesh.initialize_distributed does that inside the
     # command).
     # `metrics` is a pure host-side reader: it must not import jax at all
+    # `lint` pins JAX_PLATFORMS=cpu itself before its jaxpr layer brings
+    # jax up — the cache helper here would initialize the backend first
     if (
-        args.cmd not in ("doctor", "metrics")
+        args.cmd not in ("doctor", "metrics", "lint")
         and getattr(args, "coordinator", None) is None
     ):
         from .utils.env import enable_persistent_compile_cache

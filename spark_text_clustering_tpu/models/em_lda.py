@@ -491,7 +491,9 @@ def make_em_packed_loglik(
             n_dk.sum(-1, keepdims=True) + n_dk.shape[-1] * (alpha - 1.0)
         )
         tok = (phi_w * theta[seg_t]).sum(-1)               # [T]
-        score = (cts_t * jnp.log(jnp.where(tok > 0, tok, 1.0))).sum()
+        score = (
+            cts_t * jnp.log(jnp.where(tok > 0, tok, jnp.float32(1.0)))
+        ).sum()
         return psum_data(score)
 
     sharded = jax.shard_map(

@@ -39,14 +39,12 @@ from ..ops.lda_math import (
     _resolve_gamma_backend,
     _run_gamma_fixed_point,
     dirichlet_expectation_sharded,
-    init_gamma,
     init_gamma_rows,
     init_lambda,
     token_sstats_factors,
 )
 from ..ops.sparse import DocTermBatch, batch_from_rows, next_pow2
 from ..parallel.collectives import (
-    data_shard_batch,
     fetch_global,
     model_handoff,
     gather_model_rows,
@@ -431,7 +429,9 @@ def _make_resident_sharded(
         own = jnp.logical_and(local >= 0, local < shard_n)
         localc = jnp.clip(local, 0, shard_n - 1)
         ids_b = psum_data(jnp.where(own[:, None], ids_res[localc], 0))
-        wts_b = psum_data(jnp.where(own[:, None], wts_res[localc], 0.0))
+        wts_b = psum_data(
+            jnp.where(own[:, None], wts_res[localc], jnp.float32(0.0))
+        )
 
         b_shard = pick.shape[0] // n_data
         row0 = jax.lax.axis_index(DATA_AXIS) * b_shard

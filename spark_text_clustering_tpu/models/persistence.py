@@ -31,7 +31,7 @@ import json
 import os
 import time
 import zipfile
-from typing import List, Optional, Sequence, Tuple
+from typing import Optional, Sequence
 
 import numpy as np
 
@@ -120,7 +120,13 @@ def _write_artifact(path: str, meta: dict, arrays: dict, vocab) -> None:
     """
     os.makedirs(path, exist_ok=True)
     with open(os.path.join(path, "meta.json"), "w") as f:
-        json.dump({"format_version": FORMAT_VERSION, **meta}, f, indent=2)
+        # sort_keys: the manifest hashes this file, and two artifacts
+        # with identical contents must be byte-identical regardless of
+        # the dict-build order of the caller (lint rule STC006)
+        json.dump(
+            {"format_version": FORMAT_VERSION, **meta}, f, indent=2,
+            sort_keys=True,
+        )
     faultinject.check("artifact.file")
     np.savez(
         os.path.join(path, "arrays.npz"),
@@ -215,7 +221,9 @@ def save_train_state(path: str, step: int, **arrays: np.ndarray) -> None:
         # corrupt — re-training one interval is the safe failure mode
         atomic_write_text(
             path + ".sha256",
-            json.dumps({"sha256": digest, "step": int(step)}) + "\n",
+            json.dumps(
+                {"sha256": digest, "step": int(step)}, sort_keys=True
+            ) + "\n",
         )
 
     retry_call(_write, site="ckpt.write")

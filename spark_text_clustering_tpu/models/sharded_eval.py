@@ -74,7 +74,9 @@ def _shard_col_mask(shard_v: int, vocab_size: int) -> jnp.ndarray:
 
 def _masked_row_sum(lam_f, mask):
     """True [k] row sums of a V-sharded, pad-masked table."""
-    return psum_model(jnp.where(mask[None], lam_f, 0.0).sum(axis=-1))
+    return psum_model(
+        jnp.where(mask[None], lam_f, jnp.float32(0.0)).sum(axis=-1)
+    )
 
 
 def _sharded_gamma(eb_shard, ids, wts, gamma0, alpha_arr, max_inner, tol):
@@ -234,16 +236,21 @@ def make_sharded_log_likelihood(
         # E[log p(beta | eta) - log q(beta | lambda)] — vocab-sharded, pad
         # columns masked out of every vocab-wide sum.
         elog_beta_shard = dirichlet_expectation_sharded(lam_f, row_sum)
+        # gammaln of a bare Python float would trace as weak float64
+        # under x64 (STC201) — anchor the scalar hyperparameters to f32
+        eta_f = jnp.float32(eta)
         topic_score = psum_model(
             jnp.where(
                 mask[None],
-                (eta - lam_f) * elog_beta_shard
+                (eta_f - lam_f) * elog_beta_shard
                 + gammaln(lam_f)
-                - gammaln(eta),
-                0.0,
+                - gammaln(eta_f),
+                jnp.float32(0.0),
             ).sum()
         )
-        topic_score += (gammaln(eta * v) - gammaln(row_sum)).sum()
+        topic_score += (
+            gammaln(jnp.float32(eta * v)) - gammaln(row_sum)
+        ).sum()
         return doc_score + topic_score
 
     sharded = jax.shard_map(
@@ -298,7 +305,9 @@ def make_sharded_em_log_likelihood(
             n_dk.sum(-1, keepdims=True) + n_dk.shape[-1] * (alpha - 1.0)
         )
         tok = jnp.einsum("blk,bk->bl", phi_w, theta)
-        score = (wts * jnp.log(jnp.where(tok > 0, tok, 1.0))).sum()
+        score = (
+            wts * jnp.log(jnp.where(tok > 0, tok, jnp.float32(1.0)))
+        ).sum()
         return psum_data(score)
 
     sharded = jax.shard_map(

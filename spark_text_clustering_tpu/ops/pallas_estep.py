@@ -77,7 +77,7 @@ def digamma_approx(x: jnp.ndarray) -> jnp.ndarray:
     res = jnp.zeros_like(x)
     for _ in range(6):
         small = x < 6.0
-        res = res - jnp.where(small, 1.0 / x, 0.0)
+        res = res - jnp.where(small, 1.0 / x, jnp.float32(0.0))
         x = jnp.where(small, x + 1.0, x)
     inv = 1.0 / x
     inv2 = inv * inv

@@ -14,8 +14,6 @@ model code reads algorithmically.
 
 from __future__ import annotations
 
-from functools import partial
-from typing import Callable
 
 import jax
 import jax.numpy as jnp
@@ -44,7 +42,8 @@ def _acct(name: str, *arrays) -> None:
     for a in arrays:
         try:
             nbytes += int(a.size) * a.dtype.itemsize
-        except Exception:      # weak types / non-array operands
+        except (TypeError, AttributeError):
+            # weak types / non-array operands expose no size/itemsize
             pass
     telemetry.count(f"collective.{name}.calls")
     telemetry.count(f"collective.{name}.traced_bytes", nbytes)
@@ -113,7 +112,7 @@ def gather_model_rows(table_shard, ids):
     local, in_shard = _model_shard_local_ids(ids, shard_v)
     local = jnp.clip(local, 0, shard_v - 1)
     vals = jnp.moveaxis(table_shard, 0, -1)[local]        # [..., k]
-    vals = jnp.where(in_shard[..., None], vals, 0.0)
+    vals = jnp.where(in_shard[..., None], vals, jnp.float32(0.0))
     return psum_model(vals)
 
 
@@ -127,7 +126,7 @@ def gather_model_rows_kbl(table_shard, ids):
     local, in_shard = _model_shard_local_ids(ids, shard_v)
     local = jnp.clip(local, 0, shard_v - 1)
     vals = jnp.take(table_shard, local, axis=1)           # [k, ...]
-    vals = jnp.where(in_shard[None], vals, 0.0)
+    vals = jnp.where(in_shard[None], vals, jnp.float32(0.0))
     return psum_model(vals)
 
 
@@ -146,7 +145,7 @@ def gather_model_rows_bkl(table_shard, ids):
     local = jnp.clip(local, 0, shard_v - 1)
     vals = jnp.take(table_shard, local, axis=1)           # [k, B, L]
     vals = jnp.moveaxis(vals, 0, 1)                       # [B, k, L]
-    vals = jnp.where(in_shard[:, None, :], vals, 0.0)
+    vals = jnp.where(in_shard[:, None, :], vals, jnp.float32(0.0))
     return psum_model(vals)
 
 

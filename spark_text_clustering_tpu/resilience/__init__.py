@@ -52,6 +52,7 @@ from .retry import (
     RetryPolicy,
     backoff_delays,
     retry_call,
+    sleep,
 )
 
 __all__ = [
@@ -77,6 +78,7 @@ __all__ = [
     "RetryGiveUp",
     "retry_call",
     "backoff_delays",
+    "sleep",
     "IO_POLICY",
     "TELEMETRY_POLICY",
     "RETRIES_COUNTER",

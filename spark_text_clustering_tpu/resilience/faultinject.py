@@ -47,12 +47,29 @@ __all__ = [
     "corrupt",
     "ENV_SPEC",
     "ENV_SEED",
+    "SITES",
 ]
 
 ENV_SPEC = "STC_FAULTS"
 ENV_SEED = "STC_FAULT_SEED"
 
 KINDS = ("ioerror", "fail", "kill", "partial")
+
+# Canonical registry of every injection point the production code owns.
+# ``stc lint`` rule STC003 enforces BOTH directions against this table:
+# every ``check``/``corrupt`` call site must name a registered site (a
+# typo'd site silently never fires), and every registered site must
+# still exist in code (a stale entry documents coverage the chaos
+# harness no longer has).  Add the entry HERE in the same commit that
+# adds the ``check(...)`` call.
+SITES = frozenset({
+    "artifact.file",      # between files of a model artifact write
+    "artifact.commit",    # before the COMMIT marker seals the dir
+    "ckpt.write",         # train-state checkpoint write
+    "stream.poll",        # streaming source directory poll
+    "report.write",       # scoring report write
+    "telemetry.write",    # telemetry run-stream append
+})
 
 
 class InjectedIOError(OSError):

@@ -33,10 +33,24 @@ __all__ = [
     "RetryGiveUp",
     "backoff_delays",
     "retry_call",
+    "sleep",
 ]
 
 RETRIES_COUNTER = "resilience.retries"
 GIVEUPS_COUNTER = "resilience.giveups"
+
+
+def sleep(seconds: float) -> None:
+    """The ONE injectable wall-clock wait for every backoff/poll delay.
+
+    Production call sites (retry loops, the streaming poll cadence, the
+    accelerator probe's bring-up delays) MUST route their waits through
+    here instead of calling ``time.sleep`` directly (lint rule STC001):
+    chaos tests monkeypatch this single symbol to run a simulated clock,
+    and a delay that bypasses it silently escapes that control.
+    """
+    if seconds > 0:
+        time.sleep(seconds)
 
 
 class RetryGiveUp(ResilienceError):
@@ -111,7 +125,8 @@ def _count(name: str, **event_fields) -> None:
     # late import: telemetry's own sink retries route through this module
     from .. import telemetry
 
-    telemetry.count(name)
+    # the forwarded name is always one of the module constants above
+    telemetry.count(name)  # stc-lint: disable=STC004 -- name forwarded from RETRIES_COUNTER/GIVEUPS_COUNTER, both declared in telemetry/names.py
     if event_fields:
         telemetry.event("retry", **event_fields)
 
@@ -121,7 +136,7 @@ def retry_call(
     *args,
     site: str,
     policy: RetryPolicy = IO_POLICY,
-    sleep: Callable[[float], None] = time.sleep,
+    sleep: Callable[[float], None] = sleep,
     **kwargs,
 ):
     """Call ``fn(*args, **kwargs)`` under ``policy``.

@@ -38,6 +38,7 @@ from .config import Params
 from .ops.sparse import batch_from_rows, next_pow2, pad_rows
 from .pipeline import TextPreprocessor, is_hashed_vocab, make_vectorizer
 from .resilience import Quarantine, RetryGiveUp, faultinject, retry_call
+from .resilience.retry import sleep as _sleep
 from .utils.report import format_scoring_report, write_scoring_report
 
 __all__ = [
@@ -230,7 +231,9 @@ class FileStreamSource:
                 and time.monotonic() - last_data >= idle_timeout
             ):
                 return
-            time.sleep(poll_interval)
+            # the resilience layer's injectable sleep, NOT time.sleep:
+            # chaos tests drive the poll cadence on a simulated clock
+            _sleep(poll_interval)
 
 
 class MemoryStreamSource:

@@ -133,7 +133,9 @@ def git_rev(cwd: Optional[str] = None) -> Optional[str]:
             cwd=cwd or os.path.dirname(os.path.abspath(__file__)),
         )
         return r.stdout.strip() or None if r.returncode == 0 else None
-    except Exception:
+    except (OSError, subprocess.SubprocessError, ValueError):
+        # no git binary / not a checkout / timeout — the manifest simply
+        # records no revision
         return None
 
 
@@ -169,7 +171,8 @@ def manifest_fields(
             out["mesh_shape"] = {
                 str(k): int(v) for k, v in dict(mesh.shape).items()
             }
-        except Exception:
+        except (TypeError, ValueError, AttributeError):
+            # mesh-like object without a dict-able .shape — skip the field
             pass
     if vocab_width is not None:
         out["vocab_width"] = int(vocab_width)

@@ -31,7 +31,7 @@ from __future__ import annotations
 import json
 import math
 import sys
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Tuple
 
 from .events import read_events
 

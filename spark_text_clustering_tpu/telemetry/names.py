@@ -1,0 +1,86 @@
+"""Canonical metric-name declarations (the STC004 registry).
+
+Every metric a hot path writes through the ``telemetry.count`` /
+``telemetry.gauge`` / ``telemetry.observe`` facade must be declared here
+exactly once — ``stc lint`` rule STC004 enforces both directions:
+
+  * a call site whose (literal) name is not declared here fails lint —
+    an undeclared name is usually a typo that would fork a metric family
+    and silently split its counts;
+  * a declaration no longer referenced anywhere fails lint — stale
+    entries document observability the code no longer has.
+
+Names are dotted ``snake.case``: lowercase ``[a-z0-9_]`` segments joined
+by dots, most-general family first (``resilience.retries``,
+``stream.queue_depth``).  Dashboards and the ``metrics`` CLI key on
+these strings, so renames are breaking changes to every committed
+baseline (``scripts/records/ci_metrics_baseline.json``) — declare new
+names instead of repurposing old ones.
+
+``PREFIXES`` declares the few DYNAMIC families the telemetry facade and
+the collectives layer mint per call site (``span.<path>.seconds``,
+``collective.<op>.calls``).  A non-literal metric name at a call site is
+only lint-clean when its leading literal text matches one of these
+prefixes; everything else must be a declared literal.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, Tuple
+
+__all__ = ["METRICS", "PREFIXES", "NAME_RE", "is_valid_name"]
+
+# dotted snake.case: [a-z0-9_]+ segments joined by '.'
+NAME_RE = re.compile(r"^[a-z0-9_]+(\.[a-z0-9_]+)*$")
+
+# name -> one-line description (kept here, not in dashboards, so the
+# meaning travels with the declaration)
+METRICS: Dict[str, str] = {
+    # -- resilience (docs/RESILIENCE.md) --------------------------------
+    "resilience.retries": "transient failures absorbed by retry_call",
+    "resilience.giveups": "retry policies exhausted (RetryGiveUp raised)",
+    "resilience.quarantined": "documents routed to a dead-letter dir",
+    "resilience.artifacts_skipped":
+        "uncommitted/corrupt model dirs skipped by latest_model_dir",
+    "resilience.checkpoints_rejected":
+        "checkpoints rejected by the multi-host existence agreement",
+    # -- telemetry self-observation -------------------------------------
+    "telemetry_write_errors": "run-stream appends that failed after retry",
+    # -- streaming ------------------------------------------------------
+    "stream.queue_depth": "new-but-unconsumed files seen by the last poll",
+    "stream.score.micro_batch_seconds": "stream-score trigger wall time",
+    "stream.train.micro_batch_seconds": "stream-train trigger wall time",
+    # -- training loops -------------------------------------------------
+    "train_iteration_seconds": "per-iteration wall time (IterationTimer)",
+    # -- static analysis (docs/STATIC_ANALYSIS.md) ----------------------
+    "lint.findings": "unwaived stc lint findings in the last run",
+    "lint.waived": "stc lint findings suppressed by pragma or baseline",
+}
+
+# prefix -> owner/description of the dynamic family
+PREFIXES: Dict[str, str] = {
+    "span.": "telemetry facade: per-span latency/error families",
+    "device_sync.": "telemetry facade: attributed block_until_ready waits",
+    "train.": "telemetry facade: per-optimizer iteration histograms",
+    "collective.": "parallel.collectives: per-op trace-time calls/bytes",
+    "probe.accelerator.": "utils.env: probe attempts by outcome class",
+}
+
+
+def is_valid_name(name: str) -> bool:
+    return bool(NAME_RE.match(name))
+
+
+def declared(name: str) -> bool:
+    """Is ``name`` covered by a literal declaration or a dynamic-family
+    prefix?  (The runtime mirror of the STC004 static check — handy for
+    tests and REPL triage.)"""
+    if name in METRICS:
+        return True
+    return any(name.startswith(p) for p in PREFIXES)
+
+
+def families() -> Tuple[str, ...]:
+    """All declared names + prefixes, for report rendering."""
+    return tuple(sorted(METRICS)) + tuple(sorted(PREFIXES))

@@ -79,6 +79,7 @@ def probe_accelerator(
     """
     from .. import telemetry
     from ..resilience import RetryPolicy, backoff_delays
+    from ..resilience.retry import sleep as _retry_sleep
 
     code = (
         "import jax, json; d = jax.devices(); "
@@ -114,9 +115,11 @@ def probe_accelerator(
         )
 
     for i in range(attempts):
-        delay = delays[i]
-        if delay:
-            time.sleep(delay)
+        # the schedule AND the wait both come from the resilience layer
+        # (the residual direct time.sleep here was the drift STC001
+        # exists to catch: the delays derived from RetryPolicy but the
+        # sleep itself bypassed the injectable primitive)
+        _retry_sleep(delays[i])
         t0 = time.monotonic()
         with telemetry.span("probe.accelerator", emit=False):
             try:

@@ -18,7 +18,6 @@ outputs.
 
 from __future__ import annotations
 
-import math
 import os
 import time
 from typing import List, Optional, Sequence, Tuple

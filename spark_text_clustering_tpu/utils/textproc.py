@@ -39,7 +39,7 @@ from __future__ import annotations
 
 import re
 from functools import lru_cache
-from typing import Iterable, List, Sequence
+from typing import Iterable, List
 
 from nltk.stem import PorterStemmer
 

@@ -1,0 +1,426 @@
+"""Static-analysis subsystem self-tests (docs/STATIC_ANALYSIS.md).
+
+Three groups:
+
+  * fixture modules with PLANTED violations for every AST rule —
+    positive (each rule fires at the planted line) and negative (the
+    compliant twin next to it stays clean);
+  * waiver round trips — inline pragma (with and without a reason) and
+    baseline entries (matching, reasonless, stale);
+  * the real repo must be lint-clean: the AST layer against the
+    committed baseline yields zero unwaived findings (the CLI/CI run
+    covers the jaxpr layer end-to-end; test_jaxpr_audit.py covers its
+    rules in isolation).
+"""
+
+import json
+import os
+import textwrap
+
+from spark_text_clustering_tpu.analysis.ast_rules import (
+    PACKAGE,
+    run_ast_rules,
+)
+from spark_text_clustering_tpu.analysis.findings import (
+    DEFAULT_BASELINE_PATH,
+    Baseline,
+    Finding,
+    apply_waivers,
+    pragma_disables,
+    render_json,
+)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _fixture_root(tmp_path, source: str, name: str = "planted.py"):
+    """A throwaway repo root holding one fixture module inside a
+    package dir named like the real one (the walker keys on it)."""
+    pkg = tmp_path / PACKAGE
+    pkg.mkdir(parents=True, exist_ok=True)
+    (pkg / name).write_text(textwrap.dedent(source))
+    return str(tmp_path)
+
+
+def _hits(findings, rule, name="planted.py"):
+    rel = f"{PACKAGE}/{name}"
+    return [
+        f for f in findings
+        if f.rule == rule and f.path == rel and not f.waived
+    ]
+
+
+# ---------------------------------------------------------------------------
+# STC001 — raw sleeps
+# ---------------------------------------------------------------------------
+def test_stc001_flags_raw_sleep_not_injected_sleep(tmp_path):
+    root = _fixture_root(tmp_path, """
+        import time
+        from time import sleep
+
+        def bad_direct():
+            time.sleep(1.0)
+
+        def bad_imported():
+            sleep(2.0)
+
+        def ok_injected(sleep_fn):
+            sleep_fn(1.0)
+    """)
+    hits = _hits(run_ast_rules(root, rules=["STC001"]), "STC001")
+    assert sorted(h.line for h in hits) == [6, 9]
+
+
+# ---------------------------------------------------------------------------
+# STC002 — broad excepts
+# ---------------------------------------------------------------------------
+def test_stc002_swallowing_vs_rewrapping(tmp_path):
+    root = _fixture_root(tmp_path, """
+        def bad_bare():
+            try:
+                work()
+            except:
+                pass
+
+        def bad_broad():
+            try:
+                work()
+            except Exception:
+                return None
+
+        def ok_rewrap():
+            try:
+                work()
+            except Exception as exc:
+                raise RuntimeError("typed") from exc
+
+        def ok_uses_exc(q):
+            try:
+                work()
+            except Exception as exc:
+                q.put("doc", exc)
+
+        def ok_narrow():
+            try:
+                work()
+            except OSError:
+                pass
+    """)
+    hits = _hits(run_ast_rules(root, rules=["STC002"]), "STC002")
+    assert sorted(h.line for h in hits) == [5, 11]
+
+
+# ---------------------------------------------------------------------------
+# STC003 — fault sites
+# ---------------------------------------------------------------------------
+def test_stc003_unregistered_and_dynamic_sites(tmp_path):
+    root = _fixture_root(tmp_path, """
+        from .resilience import faultinject
+
+        def bad_typo():
+            faultinject.check("ckpt.wrte")
+
+        def bad_dynamic(site):
+            faultinject.check(site)
+
+        def ok_registered():
+            faultinject.check("ckpt.write")
+    """)
+    hits = _hits(run_ast_rules(root, rules=["STC003"]), "STC003")
+    assert sorted(h.line for h in hits) == [5, 8]
+    # reverse direction: the fixture tree uses only one registered site,
+    # so the other registry entries surface as stale-coverage findings
+    registry = [
+        f for f in run_ast_rules(root, rules=["STC003"])
+        if f.path.endswith("faultinject.py")
+    ]
+    assert registry, "expected stale-site findings for unused registry"
+    assert all("stale chaos coverage" in f.message for f in registry)
+
+
+# ---------------------------------------------------------------------------
+# STC004 — metric names
+# ---------------------------------------------------------------------------
+def test_stc004_metric_name_rules(tmp_path):
+    root = _fixture_root(tmp_path, """
+        from . import telemetry
+
+        BAD_CONST = "no.such.metric"
+
+        def bad_undeclared():
+            telemetry.count("totally.undeclared.name")
+
+        def bad_case():
+            telemetry.count("BadCase.Name")
+
+        def bad_const():
+            telemetry.count(BAD_CONST)
+
+        def bad_prefix(kind):
+            telemetry.count(f"unknown.family.{kind}")
+
+        def bad_opaque(name):
+            telemetry.count(name)
+
+        def ok_declared():
+            telemetry.count("resilience.retries")
+
+        def ok_prefix(err):
+            telemetry.count(f"probe.accelerator.{err}")
+    """)
+    hits = _hits(run_ast_rules(root, rules=["STC004"]), "STC004")
+    assert sorted(h.line for h in hits) == [7, 10, 13, 16, 19]
+
+
+# ---------------------------------------------------------------------------
+# STC005 — host syncs in jit-reachable code
+# ---------------------------------------------------------------------------
+def test_stc005_reaches_through_helpers_and_wrappers(tmp_path):
+    root = _fixture_root(tmp_path, """
+        from functools import partial
+
+        import jax
+        import numpy as np
+
+        def helper(y):
+            return y.item()
+
+        @jax.jit
+        def bad_direct(x):
+            x.block_until_ready()
+            return np.asarray(x)
+
+        @partial(jax.jit, static_argnames=())
+        def bad_via_helper(x):
+            return helper(x)
+
+        @jax.jit
+        def bad_scalar_pull(x):
+            return float(x)
+
+        def _inner(x):
+            return jax.device_get(x)
+
+        sharded = jax.shard_map(_inner, mesh=None, in_specs=(), out_specs=())
+        wrapped = jax.jit(sharded)
+
+        def not_jitted(x):
+            x.block_until_ready()
+            return np.asarray(x)
+    """)
+    hits = _hits(run_ast_rules(root, rules=["STC005"]), "STC005")
+    lines = sorted(h.line for h in hits)
+    # direct (12, 13), via helper (8), float-of-arg (21), jit(shard_map)
+    # chain (24); the un-jitted twin at the bottom stays clean
+    assert lines == [8, 12, 13, 21, 24]
+
+
+# ---------------------------------------------------------------------------
+# STC006 — mutable defaults + persistence key order
+# ---------------------------------------------------------------------------
+def test_stc006_mutable_defaults(tmp_path):
+    root = _fixture_root(tmp_path, """
+        def bad_list(a=[]):
+            return a
+
+        def bad_dict_call(b=dict()):
+            return b
+
+        def ok_none(c=None):
+            return c or []
+    """)
+    hits = _hits(run_ast_rules(root, rules=["STC006"]), "STC006")
+    assert sorted(h.line for h in hits) == [2, 5]
+
+
+def test_stc006_persistence_sort_keys(tmp_path):
+    src = """
+        import json
+
+        def bad(meta, f):
+            json.dump(meta, f, indent=2)
+
+        def ok(meta, f):
+            json.dump(meta, f, indent=2, sort_keys=True)
+    """
+    # the rule only applies to the persistence layer files
+    pkg = tmp_path / PACKAGE / "models"
+    pkg.mkdir(parents=True)
+    (pkg / "persistence.py").write_text(textwrap.dedent(src))
+    findings = run_ast_rules(str(tmp_path), rules=["STC006"])
+    hits = [f for f in findings if not f.waived]
+    assert [f.line for f in hits] == [5]
+    assert "sort_keys" in hits[0].message
+
+
+# ---------------------------------------------------------------------------
+# STC101 / STC102 — generic tier
+# ---------------------------------------------------------------------------
+def test_stc101_unused_imports_and_noqa(tmp_path):
+    root = _fixture_root(tmp_path, """
+        import os
+        import sys  # noqa: F401  (kept for side effects)
+        from typing import List, Optional
+
+        def use():
+            return os.getcwd(), List
+    """)
+    findings = run_ast_rules(root, rules=["STC101"])
+    unwaived = _hits(findings, "STC101")
+    assert [(f.line, "Optional" in f.message) for f in unwaived] == [
+        (4, True)
+    ]
+    noqa = [f for f in findings if f.waived and f.line == 3]
+    assert noqa and noqa[0].waived_by == "pragma"
+
+
+def test_stc102_fstring_logging(tmp_path):
+    root = _fixture_root(tmp_path, """
+        import logging
+
+        logger = logging.getLogger(__name__)
+
+        def bad(x):
+            logger.info(f"value {x}")
+
+        def ok(x):
+            logger.info("value %s", x)
+    """)
+    hits = _hits(run_ast_rules(root, rules=["STC102"]), "STC102")
+    assert [f.line for f in hits] == [7]
+
+
+# ---------------------------------------------------------------------------
+# waiver round trips
+# ---------------------------------------------------------------------------
+def test_pragma_waiver_with_reason(tmp_path):
+    root = _fixture_root(tmp_path, """
+        import time
+
+        def guarded():
+            time.sleep(1.0)  # stc-lint: disable=STC001 -- test drives a real clock here
+    """)
+    findings = run_ast_rules(root, rules=["STC001"])
+    waived = [f for f in findings if f.waived]
+    assert len(waived) == 1
+    assert waived[0].waived_by == "pragma"
+    assert waived[0].reason == "test drives a real clock here"
+    # a reasoned pragma produces NO meta-finding
+    augmented = apply_waivers(findings, Baseline())
+    assert not [f for f in augmented if f.rule == "STC000"]
+
+
+def test_pragma_without_reason_is_flagged(tmp_path):
+    root = _fixture_root(tmp_path, """
+        import time
+
+        def guarded():
+            time.sleep(1.0)  # stc-lint: disable=STC001
+    """)
+    findings = apply_waivers(
+        run_ast_rules(root, rules=["STC001"]), Baseline()
+    )
+    assert [f.rule for f in findings if not f.waived] == ["STC000"]
+
+
+def test_pragma_for_other_rule_does_not_waive(tmp_path):
+    root = _fixture_root(tmp_path, """
+        import time
+
+        def guarded():
+            time.sleep(1.0)  # stc-lint: disable=STC999 -- wrong rule
+    """)
+    hits = _hits(run_ast_rules(root, rules=["STC001"]), "STC001")
+    assert len(hits) == 1
+
+
+def test_baseline_round_trip(tmp_path):
+    f1 = Finding("STC001", "pkg/a.py", 10, "m", snippet="time.sleep(1)")
+    f2 = Finding("STC001", "pkg/b.py", 20, "m", snippet="time.sleep(2)")
+    bl = Baseline([
+        {"rule": "STC001", "path": "pkg/a.py", "match": "time.sleep",
+         "reason": "legacy poll loop"},
+        {"rule": "STC002", "path": "pkg/gone.py", "match": "except",
+         "reason": "file was deleted"},
+    ])
+    out = apply_waivers([f1, f2], bl)
+    assert f1.waived and f1.waived_by == "baseline"
+    assert f1.reason == "legacy poll loop"
+    assert not f2.waived
+    stale = [f for f in out if f.rule == "STC000"]
+    assert len(stale) == 1 and "stale" in stale[0].message
+
+
+def test_baseline_reasonless_waiver_is_flagged():
+    f = Finding("STC001", "pkg/a.py", 10, "m", snippet="time.sleep(1)")
+    bl = Baseline([
+        {"rule": "STC001", "path": "pkg/a.py", "match": "time.sleep",
+         "reason": ""},
+    ])
+    out = apply_waivers([f], bl)
+    assert f.waived
+    assert [g.rule for g in out if not g.waived] == ["STC000"]
+
+
+def test_one_baseline_entry_can_waive_repeated_pattern():
+    f1 = Finding("STC002", "pkg/a.py", 10, "m", snippet="except Exception:")
+    f2 = Finding("STC002", "pkg/a.py", 30, "m", snippet="except Exception:")
+    bl = Baseline([
+        {"rule": "STC002", "path": "pkg/a.py", "match": "except Exception",
+         "reason": "both guards are best-effort"},
+    ])
+    out = apply_waivers([f1, f2], bl)
+    assert f1.waived and f2.waived
+    assert not [f for f in out if f.rule == "STC000"]
+
+
+def test_pragma_grammar():
+    assert pragma_disables("x()  # stc-lint: disable=STC001 -- why") == (
+        ["STC001"], "why"
+    )
+    assert pragma_disables("x()  # stc-lint: disable=STC001,STC004 (r)") == (
+        ["STC001", "STC004"], "r"
+    )
+    assert pragma_disables("x()  # a normal comment") is None
+
+
+# ---------------------------------------------------------------------------
+# report format + repo cleanliness
+# ---------------------------------------------------------------------------
+def test_json_report_shape(tmp_path):
+    root = _fixture_root(tmp_path, """
+        import time
+
+        def bad():
+            time.sleep(1.0)
+    """)
+    findings = run_ast_rules(root, rules=["STC001"])
+    doc = json.loads(render_json(findings, ["a.b"]))
+    assert doc["counts"]["findings"] == 1
+    assert doc["entrypoints_audited"] == ["a.b"]
+    assert doc["findings"][0]["rule"] == "STC001"
+    assert doc["findings"][0]["line"] == 5
+
+
+def test_repo_is_ast_lint_clean():
+    """The merged tree carries zero unwaived AST-layer findings, and
+    every waiver (pragma or baseline) has a non-empty reason."""
+    findings = run_ast_rules(REPO_ROOT)
+    baseline = Baseline.load(
+        os.path.join(REPO_ROOT, DEFAULT_BASELINE_PATH)
+    )
+    out = apply_waivers(findings, baseline)
+    unwaived = [f for f in out if not f.waived]
+    assert unwaived == [], "\n".join(
+        f"{f.path}:{f.line}: {f.rule}: {f.message}" for f in unwaived
+    )
+    assert all(f.reason for f in out if f.waived)
+
+
+def test_committed_baseline_reasons_nonempty():
+    path = os.path.join(REPO_ROOT, DEFAULT_BASELINE_PATH)
+    with open(path) as f:
+        data = json.load(f)
+    assert data["waivers"], "baseline should carry the audited waivers"
+    for w in data["waivers"]:
+        assert w.get("reason", "").strip(), w
