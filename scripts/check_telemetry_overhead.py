@@ -86,15 +86,21 @@ def main() -> int:
         + len(snap["gauges"])
     )
 
-    # disabled per-call primitive cost (span + count + observe per loop)
+    # disabled per-call primitive cost (span + count + observe + a
+    # dispatch-instrumented call per loop — the wrapper must collapse to
+    # one bool check plus the underlying call when telemetry is off)
     assert not telemetry.enabled()
+    wrapped_noop = telemetry.instrument_dispatch(
+        "overhead.probe", lambda: None
+    )
     t0 = time.perf_counter()
     for _ in range(PRIMITIVE_LOOP):
         with telemetry.span("overhead.probe"):
             pass
         telemetry.count("overhead.probe")
         telemetry.observe("overhead.probe", 0.0)
-    per_call = (time.perf_counter() - t0) / (3 * PRIMITIVE_LOOP)
+        wrapped_noop()
+    per_call = (time.perf_counter() - t0) / (4 * PRIMITIVE_LOOP)
 
     overhead_s = calls * per_call
     ratio = overhead_s / max(fit_s, 1e-9)

@@ -4,7 +4,8 @@
 #
 #   1. stc lint — project-native static analysis (AST invariant rules +
 #      jaxpr purity/dtype audit of every registered jitted entry point;
-#      docs/STATIC_ANALYSIS.md); exits non-zero on any unwaived finding
+#      docs/STATIC_ANALYSIS.md); exits non-zero on any unwaived finding.
+#      Emits a telemetry run stream consumed by gate 6.
 #   2. ruff — generic-Python tier (unused imports, logging f-strings,
 #      mutable defaults; config in pyproject.toml); SKIPPED when no
 #      ruff binary exists (hermetic containers): the native STC101/102/
@@ -14,14 +15,21 @@
 #   5. metrics regression gate: a tiny deterministic training run's
 #      telemetry checked against the committed tolerance baseline
 #      (scripts/records/ci_metrics_baseline.json) — counter drift
-#      (iterations, events, retries, quarantines) gates; wall-time
-#      metrics are excluded (machine-dependent)
+#      (iterations, events, retries, quarantines, dispatches) gates;
+#      wall-time metrics are excluded (machine-dependent)
+#   6. lint metrics gate: the stage-1 lint run stream checked against
+#      the SAME baseline (--include lint.) so the waiver count is
+#      version-gated too (lint.findings must stay 0, lint.waived exact)
+#   7. cross-host skew gate: two simulated per-process streams merged
+#      with `metrics merge --fail-on-skew` — the planted straggler MUST
+#      be flagged (exit 1) and the balanced pair must pass (exit 0)
 #
 # Usage:
-#   scripts/ci_check.sh                 # run all five gates
-#   scripts/ci_check.sh --rebaseline    # recapture BOTH baselines
-#                                       # (metrics + lint waivers;
-#                                       # commit the result deliberately)
+#   scripts/ci_check.sh                 # run all seven gates
+#   scripts/ci_check.sh --rebaseline    # recapture ALL baselines
+#                                       # (metrics + lint waivers +
+#                                       # lint counters; commit the
+#                                       # result deliberately)
 set -uo pipefail
 cd "$(dirname "$0")/.."
 
@@ -32,9 +40,13 @@ export JAX_PLATFORMS=cpu
 export XLA_FLAGS="--xla_force_host_platform_device_count=8"
 BASELINE=scripts/records/ci_metrics_baseline.json
 # exclude machine-dependent wall-time metrics from the gate; counters and
-# event counts must stay exact across machines
+# event counts must stay exact across machines.  dispatch cost-model
+# estimates (est_*/device_*_total gauges) are backend/version-dependent
+# and excluded too; dispatch CALL counters stay exact.
 EXCLUDES=(--exclude seconds --exclude _ms --exclude _s_ --exclude
-          s_per_iter --exclude duration_s --exclude docs_per_s)
+          s_per_iter --exclude duration_s --exclude docs_per_s
+          --exclude .est_ --exclude device_seconds_total --exclude
+          device_bytes_total)
 
 run_ci_train() {
     # tiny deterministic corpus + train: same flags as the baseline was
@@ -61,6 +73,34 @@ EOF
         --telemetry-file "$workdir/run.jsonl" >/dev/null
 }
 
+make_skew_streams() {
+    # two synthetic per-process streams: balanced pair + a pair with a
+    # planted straggler/retry divergence on p1 (the merge gate's fixture)
+    local workdir="$1"
+    python - "$workdir" <<'EOF'
+import sys
+
+from spark_text_clustering_tpu.telemetry import TelemetryWriter
+from spark_text_clustering_tpu.telemetry.registry import MetricRegistry
+
+workdir = sys.argv[1]
+
+def stream(path, pidx, span_s, retries):
+    reg = MetricRegistry()
+    reg.histogram("span.train.em.seconds").observe(span_s)
+    reg.counter("resilience.retries").inc(retries)
+    w = TelemetryWriter(path, registry=reg, run_id=f"ci-skew-p{pidx}")
+    w.write_manifest(kind="ci-skew", process_index=pidx, process_count=2)
+    w.emit("span", name="train.em", seconds=span_s)
+    w.close()
+
+stream(f"{workdir}/bal-p0.jsonl", 0, 0.100, 0)
+stream(f"{workdir}/bal-p1.jsonl", 1, 0.104, 0)
+stream(f"{workdir}/skew-p0.jsonl", 0, 0.100, 0)
+stream(f"{workdir}/skew-p1.jsonl", 1, 0.900, 7)   # the straggler
+EOF
+}
+
 if [[ "${1:-}" == "--rebaseline" ]]; then
     python -m spark_text_clustering_tpu.cli lint --rebaseline || exit 1
     work=$(mktemp -d)
@@ -68,17 +108,27 @@ if [[ "${1:-}" == "--rebaseline" ]]; then
     run_ci_train "$work" || exit 1
     python -m spark_text_clustering_tpu.cli metrics check "$work/run.jsonl" \
         --baseline "$BASELINE" --write-baseline --tolerance 0.0 \
-        "${EXCLUDES[@]}"
+        "${EXCLUDES[@]}" || exit 1
+    # fold the lint counters into the same baseline (partial capture:
+    # only the lint. family is refreshed, training entries stay put)
+    python -m spark_text_clustering_tpu.cli lint \
+        --telemetry-file "$work/lint.jsonl" >/dev/null || exit 1
+    python -m spark_text_clustering_tpu.cli metrics check "$work/lint.jsonl" \
+        --baseline "$BASELINE" --write-baseline --tolerance 0.0 \
+        --include lint.
     exit $?
 fi
 
 fail=0
+work=$(mktemp -d)
+trap 'rm -rf "$work"' EXIT
 
-echo "== [1/5] stc lint (AST rules + jaxpr audit) =="
-python -m spark_text_clustering_tpu.cli lint
+echo "== [1/7] stc lint (AST rules + jaxpr audit) =="
+python -m spark_text_clustering_tpu.cli lint \
+    --telemetry-file "$work/lint.jsonl"
 if [[ $? -ne 0 ]]; then echo "FAIL: stc lint"; fail=1; fi
 
-echo "== [2/5] ruff (generic-Python tier) =="
+echo "== [2/7] ruff (generic-Python tier) =="
 if command -v ruff >/dev/null 2>&1; then
     ruff check spark_text_clustering_tpu
     if [[ $? -ne 0 ]]; then echo "FAIL: ruff"; fail=1; fi
@@ -86,25 +136,54 @@ else
     echo "ruff not installed — skipped (stc lint STC101/102/006 cover it)"
 fi
 
-echo "== [3/5] tier-1 tests =="
+echo "== [3/7] tier-1 tests =="
 timeout -k 10 870 python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors -p no:cacheprovider \
     -p no:xdist -p no:randomly
 if [[ $? -ne 0 ]]; then echo "FAIL: tier-1"; fail=1; fi
 
-echo "== [4/5] telemetry overhead budget =="
+echo "== [4/7] telemetry overhead budget =="
 python scripts/check_telemetry_overhead.py
 if [[ $? -ne 0 ]]; then echo "FAIL: telemetry overhead"; fail=1; fi
 
-echo "== [5/5] metrics regression gate =="
-work=$(mktemp -d)
-trap 'rm -rf "$work"' EXIT
+echo "== [5/7] metrics regression gate =="
 if run_ci_train "$work"; then
     python -m spark_text_clustering_tpu.cli metrics check "$work/run.jsonl" \
-        --baseline "$BASELINE" "${EXCLUDES[@]}"
+        --baseline "$BASELINE" "${EXCLUDES[@]}" --exclude lint.
     if [[ $? -ne 0 ]]; then echo "FAIL: metrics check"; fail=1; fi
 else
     echo "FAIL: CI training run"
+    fail=1
+fi
+
+echo "== [6/7] lint metrics gate (waiver count version-gated) =="
+if [[ -s "$work/lint.jsonl" ]]; then
+    python -m spark_text_clustering_tpu.cli metrics check "$work/lint.jsonl" \
+        --baseline "$BASELINE" --include lint.
+    if [[ $? -ne 0 ]]; then echo "FAIL: lint metrics check"; fail=1; fi
+else
+    echo "FAIL: no lint telemetry stream from stage 1"
+    fail=1
+fi
+
+echo "== [7/7] cross-host skew gate (metrics merge) =="
+if make_skew_streams "$work"; then
+    python -m spark_text_clustering_tpu.cli metrics merge \
+        "$work/skew-p0.jsonl" "$work/skew-p1.jsonl" --fail-on-skew \
+        >/dev/null
+    if [[ $? -ne 1 ]]; then
+        echo "FAIL: planted straggler not flagged by metrics merge"
+        fail=1
+    fi
+    python -m spark_text_clustering_tpu.cli metrics merge \
+        "$work/bal-p0.jsonl" "$work/bal-p1.jsonl" --fail-on-skew \
+        >/dev/null
+    if [[ $? -ne 0 ]]; then
+        echo "FAIL: balanced streams flagged as skewed"
+        fail=1
+    fi
+else
+    echo "FAIL: could not build skew fixture streams"
     fail=1
 fi
 

@@ -168,6 +168,15 @@ def _build_sharded_log_likelihood():
     )
 
 
+def _build_sharded_em_log_likelihood():
+    from ..models.sharded_eval import make_sharded_em_log_likelihood
+
+    fn = make_sharded_em_log_likelihood(
+        _mesh(), alpha=11.0, eta=1.1, vocab_size=V
+    )
+    return fn, (_f32((K, V)), _f32((B, K)), _batch())
+
+
 def _build_pallas_estep_bkl():
     import functools
 
@@ -234,6 +243,10 @@ ENTRYPOINTS: Tuple[EntryPoint, ...] = (
     EntryPoint(
         "sharded_eval.log_likelihood", True,
         _build_sharded_log_likelihood,
+    ),
+    EntryPoint(
+        "sharded_eval.em_log_likelihood", True,
+        _build_sharded_em_log_likelihood,
     ),
     EntryPoint(
         "ops.pallas_estep.gamma_fixed_point_bkl", False,

@@ -131,13 +131,14 @@ def _init_distributed(args: argparse.Namespace) -> bool:
 
 def cmd_train(args: argparse.Namespace) -> int:
     coordinator = _init_distributed(args)
-    # telemetry run stream: coordinator-only (a worker opening the same
-    # file would truncate the coordinator's records, like --metrics-file)
-    own_telemetry = bool(
-        getattr(args, "telemetry_file", None) and coordinator
-    )
+    # telemetry run streams are PER PROCESS: each jax.process_index()
+    # writes its own manifested `<stem>-p<idx>.jsonl` (single-process
+    # runs keep the given path verbatim), so workers are no longer
+    # silent and `metrics merge` can fold the mesh back into one
+    # logical run with a cross-host skew report
+    own_telemetry = bool(getattr(args, "telemetry_file", None))
     if own_telemetry:
-        telemetry.configure(args.telemetry_file)
+        telemetry.configure(telemetry.per_process_path(args.telemetry_file))
     timer = PhaseTimer()
     sw = _load_stop_words(args.stop_words)
     with timer.phase("read"):
@@ -411,6 +412,7 @@ def cmd_stream_score(args: argparse.Namespace) -> int:
         max_files_per_trigger=args.max_files_per_trigger,
         min_file_age_s=args.min_file_age,
     )
+    controller = _make_trigger_controller(args)
     scorer = StreamingScorer(
         model,
         stop_words=_load_stop_words(args.stop_words),
@@ -420,12 +422,20 @@ def cmd_stream_score(args: argparse.Namespace) -> int:
         keep_results=not args.no_report,
         quarantine_dir=args.quarantine_dir,
     )
+    import time as _time
+
     for mb in src.stream(
         poll_interval=args.poll_interval, idle_timeout=args.idle_timeout
     ):
+        t0 = _time.perf_counter()
         for sd in scorer.process(mb):
             print(f"[batch {mb.batch_id}] "
                   f"{os.path.basename(sd.name)} -> topic {sd.topic}")
+        if controller is not None:
+            controller.update(
+                src.last_queue_depth, _time.perf_counter() - t0
+            )
+            controller.apply(src)
     for t, c in enumerate(scorer.tallies):
         print(f"topic {t}: {c} books")
     if scorer.results and not args.no_report:
@@ -508,7 +518,10 @@ def cmd_stream_train(args: argparse.Namespace) -> int:
         ),
     )
     trainer.run(
-        src, poll_interval=args.poll_interval, idle_timeout=args.idle_timeout
+        src,
+        controller=_make_trigger_controller(args),
+        poll_interval=args.poll_interval,
+        idle_timeout=args.idle_timeout,
     )
     print(f"stream ended: {trainer.docs_seen} docs / "
           f"{trainer.batches_seen} micro-batches")
@@ -569,6 +582,19 @@ def cmd_doctor(args: argparse.Namespace) -> int:
     return 0
 
 
+def _make_trigger_controller(args: argparse.Namespace):
+    """The adaptive ``max_files_per_trigger`` AIMD controller behind
+    ``--adaptive-trigger`` (None when the flag is off)."""
+    if not getattr(args, "adaptive_trigger", False):
+        return None
+    from .streaming import AIMDTriggerController
+
+    return AIMDTriggerController(
+        target_batch_seconds=args.target_batch_seconds,
+        initial_cap=args.max_files_per_trigger or 8,
+    )
+
+
 def _add_distributed_args(p: argparse.ArgumentParser) -> None:
     """Multi-host DCN flags (every process runs the same command with its
     own --process-id; tests/test_multihost.py exercises the path)."""
@@ -587,6 +613,13 @@ def _add_stream_args(p: argparse.ArgumentParser) -> None:
                    help="stop after this many idle seconds (streaming jobs "
                         "run until the source dries up)")
     p.add_argument("--max-files-per-trigger", type=int, default=None)
+    p.add_argument("--adaptive-trigger", action="store_true",
+                   help="AIMD-adapt max_files_per_trigger from queue "
+                        "depth + per-batch seconds (the cap is observable "
+                        "as the stream.trigger_cap gauge)")
+    p.add_argument("--target-batch-seconds", type=float, default=2.0,
+                   help="per-trigger latency budget the adaptive "
+                        "controller steers toward")
     p.add_argument("--min-file-age", type=float, default=0.0,
                    help="seconds a file's mtime must settle before pickup "
                         "(use when producers don't rename atomically)")

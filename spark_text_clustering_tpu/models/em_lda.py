@@ -1064,9 +1064,14 @@ class EMLDA:
                 h.update((cts_f > 0).tobytes())
                 fn_key = (v, True, h.hexdigest())
             if self._packed_fn is None or self._packed_fn_vocab != fn_key:
-                self._packed_fn = make_em_packed_runner(
-                    self.mesh, alpha=alpha, eta=eta, vocab_size=v,
-                    scatter_plan=scatter_plan,
+                # dispatch attribution: calls + runtime collective bytes
+                # per compiled executable (telemetry.dispatch)
+                self._packed_fn = telemetry.instrument_dispatch(
+                    "em.packed_chunk",
+                    make_em_packed_runner(
+                        self.mesh, alpha=alpha, eta=eta, vocab_size=v,
+                        scatter_plan=scatter_plan,
+                    ),
                 )
                 self._packed_fn_vocab = fn_key
             run = self._packed_fn
@@ -1102,8 +1107,11 @@ class EMLDA:
             # optional doc-topic export read the packed arrays directly
             ll_key = (v, alpha, eta)
             if self._packed_ll_key != ll_key:
-                self._packed_ll_fn = make_em_packed_loglik(
-                    self.mesh, alpha=alpha, eta=eta, vocab_size=v
+                self._packed_ll_fn = telemetry.instrument_dispatch(
+                    "em.packed_loglik",
+                    make_em_packed_loglik(
+                        self.mesh, alpha=alpha, eta=eta, vocab_size=v
+                    ),
                 )
                 self._packed_ll_key = ll_key
             self.last_log_likelihood = float(
@@ -1119,8 +1127,11 @@ class EMLDA:
             # Per-iteration dispatch + sync: observable progress, one print
             # per sweep — the debugging path.
             if self._step_fn is None or self._step_fn_vocab != v:
-                self._step_fn = make_em_bucket_step(
-                    self.mesh, alpha=alpha, eta=eta, vocab_size=v
+                self._step_fn = telemetry.instrument_dispatch(
+                    "em.bucket_step",
+                    make_em_bucket_step(
+                        self.mesh, alpha=alpha, eta=eta, vocab_size=v
+                    ),
                 )
                 self._step_fn_vocab = v
             bucket_step = self._step_fn
@@ -1148,8 +1159,11 @@ class EMLDA:
             # scan removes the remaining per-iteration dispatch too).
             # Iteration times are recorded as the chunk mean.
             if self._chunk_fn is None or self._chunk_fn_vocab != v:
-                self._chunk_fn = make_em_chunk_runner(
-                    self.mesh, alpha=alpha, eta=eta, vocab_size=v
+                self._chunk_fn = telemetry.instrument_dispatch(
+                    "em.chunk_runner",
+                    make_em_chunk_runner(
+                        self.mesh, alpha=alpha, eta=eta, vocab_size=v
+                    ),
                 )
                 self._chunk_fn_vocab = v
             run_chunk = self._chunk_fn

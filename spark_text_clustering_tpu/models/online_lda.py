@@ -1059,12 +1059,17 @@ class OnlineLDA:
 
         key_fn = (plan.d, n)
         if self._tiles_res_fn is None or self._tiles_res_key != key_fn:
-            self._tiles_res_fn = make_online_tiles_resident_chunk(
-                self.mesh, alpha=alpha, eta=eta, tau0=p.tau0,
-                kappa=p.kappa, k=k, gamma_shape=p.gamma_shape,
-                seed=p.seed, d=plan.d, n_docs=n,
-                max_inner=p.estep_max_inner, tol=p.estep_tol,
-                interpret=jax.default_backend() != "tpu",
+            # dispatch attribution: calls + runtime collective bytes per
+            # compiled executable (telemetry.dispatch)
+            self._tiles_res_fn = telemetry.instrument_dispatch(
+                "online.tiles_resident_chunk",
+                make_online_tiles_resident_chunk(
+                    self.mesh, alpha=alpha, eta=eta, tau0=p.tau0,
+                    kappa=p.kappa, k=k, gamma_shape=p.gamma_shape,
+                    seed=p.seed, d=plan.d, n_docs=n,
+                    max_inner=p.estep_max_inner, tol=p.estep_tol,
+                    interpret=jax.default_backend() != "tpu",
+                ),
             )
             self._tiles_res_key = key_fn
         run = self._tiles_res_fn
@@ -1183,10 +1188,14 @@ class OnlineLDA:
         np.cumsum([len(i) for i, _ in rows], out=offsets[1:])
 
         if self._packed_chunk_fn is None:
-            self._packed_chunk_fn = make_online_packed_chunk(
-                self.mesh, alpha=alpha, eta=eta, tau0=p.tau0,
-                kappa=p.kappa, k=k, gamma_shape=p.gamma_shape, seed=p.seed,
-                max_inner=p.estep_max_inner, tol=p.estep_tol,
+            self._packed_chunk_fn = telemetry.instrument_dispatch(
+                "online.packed_chunk",
+                make_online_packed_chunk(
+                    self.mesh, alpha=alpha, eta=eta, tau0=p.tau0,
+                    kappa=p.kappa, k=k, gamma_shape=p.gamma_shape,
+                    seed=p.seed,
+                    max_inner=p.estep_max_inner, tol=p.estep_tol,
+                ),
             )
         n_data = self.mesh.shape[DATA_AXIS]
         tok_spec = NamedSharding(self.mesh, P(None, DATA_AXIS))
@@ -1275,11 +1284,14 @@ class OnlineLDA:
             def dispatch_tiles(st):
                 fn = self._tiles_chunk_fns.get(plan.d)
                 if fn is None:
-                    fn = make_online_packed_tiles_chunk(
-                        self.mesh, alpha=alpha, eta=eta, tau0=p.tau0,
-                        kappa=p.kappa, k=k, gamma_shape=p.gamma_shape,
-                        seed=p.seed, d=plan.d,
-                        interpret=jax.default_backend() != "tpu",
+                    fn = telemetry.instrument_dispatch(
+                        "online.packed_tiles_chunk",
+                        make_online_packed_tiles_chunk(
+                            self.mesh, alpha=alpha, eta=eta, tau0=p.tau0,
+                            kappa=p.kappa, k=k, gamma_shape=p.gamma_shape,
+                            seed=p.seed, d=plan.d,
+                            interpret=jax.default_backend() != "tpu",
+                        ),
                     )
                     self._tiles_chunk_fns[plan.d] = fn
                 t0 = time.perf_counter()
@@ -1629,10 +1641,13 @@ class OnlineLDA:
 
             if verbose:
                 if self._resident_fn is None:
-                    self._resident_fn = make_online_resident_step(
-                        self.mesh, alpha=alpha, eta=eta, tau0=p.tau0,
-                        kappa=p.kappa, k=k, gamma_shape=p.gamma_shape,
-                        seed=p.seed,
+                    self._resident_fn = telemetry.instrument_dispatch(
+                        "online.resident_step",
+                        make_online_resident_step(
+                            self.mesh, alpha=alpha, eta=eta, tau0=p.tau0,
+                            kappa=p.kappa, k=k, gamma_shape=p.gamma_shape,
+                            seed=p.seed,
+                        ),
                     )
                 for it in range(start_it, n_iters):
                     timer.start()
@@ -1652,11 +1667,14 @@ class OnlineLDA:
                 # cost a tunnel round trip each).  Iteration times are
                 # recorded as the chunk mean.
                 if self._resident_chunk_fn is None:
-                    self._resident_chunk_fn = make_online_resident_chunk(
-                        self.mesh, alpha=alpha, eta=eta, tau0=p.tau0,
-                        kappa=p.kappa, k=k, gamma_shape=p.gamma_shape,
-                        seed=p.seed, max_inner=p.estep_max_inner,
-                        tol=p.estep_tol,
+                    self._resident_chunk_fn = telemetry.instrument_dispatch(
+                        "online.resident_chunk",
+                        make_online_resident_chunk(
+                            self.mesh, alpha=alpha, eta=eta, tau0=p.tau0,
+                            kappa=p.kappa, k=k, gamma_shape=p.gamma_shape,
+                            seed=p.seed, max_inner=p.estep_max_inner,
+                            tol=p.estep_tol,
+                        ),
                     )
                 # resident corpus: each dispatch stages only the pick
                 # indices, so the whole run can be one scan
@@ -1697,13 +1715,21 @@ class OnlineLDA:
 
         if self._step_fn is None or self._step_fn_corpus != n:
             self._step_fn = (
-                make_online_eb(self.mesh),
-                make_online_estep(
-                    self.mesh, alpha=alpha,
-                    max_inner=p.estep_max_inner, tol=p.estep_tol,
+                telemetry.instrument_dispatch(
+                    "online.eb", make_online_eb(self.mesh)
                 ),
-                make_online_mstep(
-                    self.mesh, eta=eta, tau0=p.tau0, kappa=p.kappa
+                telemetry.instrument_dispatch(
+                    "online.estep",
+                    make_online_estep(
+                        self.mesh, alpha=alpha,
+                        max_inner=p.estep_max_inner, tol=p.estep_tol,
+                    ),
+                ),
+                telemetry.instrument_dispatch(
+                    "online.mstep",
+                    make_online_mstep(
+                        self.mesh, eta=eta, tau0=p.tau0, kappa=p.kappa
+                    ),
                 ),
             )
             self._step_fn_corpus = n

@@ -35,6 +35,7 @@ from jax import lax
 from jax.scipy.special import digamma, gammaln
 from jax.sharding import Mesh, PartitionSpec as P
 
+from .. import telemetry
 from ..ops.lda_math import (
     _resolve_gamma_backend,
     _run_gamma_fixed_point,
@@ -153,7 +154,12 @@ def make_sharded_topic_inference(
     def infer(lam, batch: DocTermBatch, gamma0):
         return sharded(lam, batch.token_ids, batch.token_weights, gamma0)
 
-    return infer
+    # dispatch attribution (telemetry.dispatch): scoring dispatches are
+    # the serving hot path, so they get digests like the train steps;
+    # the wrapper is transparent under an outer trace (jaxpr audit)
+    return telemetry.instrument_dispatch(
+        "sharded_eval.topic_inference", infer
+    )
 
 
 def make_sharded_log_likelihood(
@@ -275,7 +281,9 @@ def make_sharded_log_likelihood(
             jnp.float32(corpus_size), jnp.float32(batch_docs),
         )
 
-    return loglik
+    return telemetry.instrument_dispatch(
+        "sharded_eval.log_likelihood", loglik
+    )
 
 
 def make_sharded_em_log_likelihood(
@@ -327,7 +335,9 @@ def make_sharded_em_log_likelihood(
     def loglik(n_wk, n_dk, batch: DocTermBatch):
         return sharded(n_wk, n_dk, batch.token_ids, batch.token_weights)
 
-    return loglik
+    return telemetry.instrument_dispatch(
+        "sharded_eval.em_log_likelihood", loglik
+    )
 
 
 def make_sharded_top_terms(

@@ -21,6 +21,7 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from .. import telemetry
+from ..telemetry import dispatch as dispatch_attr
 from .mesh import DATA_AXIS, MODEL_AXIS
 
 
@@ -31,12 +32,19 @@ def _acct(name: str, *arrays) -> None:
     the counters say how many collective call sites each compiled
     program contains and how many bytes each moves per execution
     (``collective.<name>.calls`` / ``.traced_bytes``), not a per-step
-    runtime total (multiply by dispatch counts for that).  Host-side
-    helpers (``fetch_global``, ``data_shard_batch``, ``model_handoff``)
-    call this per REAL transfer, so their counters are true totals.
-    Disabled telemetry short-circuits on one bool check.
+    runtime total.  The trace also lands on the dispatch-attribution
+    layer (``telemetry.dispatch.note_collective``): when the tracing
+    happens inside an ``instrument_dispatch``-wrapped first call, the
+    per-execution bytes attach to that executable's digest and
+    ``dispatch.<digest>.collective_bytes`` accumulates the RUNTIME total
+    (bytes/execution x dispatches).  Host-side helpers
+    (``fetch_global``, ``data_shard_batch``, ``model_handoff``) call
+    this per REAL transfer, so their counters are true totals.
+    Disabled telemetry short-circuits on one bool check; a
+    ``cost_analysis`` retrace is suppressed entirely so it cannot
+    double-count the trace-time counters.
     """
-    if not telemetry.enabled():
+    if not telemetry.enabled() or dispatch_attr.cost_tracing():
         return
     nbytes = 0
     for a in arrays:
@@ -47,6 +55,7 @@ def _acct(name: str, *arrays) -> None:
             pass
     telemetry.count(f"collective.{name}.calls")
     telemetry.count(f"collective.{name}.traced_bytes", nbytes)
+    dispatch_attr.note_collective(nbytes)
 
 __all__ = [
     "psum_data",

@@ -43,12 +43,78 @@ from .utils.report import format_scoring_report, write_scoring_report
 
 __all__ = [
     "MicroBatch",
+    "AIMDTriggerController",
     "FileStreamSource",
     "MemoryStreamSource",
     "ScoredDoc",
     "StreamingScorer",
     "StreamingOnlineLDA",
 ]
+
+
+class AIMDTriggerController:
+    """Adaptive ``max_files_per_trigger``: AIMD over the backpressure
+    signals the telemetry layer already records (ROADMAP "streaming
+    backpressure signals").
+
+    TCP-style additive-increase / multiplicative-decrease on the trigger
+    cap, driven by the two observables every trigger produces:
+
+      * per-batch wall seconds (the ``stream.*.micro_batch_seconds``
+        quantity) — a trigger slower than ``target_batch_seconds`` means
+        the cap overshot what the device/host pipeline absorbs in one
+        trigger budget: **decrease** multiplicatively;
+      * ``stream.queue_depth`` — files still waiting after the trigger
+        was cut means the source is backing up while we have latency
+        headroom: **increase** additively.
+
+    Decisions are themselves observable: every update sets the
+    ``stream.trigger_cap`` gauge and the cap history rides the
+    ``micro_batch`` events of the stream it controls.  The controller is
+    transport-agnostic — the consumer measures the batch, calls
+    ``update``, and applies the returned cap to its source (see
+    ``StreamingOnlineLDA.run`` / the ``stream-score`` CLI loop).
+    """
+
+    def __init__(
+        self,
+        *,
+        target_batch_seconds: float = 2.0,
+        initial_cap: int = 8,
+        min_cap: int = 1,
+        max_cap: int = 1024,
+        increase: int = 1,
+        backoff: float = 0.5,
+    ) -> None:
+        if target_batch_seconds <= 0:
+            raise ValueError("target_batch_seconds must be > 0")
+        if not (0.0 < backoff < 1.0):
+            raise ValueError("backoff must be in (0, 1)")
+        self.target = float(target_batch_seconds)
+        self.min_cap = max(1, int(min_cap))
+        self.max_cap = max(self.min_cap, int(max_cap))
+        self.increase = max(1, int(increase))
+        self.backoff = float(backoff)
+        self.cap = min(self.max_cap, max(self.min_cap, int(initial_cap)))
+
+    def update(self, queue_depth: int, batch_seconds: float) -> int:
+        """One AIMD step from the latest trigger's observations; returns
+        the new cap (also mirrored to the ``stream.trigger_cap`` gauge)."""
+        if batch_seconds > self.target:
+            # overshoot: halve toward a trigger that fits the budget
+            self.cap = max(self.min_cap, int(self.cap * self.backoff))
+        elif queue_depth > self.cap:
+            # true backlog (the poll saw more than one trigger's worth)
+            # with latency headroom: probe one step wider
+            self.cap = min(self.max_cap, self.cap + self.increase)
+        telemetry.gauge("stream.trigger_cap", self.cap)
+        return self.cap
+
+    def apply(self, source) -> None:
+        """Push the current cap onto a source that honors one
+        (``FileStreamSource.max_files``-style)."""
+        if hasattr(source, "max_files"):
+            source.max_files = self.cap
 
 
 @dataclass
@@ -517,13 +583,19 @@ class StreamingOnlineLDA:
         k = params.k
         self._alpha = np.full((k,), params.resolved_alpha(), np.float32)
         self._key = jax.random.PRNGKey(params.seed)
-        self._step_fn = make_online_train_step(
-            self.mesh,
-            alpha=self._alpha,
-            eta=params.resolved_eta(),
-            tau0=params.tau0,
-            kappa=params.kappa,
-            corpus_size=None,           # dynamic: running docs_seen
+        # dispatch attribution: every micro-batch reuses this one
+        # compiled executable — the digest's call counter is the
+        # stream's dispatch count (telemetry.dispatch)
+        self._step_fn = telemetry.instrument_dispatch(
+            "stream.online_step",
+            make_online_train_step(
+                self.mesh,
+                alpha=self._alpha,
+                eta=params.resolved_eta(),
+                tau0=params.tau0,
+                kappa=params.kappa,
+                corpus_size=None,       # dynamic: running docs_seen
+            ),
         )
 
         self._ckpt_path = (
@@ -612,10 +684,15 @@ class StreamingOnlineLDA:
         )
 
     # -- lifecycle -------------------------------------------------------
-    def run(self, source, **stream_kw) -> "StreamingOnlineLDA":
+    def run(self, source, controller=None, **stream_kw) -> "StreamingOnlineLDA":
         """Drain a source (``poll``-able or iterable of MicroBatch),
         committing source progress each time a model checkpoint lands and
-        once more (with a final checkpoint) at stream end."""
+        once more (with a final checkpoint) at stream end.
+
+        ``controller``: an optional ``AIMDTriggerController`` — after
+        each trigger it observes (queue depth, batch seconds) and
+        retunes the source's ``max_files`` cap (adaptive backpressure).
+        """
         if hasattr(source, "stream"):
             it = source.stream(**stream_kw)
         elif hasattr(source, "poll"):
@@ -630,7 +707,15 @@ class StreamingOnlineLDA:
             it = iter(source)
         commit = getattr(source, "commit", None)
         for mb in it:
-            if self.process(mb) and commit is not None:
+            t0 = time.perf_counter()
+            wrote_ckpt = self.process(mb)
+            if controller is not None:
+                controller.update(
+                    getattr(source, "last_queue_depth", 0),
+                    time.perf_counter() - t0,
+                )
+                controller.apply(source)
+            if wrote_ckpt and commit is not None:
                 commit()
         if self._ckpt_path:
             self.checkpoint()

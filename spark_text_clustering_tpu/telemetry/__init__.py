@@ -24,6 +24,13 @@ Usage (a driver that owns a run)::
     ... train ...
     telemetry.shutdown()        # final registry snapshot + close
 
+Multi-host drivers route the path through ``per_process_path`` so every
+``jax.process_index()`` owns its own manifested stream
+(``events-p<idx>.jsonl``); ``metrics merge`` folds them back into one
+logical run with a cross-host skew report.  Hot-loop jitted callables
+wrap with ``instrument_dispatch(label, fn)`` for per-executable
+dispatch/device-time attribution (``dispatch.<digest>.*``).
+
 **Disabled is the default and costs (almost) nothing**: every helper
 collapses to one module-global bool check; ``span()`` returns a shared
 no-op singleton (no allocation).  The registry object itself is always
@@ -41,11 +48,14 @@ import time
 from contextlib import contextmanager
 from typing import Iterable, Optional
 
+from .dispatch import instrument as instrument_dispatch
 from .events import (
     SCHEMA_VERSION,
     JsonlSink,
     TelemetryWriter,
     manifest_fields,
+    per_process_path,
+    process_info,
     read_events,
 )
 from .registry import (
@@ -68,6 +78,9 @@ __all__ = [
     "JsonlSink",
     "read_events",
     "manifest_fields",
+    "per_process_path",
+    "process_info",
+    "instrument_dispatch",
     "Span",
     "current_path",
     "get_registry",

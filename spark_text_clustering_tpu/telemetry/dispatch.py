@@ -1,0 +1,304 @@
+"""Per-executable device-time attribution (the ``dispatch.*`` family).
+
+The collective counters in ``parallel.collectives`` fire at TRACE time —
+they say what ONE execution of a compiled program moves, not how much a
+run moved in total.  This module closes the gap: every hot-loop jitted
+callable is wrapped with ``instrument(label, fn)``, which keys each
+distinct (label, abstract-argument-signature) pair to a stable digest —
+the host-side analogue of jax's compiled-executable cache key — and
+records per digest:
+
+  * ``dispatch.<digest>.calls``                 (counter) dispatches
+  * ``dispatch.<digest>.collective_bytes``      (counter) runtime bytes
+    moved by collectives = trace-time bytes/execution x calls, captured
+    by observing the ``collectives._acct`` hooks that fire while the
+    FIRST wrapped call traces
+  * ``dispatch.<digest>.est_seconds`` / ``.est_bytes`` / ``.est_flops``
+    (gauges) per-execution XLA ``cost_analysis()`` estimates, when the
+    callable exposes the AOT ``lower()`` path
+  * ``dispatch.<digest>.device_seconds_total`` / ``.device_bytes_total``
+    (gauges) the estimates multiplied by the live call counter
+
+plus one ``dispatch_executable`` event per digest per run stream mapping
+the digest back to its human label and argument signature.
+
+jax 0.4.x caveats (docs/OBSERVABILITY.md "dispatch attribution"):
+``cost_analysis`` needs a second trace via ``fn.lower(...).compile()``
+(the jit fast path exposes no hook), so it runs ONCE per digest, only
+while telemetry is enabled, and with the collective accounting
+suppressed so the retrace cannot double-count trace-time collective
+counters.  Collective bytes/execution are only observable when the
+first *instrumented* call is also the call that compiles — a warm jit
+cache yields calls-only attribution.  Disabled telemetry reduces the
+wrapper to one bool check plus the underlying call.
+
+This module is jax-free at import (the registry/probe constraint);
+jax is only touched when telemetry is live and only if already loaded.
+"""
+
+from __future__ import annotations
+
+import functools
+import hashlib
+import os
+import sys
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Optional
+
+__all__ = [
+    "ExecutableRecord",
+    "instrument",
+    "records",
+    "reset",
+    "note_collective",
+    "cost_tracing",
+]
+
+_tls = threading.local()
+_lock = threading.Lock()
+
+
+@dataclass
+class ExecutableRecord:
+    """What we know about one (label, signature) executable."""
+
+    digest: str
+    label: str
+    signature: str
+    calls: int = 0
+    # trace-time collective bytes observed during the first traced call
+    # (None until a capture ran; 0 = captured but warm cache / no
+    # collectives, so nothing attributable)
+    collective_bytes_per_call: Optional[int] = None
+    est_flops: Optional[float] = None
+    est_bytes: Optional[float] = None
+    est_seconds: Optional[float] = None
+    cost_source: str = "pending"
+    announced_to: Optional[int] = None
+    _capturing: bool = field(default=False, repr=False)
+
+
+_records: Dict[str, ExecutableRecord] = {}
+
+
+def records() -> Dict[str, ExecutableRecord]:
+    """Live digest -> record table (tests / REPL triage)."""
+    return dict(_records)
+
+
+def reset() -> None:
+    with _lock:
+        _records.clear()
+
+
+# -- trace-context plumbing (collectives._acct calls in) --------------------
+def _stack():
+    st = getattr(_tls, "dispatch_stack", None)
+    if st is None:
+        st = _tls.dispatch_stack = []
+    return st
+
+
+def cost_tracing() -> bool:
+    """True while a ``cost_analysis`` retrace is in flight on this
+    thread — ``collectives._acct`` must skip entirely (the retrace would
+    otherwise double-count every trace-time collective counter)."""
+    return bool(getattr(_tls, "cost_tracing", False))
+
+
+def note_collective(nbytes: int) -> None:
+    """Attribute trace-time collective bytes to the instrumented call
+    currently tracing on this thread (no-op outside a first call)."""
+    st = getattr(_tls, "dispatch_stack", None)
+    if st:
+        rec = st[-1]
+        if rec.collective_bytes_per_call is None:
+            rec.collective_bytes_per_call = 0
+        rec.collective_bytes_per_call += int(nbytes)
+
+
+# -- signature / digest ------------------------------------------------------
+def _leaf_sig(leaf: Any) -> str:
+    shape = getattr(leaf, "shape", None)
+    dtype = getattr(leaf, "dtype", None)
+    if shape is not None and dtype is not None:
+        return f"{dtype}{tuple(shape)}"
+    if isinstance(leaf, (int, float, bool, str)) or leaf is None:
+        return repr(leaf)
+    return type(leaf).__name__
+
+
+def _abstract_signature(args, kwargs) -> Optional[str]:
+    """Shape/dtype signature of a call's operands — the digest key.
+
+    Returns None when any operand is a jax tracer (the wrapped call is
+    itself being traced, e.g. by the jaxpr audit): attribution must
+    stand aside and let the trace pass through untouched.
+    """
+    if "jax" in sys.modules:
+        # jax-free import contract: tree-flatten (and tracer detection)
+        # only when jax is already up — plain operands otherwise
+        import jax
+
+        tracer_cls: tuple = (jax.core.Tracer,)
+        leaves = jax.tree_util.tree_leaves((args, kwargs))
+    else:
+        tracer_cls = ()
+        leaves = list(args) + [v for _, v in sorted(kwargs.items())]
+    parts = []
+    for leaf in leaves:
+        if tracer_cls and isinstance(leaf, tracer_cls):
+            return None
+        parts.append(_leaf_sig(leaf))
+    return "|".join(parts)
+
+
+def _digest(label: str, signature: str) -> str:
+    h = hashlib.sha1(f"{label}|{signature}".encode()).hexdigest()[:10]
+    return h
+
+
+# -- cost analysis -----------------------------------------------------------
+def _normalize_cost(raw) -> Dict[str, float]:
+    """``cost_analysis()`` returns a dict on some jax versions and a
+    one-element list of dicts on others; keys carry spaces."""
+    if isinstance(raw, (list, tuple)):
+        raw = raw[0] if raw else {}
+    if not isinstance(raw, dict):
+        return {}
+    out = {}
+    for key, name in (
+        ("flops", "est_flops"),
+        ("bytes accessed", "est_bytes"),
+        ("optimal_seconds", "est_seconds"),
+    ):
+        v = raw.get(key)
+        if isinstance(v, (int, float)) and v >= 0:
+            out[name] = float(v)
+    return out
+
+
+def _analyze_cost(rec: ExecutableRecord, fn, args, kwargs) -> None:
+    if os.environ.get("STC_DISPATCH_COST", "1") == "0":
+        rec.cost_source = "disabled"
+        return
+    lower = getattr(fn, "lower", None)
+    if lower is None:
+        rec.cost_source = "no_lower"
+        return
+    _tls.cost_tracing = True
+    try:
+        compiled = lower(*args, **kwargs).compile()
+        cost = _normalize_cost(compiled.cost_analysis())
+        rec.est_flops = cost.get("est_flops")
+        rec.est_bytes = cost.get("est_bytes")
+        rec.est_seconds = cost.get("est_seconds")
+        rec.cost_source = "cost_analysis" if cost else "empty"
+    except Exception as exc:
+        # attribution is best-effort by contract: a backend that cannot
+        # lower/compile AOT (or rejects the static-arg calling
+        # convention) degrades to calls-only counting, with the reason
+        # kept on the record for triage
+        rec.cost_source = f"error:{type(exc).__name__}"
+    finally:
+        _tls.cost_tracing = False
+
+
+# -- accounting --------------------------------------------------------------
+def _account(rec: ExecutableRecord) -> None:
+    from . import get_registry, get_writer
+
+    reg = get_registry()
+    d = rec.digest
+    rec.calls += 1
+    calls = reg.counter(f"dispatch.{d}.calls")
+    calls.inc()
+    if rec.collective_bytes_per_call:
+        reg.counter(f"dispatch.{d}.collective_bytes").inc(
+            rec.collective_bytes_per_call
+        )
+    if rec.est_seconds is not None:
+        reg.gauge(f"dispatch.{d}.est_seconds").set(rec.est_seconds)
+        reg.gauge(f"dispatch.{d}.device_seconds_total").set(
+            calls.value * rec.est_seconds
+        )
+    if rec.est_bytes is not None:
+        reg.gauge(f"dispatch.{d}.est_bytes").set(rec.est_bytes)
+        reg.gauge(f"dispatch.{d}.device_bytes_total").set(
+            calls.value * rec.est_bytes
+        )
+    if rec.est_flops is not None:
+        reg.gauge(f"dispatch.{d}.est_flops").set(rec.est_flops)
+    w = get_writer()
+    if w is not None and rec.announced_to != id(w):
+        # once per run stream: the digest -> label mapping consumers
+        # (merge / trace / dashboards) join dispatch.* metrics against
+        rec.announced_to = id(w)
+        w.emit(
+            "dispatch_executable",
+            digest=d,
+            label=rec.label,
+            signature=rec.signature[:400],
+            collective_bytes_per_call=rec.collective_bytes_per_call,
+            est_flops=rec.est_flops,
+            est_bytes=rec.est_bytes,
+            est_seconds=rec.est_seconds,
+            cost_source=rec.cost_source,
+        )
+
+
+def _call_recorded(label: str, fn, args, kwargs):
+    signature = _abstract_signature(args, kwargs)
+    if signature is None:  # under an outer trace: stand aside
+        return fn(*args, **kwargs)
+    digest = _digest(label, signature)
+    rec = _records.get(digest)
+    if rec is None:
+        with _lock:
+            rec = _records.get(digest)
+            if rec is None:
+                rec = ExecutableRecord(digest, label, signature)
+                _records[digest] = rec
+    if rec.collective_bytes_per_call is None and not rec._capturing:
+        # first call for this executable: if it compiles, the trace-time
+        # collective hooks fire inside this frame and land on the record
+        rec._capturing = True
+        _stack().append(rec)
+        try:
+            out = fn(*args, **kwargs)
+        finally:
+            _stack().pop()
+            rec._capturing = False
+            if rec.collective_bytes_per_call is None:
+                rec.collective_bytes_per_call = 0  # warm cache: nothing seen
+        _analyze_cost(rec, fn, args, kwargs)
+    else:
+        out = fn(*args, **kwargs)
+    _account(rec)
+    return out
+
+
+# -- public wrapper ----------------------------------------------------------
+def instrument(label: str, fn: Callable) -> Callable:
+    """Wrap a (usually jitted) callable with dispatch attribution.
+
+    Disabled telemetry costs one bool check; attribution never raises
+    into the training loop it observes.
+    """
+
+    @functools.wraps(fn)
+    def wrapped(*args, **kwargs):
+        from . import enabled
+
+        if not enabled():
+            return fn(*args, **kwargs)
+        return _call_recorded(label, fn, args, kwargs)
+
+    wrapped.__wrapped__ = fn
+    wrapped.dispatch_label = label
+    # keep the AOT surface reachable (compile tests / cost analysis do
+    # `fn.lower(...).compile()` on the wrapped callable)
+    if hasattr(fn, "lower"):
+        wrapped.lower = fn.lower
+    return wrapped

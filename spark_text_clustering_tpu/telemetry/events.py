@@ -38,6 +38,8 @@ __all__ = [
     "read_events",
     "manifest_fields",
     "git_rev",
+    "process_info",
+    "per_process_path",
 ]
 
 SCHEMA_VERSION = 1
@@ -139,6 +141,52 @@ def git_rev(cwd: Optional[str] = None) -> Optional[str]:
         return None
 
 
+def process_info() -> Dict:
+    """``{"process_index": i, "process_count": n}`` from an
+    already-imported jax — reading it must NEVER trigger accelerator
+    bring-up, so a jax-free process reports nothing (single-process
+    semantics)."""
+    import sys
+
+    if "jax" not in sys.modules:
+        return {}
+    try:
+        import jax
+
+        return {
+            "process_index": int(jax.process_index()),
+            "process_count": int(jax.process_count()),
+        }
+    except (RuntimeError, ValueError, AttributeError, OSError):
+        # backend not (yet) initialized — the manifest simply records
+        # no process dimension
+        return {}
+
+
+def per_process_path(path: str, process_index: Optional[int] = None,
+                     process_count: Optional[int] = None) -> str:
+    """The per-process run-stream name for this ``jax.process_index()``.
+
+    Multi-host runs must not share one sink (a worker opening the
+    coordinator's file would truncate its records — the PR 1 failure
+    mode that forced the coordinator-only sink), so each process writes
+    ``<stem>-p<idx><ext>``.  Single-process runs keep the caller's path
+    verbatim, which keeps every existing single-host workflow and test
+    unchanged.
+    """
+    info = process_info()
+    idx = process_index if process_index is not None else int(
+        info.get("process_index", 0)
+    )
+    cnt = process_count if process_count is not None else int(
+        info.get("process_count", 1)
+    )
+    if cnt <= 1:
+        return path
+    root, ext = os.path.splitext(path)
+    return f"{root}-p{idx}{ext or '.jsonl'}"
+
+
 def manifest_fields(
     params=None,
     mesh=None,
@@ -159,6 +207,9 @@ def manifest_fields(
         "host": platform.node(),
         "git_rev": git_rev(),
     }
+    # process dimension: which member of a multi-host mesh wrote this
+    # stream (`metrics merge` folds N such streams into one logical run)
+    out.update(process_info())
     if params is not None:
         cfg = json.loads(params.to_json())
         out["config"] = cfg
@@ -242,10 +293,14 @@ class TelemetryWriter:
         if not self._manifest_written:
             self.write_manifest(auto=True)
         if self._registry is not None:
+            # the snapshot carries the process dimension so a merged
+            # view can attribute every counter to its writer even when
+            # streams are renamed/concatenated downstream
             self._sink.write({
                 "ts": time.time(),
                 "event": "registry",
                 "snapshot": self._registry.snapshot(),
+                **process_info(),
             })
 
 

@@ -7,6 +7,10 @@ ad-hoc JSON spelunking:
     python -m spark_text_clustering_tpu.cli metrics diff a.jsonl b.jsonl
     python -m spark_text_clustering_tpu.cli metrics check run.jsonl \
         --baseline base.json [--write-baseline] [--tolerance 0.25]
+    python -m spark_text_clustering_tpu.cli metrics merge \
+        run/events-p0.jsonl run/events-p1.jsonl [--fail-on-skew]
+    python -m spark_text_clustering_tpu.cli metrics trace \
+        run/events-p*.jsonl --out trace.json     # Perfetto-loadable
 
 Accepted inputs: a telemetry JSONL stream (manifest-first, the format
 ``telemetry.TelemetryWriter`` emits) OR a plain one-object JSON file
@@ -30,6 +34,7 @@ from __future__ import annotations
 
 import json
 import math
+import os
 import sys
 from typing import Dict, List, Tuple
 
@@ -39,9 +44,14 @@ __all__ = [
     "load_run",
     "run_metrics",
     "flatten_numeric",
+    "load_process_streams",
+    "merge_metrics",
+    "skew_findings",
     "cmd_summarize",
     "cmd_diff",
     "cmd_check",
+    "cmd_merge",
+    "cmd_trace",
     "add_metrics_subparser",
 ]
 
@@ -186,6 +196,254 @@ def run_metrics(events: List[Dict]) -> Dict[str, float]:
     return out
 
 
+# ---------------------------------------------------------------------------
+# merge: fold N per-process streams into one logical run + skew report
+# ---------------------------------------------------------------------------
+def load_process_streams(paths: List[str]):
+    """Load N per-process run streams, degrading gracefully: a missing,
+    unreadable, or manifest-less stream is reported and SKIPPED — a dead
+    worker must not make the surviving 127 hosts' telemetry unreadable.
+
+    Returns ``(streams, problems)``; each stream is ``{"path", "proc",
+    "label", "manifest", "events", "metrics"}``, ordered by process
+    index (falling back to argument order when a manifest carries none).
+    """
+    streams, problems = [], []
+    for i, path in enumerate(paths):
+        try:
+            manifest, events = load_run(path)
+        except OSError as exc:
+            problems.append(f"{path}: unreadable ({exc})")
+            continue
+        if not manifest and not events:
+            problems.append(f"{path}: empty stream (no manifest, no events)")
+            continue
+        if not manifest:
+            problems.append(
+                f"{path}: truncated stream (no manifest record) — "
+                f"metrics from its {len(events)} events still merged"
+            )
+        pidx = manifest.get("process_index")
+        proc = int(pidx) if isinstance(pidx, (int, float)) \
+            and not isinstance(pidx, bool) else i
+        streams.append({
+            "path": path,
+            "proc": proc,
+            "manifest": manifest,
+            "events": events,
+            "metrics": run_metrics(events),
+        })
+    # duplicate process indices (e.g. two streams with no manifest) must
+    # not silently shadow each other in the per-process tables
+    seen: Dict[int, int] = {}
+    for s in streams:
+        n = seen.get(s["proc"], 0)
+        seen[s["proc"]] = n + 1
+        s["label"] = f"p{s['proc']}" + (f".{n}" if n else "")
+    streams.sort(key=lambda s: (s["proc"], s["label"]))
+    return streams, problems
+
+
+def merge_metrics(streams) -> Dict[str, Dict]:
+    """Per-metric cross-process statistics: min / median / max / spread
+    (relative max-min width) + the per-process values themselves."""
+    import statistics
+
+    names = sorted({k for s in streams for k in s["metrics"]})
+    out: Dict[str, Dict] = {}
+    for name in names:
+        per = {
+            s["label"]: s["metrics"][name]
+            for s in streams if name in s["metrics"]
+        }
+        vals = sorted(per.values())
+        med = statistics.median(vals)
+        spread = (vals[-1] - vals[0]) / max(abs(med), _EPS)
+        out[name] = {
+            "min": vals[0], "median": med, "max": vals[-1],
+            "spread": spread, "per_process": per,
+            "processes": len(per),
+        }
+    return out
+
+
+# metric families the skew report inspects beyond generic timing spread
+_RETRY_KEY = "counter.resilience.retries"
+_QUEUE_KEY = "gauge.stream.queue_depth"
+
+
+def skew_findings(streams, merged: Dict[str, Dict],
+                  threshold: float) -> List[Dict]:
+    """Cross-host skew report over merged per-process metrics.
+
+    Three detectors (ROADMAP "multi-host telemetry aggregation"):
+      * **straggler** — a timing metric (``span.*.seconds`` histograms,
+        ``phase.*.seconds``, per-iteration means) whose max/median
+        spread exceeds ``threshold``; names the slowest process.
+      * **retries** — ``resilience.retries`` diverging across processes
+        (one host absorbing transient faults the others never see).
+      * **queue_depth** — ``stream.queue_depth`` divergence beyond the
+        threshold (one host's source backing up).
+    """
+    import statistics
+
+    finds: List[Dict] = []
+    for name, stat in merged.items():
+        if name in (_RETRY_KEY, _QUEUE_KEY):
+            if len(streams) < 2:
+                continue
+            # counters/gauges are zero-initialized: a process whose
+            # snapshot never mentions the metric reports 0, not
+            # "unknown" — otherwise the one host absorbing all the
+            # retries hides the divergence by being the only reporter
+            per = {
+                s["label"]: s["metrics"].get(name, 0.0) for s in streams
+            }
+            vals = sorted(per.values())
+            med = statistics.median(vals)
+            spread = (vals[-1] - vals[0]) / max(abs(med), _EPS)
+            worst = max(per, key=lambda lbl: per[lbl])
+            diverged = (
+                vals[-1] > vals[0] if name == _RETRY_KEY
+                else spread > threshold
+            )
+            if diverged:
+                finds.append({
+                    "kind": "retries" if name == _RETRY_KEY
+                    else "queue_depth",
+                    "metric": name, "process": worst,
+                    "value": per[worst], "median": med, "spread": spread,
+                })
+            continue
+        if stat["processes"] < 2:
+            continue
+        per = stat["per_process"]
+        is_timing = any(h in name for h in _TIMING_HINTS)
+        if is_timing and stat["spread"] > threshold and stat["max"] > 0:
+            slowest = max(per, key=lambda lbl: per[lbl])
+            finds.append({
+                "kind": "straggler", "metric": name,
+                "process": slowest, "value": per[slowest],
+                "median": stat["median"], "spread": stat["spread"],
+            })
+    order = {"straggler": 0, "retries": 1, "queue_depth": 2}
+    finds.sort(key=lambda f: (order[f["kind"]], -f["spread"], f["metric"]))
+    return finds
+
+
+def _clock_offsets(streams) -> Dict[str, float]:
+    """Per-process manifest-timestamp offset from the earliest stream —
+    surfaced (never corrected) so cross-host clock skew is visible."""
+    ts = {
+        s["label"]: s["manifest"].get("ts")
+        for s in streams
+        if _is_num(s["manifest"].get("ts"))
+    }
+    if not ts:
+        return {}
+    t0 = min(ts.values())
+    return {lbl: round(t - t0, 6) for lbl, t in ts.items()}
+
+
+def cmd_merge(args) -> int:
+    try:
+        return _cmd_merge(args)
+    except BrokenPipeError:      # `... | head` closed the pipe
+        return 0
+
+
+def _cmd_merge(args) -> int:
+    streams, problems = load_process_streams(args.runs)
+    for p in problems:
+        print(f"warning: {p}", file=sys.stderr)
+    if not streams:
+        print("no readable run streams to merge", file=sys.stderr)
+        return 2
+    merged = merge_metrics(streams)
+    findings = skew_findings(streams, merged, args.skew_threshold)
+    offsets = _clock_offsets(streams)
+
+    if getattr(args, "json", False):
+        doc = {
+            "processes": [
+                {
+                    "label": s["label"], "path": s["path"],
+                    "run_id": s["manifest"].get("run_id"),
+                    "host": s["manifest"].get("host"),
+                    "events": len(s["events"]),
+                    "clock_offset_s": offsets.get(s["label"]),
+                }
+                for s in streams
+            ],
+            "metrics": {f"merge.{k}": v for k, v in merged.items()},
+            "skew": [
+                {**f, "name": f"skew.{f['kind']}"} for f in findings
+            ],
+            "skew_threshold": args.skew_threshold,
+            "problems": problems,
+        }
+        print(json.dumps(doc, sort_keys=True))
+    else:
+        print(f"merged {len(streams)} process stream(s)")
+        for s in streams:
+            off = offsets.get(s["label"])
+            off_s = f", clock_offset={off:+.3f}s" if off is not None else ""
+            print(
+                f"  {s['label']}: {s['path']} "
+                f"(run_id={s['manifest'].get('run_id', '?')}, "
+                f"host={s['manifest'].get('host', '?')}, "
+                f"events={len(s['events'])}{off_s})"
+            )
+        w = max((len(k) for k in merged), default=10)
+        print(f"{'metric'.ljust(w)}  {'min':>12}  {'median':>12}  "
+              f"{'max':>12}  {'spread':>7}")
+        for k in sorted(merged):
+            st = merged[k]
+            mark = "  <<" if st["spread"] > args.skew_threshold \
+                and st["processes"] > 1 else ""
+            print(
+                f"{k.ljust(w)}  {st['min']:>12.6g}  {st['median']:>12.6g}"
+                f"  {st['max']:>12.6g}  {st['spread']:>7.2f}{mark}"
+            )
+        print(f"skew report (threshold {args.skew_threshold:g}):")
+        if not findings:
+            print("  no cross-host skew beyond threshold")
+        for f in findings:
+            print(
+                f"  {f['kind'].upper()} {f['metric']}: {f['process']}="
+                f"{f['value']:.6g} vs median {f['median']:.6g} "
+                f"(spread {f['spread']:.2f})"
+            )
+        print(f"# {len(merged)} metrics, {len(findings)} skew finding(s)")
+    if args.fail_on_skew and findings:
+        return 1
+    return 0
+
+
+def cmd_trace(args) -> int:
+    from .trace_export import trace_document
+
+    streams, problems = load_process_streams(args.runs)
+    for p in problems:
+        print(f"warning: {p}", file=sys.stderr)
+    if not streams:
+        print("no readable run streams to export", file=sys.stderr)
+        return 2
+    doc = trace_document(streams)
+    payload = json.dumps(doc)
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as f:
+            f.write(payload)
+        print(
+            f"trace written: {args.out} "
+            f"({len(doc['traceEvents'])} events, {len(streams)} track(s))"
+            f" — load in Perfetto / chrome://tracing"
+        )
+    else:
+        print(payload)
+    return 0
+
+
 def _print_manifest(manifest: Dict, file=None) -> None:
     file = file if file is not None else sys.stdout
     if not manifest:
@@ -296,11 +554,38 @@ def cmd_check(args) -> int:
     _, events = load_run(args.run)
     metrics = run_metrics(events)
     exclude = list(args.exclude or [])
+    include = list(getattr(args, "include", None) or [])
+
+    def selected(name: str) -> bool:
+        if include and not any(s in name for s in include):
+            return False
+        return not any(s in name for s in exclude)
 
     if args.write_baseline:
         base = _capture_baseline(
-            args.run, metrics, args.tolerance, exclude
+            args.run,
+            {k: v for k, v in metrics.items() if selected(k)},
+            args.tolerance, [],
         )
+        if include and os.path.exists(args.baseline):
+            # partial capture: refresh ONLY the included families inside
+            # an existing baseline (how ci_check folds lint.* counters
+            # into the shared ci_metrics_baseline.json without clobbering
+            # the training-run entries)
+            try:
+                with open(args.baseline, "r", encoding="utf-8") as f:
+                    prev = json.load(f)
+            except (OSError, json.JSONDecodeError) as exc:
+                print(f"cannot merge into baseline {args.baseline}: {exc}",
+                      file=sys.stderr)
+                return 2
+            kept = {
+                k: v for k, v in prev.get("metrics", {}).items()
+                if not any(s in k for s in include)
+            }
+            kept.update(base["metrics"])
+            prev["metrics"] = kept
+            base = prev
         with open(args.baseline, "w", encoding="utf-8") as f:
             json.dump(base, f, indent=2, sort_keys=True)
             f.write("\n")
@@ -318,7 +603,7 @@ def cmd_check(args) -> int:
     failures = []
     checked = 0
     for k, spec in sorted(base.get("metrics", {}).items()):
-        if any(s in k for s in exclude):
+        if not selected(k):
             continue
         want = spec.get("value")
         tol = spec.get(
@@ -383,4 +668,43 @@ def add_metrics_subparser(sub) -> None:
         help="skip metrics whose name contains this substring "
              "(repeatable)",
     )
+    ck.add_argument(
+        "--include", action="append", default=[],
+        help="check ONLY metrics whose name contains this substring "
+             "(repeatable); with --write-baseline and an existing "
+             "baseline, refresh just these families in place",
+    )
     ck.set_defaults(fn=cmd_check)
+
+    mg = msub.add_parser(
+        "merge",
+        help="fold N per-process run streams into one logical run "
+             "with a cross-host skew report",
+    )
+    mg.add_argument(
+        "runs", nargs="+",
+        help="per-process telemetry .jsonl streams (events-p<idx>.jsonl)",
+    )
+    mg.add_argument("--json", action="store_true")
+    mg.add_argument(
+        "--skew-threshold", type=float, default=0.5,
+        help="relative (max-min)/|median| width beyond which a "
+             "cross-process metric counts as skewed",
+    )
+    mg.add_argument(
+        "--fail-on-skew", action="store_true",
+        help="exit 1 when the skew report is non-empty (the CI gate)",
+    )
+    mg.set_defaults(fn=cmd_merge)
+
+    tc = msub.add_parser(
+        "trace",
+        help="export run stream(s) as Perfetto-loadable Chrome "
+             "trace_event JSON (one track per process)",
+    )
+    tc.add_argument("runs", nargs="+")
+    tc.add_argument(
+        "--out", default=None,
+        help="write the trace here (default: stdout)",
+    )
+    tc.set_defaults(fn=cmd_trace)

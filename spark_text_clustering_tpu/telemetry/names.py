@@ -49,6 +49,8 @@ METRICS: Dict[str, str] = {
     "telemetry_write_errors": "run-stream appends that failed after retry",
     # -- streaming ------------------------------------------------------
     "stream.queue_depth": "new-but-unconsumed files seen by the last poll",
+    "stream.trigger_cap":
+        "current AIMD max_files_per_trigger cap (backpressure controller)",
     "stream.score.micro_batch_seconds": "stream-score trigger wall time",
     "stream.train.micro_batch_seconds": "stream-train trigger wall time",
     # -- training loops -------------------------------------------------
@@ -65,6 +67,14 @@ PREFIXES: Dict[str, str] = {
     "train.": "telemetry facade: per-optimizer iteration histograms",
     "collective.": "parallel.collectives: per-op trace-time calls/bytes",
     "probe.accelerator.": "utils.env: probe attempts by outcome class",
+    "dispatch.":
+        "telemetry.dispatch: per-compiled-executable calls / runtime "
+        "collective bytes / cost_analysis device-time estimates",
+    # CLI-derived families (written by `metrics merge`, never by a hot
+    # path): cross-process aggregates and skew-report findings
+    "merge.": "metrics merge: per-metric min/median/max across processes",
+    "skew.": "metrics merge: cross-host skew findings (straggler/retries/"
+             "queue-depth divergence)",
 }
 
 

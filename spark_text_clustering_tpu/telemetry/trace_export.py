@@ -1,0 +1,121 @@
+"""Chrome ``trace_event`` export of telemetry run streams.
+
+``metrics trace`` converts one or more (per-process) JSONL run streams
+into the Trace Event Format that Perfetto / ``chrome://tracing`` load
+directly: one *process track* per telemetry stream (pid = the stream's
+``process_index``), spans / training iterations / micro-batches as
+complete ("X") duration events, everything else as instants.
+
+Clock skew: hosts in a mesh do not share a clock, so timestamps are
+re-based PER STREAM against that stream's manifest timestamp — each
+host's track starts at t=0 and is internally consistent; cross-track
+alignment is therefore structural (same phase names line up), not
+wall-clock-exact.  The per-stream offset is recorded in the track's
+``process_name`` metadata so the original skew stays inspectable.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List
+
+__all__ = ["trace_events_from_streams", "trace_document"]
+
+_US = 1e6  # trace_event timestamps/durations are microseconds
+
+
+def _num(v) -> bool:
+    return isinstance(v, (int, float)) and not isinstance(v, bool) \
+        and math.isfinite(v)
+
+
+def _base_ts(manifest: Dict, events: List[Dict]) -> float:
+    ts = manifest.get("ts")
+    if _num(ts):
+        return float(ts)
+    for e in events:
+        if _num(e.get("ts")):
+            return float(e["ts"])
+    return 0.0
+
+
+def _complete(name, cat, pid, start_us, dur_us, args=None) -> Dict:
+    ev = {
+        "name": str(name), "cat": cat, "ph": "X", "pid": pid, "tid": 0,
+        "ts": round(max(0.0, start_us), 3), "dur": round(max(0.0, dur_us), 3),
+    }
+    if args:
+        ev["args"] = args
+    return ev
+
+
+def trace_events_from_streams(streams: List[Dict]) -> List[Dict]:
+    """``streams``: [{"proc": pid, "manifest": ..., "events": [...]}]
+    (the shape ``metrics_cli.load_process_streams`` returns).  Returns a
+    flat trace_event list, one pid track per stream."""
+    out: List[Dict] = []
+    for s in streams:
+        pid = int(s["proc"])
+        manifest, events = s["manifest"], s["events"]
+        base = _base_ts(manifest, events)
+        host = manifest.get("host", "?")
+        out.append({
+            "name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+            "args": {
+                "name": f"p{pid} {host}"
+                        f" (run {manifest.get('run_id', '?')})",
+            },
+        })
+        out.append({
+            "name": "process_sort_index", "ph": "M", "pid": pid,
+            "tid": 0, "args": {"sort_index": pid},
+        })
+        for e in events:
+            ts = e.get("ts")
+            if not _num(ts):
+                continue
+            rel_us = (float(ts) - base) * _US
+            kind = e.get("event")
+            secs = e.get("seconds")
+            if kind == "span" and _num(secs):
+                # span events are emitted at EXIT: ts is the end time
+                out.append(_complete(
+                    e.get("name", "span"), "span", pid,
+                    rel_us - float(secs) * _US, float(secs) * _US,
+                ))
+            elif kind == "train_iteration" and _num(secs):
+                out.append(_complete(
+                    f"{e.get('optimizer', '?')}[{e.get('iteration')}]",
+                    "train", pid,
+                    rel_us - float(secs) * _US, float(secs) * _US,
+                    {"kind": e.get("kind")},
+                ))
+            elif kind == "micro_batch" and _num(secs):
+                out.append(_complete(
+                    f"micro_batch[{e.get('batch_id')}]",
+                    f"stream.{e.get('role', '?')}", pid,
+                    rel_us - float(secs) * _US, float(secs) * _US,
+                    {"docs": e.get("docs")},
+                ))
+            elif kind == "phase" and _num(secs):
+                out.append(_complete(
+                    f"phase:{e.get('name', '?')}", "phase", pid,
+                    rel_us - float(secs) * _US, float(secs) * _US,
+                ))
+            elif kind in ("manifest", "registry"):
+                continue
+            else:
+                out.append({
+                    "name": str(kind), "cat": "event", "ph": "i",
+                    "pid": pid, "tid": 0, "ts": round(max(0.0, rel_us), 3),
+                    "s": "p",
+                })
+    return out
+
+
+def trace_document(streams: List[Dict]) -> Dict:
+    """The full Perfetto-loadable JSON object."""
+    return {
+        "traceEvents": trace_events_from_streams(streams),
+        "displayTimeUnit": "ms",
+    }

@@ -98,6 +98,17 @@ def main() -> int:
     import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec as P
 
+    # per-process telemetry stream (events-p<idx>.jsonl next to the
+    # output file): every process — not just the coordinator — writes
+    # its own manifested run stream; the parent test (and `metrics
+    # merge`) folds them back into one logical run
+    from spark_text_clustering_tpu import telemetry
+
+    telemetry.configure(telemetry.per_process_path(
+        os.path.join(os.path.dirname(out_path), "events.jsonl")
+    ))
+    telemetry.manifest(kind="multihost-test")
+
     assert jax.process_count() == nproc, jax.process_count()
     n_dev = jax.device_count()
     assert n_dev == 2 * nproc, n_dev
@@ -203,6 +214,8 @@ def main() -> int:
     vocab_global, _ = build_vocab(count_terms(tok_docs), 8)
     assert vocab_dist == vocab_global, (vocab_dist, vocab_global)
     assert t2i_dist[vocab_dist[0]] == 0
+
+    telemetry.shutdown()  # flush each process's registry snapshot
 
     if pid == 0:
         assert ckpt_exists, "coordinator checkpoint missing"

@@ -1,0 +1,263 @@
+"""Per-executable dispatch attribution (telemetry.dispatch) and the
+per-process run-stream plumbing (events-p<idx>.jsonl naming, process
+dimension in manifests/registry snapshots)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from spark_text_clustering_tpu import telemetry
+from spark_text_clustering_tpu.telemetry import dispatch as dispatch_attr
+
+
+@pytest.fixture(autouse=True)
+def _telemetry_reset():
+    telemetry.shutdown()
+    telemetry.get_registry().reset()
+    dispatch_attr.reset()
+    yield
+    telemetry.shutdown()
+    telemetry.get_registry().reset()
+    dispatch_attr.reset()
+
+
+def _dispatch_counters():
+    snap = telemetry.get_registry().snapshot()
+    return {
+        k: v for k, v in snap["counters"].items()
+        if k.startswith("dispatch.")
+    }
+
+
+class TestInstrument:
+    def test_disabled_mode_is_a_passthrough(self):
+        calls = []
+        fn = telemetry.instrument_dispatch(
+            "t.f", lambda x: calls.append(x) or x + 1
+        )
+        assert fn(1) == 2
+        assert calls == [1]
+        assert _dispatch_counters() == {}
+        assert dispatch_attr.records() == {}
+
+    def test_calls_counted_per_executable_digest(self):
+        telemetry.configure(None)
+        fn = telemetry.instrument_dispatch(
+            "t.add", jax.jit(lambda x: x + 1)
+        )
+        a = jnp.ones((4,))
+        fn(a)
+        fn(a)
+        fn(jnp.ones((8,)))          # new shape -> new executable digest
+        recs = dispatch_attr.records()
+        assert len(recs) == 2
+        by_calls = sorted(r.calls for r in recs.values())
+        assert by_calls == [1, 2]
+        counters = _dispatch_counters()
+        assert sorted(
+            v for k, v in counters.items() if k.endswith(".calls")
+        ) == [1, 2]
+        for rec in recs.values():
+            assert rec.label == "t.add"
+
+    def test_wrapper_preserves_aot_surface(self):
+        jitted = jax.jit(lambda x: x * 2)
+        fn = telemetry.instrument_dispatch("t.mul", jitted)
+        assert fn.__wrapped__ is jitted
+        # compile tests and cost analysis rely on .lower surviving
+        hlo = fn.lower(jnp.ones((4,))).compile().as_text()
+        assert hlo
+
+    def test_transparent_under_an_outer_trace(self):
+        # the jaxpr audit (and any enclosing jit) must see the wrapped
+        # function as if the wrapper did not exist — no bookkeeping on
+        # tracer operands
+        telemetry.configure(None)
+        fn = telemetry.instrument_dispatch(
+            "t.traced", jax.jit(lambda x: x - 1)
+        )
+        jaxpr = jax.make_jaxpr(fn)(jnp.ones((4,)))
+        assert jaxpr is not None
+        assert dispatch_attr.records() == {}
+
+    def test_executable_event_emitted_once_per_stream(self, tmp_path):
+        p = str(tmp_path / "run.jsonl")
+        telemetry.configure(p)
+        telemetry.manifest(kind="t")
+        fn = telemetry.instrument_dispatch(
+            "t.evt", jax.jit(lambda x: x + 3)
+        )
+        for _ in range(4):
+            fn(jnp.ones((4,)))
+        telemetry.shutdown()
+        evs = telemetry.read_events(p)
+        execs = [e for e in evs if e["event"] == "dispatch_executable"]
+        assert len(execs) == 1
+        assert execs[0]["label"] == "t.evt"
+        assert execs[0]["digest"]
+        assert execs[0]["cost_source"]
+
+
+class TestTrainingAttribution:
+    """Acceptance: dispatch.* counters are nonzero after an EM + online
+    training run and appear in `metrics summarize`."""
+
+    def _rows(self, seed=0, v=50):
+        rng = np.random.default_rng(seed)
+        rows = []
+        for _ in range(16):
+            ids = np.sort(
+                rng.choice(v, size=8, replace=False)
+            ).astype(np.int32)
+            rows.append((ids, rng.integers(1, 5, 8).astype(np.float32)))
+        return rows, [f"t{i}" for i in range(v)]
+
+    @pytest.mark.parametrize("algorithm", ["em", "online"])
+    def test_fit_attributes_dispatches(self, algorithm, tmp_path, capsys):
+        from spark_text_clustering_tpu.cli import main
+        from spark_text_clustering_tpu.config import Params
+        from spark_text_clustering_tpu.models.em_lda import EMLDA
+        from spark_text_clustering_tpu.models.online_lda import OnlineLDA
+        from spark_text_clustering_tpu.parallel.mesh import make_mesh
+
+        rows, vocab = self._rows()
+        p = str(tmp_path / "run.jsonl")
+        telemetry.configure(p)
+        telemetry.manifest(kind="t", algorithm=algorithm)
+        cls = {"em": EMLDA, "online": OnlineLDA}[algorithm]
+        cls(
+            Params(k=2, algorithm=algorithm, max_iterations=3, seed=0),
+            mesh=make_mesh(data_shards=4, model_shards=2),
+        ).fit(rows, vocab)
+        telemetry.shutdown()
+
+        evs = telemetry.read_events(p)
+        snap = evs[-1]["snapshot"]
+        calls = {
+            k: v for k, v in snap["counters"].items()
+            if k.startswith("dispatch.") and k.endswith(".calls")
+        }
+        assert calls and all(v > 0 for v in calls.values())
+        # the trace-time collective bytes became a runtime total
+        coll = {
+            k: v for k, v in snap["counters"].items()
+            if k.startswith("dispatch.")
+            and k.endswith(".collective_bytes")
+        }
+        assert coll and any(v > 0 for v in coll.values())
+        labels = {
+            e["label"] for e in evs
+            if e["event"] == "dispatch_executable"
+        }
+        assert any(
+            lbl.startswith(("em.", "online.", "sharded_eval."))
+            for lbl in labels
+        )
+        # and metrics summarize surfaces the family
+        assert main(["metrics", "summarize", p]) == 0
+        out = capsys.readouterr().out
+        assert "counter.dispatch." in out
+
+    def test_streaming_trainer_attributes_dispatches(self):
+        from spark_text_clustering_tpu.config import Params
+        from spark_text_clustering_tpu.parallel.mesh import make_mesh
+        from spark_text_clustering_tpu.streaming import (
+            MemoryStreamSource,
+            StreamingOnlineLDA,
+        )
+
+        telemetry.configure(None)
+        trainer = StreamingOnlineLDA(
+            Params(k=2, algorithm="online", seed=0),
+            num_features=64,
+            mesh=make_mesh(data_shards=4, model_shards=2),
+            batch_capacity=4,
+            lemmatize=False,
+        )
+        src = MemoryStreamSource(max_docs_per_trigger=3)
+        src.add(["piano violin cello"] * 6)
+        while True:
+            mb = src.poll()
+            if mb is None:
+                break
+            trainer.process(mb)
+        counters = _dispatch_counters()
+        step_calls = [
+            v for k, v in counters.items() if k.endswith(".calls")
+        ]
+        assert step_calls and max(step_calls) >= 2  # one per micro-batch
+
+
+class TestPerProcessStreams:
+    def test_single_process_path_is_identity(self):
+        assert telemetry.per_process_path("runs/a.jsonl") == "runs/a.jsonl"
+
+    def test_multi_process_naming(self):
+        assert telemetry.per_process_path(
+            "runs/events.jsonl", process_index=3, process_count=8
+        ) == "runs/events-p3.jsonl"
+        assert telemetry.per_process_path(
+            "runs/events", process_index=1, process_count=2
+        ) == "runs/events-p1.jsonl"
+
+    def test_manifest_and_registry_carry_process_dimension(self, tmp_path):
+        p = str(tmp_path / "run.jsonl")
+        telemetry.configure(p)
+        telemetry.manifest(kind="t")
+        telemetry.count("telemetry_write_errors", 0)
+        telemetry.shutdown()
+        evs = telemetry.read_events(p)
+        man = evs[0]
+        # conftest imported jax, so the single-process dimension is known
+        assert man["process_index"] == 0
+        assert man["process_count"] == 1
+        reg_ev = evs[-1]
+        assert reg_ev["event"] == "registry"
+        assert reg_ev["process_index"] == 0
+
+    def test_process_info_shape(self):
+        info = telemetry.process_info()
+        assert info["process_index"] == 0
+        assert info["process_count"] == 1
+
+
+class TestCostTracingSuppression:
+    def test_cost_retrace_does_not_double_count_collectives(self):
+        """The cost_analysis lower()+compile() retrace fires the
+        collective helpers again; the suppression flag must keep the
+        trace-time counters at exactly one trace's worth."""
+        from spark_text_clustering_tpu.models.em_lda import (
+            make_em_bucket_step,
+        )
+        from spark_text_clustering_tpu.ops.sparse import DocTermBatch
+        from spark_text_clustering_tpu.parallel.mesh import make_mesh
+
+        telemetry.configure(None)
+        mesh = make_mesh(data_shards=1, model_shards=1,
+                         devices=jax.devices()[:1])
+        raw = make_em_bucket_step(mesh, alpha=11.0, eta=1.1, vocab_size=16)
+        fn = telemetry.instrument_dispatch("t.em_bucket", raw)
+        batch = DocTermBatch(
+            np.zeros((4, 4), np.int32), np.ones((4, 4), np.float32)
+        )
+        args = (np.ones((2, 16), np.float32),
+                np.ones((4, 2), np.float32), batch)
+        fn(*args)
+        snap1 = telemetry.get_registry().snapshot()["counters"]
+        traced1 = {
+            k: v for k, v in snap1.items()
+            if k.startswith("collective.") and k.endswith(".calls")
+        }
+        assert traced1, "the instrumented trace must count collectives"
+        # a second identical call is a cache hit: no new trace counts
+        fn(*args)
+        snap2 = telemetry.get_registry().snapshot()["counters"]
+        traced2 = {
+            k: v for k, v in snap2.items()
+            if k.startswith("collective.") and k.endswith(".calls")
+        }
+        assert traced2 == traced1
+        rec = next(iter(dispatch_attr.records().values()))
+        assert rec.calls == 2
+        assert rec.collective_bytes_per_call is not None

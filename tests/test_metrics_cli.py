@@ -1,6 +1,8 @@
-"""``metrics`` CLI (summarize / diff / check) + the end-to-end
-acceptance flow: train via the CLI with telemetry on, summarize the
-emitted JSONL, capture a baseline, check passes, perturbed check fails."""
+"""``metrics`` CLI (summarize / diff / check / merge / trace) + the
+end-to-end acceptance flow: train via the CLI with telemetry on,
+summarize the emitted JSONL, capture a baseline, check passes,
+perturbed check fails; merge folds per-process streams into one logical
+run with a skew report; trace exports Perfetto-loadable JSON."""
 
 import json
 
@@ -10,9 +12,13 @@ from spark_text_clustering_tpu import telemetry
 from spark_text_clustering_tpu.cli import main
 from spark_text_clustering_tpu.telemetry.metrics_cli import (
     flatten_numeric,
+    load_process_streams,
     load_run,
+    merge_metrics,
     run_metrics,
+    skew_findings,
 )
+from spark_text_clustering_tpu.telemetry.registry import MetricRegistry
 
 
 @pytest.fixture(autouse=True)
@@ -160,6 +166,220 @@ class TestMetricsCommands:
         assert (
             b["metrics"]["train.em.iterations"]["tolerance"] == 0.25
         )
+
+
+def _make_proc_stream(
+    tmp_path, idx, *, nproc=2, span_s=0.1, retries=0, queue_depth=0.0,
+    ts=None, iters=3, iter_s=None,
+):
+    """One synthetic per-process run stream (events-p<idx>.jsonl): a
+    manifest carrying the process dimension, span/train events, and a
+    registry snapshot with the skew-relevant counters/gauges."""
+    p = str(tmp_path / f"events-p{idx}.jsonl")
+    reg = MetricRegistry()
+    reg.histogram("span.train.em.seconds").observe(span_s)
+    if retries:
+        reg.counter("resilience.retries").inc(retries)
+    reg.gauge("stream.queue_depth").set(queue_depth)
+    w = telemetry.TelemetryWriter(p, registry=reg, run_id=f"r-p{idx}")
+    fields = {"kind": "synth", "process_index": idx,
+              "process_count": nproc, "host": f"host{idx}"}
+    if ts is not None:  # simulate a skewed host clock
+        fields["ts"] = ts
+    w.write_manifest(**fields)
+    for i in range(iters):
+        w.emit("train_iteration", optimizer="em", iteration=i,
+               seconds=iter_s if iter_s is not None else span_s,
+               kind="per_iteration")
+    w.emit("span", name="train.em", seconds=span_s)
+    w.close()
+    return p
+
+
+class TestMerge:
+    def test_min_median_max_across_processes(self, tmp_path, capsys):
+        paths = [
+            _make_proc_stream(tmp_path, i, nproc=3, span_s=0.1 + 0.01 * i)
+            for i in range(3)
+        ]
+        assert main(["metrics", "merge", *paths]) == 0
+        out = capsys.readouterr().out
+        assert "merged 3 process stream(s)" in out
+        assert "min" in out and "median" in out and "max" in out
+        streams, problems = load_process_streams(paths)
+        assert not problems
+        merged = merge_metrics(streams)
+        st = merged["hist.span.train.em.seconds.mean"]
+        assert st["min"] == pytest.approx(0.1, rel=1e-6)
+        assert st["median"] == pytest.approx(0.11, rel=1e-6)
+        assert st["max"] == pytest.approx(0.12, rel=1e-6)
+        assert st["per_process"]["p2"] == pytest.approx(0.12, rel=1e-6)
+
+    def test_straggler_process_flagged_and_gates(self, tmp_path, capsys):
+        a = _make_proc_stream(tmp_path, 0, span_s=0.1)
+        b = _make_proc_stream(tmp_path, 1, span_s=1.0)  # 10x straggler
+        assert main([
+            "metrics", "merge", a, b, "--fail-on-skew",
+        ]) == 1
+        out = capsys.readouterr().out
+        assert "STRAGGLER" in out
+        # json view names the slowest process
+        assert main(["metrics", "merge", a, b, "--json"]) == 0
+        doc = json.loads(capsys.readouterr().out)
+        stragglers = [
+            f for f in doc["skew"] if f["kind"] == "straggler"
+        ]
+        assert stragglers and all(
+            f["process"] == "p1" for f in stragglers
+        )
+        # balanced pair passes the same gate
+        c = _make_proc_stream(tmp_path, 0, span_s=0.1)
+        d = _make_proc_stream(tmp_path, 1, span_s=0.102)
+        assert main([
+            "metrics", "merge", c, d, "--fail-on-skew",
+        ]) == 0
+
+    def test_retries_and_queue_depth_divergence(self, tmp_path, capsys):
+        a = _make_proc_stream(tmp_path, 0, retries=0, queue_depth=1.0)
+        b = _make_proc_stream(tmp_path, 1, retries=7, queue_depth=40.0)
+        assert main(["metrics", "merge", a, b, "--json"]) == 0
+        doc = json.loads(capsys.readouterr().out)
+        kinds = {f["kind"]: f for f in doc["skew"]}
+        assert kinds["retries"]["process"] == "p1"
+        assert kinds["queue_depth"]["process"] == "p1"
+
+    def test_missing_worker_stream_degrades(self, tmp_path, capsys):
+        a = _make_proc_stream(tmp_path, 0)
+        gone = str(tmp_path / "events-p1.jsonl.gone")
+        assert main(["metrics", "merge", a, gone]) == 0
+        err = capsys.readouterr().err
+        assert "unreadable" in err
+
+    def test_truncated_worker_stream_degrades(self, tmp_path, capsys):
+        a = _make_proc_stream(tmp_path, 0)
+        b = _make_proc_stream(tmp_path, 1)
+        with open(b, "r", encoding="utf-8") as f:
+            whole = f.read()
+        # cut mid-record (a live run being merged mid-write)
+        with open(b, "w", encoding="utf-8") as f:
+            f.write(whole[: int(len(whole) * 0.6)])
+        assert main(["metrics", "merge", a, b]) == 0
+        out = capsys.readouterr().out
+        assert "merged 2 process stream(s)" in out
+
+    def test_clock_skewed_timestamps_survive(self, tmp_path, capsys):
+        a = _make_proc_stream(tmp_path, 0, ts=1_700_000_000.0)
+        b = _make_proc_stream(tmp_path, 1, ts=1_700_000_137.5)
+        assert main(["metrics", "merge", a, b, "--json"]) == 0
+        doc = json.loads(capsys.readouterr().out)
+        offs = {
+            p["label"]: p["clock_offset_s"] for p in doc["processes"]
+        }
+        assert offs["p0"] == 0.0
+        assert offs["p1"] == pytest.approx(137.5, abs=1.0)
+
+    def test_no_streams_is_an_error(self, tmp_path, capsys):
+        assert main([
+            "metrics", "merge", str(tmp_path / "nope.jsonl"),
+        ]) == 2
+
+    def test_skew_findings_need_two_processes(self, tmp_path):
+        a = _make_proc_stream(tmp_path, 0, span_s=5.0, retries=9)
+        streams, _ = load_process_streams([a])
+        assert skew_findings(streams, merge_metrics(streams), 0.5) == []
+
+
+class TestTraceExport:
+    def test_round_trip_valid_trace_event_json(self, tmp_path, capsys):
+        a = _make_proc_stream(tmp_path, 0, span_s=0.1)
+        b = _make_proc_stream(tmp_path, 1, span_s=0.2)
+        out_path = str(tmp_path / "trace.json")
+        assert main([
+            "metrics", "trace", a, b, "--out", out_path,
+        ]) == 0
+        with open(out_path, encoding="utf-8") as f:
+            doc = json.load(f)   # must be VALID JSON
+        evs = doc["traceEvents"]
+        assert isinstance(evs, list) and evs
+        assert doc["displayTimeUnit"] == "ms"
+        pids = {e["pid"] for e in evs}
+        assert pids == {0, 1}    # one track per process
+        complete = [e for e in evs if e["ph"] == "X"]
+        assert complete, "spans/iterations must export as complete events"
+        for e in complete:
+            assert e["ts"] >= 0 and e["dur"] >= 0
+            assert isinstance(e["name"], str) and e["name"]
+        metas = [e for e in evs if e["ph"] == "M"]
+        assert any(e["name"] == "process_name" for e in metas)
+        # span duration survives the round trip (0.1s -> 1e5 us)
+        span_evs = [e for e in complete if e.get("cat") == "span"]
+        assert any(abs(e["dur"] - 1e5) < 1e3 for e in span_evs
+                   if e["pid"] == 0)
+
+    def test_stdout_mode_emits_json(self, tmp_path, capsys):
+        a = _make_proc_stream(tmp_path, 0)
+        assert main(["metrics", "trace", a]) == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["traceEvents"]
+
+
+class TestMultihostShapedMerge:
+    """Merge over streams produced by REAL fits of the multihost
+    worker's shared fixtures — the multihost-shaped path without a
+    multi-process backend: each 'process' is a separate single-process
+    fit writing its own per-process-named stream."""
+
+    def test_fit_streams_merge_and_flag_planted_straggler(
+        self, tmp_path, capsys
+    ):
+        from multihost_worker import make_toy_fit_rows
+        from spark_text_clustering_tpu.config import Params
+        from spark_text_clustering_tpu.models.em_lda import EMLDA
+        from spark_text_clustering_tpu.parallel.mesh import make_mesh
+
+        rows, vocab = make_toy_fit_rows()
+        paths = []
+        for idx in (0, 1):
+            p = telemetry.per_process_path(
+                str(tmp_path / "events.jsonl"),
+                process_index=idx, process_count=2,
+            )
+            assert p.endswith(f"events-p{idx}.jsonl")
+            telemetry.configure(p)
+            telemetry.manifest(
+                kind="multihost-shaped", process_index=idx,
+                process_count=2,
+            )
+            mesh = make_mesh(data_shards=4, model_shards=2)
+            with telemetry.span("train.em", emit=True):
+                EMLDA(
+                    Params(k=2, algorithm="em", max_iterations=3, seed=0),
+                    mesh=mesh,
+                ).fit(rows, vocab)
+            if idx == 1:
+                # plant the straggler: p1's train span also absorbed an
+                # artificial 30s stall (both processes record the span
+                # histogram, so the detector can rank them)
+                telemetry.get_registry().histogram(
+                    "span.train.em.seconds"
+                ).observe(30.0)
+            telemetry.shutdown()
+            paths.append(p)
+
+        streams, problems = load_process_streams(paths)
+        assert not problems
+        assert [s["proc"] for s in streams] == [0, 1]
+        merged = merge_metrics(streams)
+        # real training metrics fold across both "hosts"
+        assert merged["train.em.iterations"]["processes"] == 2
+        finds = skew_findings(streams, merged, 0.5)
+        stragglers = [f for f in finds if f["kind"] == "straggler"]
+        assert any(f["process"] == "p1" for f in stragglers)
+        # and the CLI gate sees the same thing
+        assert main([
+            "metrics", "merge", *paths, "--fail-on-skew",
+        ]) == 1
+        capsys.readouterr()
 
 
 class TestEndToEnd:

@@ -16,6 +16,7 @@ import pytest
 from spark_text_clustering_tpu.config import Params
 from spark_text_clustering_tpu.models.base import LDAModel
 from spark_text_clustering_tpu.streaming import (
+    AIMDTriggerController,
     FileStreamSource,
     MemoryStreamSource,
     MicroBatch,
@@ -154,6 +155,86 @@ class TestMemoryStreamSource:
         assert (len(mb1), len(mb2)) == (3, 1)
         assert mb1.names == ["a", "b", "c"] and mb2.names == ["d"]
         assert src.poll() is None
+
+
+# ---------------------------------------------------------------------------
+# Adaptive backpressure controller
+# ---------------------------------------------------------------------------
+class TestAIMDTriggerController:
+    def test_overshoot_halves_backlog_widens(self):
+        c = AIMDTriggerController(
+            target_batch_seconds=1.0, initial_cap=8
+        )
+        # slow trigger: multiplicative decrease
+        assert c.update(queue_depth=0, batch_seconds=2.0) == 4
+        assert c.update(queue_depth=0, batch_seconds=2.0) == 2
+        # backlog with latency headroom: additive increase
+        assert c.update(queue_depth=10, batch_seconds=0.1) == 3
+        assert c.update(queue_depth=10, batch_seconds=0.1) == 4
+        # in budget, no backlog: hold
+        assert c.update(queue_depth=1, batch_seconds=0.1) == 4
+
+    def test_cap_respects_bounds(self):
+        c = AIMDTriggerController(
+            target_batch_seconds=1.0, initial_cap=2, min_cap=1, max_cap=3
+        )
+        for _ in range(5):
+            c.update(queue_depth=0, batch_seconds=9.0)
+        assert c.cap == 1
+        for _ in range(9):
+            c.update(queue_depth=99, batch_seconds=0.0)
+        assert c.cap == 3
+
+    def test_invalid_config_rejected(self):
+        with pytest.raises(ValueError):
+            AIMDTriggerController(target_batch_seconds=0.0)
+        with pytest.raises(ValueError):
+            AIMDTriggerController(backoff=1.5)
+
+    def test_decisions_observable_as_trigger_cap_gauge(self):
+        from spark_text_clustering_tpu import telemetry
+
+        telemetry.configure(None)
+        try:
+            c = AIMDTriggerController(
+                target_batch_seconds=1.0, initial_cap=8
+            )
+            c.update(queue_depth=0, batch_seconds=5.0)
+            snap = telemetry.get_registry().snapshot()
+            assert snap["gauges"]["stream.trigger_cap"] == 4
+        finally:
+            telemetry.shutdown()
+            telemetry.get_registry().reset()
+
+    def test_apply_retunes_file_source_cap(self, tmp_path):
+        src = FileStreamSource(str(tmp_path), max_files_per_trigger=8)
+        c = AIMDTriggerController(
+            target_batch_seconds=1.0, initial_cap=8
+        )
+        c.update(queue_depth=0, batch_seconds=3.0)
+        c.apply(src)
+        assert src.max_files == 4
+
+    def test_trainer_run_drives_controller(self):
+        """StreamingOnlineLDA.run feeds (queue_depth, seconds) into the
+        controller after every trigger; fast in-budget triggers with a
+        standing backlog must widen the cap."""
+        trainer = StreamingOnlineLDA(
+            Params(k=2, algorithm="online", seed=0),
+            vocab=_toy_model().vocab,
+            lemmatize=False,
+            batch_capacity=4,
+        )
+        src = MemoryStreamSource(max_docs_per_trigger=2)
+        src.add(TOPIC_A_DOCS + TOPIC_B_DOCS)
+        c = AIMDTriggerController(
+            target_batch_seconds=60.0, initial_cap=1
+        )
+        trainer.run(src, controller=c, poll_interval=0.0)
+        assert trainer.batches_seen > 0
+        # 8 docs / 2 per trigger = 4 triggers; the first three see a
+        # backlog above the 1-file cap, so the cap grew additively
+        assert c.cap > 1
 
 
 # ---------------------------------------------------------------------------
