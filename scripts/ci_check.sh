@@ -23,9 +23,15 @@
 #   7. cross-host skew gate: two simulated per-process streams merged
 #      with `metrics merge --fail-on-skew` — the planted straggler MUST
 #      be flagged (exit 1) and the balanced pair must pass (exit 0)
+#   8. exactly-once ledger chaos drill: a stream-train run is KILLED at
+#      the epoch-ledger commit append (STC_FAULTS, the fast
+#      single-process drill — the full kill-at-every-site sweep runs in
+#      tier-1 as tests/test_ledger.py), resumed, and the resumed run's
+#      ledger counters (commits, rollbacks) gated against the committed
+#      baseline via `metrics check --include ledger.`
 #
 # Usage:
-#   scripts/ci_check.sh                 # run all seven gates
+#   scripts/ci_check.sh                 # run all eight gates
 #   scripts/ci_check.sh --rebaseline    # recapture ALL baselines
 #                                       # (metrics + lint waivers +
 #                                       # lint counters; commit the
@@ -73,6 +79,44 @@ EOF
         --telemetry-file "$workdir/run.jsonl" >/dev/null
 }
 
+run_ledger_drill() {
+    # the single-process exactly-once drill: kill a transactional
+    # stream-train at the ledger commit append, resume, emit the
+    # resumed run's telemetry (its ledger.commits / ledger.rollbacks
+    # are machine-independent)
+    local workdir="$1"
+    python - "$workdir" <<'EOF'
+import os, sys
+import numpy as np
+
+workdir = sys.argv[1]
+watch = os.path.join(workdir, "drill_watch")
+os.makedirs(watch, exist_ok=True)
+rng = np.random.default_rng(0)
+pools = [[f"apple{i}" for i in range(12)], [f"stone{i}" for i in range(12)]]
+for d in range(4):
+    text = " ".join(rng.choice(pools[d % 2], size=20))
+    with open(os.path.join(watch, f"doc{d:02d}.txt"), "w") as f:
+        f.write(text)
+EOF
+    local common=(stream-train --watch-dir "$workdir/drill_watch"
+                  --idle-timeout 0 --poll-interval 0.01 --k 2
+                  --hash-features 64 --no-lemmatize
+                  --models-dir "$workdir/drill_models"
+                  --checkpoint-dir "$workdir/drill_ckpt"
+                  --checkpoint-interval 1 --max-files-per-trigger 2
+                  --seed 3)
+    STC_FAULTS="ledger.commit:kill@1" \
+        python -m spark_text_clustering_tpu.cli "${common[@]}" \
+        >/dev/null 2>&1
+    if [[ $? -ne 137 ]]; then
+        echo "drill: kill at ledger.commit did not exit 137"
+        return 1
+    fi
+    python -m spark_text_clustering_tpu.cli "${common[@]}" --resume \
+        --telemetry-file "$workdir/ledger_drill.jsonl" >/dev/null
+}
+
 make_skew_streams() {
     # two synthetic per-process streams: balanced pair + a pair with a
     # planted straggler/retry divergence on p1 (the merge gate's fixture)
@@ -115,7 +159,12 @@ if [[ "${1:-}" == "--rebaseline" ]]; then
         --telemetry-file "$work/lint.jsonl" >/dev/null || exit 1
     python -m spark_text_clustering_tpu.cli metrics check "$work/lint.jsonl" \
         --baseline "$BASELINE" --write-baseline --tolerance 0.0 \
-        --include lint.
+        --include lint. || exit 1
+    # fold the exactly-once drill's ledger counters the same way
+    run_ledger_drill "$work" || exit 1
+    python -m spark_text_clustering_tpu.cli metrics check \
+        "$work/ledger_drill.jsonl" --baseline "$BASELINE" \
+        --write-baseline --tolerance 0.0 --include ledger.
     exit $?
 fi
 
@@ -123,12 +172,12 @@ fail=0
 work=$(mktemp -d)
 trap 'rm -rf "$work"' EXIT
 
-echo "== [1/7] stc lint (AST rules + jaxpr audit) =="
+echo "== [1/8] stc lint (AST rules + jaxpr audit) =="
 python -m spark_text_clustering_tpu.cli lint \
     --telemetry-file "$work/lint.jsonl"
 if [[ $? -ne 0 ]]; then echo "FAIL: stc lint"; fail=1; fi
 
-echo "== [2/7] ruff (generic-Python tier) =="
+echo "== [2/8] ruff (generic-Python tier) =="
 if command -v ruff >/dev/null 2>&1; then
     ruff check spark_text_clustering_tpu
     if [[ $? -ne 0 ]]; then echo "FAIL: ruff"; fail=1; fi
@@ -136,27 +185,30 @@ else
     echo "ruff not installed — skipped (stc lint STC101/102/006 cover it)"
 fi
 
-echo "== [3/7] tier-1 tests =="
+echo "== [3/8] tier-1 tests =="
 timeout -k 10 870 python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors -p no:cacheprovider \
     -p no:xdist -p no:randomly
 if [[ $? -ne 0 ]]; then echo "FAIL: tier-1"; fail=1; fi
 
-echo "== [4/7] telemetry overhead budget =="
+echo "== [4/8] telemetry overhead budget =="
 python scripts/check_telemetry_overhead.py
 if [[ $? -ne 0 ]]; then echo "FAIL: telemetry overhead"; fail=1; fi
 
-echo "== [5/7] metrics regression gate =="
+echo "== [5/8] metrics regression gate =="
 if run_ci_train "$work"; then
+    # lint. and ledger. families are captured by their own gates (1/6
+    # and 8) — a batch train run never touches either
     python -m spark_text_clustering_tpu.cli metrics check "$work/run.jsonl" \
-        --baseline "$BASELINE" "${EXCLUDES[@]}" --exclude lint.
+        --baseline "$BASELINE" "${EXCLUDES[@]}" --exclude lint. \
+        --exclude ledger.
     if [[ $? -ne 0 ]]; then echo "FAIL: metrics check"; fail=1; fi
 else
     echo "FAIL: CI training run"
     fail=1
 fi
 
-echo "== [6/7] lint metrics gate (waiver count version-gated) =="
+echo "== [6/8] lint metrics gate (waiver count version-gated) =="
 if [[ -s "$work/lint.jsonl" ]]; then
     python -m spark_text_clustering_tpu.cli metrics check "$work/lint.jsonl" \
         --baseline "$BASELINE" --include lint.
@@ -166,7 +218,7 @@ else
     fail=1
 fi
 
-echo "== [7/7] cross-host skew gate (metrics merge) =="
+echo "== [7/8] cross-host skew gate (metrics merge) =="
 if make_skew_streams "$work"; then
     python -m spark_text_clustering_tpu.cli metrics merge \
         "$work/skew-p0.jsonl" "$work/skew-p1.jsonl" --fail-on-skew \
@@ -184,6 +236,17 @@ if make_skew_streams "$work"; then
     fi
 else
     echo "FAIL: could not build skew fixture streams"
+    fail=1
+fi
+
+echo "== [8/8] exactly-once ledger chaos drill (STC_FAULTS) =="
+if run_ledger_drill "$work"; then
+    python -m spark_text_clustering_tpu.cli metrics check \
+        "$work/ledger_drill.jsonl" --baseline "$BASELINE" \
+        --include ledger.
+    if [[ $? -ne 0 ]]; then echo "FAIL: ledger drill metrics"; fail=1; fi
+else
+    echo "FAIL: ledger chaos drill run"
     fail=1
 fi
 

@@ -61,6 +61,7 @@ PERSISTENCE_FILES = {
     f"{PACKAGE}/models/persistence.py",
     f"{PACKAGE}/resilience/integrity.py",
     f"{PACKAGE}/resilience/resume.py",
+    f"{PACKAGE}/resilience/ledger.py",
 }
 # Spark-compat export writes key order the REFERENCE format dictates
 SORTKEYS_EXEMPT = {f"{PACKAGE}/models/reference_export.py"}

@@ -73,6 +73,7 @@ def _resume_gate(
     coordinator: bool,
     resume_requested: bool,
     state_name: Optional[str] = None,
+    ledgered: bool = False,
 ) -> Optional[int]:
     """Checkpoint-dir compatibility gate (resilience.resume).
 
@@ -82,19 +83,37 @@ def _resume_gate(
     different model), announces the resume point when --resume asked for
     one, and records this run's envelope for the next resume.  Returns an
     exit code to abort with, or None to proceed.
+
+    ``ledgered`` marks streams whose checkpoint dir carries an epoch
+    commit ledger (resilience.ledger): the envelope then records the
+    process count + ledger flag so a later restart with a different
+    topology is validated as ELASTIC resume (shard-merge through the
+    ledger) instead of silently misloading, and --resume announces the
+    last committed epoch (agreed across processes) rather than a bare
+    state file.
     """
     if not params.checkpoint_dir:
         if resume_requested:
             print("--resume requires --checkpoint-dir", file=sys.stderr)
             return 2
         return None
+    import jax
+
     vocab_fp = vocab_fingerprint(vocab) if vocab is not None else None
     try:
-        validate_resume_meta(params.checkpoint_dir, params, vocab_fp)
+        validate_resume_meta(
+            params.checkpoint_dir, params, vocab_fp,
+            process_count=jax.process_count() if ledgered else None,
+        )
     except ResumeMismatchError as exc:
         print(f"error: {exc}", file=sys.stderr)
         return 2
     if resume_requested:
+        from .parallel.mesh import agree_ledger_epoch
+
+        epoch = agree_ledger_epoch(
+            params.checkpoint_dir if ledgered else None
+        )
         if state_name is None:
             state_name = {
                 "em": "em_state.npz", "online": "train_state.npz"
@@ -103,7 +122,12 @@ def _resume_gate(
             os.path.join(params.checkpoint_dir, state_name)
             if state_name else None
         )
-        if state and train_state_valid(state):
+        if epoch >= 0:
+            print(
+                f"resuming from checkpoint {params.checkpoint_dir} "
+                f"(epoch ledger, committed epoch {epoch})"
+            )
+        elif state and train_state_valid(state):
             print(f"resuming from checkpoint {state}")
         else:
             print(
@@ -111,7 +135,16 @@ def _resume_gate(
                 f"{params.checkpoint_dir}; starting fresh"
             )
     if coordinator:
-        write_resume_meta(params.checkpoint_dir, params, vocab_fp)
+        write_resume_meta(
+            params.checkpoint_dir, params, vocab_fp,
+            **(
+                {
+                    "process_count": jax.process_count(),
+                    "ledger": True,
+                }
+                if ledgered else {}
+            ),
+        )
     return None
 
 
@@ -323,7 +356,10 @@ def cmd_train(args: argparse.Namespace) -> int:
 
 
 def cmd_score(args: argparse.Namespace) -> int:
-    model_path = args.model or latest_model_dir(args.models_dir, args.lang)
+    model_path = args.model or latest_model_dir(
+        args.models_dir, args.lang,
+        verify_deep=bool(getattr(args, "verify_deep", False)),
+    )
     if model_path is None:
         print(f"no model for lang {args.lang} under {args.models_dir}",
               file=sys.stderr)
@@ -387,7 +423,10 @@ def cmd_stream_score(args: argparse.Namespace) -> int:
     LDALoader flow as a micro-batch stream; north-star "streaming" row)."""
     from .streaming import FileStreamSource, StreamingScorer
 
-    model_path = args.model or latest_model_dir(args.models_dir, args.lang)
+    model_path = args.model or latest_model_dir(
+        args.models_dir, args.lang,
+        verify_deep=bool(getattr(args, "verify_deep", False)),
+    )
     if model_path is None:
         print(f"no model for lang {args.lang} under {args.models_dir}",
               file=sys.stderr)
@@ -406,11 +445,32 @@ def cmd_stream_score(args: argparse.Namespace) -> int:
             vocab_width=model.vocab_size, watch_dir=args.watch_dir,
         )
 
+    # Transactional scoring (--checkpoint-dir): every trigger becomes one
+    # committed epoch in resilience.ledger — the per-epoch report file
+    # and the consumed source paths commit in ONE ledger append, so a
+    # resumed stream re-emits each report EXACTLY once: committed source
+    # files are suppressed from re-polling, uncommitted epochs roll back
+    # (orphan reports quarantined) and re-score.
+    ledger = None
+    preseen: list = []
+    if args.checkpoint_dir:
+        from .resilience import EpochLedger
+
+        ledger = EpochLedger(args.checkpoint_dir)
+        ledger.recover()
+        preseen = sorted(ledger.committed_sources())
+        if preseen:
+            telemetry.count("ledger.replays_suppressed", len(preseen))
+            telemetry.event(
+                "replays_suppressed", files=len(preseen),
+                ledger=args.checkpoint_dir,
+            )
     src = FileStreamSource(
         args.watch_dir,
         include_all=args.include_all,
         max_files_per_trigger=args.max_files_per_trigger,
         min_file_age_s=args.min_file_age,
+        preseen=preseen,
     )
     controller = _make_trigger_controller(args)
     scorer = StreamingScorer(
@@ -418,19 +478,48 @@ def cmd_stream_score(args: argparse.Namespace) -> int:
         stop_words=_load_stop_words(args.stop_words),
         lemmatize=not args.no_lemmatize,
         batch_capacity=args.batch_capacity,
-        # endless streams must not retain every doc's result in memory
-        keep_results=not args.no_report,
+        # endless streams must not retain every doc's result in memory;
+        # ledgered streams emit per-epoch reports instead of one final
+        # accumulated report, so they never retain either
+        keep_results=not args.no_report and ledger is None,
         quarantine_dir=args.quarantine_dir,
     )
+    import numpy as np
+
     import time as _time
 
     for mb in src.stream(
         poll_interval=args.poll_interval, idle_timeout=args.idle_timeout
     ):
         t0 = _time.perf_counter()
-        for sd in scorer.process(mb):
+        out = scorer.process(mb)
+        for sd in out:
             print(f"[batch {mb.batch_id}] "
                   f"{os.path.basename(sd.name)} -> topic {sd.topic}")
+        if ledger is not None:
+            epoch = ledger.next_epoch()
+            fname = f"Result_{args.lang}_epoch-{epoch:06d}"
+            path = os.path.join(args.output_dir, fname)
+            ledger.begin(
+                epoch, kind="stream-score",
+                sources=mb.names, payloads=[path],
+            )
+            text = format_scoring_report(
+                model,
+                [sd.name for sd in out],
+                np.stack([sd.distribution for sd in out])
+                if out else np.zeros((0, model.k)),
+                [sd.row for sd in out],
+            )
+            write_scoring_report(
+                text, args.output_dir, args.lang, filename=fname
+            )
+            ledger.commit(
+                epoch, kind="stream-score",
+                sources=mb.names, payloads={fname: path},
+                model_ref=model_path,
+            )
+            print(f"[epoch {epoch}] report committed: {path}")
         if controller is not None:
             controller.update(
                 src.last_queue_depth, _time.perf_counter() - t0
@@ -438,7 +527,7 @@ def cmd_stream_score(args: argparse.Namespace) -> int:
             controller.apply(src)
     for t, c in enumerate(scorer.tallies):
         print(f"topic {t}: {c} books")
-    if scorer.results and not args.no_report:
+    if scorer.results and not args.no_report and ledger is None:
         path = scorer.write_report(args.output_dir, args.lang)
         print(f"report written to {path}")
     if own_telemetry:
@@ -473,13 +562,14 @@ def cmd_stream_train(args: argparse.Namespace) -> int:
             return 2
         num_features = None
     # the gate must run BEFORE the trainer constructor auto-restores
-    # from any existing stream_state.npz
+    # from the epoch ledger (or a legacy stream_state.npz)
     rc = _resume_gate(
         params,
         vocab if vocab is not None else [f"h{i}" for i in range(num_features)],
         True,
         bool(getattr(args, "resume", False)),
         state_name="stream_state.npz",
+        ledgered=bool(params.checkpoint_dir),
     )
     if rc is not None:
         return rc
@@ -505,12 +595,27 @@ def cmd_stream_train(args: argparse.Namespace) -> int:
         checkpoint_every=args.checkpoint_interval,
         quarantine_dir=args.quarantine_dir,
     )
+    # Source progress is EXACTLY-ONCE through the trainer's epoch commit
+    # ledger: committed source paths seed the seen-set (never re-ingested,
+    # never double-trained), uncommitted ones were just rolled back by
+    # recover() and re-emit.  The legacy seen_files.txt log is still read
+    # (pre-ledger checkpoint dirs) and still written (source.commit after
+    # each epoch commit) for backward compatibility.
+    preseen: list = []
+    if trainer.ledger is not None:
+        preseen = sorted(trainer.ledger.committed_sources())
+        if preseen:
+            telemetry.count("ledger.replays_suppressed", len(preseen))
+            telemetry.event(
+                "replays_suppressed", files=len(preseen),
+                ledger=params.checkpoint_dir,
+            )
     src = FileStreamSource(
         args.watch_dir,
         include_all=args.include_all,
         max_files_per_trigger=args.max_files_per_trigger,
         min_file_age_s=args.min_file_age,
-        # resume must not re-ingest (and double-train on) consumed files
+        preseen=preseen,
         state_path=(
             os.path.join(args.checkpoint_dir, "seen_files.txt")
             if args.checkpoint_dir
@@ -529,7 +634,31 @@ def cmd_stream_train(args: argparse.Namespace) -> int:
     for i, topic in enumerate(model.describe_topics_terms(10)):
         print(f"TOPIC {i}: " + ", ".join(t for t, _ in topic))
     out_dir = model_dir_name(args.lang, base=args.models_dir)
-    model.save(out_dir)
+    if trainer.ledger is not None:
+        # artifact <-> ledger cross-reference: the model dir records the
+        # publishing epoch in meta.json, and a `model-publish` ledger
+        # record pins the sealed artifact (dir + manifest SHA256) — so
+        # "which committed state produced this model" and "which model
+        # did epoch N publish" both resolve from either side.
+        from .models.persistence import save_model
+        from .resilience import artifact_ref
+
+        publish_epoch = trainer.ledger.next_epoch()
+        save_model(
+            model, out_dir,
+            ledger_ref={
+                "dir": params.checkpoint_dir, "epoch": publish_epoch,
+            },
+        )
+        trainer.ledger.begin(
+            publish_epoch, kind="model-publish", sources=[], payloads=[],
+        )
+        trainer.ledger.commit(
+            publish_epoch, kind="model-publish", sources=[],
+            model_ref=artifact_ref(out_dir),
+        )
+    else:
+        model.save(out_dir)
     print(f"model saved to {out_dir}")
     if own_telemetry:
         telemetry.event(
@@ -538,6 +667,32 @@ def cmd_stream_train(args: argparse.Namespace) -> int:
         )
         telemetry.shutdown()
     return 0
+
+
+def cmd_stream_requeue(args: argparse.Namespace) -> int:
+    """Replay a quarantine dir back into a watch directory (the
+    dead-letter queue's recovery half, ROADMAP follow-up): payloads move
+    into the watch dir for re-ingestion, error sidecars archive under
+    ``<quarantine-dir>/.archive/``.  ``--dry-run`` lists without moving."""
+    from .resilience import requeue
+
+    res = requeue(
+        args.quarantine_dir, args.watch_dir, dry_run=args.dry_run,
+    )
+    verb = "would replay" if args.dry_run else "replayed"
+    for p in res["replayed"]:
+        print(f"{verb}: {os.path.basename(p)} -> {args.watch_dir}")
+    averb = "would archive" if args.dry_run else "archived"
+    for p in res["archived"]:
+        print(f"{averb}: {os.path.basename(p)}")
+    for p in res["skipped"]:
+        print(f"skipped (move failed, still quarantined): {p}",
+              file=sys.stderr)
+    print(
+        f"{len(res['replayed'])} {verb}, "
+        f"{len(res['archived'])} {averb}, {len(res['skipped'])} skipped"
+    )
+    return 1 if res["skipped"] else 0
 
 
 def cmd_doctor(args: argparse.Namespace) -> int:
@@ -725,6 +880,11 @@ def build_parser() -> argparse.ArgumentParser:
     sc.add_argument("--model-shards", type=int, default=1,
                     help="score with lambda V-sharded [k, V/s] per device "
                          "(inference at training scale)")
+    sc.add_argument("--verify-deep", action="store_true",
+                    help="re-verify each candidate model's SHA256 "
+                         "manifest at selection time instead of trusting "
+                         "its COMMIT marker; corrupt dirs fall back to "
+                         "the next newest committed one")
     sc.set_defaults(fn=cmd_score)
 
     ss = sub.add_parser(
@@ -739,6 +899,15 @@ def build_parser() -> argparse.ArgumentParser:
                     help="per-doc output only; don't accumulate results "
                          "for a final report (constant memory for endless "
                          "streams)")
+    ss.add_argument("--checkpoint-dir", default=None,
+                    help="epoch commit ledger dir: every trigger commits "
+                         "its report + consumed files transactionally, "
+                         "so a restarted stream emits each report "
+                         "EXACTLY once (uncommitted epochs roll back, "
+                         "committed files never re-score)")
+    ss.add_argument("--verify-deep", action="store_true",
+                    help="re-verify the selected model's SHA256 manifest "
+                         "at selection time (see `score --verify-deep`)")
     ss.set_defaults(fn=cmd_stream_score)
 
     st = sub.add_parser(
@@ -763,6 +932,25 @@ def build_parser() -> argparse.ArgumentParser:
     st.add_argument("--model-shards", type=int, default=1)
     st.add_argument("--models-dir", default="models")
     st.set_defaults(fn=cmd_stream_train)
+
+    stream = sub.add_parser(
+        "stream",
+        help="stream maintenance verbs (requeue quarantined documents)",
+    )
+    stream_sub = stream.add_subparsers(dest="stream_cmd", required=True)
+    rq = stream_sub.add_parser(
+        "requeue",
+        help="replay a quarantine dir back into a watch directory, "
+             "archiving the error sidecars under .archive/",
+    )
+    rq.add_argument("--quarantine-dir", required=True,
+                    help="dead-letter dir written by --quarantine-dir "
+                         "streams")
+    rq.add_argument("--watch-dir", required=True,
+                    help="watch directory to replay the payloads into")
+    rq.add_argument("--dry-run", action="store_true",
+                    help="list what would move without touching anything")
+    rq.set_defaults(fn=cmd_stream_requeue)
 
     dr = sub.add_parser(
         "doctor", help="environment health report (hang-proof probes)"
@@ -794,8 +982,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     # `metrics` is a pure host-side reader: it must not import jax at all
     # `lint` pins JAX_PLATFORMS=cpu itself before its jaxpr layer brings
     # jax up — the cache helper here would initialize the backend first
+    # `stream` (requeue) is pure filesystem maintenance: no jax either
     if (
-        args.cmd not in ("doctor", "metrics", "lint")
+        args.cmd not in ("doctor", "metrics", "lint", "stream")
         and getattr(args, "coordinator", None) is None
     ):
         from .utils.env import enable_persistent_compile_cache

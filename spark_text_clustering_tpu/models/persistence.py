@@ -67,7 +67,9 @@ def model_dir_name(lang: str, base: str = "models") -> str:
     return os.path.join(base, f"LdaModel_{lang}_{int(time.time() * 1000)}")
 
 
-def latest_model_dir(base: str, lang: str) -> Optional[str]:
+def latest_model_dir(
+    base: str, lang: str, verify_deep: bool = False
+) -> Optional[str]:
     """Newest VALID saved model for a language.
 
     The reference takes the LAST entry of an UNSORTED listFiles
@@ -77,6 +79,13 @@ def latest_model_dir(base: str, lang: str) -> Optional[str]:
     uncommitted/partial dirs — a crashed save — are skipped with a
     structured ``artifact_skipped`` telemetry event rather than selected
     for scoring.
+
+    ``verify_deep`` (the ``--verify-deep`` scoring mode, ROADMAP
+    follow-up) re-verifies each candidate's SHA256 manifest via
+    ``resilience.integrity.verify_artifact`` instead of trusting the
+    COMMIT marker, falling back to the next newest committed dir on
+    corruption — belt-and-braces selection for deployments where disks
+    rot under sealed artifacts.
     """
     if not os.path.isdir(base):
         return None
@@ -94,6 +103,16 @@ def latest_model_dir(base: str, lang: str) -> Optional[str]:
         path = os.path.join(base, d)
         status = artifact_status(path)
         if status in ("committed", "legacy"):
+            if verify_deep:
+                try:
+                    verify_artifact(path)
+                except CorruptArtifactError as exc:
+                    telemetry.count("resilience.artifacts_skipped")
+                    telemetry.event(
+                        "artifact_skipped", path=path,
+                        status="corrupt", lang=lang, error=str(exc),
+                    )
+                    continue
             return path
         telemetry.count("resilience.artifacts_skipped")
         telemetry.event(
@@ -109,7 +128,10 @@ def latest_model_dir(base: str, lang: str) -> Optional[str]:
     return None
 
 
-def _write_artifact(path: str, meta: dict, arrays: dict, vocab) -> None:
+def _write_artifact(
+    path: str, meta: dict, arrays: dict, vocab,
+    ledger_ref: Optional[dict] = None,
+) -> None:
     """The single artifact layout, sealed with a manifest + COMMIT.
 
     Payload files land first (with a fault-injection point between them
@@ -124,7 +146,15 @@ def _write_artifact(path: str, meta: dict, arrays: dict, vocab) -> None:
         # with identical contents must be byte-identical regardless of
         # the dict-build order of the caller (lint rule STC006)
         json.dump(
-            {"format_version": FORMAT_VERSION, **meta}, f, indent=2,
+            {
+                "format_version": FORMAT_VERSION,
+                # artifact->ledger back-reference: which stream epoch
+                # published this model (the ledger's model_ref record is
+                # the forward direction) — None for batch-trained models
+                **({"ledger_ref": ledger_ref} if ledger_ref else {}),
+                **meta,
+            },
+            f, indent=2,
             sort_keys=True,
         )
     faultinject.check("artifact.file")
@@ -141,9 +171,14 @@ def _write_artifact(path: str, meta: dict, arrays: dict, vocab) -> None:
     )
 
 
-def save_model(model, path: str) -> None:
+def save_model(model, path: str, ledger_ref: Optional[dict] = None) -> None:
     """Persist any framework model (dispatches on type — callers that got
-    their model from an estimator-swapped pipeline need not care which)."""
+    their model from an estimator-swapped pipeline need not care which).
+
+    ``ledger_ref`` cross-references the epoch commit ledger that
+    published this artifact (``{"dir": ..., "epoch": n}``, recorded in
+    ``meta.json``); the ledger's matching ``model-publish`` record holds
+    the forward reference (``resilience.integrity.artifact_ref``)."""
     from .base import LDAModel  # local imports to avoid cycles
     from .nmf import NMFModel
 
@@ -154,6 +189,7 @@ def save_model(model, path: str) -> None:
         raise TypeError(f"cannot save a {type(model).__name__}")
     _write_artifact(
         path,
+        ledger_ref=ledger_ref,
         meta={
             "class": "spark_text_clustering_tpu.models.LDAModel",
             "k": model.k,

@@ -24,6 +24,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 __all__ = ["make_mesh", "data_sharding", "model_sharding", "replicated",
            "initialize_distributed", "is_coordinator",
+           "agree_checkpoint_exists", "agree_ledger_epoch",
            "DATA_AXIS", "MODEL_AXIS"]
 
 DATA_AXIS = "data"
@@ -138,3 +139,36 @@ def agree_checkpoint_exists(path: Optional[str]) -> bool:
             )
         return coord
     return exists
+
+
+def agree_ledger_epoch(ledger_dir: Optional[str]) -> int:
+    """Last committed epoch of a stream checkpoint dir's commit ledger,
+    agreed across processes (-1 when there is no ledger).
+
+    The coordinator OWNS the ledger append (resilience.ledger: workers
+    stage shards, process 0 commits), so its view of the newest
+    committed epoch is authoritative — it is broadcast, and a process
+    that reads a different epoch from its own filesystem raises instead
+    of silently resuming from a different transaction point (the
+    mismatched-collectives hang ``agree_checkpoint_exists`` guards
+    against, one level up the protocol)."""
+    if not ledger_dir:
+        return -1
+    from ..resilience.ledger import EpochLedger
+
+    local = EpochLedger(ledger_dir).last_committed()
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        coord = int(multihost_utils.broadcast_one_to_all(
+            np.asarray(local, np.int64)
+        ))
+        if coord != local:
+            raise RuntimeError(
+                f"epoch ledger {ledger_dir}: process "
+                f"{jax.process_index()} reads last committed epoch "
+                f"{local} but the coordinator reads {coord} — "
+                "checkpoint_dir must be ONE shared filesystem"
+            )
+        return coord
+    return local

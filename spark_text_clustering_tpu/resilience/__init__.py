@@ -1,5 +1,6 @@
 """Fault-tolerance layer: checksummed atomic artifacts, auto-resume,
-retry/backoff, dead-letter quarantine, and a fault-injection chaos
+retry/backoff, dead-letter quarantine, the transactional epoch commit
+ledger (exactly-once streaming resume), and a fault-injection chaos
 harness.
 
 The reference inherits durability from Spark (DistributedLDAModel
@@ -29,13 +30,22 @@ from .errors import (
 from .integrity import (
     COMMIT_NAME,
     MANIFEST_NAME,
+    artifact_ref,
     artifact_status,
     atomic_write_text,
     file_sha256,
     finalize_artifact_dir,
     verify_artifact,
 )
-from .quarantine import QUARANTINED_COUNTER, Quarantine
+from .ledger import (
+    LEDGER_NAME,
+    EpochLedger,
+    RecoveryReport,
+    shard_filename,
+    shard_span,
+    validate_shard_plan,
+)
+from .quarantine import QUARANTINED_COUNTER, Quarantine, requeue
 from .resume import (
     RESUME_META_NAME,
     config_hash,
@@ -69,6 +79,14 @@ __all__ = [
     "verify_artifact",
     "Quarantine",
     "QUARANTINED_COUNTER",
+    "requeue",
+    "artifact_ref",
+    "LEDGER_NAME",
+    "EpochLedger",
+    "RecoveryReport",
+    "shard_filename",
+    "shard_span",
+    "validate_shard_plan",
     "RESUME_META_NAME",
     "config_hash",
     "vocab_fingerprint",

@@ -69,6 +69,8 @@ SITES = frozenset({
     "stream.poll",        # streaming source directory poll
     "report.write",       # scoring report write
     "telemetry.write",    # telemetry run-stream append
+    "ledger.stage",       # before an epoch intent record is staged
+    "ledger.commit",      # before the epoch ledger append (commit point)
 })
 
 

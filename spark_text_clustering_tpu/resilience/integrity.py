@@ -41,6 +41,7 @@ __all__ = [
     "finalize_artifact_dir",
     "artifact_status",
     "verify_artifact",
+    "artifact_ref",
 ]
 
 MANIFEST_NAME = "MANIFEST.json"
@@ -98,6 +99,19 @@ def finalize_artifact_dir(
     faultinject.check("artifact.commit")
     atomic_write_text(os.path.join(path, COMMIT_NAME), "committed\n")
     return hashes
+
+
+def artifact_ref(path: str) -> Dict[str, str]:
+    """Stable cross-reference to a sealed artifact dir for the epoch
+    commit ledger (``resilience.ledger``): the directory plus the SHA256
+    of its manifest — which itself pins every payload hash, so the ref
+    transitively pins the whole artifact.  Legacy (manifest-less) dirs
+    get a ref without a digest."""
+    ref = {"path": path}
+    manifest = os.path.join(path, MANIFEST_NAME)
+    if os.path.exists(manifest):
+        ref["manifest_sha256"] = file_sha256(manifest)
+    return ref
 
 
 def artifact_status(path: str) -> str:

@@ -12,6 +12,14 @@ Layout::
 
     <dir>/q-<seq>-<safe name>.txt          the document text
     <dir>/q-<seq>-<safe name>.error.json   {name, stage, error, batch_id}
+    <dir>/.archive/                        error sidecars retired by
+                                           ``stc stream requeue``
+
+``requeue`` is the replay half (ROADMAP follow-up): once the bug that
+dead-lettered the docs is fixed, it moves the ``.txt`` payloads back
+into a watch directory (the stream re-ingests them as new files) and
+archives their error sidecars under ``.archive/`` so the quarantine dir
+empties without losing the failure forensics.
 """
 
 from __future__ import annotations
@@ -19,13 +27,17 @@ from __future__ import annotations
 import json
 import os
 import re
-from typing import Optional
+import shutil
+from typing import Dict, List, Optional
 
 from .integrity import atomic_write_text
 
-__all__ = ["Quarantine", "QUARANTINED_COUNTER"]
+__all__ = ["Quarantine", "QUARANTINED_COUNTER", "ARCHIVE_DIRNAME", "requeue"]
 
 QUARANTINED_COUNTER = "resilience.quarantined"
+REPLAYED_COUNTER = "requeue.replayed"
+ARCHIVED_COUNTER = "requeue.archived"
+ARCHIVE_DIRNAME = ".archive"
 
 _SAFE = re.compile(r"[^A-Za-z0-9._-]+")
 
@@ -84,3 +96,64 @@ class Quarantine:
         except OSError:
             return None
         return stem + ".txt"
+
+
+def requeue(
+    quarantine_dir: str,
+    watch_dir: str,
+    *,
+    dry_run: bool = False,
+) -> Dict[str, List[str]]:
+    """Replay a quarantine dir back into a watch directory.
+
+    Every ``q-*.txt`` payload moves into ``watch_dir`` (atomic rename
+    when same-filesystem; the stream source picks it up as a brand-new
+    file — its path never matched the original, so the seen-set cannot
+    suppress it) and its ``.error.json`` sidecar moves to
+    ``<quarantine_dir>/.archive/``.  ``dry_run`` lists what WOULD move
+    without touching anything.  Returns ``{"replayed": [...],
+    "archived": [...], "skipped": [...]}`` (skipped = payloads whose
+    move failed; they stay quarantined for the next attempt).
+    """
+    from .. import telemetry
+
+    out: Dict[str, List[str]] = {
+        "replayed": [], "archived": [], "skipped": [],
+    }
+    try:
+        names = sorted(os.listdir(quarantine_dir))
+    except OSError:
+        return out
+    payloads = [
+        n for n in names
+        if n.startswith("q-") and n.endswith(".txt")
+    ]
+    archive = os.path.join(quarantine_dir, ARCHIVE_DIRNAME)
+    for n in payloads:
+        src = os.path.join(quarantine_dir, n)
+        dest = os.path.join(watch_dir, n)
+        sidecar = n[: -len(".txt")] + ".error.json"
+        side_src = os.path.join(quarantine_dir, sidecar)
+        if dry_run:
+            out["replayed"].append(dest)
+            if os.path.exists(side_src):
+                out["archived"].append(os.path.join(archive, sidecar))
+            continue
+        try:
+            os.makedirs(watch_dir, exist_ok=True)
+            shutil.move(src, dest)
+        except OSError:
+            out["skipped"].append(src)
+            continue
+        out["replayed"].append(dest)
+        telemetry.count(REPLAYED_COUNTER)
+        if os.path.exists(side_src):
+            try:
+                os.makedirs(archive, exist_ok=True)
+                shutil.move(side_src, os.path.join(archive, sidecar))
+                out["archived"].append(os.path.join(archive, sidecar))
+                telemetry.count(ARCHIVED_COUNTER)
+            except OSError:
+                out["skipped"].append(side_src)
+        telemetry.event("requeue", doc=n, watch_dir=watch_dir)
+    return out

@@ -103,6 +103,7 @@ def validate_resume_meta(
     checkpoint_dir: str,
     params,
     vocab_fp: Optional[int] = None,
+    process_count: Optional[int] = None,
 ) -> Optional[dict]:
     """Check a checkpoint dir's recorded envelope against this run.
 
@@ -110,6 +111,14 @@ def validate_resume_meta(
     to validate against, e.g. pre-resilience checkpoints).  Raises
     ``ResumeMismatchError`` on a config-hash or vocab-fingerprint
     mismatch.
+
+    ``process_count`` (when the caller passes one) gates ELASTIC resume:
+    a restart with a different process count than the one recorded is
+    only valid when the dir carries an epoch commit ledger — committed
+    ledger records pin per-process state shards with explicit vocab
+    column spans, so the merged state can be re-sliced for the new
+    topology (``resilience.ledger.shard_span``).  Without a ledger the
+    shards' provenance is unknowable and the resume must refuse.
     """
     path = os.path.join(checkpoint_dir, RESUME_META_NAME)
     if not os.path.exists(path):
@@ -139,5 +148,19 @@ def validate_resume_meta(
             checkpoint_dir,
             "checkpoint was trained with a different vocabulary "
             "(fingerprint mismatch) — term columns would misalign",
+        )
+    if (
+        process_count is not None
+        and meta.get("process_count") is not None
+        and int(meta["process_count"]) != int(process_count)
+        and not meta.get("ledger")
+    ):
+        raise ResumeMismatchError(
+            checkpoint_dir,
+            f"checkpoint was written by {meta['process_count']} "
+            f"process(es) but this run has {process_count}, and the dir "
+            f"has no epoch commit ledger — elastic resume needs "
+            f"ledger-pinned state shards (re-run the original topology "
+            f"or start fresh)",
         )
     return meta

@@ -157,6 +157,7 @@ class FileStreamSource:
         encoding: str = "utf-8",
         min_file_age_s: float = 0.0,
         state_path: Optional[str] = None,
+        preseen: Optional[Sequence[str]] = None,
     ) -> None:
         self.directory = directory
         self.suffix = suffix
@@ -174,8 +175,14 @@ class FileStreamSource:
         # Crash between checkpoint and commit() re-emits at most one
         # checkpoint interval of files (at-least-once; benign for online VB)
         # rather than dropping them (never-trained).
+        #
+        # Transactional streams supersede state_path: the EPOCH COMMIT
+        # LEDGER (resilience.ledger) owns source progress, and the
+        # consumer seeds ``preseen`` from its committed records instead —
+        # exactly-once, because the same append that commits the
+        # training/report payloads commits the consumed paths.
         self.state_path = state_path
-        self._seen: set = set()
+        self._seen: set = set(preseen or ())
         self._pending: List[str] = []
         self._next_id = 0
         # new-but-unconsumed files seen by the last poll() — the source's
@@ -183,7 +190,9 @@ class FileStreamSource:
         self.last_queue_depth = 0
         if state_path and os.path.exists(state_path):
             with open(state_path, "r", encoding="utf-8") as f:
-                self._seen = {line.rstrip("\n") for line in f if line.strip()}
+                self._seen |= {
+                    line.rstrip("\n") for line in f if line.strip()
+                }
 
     def commit(self) -> None:
         """Durably record every path staged since the last commit.
@@ -538,6 +547,8 @@ class StreamingOnlineLDA:
         corpus_size_hint: Optional[int] = None,
         checkpoint_every: Optional[int] = None,
         quarantine_dir: Optional[str] = None,
+        process_index: Optional[int] = None,
+        process_count: Optional[int] = None,
     ) -> None:
         import jax
         import jax.numpy as jnp
@@ -546,6 +557,7 @@ class StreamingOnlineLDA:
         from .models.online_lda import TrainState, make_online_train_step
         from .ops.lda_math import init_lambda
         from .parallel.mesh import DATA_AXIS, make_mesh, model_sharding
+        from .resilience.ledger import EpochLedger
 
         if (vocab is None) == (num_features is None):
             raise ValueError("exactly one of vocab / num_features required")
@@ -598,13 +610,43 @@ class StreamingOnlineLDA:
             ),
         )
 
+        # transactional epoch commits: with a checkpoint dir, ALL durable
+        # state (state shards, consumed source paths, published models)
+        # hangs off ONE append-only ledger — resume is exactly-once.
+        # Legacy dirs (a bare stream_state.npz, no epochs.jsonl) still
+        # load through the pre-ledger path below.
+        self.process_index = (
+            jax.process_index() if process_index is None else process_index
+        )
+        self.process_count = (
+            jax.process_count() if process_count is None else process_count
+        )
+        self.ledger = (
+            EpochLedger(params.checkpoint_dir)
+            if params.checkpoint_dir else None
+        )
+        self._pending_sources: List[str] = []
+        self._last_committed_step = -1
         self._ckpt_path = (
             os.path.join(params.checkpoint_dir, "stream_state.npz")
             if params.checkpoint_dir
             else None
         )
-        if self._ckpt_path and os.path.exists(self._ckpt_path):
-            self._restore()             # resume: no throwaway fresh init
+        if self.ledger is not None and self.process_index == 0:
+            # roll the dir to a consistent state BEFORE reading it:
+            # truncate torn appends, quarantine uncommitted payloads
+            self.ledger.recover()
+        # resume point = the newest committed epoch CARRYING state shards
+        # (model-publish records are shard-less bookkeeping)
+        resume_rec = None
+        if self.ledger is not None:
+            for rec in self.ledger.records():
+                if rec.get("shards"):
+                    resume_rec = rec
+        if resume_rec is not None:
+            self._restore_ledger(resume_rec)
+        elif self._ckpt_path and os.path.exists(self._ckpt_path):
+            self._restore()             # legacy resume: pre-ledger format
         else:
             lam0 = init_lambda(
                 jax.random.fold_in(self._key, 0xFFFF), k, self._v_pad,
@@ -612,6 +654,7 @@ class StreamingOnlineLDA:
             )
             lam0 = jax.device_put(lam0, model_sharding(self.mesh))
             self.state = TrainState(lam0, jnp.int32(0))
+            self._last_committed_step = 0
 
     # -- vectorization ---------------------------------------------------
     def _vectorize(self, mb: MicroBatch):
@@ -624,6 +667,11 @@ class StreamingOnlineLDA:
         FileStreamSource.commit)."""
         t0 = time.perf_counter()
         with telemetry.span("stream.train_batch", emit=False):
+            # every consumed path joins the NEXT epoch's commit record,
+            # whether or not its docs survive vectorization — a file
+            # whose docs all fail must still be committed as consumed or
+            # it would replay forever
+            self._pending_sources.extend(mb.names)
             _, _, raw_rows = _vectorize_quarantined(
                 self.pre, self._rows_for, mb, self.quarantine, "vectorize"
             )
@@ -723,19 +771,120 @@ class StreamingOnlineLDA:
             commit()
         return self
 
-    def checkpoint(self) -> None:
+    def checkpoint(self) -> bool:
+        """Commit one transactional epoch: stage the intent (consumed
+        sources + the shard files about to land), write this process's
+        state shard durably, then append the commit record — the
+        two-phase protocol from resilience.ledger.  Returns True when a
+        record was appended (False: nothing new since the last commit).
+
+        Multi-host: every process stages its own vocab-column shard;
+        the COORDINATOR alone appends, after rendezvousing on all
+        ``process_count`` ready markers; workers rendezvous on the
+        commit itself, so no process runs ahead of the transaction.
+        """
         import jax
 
-        from .models.persistence import save_train_state
+        from .resilience.ledger import shard_filename, shard_span
 
-        save_train_state(
-            self._ckpt_path,
-            int(self.state.step),
-            lam=np.asarray(jax.device_get(self.state.lam)),
+        sources = self._pending_sources
+        step = int(self.state.step)
+        if not sources and step == self._last_committed_step:
+            return False                # empty epoch: nothing to commit
+        epoch = self.ledger.next_epoch()
+        lo, hi = shard_span(self._v_pad, self.process_index,
+                            self.process_count)
+        lam = np.asarray(jax.device_get(self.state.lam))
+        if self.process_index == 0:
+            self.ledger.begin(
+                epoch,
+                kind="stream-train",
+                sources=sources,
+                payloads=[
+                    shard_filename(epoch, p)
+                    for p in range(self.process_count)
+                ],
+                process_count=self.process_count,
+            )
+        spec = self.ledger.stage_shard(
+            epoch, self.process_index, self.process_count,
+            cols=(lo, hi), step=step,
+            lam=lam[:, lo:hi],
             docs_seen=np.int64(self.docs_seen),
             batches_seen=np.int64(self.batches_seen),
             vocab_fp=np.int64(_vocab_fingerprint(self.vocab)),
         )
+        if self.process_index == 0:
+            shards = (
+                [spec] if self.process_count == 1
+                else self.ledger.await_shards(epoch, self.process_count)
+            )
+            self.ledger.commit(
+                epoch,
+                kind="stream-train",
+                sources=sources,
+                shards=shards,
+                process_count=self.process_count,
+                step=step,
+                docs_seen=int(self.docs_seen),
+                batches_seen=int(self.batches_seen),
+            )
+        else:
+            self.ledger.await_committed(epoch)
+        self._pending_sources = []
+        self._last_committed_step = step
+        return True
+
+    def _restore_ledger(self, record) -> None:
+        """Resume from the newest committed epoch: verify every shard
+        against its recorded digest (a mismatch means the checkpoint is
+        torn — refuse, never load garbage), then merge the vocab-column
+        shards back into one state.  The shard plan is validated against
+        THIS run's padded vocab width, so a restart with a different
+        process count re-slices transparently (elastic resume)."""
+        import jax
+        import jax.numpy as jnp
+
+        from .models.online_lda import TrainState
+        from .models.persistence import load_train_state
+        from .parallel.mesh import model_sharding
+        from .resilience import CorruptArtifactError, file_sha256
+        from .resilience.ledger import validate_shard_plan
+
+        shards = validate_shard_plan(record, self._v_pad)
+        lam = np.empty((self.params.k, self._v_pad), np.float32)
+        for s in shards:
+            path = self.ledger.resolve(s["file"])
+            if not os.path.exists(path) or file_sha256(path) != s["sha256"]:
+                raise CorruptArtifactError(
+                    path,
+                    f"committed epoch {record['epoch']} shard p{s['p']} "
+                    f"is missing or does not match its ledger digest — "
+                    f"torn cross-host checkpoint; refusing to load",
+                )
+            st = load_train_state(path, require=("lam",))
+            fp = int(st.get("vocab_fp", -1))
+            if fp not in (-1, _vocab_fingerprint(self.vocab)):
+                raise ValueError(
+                    f"checkpoint {path} was trained with a DIFFERENT "
+                    f"vocabulary of the same size — term columns would "
+                    f"misalign; use the original vocab/num_features or a "
+                    f"fresh checkpoint dir"
+                )
+            lo, hi = s["cols"]
+            if st["lam"].shape != (self.params.k, hi - lo):
+                raise ValueError(
+                    f"checkpoint lam {st['lam'].shape} != "
+                    f"{(self.params.k, hi - lo)}"
+                )
+            lam[:, lo:hi] = st["lam"]
+        self.state = TrainState(
+            jax.device_put(jnp.asarray(lam), model_sharding(self.mesh)),
+            jnp.int32(int(record["step"])),
+        )
+        self.docs_seen = int(record.get("docs_seen", 0))
+        self.batches_seen = int(record.get("batches_seen", 0))
+        self._last_committed_step = int(record["step"])
 
     def _restore(self) -> None:
         import jax
@@ -764,6 +913,7 @@ class StreamingOnlineLDA:
         )
         self.docs_seen = int(st.get("docs_seen", 0))
         self.batches_seen = int(st.get("batches_seen", 0))
+        self._last_committed_step = int(st["step"])
 
     def model(self):
         """Snapshot the current topics as an ``LDAModel``."""

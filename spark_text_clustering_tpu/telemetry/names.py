@@ -45,6 +45,19 @@ METRICS: Dict[str, str] = {
         "uncommitted/corrupt model dirs skipped by latest_model_dir",
     "resilience.checkpoints_rejected":
         "checkpoints rejected by the multi-host existence agreement",
+    # -- epoch commit ledger (docs/RESILIENCE.md "Epoch commit ledger") -
+    "ledger.commits": "epoch records appended to the commit ledger",
+    "ledger.rollbacks":
+        "uncommitted epochs rolled back at recovery (orphan payloads "
+        "quarantined) plus torn ledger appends truncated",
+    "ledger.replays_suppressed":
+        "committed source files suppressed from re-emission at resume "
+        "(the exactly-once half the at-least-once window used to replay)",
+    # -- quarantine requeue (stc stream requeue) ------------------------
+    "requeue.replayed":
+        "quarantined documents replayed back into a watch directory",
+    "requeue.archived":
+        "error sidecars archived to quarantine .archive/ during requeue",
     # -- telemetry self-observation -------------------------------------
     "telemetry_write_errors": "run-stream appends that failed after retry",
     # -- streaming ------------------------------------------------------

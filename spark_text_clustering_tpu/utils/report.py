@@ -181,17 +181,29 @@ def write_scoring_report(
     output_dir: str,
     lang: str,
     timestamp_millis: Optional[int] = None,
+    filename: Optional[str] = None,
 ) -> str:
     """Write to ``<output_dir>/Result_<lang>_<millis>`` (LDALoader.scala:210-212).
 
     Atomic (tmp + rename) and retried under the shared I/O policy: a
     report either exists complete or not at all — a crash mid-write must
     never leave a partial report a downstream consumer mistakes for the
-    real thing."""
+    real thing.
+
+    ``filename`` overrides the timestamped name — transactional streams
+    (resilience.ledger) name each epoch's report deterministically
+    (``Result_<lang>_epoch-<n>``) so a resumed run re-emits the SAME
+    file it would have, byte for byte, instead of a timestamp-forked
+    duplicate."""
     from ..resilience import atomic_write_text, faultinject, retry_call
 
-    ts = timestamp_millis if timestamp_millis is not None else int(time.time() * 1000)
-    path = os.path.join(output_dir, f"Result_{lang}_{ts}")
+    if filename is None:
+        ts = (
+            timestamp_millis if timestamp_millis is not None
+            else int(time.time() * 1000)
+        )
+        filename = f"Result_{lang}_{ts}"
+    path = os.path.join(output_dir, filename)
 
     def _write() -> None:
         faultinject.check("report.write")

@@ -1,0 +1,831 @@
+"""Epoch commit ledger: exactly-once streaming resume.
+
+Covers the transactional protocol end to end: record checksums and torn
+appends, two-phase stage/commit, rollback of uncommitted epochs,
+multi-host shard staging + rendezvous (torn cross-host checkpoints roll
+back, never load), elastic resume across a process-count change, the
+subprocess kill-at-every-fault-site chaos sweeps proving resumed
+``stream-train`` state and ``stream-score`` reports match uninterrupted
+runs exactly, the ``stream requeue`` dead-letter replay verb, and the
+``--verify-deep`` model-selection mode.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+from spark_text_clustering_tpu import telemetry
+from spark_text_clustering_tpu.resilience import (
+    CorruptArtifactError,
+    EpochLedger,
+    ResilienceError,
+    ResumeMismatchError,
+    faultinject,
+    requeue,
+    shard_filename,
+    shard_span,
+    validate_shard_plan,
+    validate_resume_meta,
+    write_resume_meta,
+)
+from spark_text_clustering_tpu.resilience.ledger import record_checksum
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults_and_registry():
+    faultinject.reset()
+    telemetry.get_registry().reset()
+    yield
+    faultinject.reset()
+    telemetry.shutdown()
+    telemetry.get_registry().reset()
+
+
+def _payload(d, name, text="payload"):
+    p = os.path.join(str(d), name)
+    with open(p, "w") as f:
+        f.write(text)
+    return p
+
+
+# ---------------------------------------------------------------------------
+# Record format / torn appends
+# ---------------------------------------------------------------------------
+class TestLedgerRecords:
+    def test_checksum_covers_body_not_itself(self):
+        rec = {"epoch": 0, "kind": "t", "sources": ["a"]}
+        h = record_checksum(rec)
+        assert record_checksum({**rec, "checksum": h}) == h
+        assert record_checksum({**rec, "epoch": 1}) != h
+
+    def test_commit_appends_checksummed_line(self, tmp_path):
+        telemetry.configure(None)
+        led = EpochLedger(str(tmp_path))
+        p = _payload(tmp_path, "r0")
+        led.begin(0, kind="stream-score", sources=["a"], payloads=[p])
+        rec = led.commit(
+            0, kind="stream-score", sources=["a"], payloads={"r0": p},
+        )
+        (line,) = open(led.path).read().splitlines()
+        on_disk = json.loads(line)
+        assert on_disk == rec
+        assert record_checksum(on_disk) == on_disk["checksum"]
+        assert led.committed_sources() == {"a"}
+        snap = telemetry.get_registry().snapshot()
+        assert snap["counters"]["ledger.commits"] == 1
+
+    def test_out_of_order_epoch_rejected(self, tmp_path):
+        led = EpochLedger(str(tmp_path))
+        with pytest.raises(ValueError, match="out of order"):
+            led.begin(3, kind="t", sources=[], payloads=[])
+
+    def test_torn_tail_is_truncated_by_recover(self, tmp_path):
+        telemetry.configure(None)
+        led = EpochLedger(str(tmp_path))
+        led.begin(0, kind="t", sources=["a"], payloads=[])
+        led.commit(0, kind="t", sources=["a"])
+        with open(led.path, "a") as f:
+            f.write('{"epoch": 1, "kind": "t", "torn mid-app')
+        # reads tolerate the torn tail without mutating the file
+        assert EpochLedger(str(tmp_path)).last_committed() == 0
+        rep = EpochLedger(str(tmp_path)).recover()
+        assert rep.truncated_lines == 1 and rep.last_epoch == 0
+        # recover() rewrote the file: the torn line is gone for good
+        assert len(open(led.path).read().splitlines()) == 1
+        snap = telemetry.get_registry().snapshot()
+        assert snap["counters"]["ledger.rollbacks"] == 1
+
+    def test_mid_file_corruption_is_typed(self, tmp_path):
+        led = EpochLedger(str(tmp_path))
+        led.begin(0, kind="t", sources=[], payloads=[])
+        led.commit(0, kind="t", sources=[])
+        led.begin(1, kind="t", sources=[], payloads=[])
+        led.commit(1, kind="t", sources=[])
+        lines = open(led.path).read().splitlines()
+        lines[0] = lines[0][: len(lines[0]) // 2]      # corrupt NON-tail
+        with open(led.path, "w") as f:
+            f.write("\n".join(lines) + "\n")
+        with pytest.raises(CorruptArtifactError, match="not the"):
+            EpochLedger(str(tmp_path)).records()
+
+
+# ---------------------------------------------------------------------------
+# Two-phase protocol + rollback
+# ---------------------------------------------------------------------------
+class TestTwoPhase:
+    def test_commit_clears_intent(self, tmp_path):
+        telemetry.configure(None)
+        led = EpochLedger(str(tmp_path))
+        p = _payload(tmp_path, "r0")
+        intent = led.begin(
+            0, kind="stream-score", sources=["a"], payloads=[p],
+        )
+        assert os.path.exists(intent)
+        led.commit(0, kind="stream-score", sources=["a"], payloads={"r0": p})
+        assert not os.path.exists(intent)
+
+    def test_vanished_payload_fails_commit(self, tmp_path):
+        led = EpochLedger(str(tmp_path))
+        led.begin(0, kind="t", sources=[], payloads=["gone"])
+        with pytest.raises(CorruptArtifactError, match="vanished"):
+            led.commit(
+                0, kind="t", sources=[],
+                payloads={"gone": str(tmp_path / "gone")},
+            )
+
+    def test_uncommitted_epoch_rolls_back_and_quarantines(self, tmp_path):
+        """The crash window between stage and commit: orphan payloads
+        are quarantined — never re-emitted as if valid — and counted."""
+        telemetry.configure(None)
+        led = EpochLedger(str(tmp_path))
+        p = _payload(tmp_path, "orphan_report")
+        led.begin(0, kind="stream-score", sources=["a"], payloads=[p])
+        # crash here: no commit
+        rep = EpochLedger(str(tmp_path)).recover()
+        assert rep.rolled_back == [0]
+        assert not os.path.exists(p)
+        q = tmp_path / "quarantined_epochs" / "epoch-000000" / "orphan_report"
+        assert q.exists() and q.read_text() == "payload"
+        assert not os.path.exists(led._intent_path(0))
+        snap = telemetry.get_registry().snapshot()
+        assert snap["counters"]["ledger.rollbacks"] == 1
+
+    def test_post_commit_crash_window_cleans_without_rollback(self, tmp_path):
+        """A crash AFTER the ledger append but before intent cleanup
+        must NOT roll the committed epoch back."""
+        telemetry.configure(None)
+        led = EpochLedger(str(tmp_path))
+        p = _payload(tmp_path, "r0")
+        led.begin(0, kind="t", sources=["a"], payloads=[p])
+        led.commit(0, kind="t", sources=["a"], payloads={"r0": p})
+        # simulate the torn post-commit window: stale intent reappears
+        led.begin(1, kind="t", sources=["b"], payloads=[])
+        led.commit(1, kind="t", sources=["b"])
+        stale = led._intent_path(1)
+        with open(stale, "w") as f:
+            json.dump({"epoch": 1, "payloads": [p]}, f)
+        rep = EpochLedger(str(tmp_path)).recover()
+        assert rep.rolled_back == []
+        assert not os.path.exists(stale)
+        assert os.path.exists(p)        # committed payload untouched
+        assert EpochLedger(str(tmp_path)).last_committed() == 1
+
+    def test_recover_is_idempotent(self, tmp_path):
+        telemetry.configure(None)
+        led = EpochLedger(str(tmp_path))
+        _payload(tmp_path, "r")
+        led.begin(0, kind="t", sources=[], payloads=[str(tmp_path / "r")])
+        EpochLedger(str(tmp_path)).recover()
+        rep2 = EpochLedger(str(tmp_path)).recover()
+        assert rep2.rolled_back == [] and rep2.quarantined == []
+
+    def test_fault_sites_fire(self, tmp_path):
+        led = EpochLedger(str(tmp_path))
+        faultinject.configure("ledger.stage:ioerror@1.0")
+        with pytest.raises(Exception):
+            led.begin(0, kind="t", sources=[], payloads=[])
+        faultinject.configure("ledger.commit:ioerror@1.0")
+        led.begin(0, kind="t", sources=[], payloads=[])
+        with pytest.raises(Exception):
+            led.commit(0, kind="t", sources=[])
+
+
+# ---------------------------------------------------------------------------
+# Shard plans: spans, validation, multi-host staging rendezvous
+# ---------------------------------------------------------------------------
+class TestShards:
+    def test_shard_span_partitions_exactly(self):
+        for v_pad in (64, 65, 7, 1):
+            for pc in (1, 2, 3, 4):
+                spans = [shard_span(v_pad, p, pc) for p in range(pc)]
+                at = 0
+                for lo, hi in spans:
+                    assert lo == at
+                    at = hi
+                assert at == v_pad
+
+    def test_validate_shard_plan_rejects_gaps_and_overlap(self):
+        ok = {
+            "epoch": 0,
+            "shards": [
+                {"p": 0, "cols": [0, 32], "file": "a", "sha256": "x"},
+                {"p": 1, "cols": [32, 64], "file": "b", "sha256": "y"},
+            ],
+        }
+        assert len(validate_shard_plan(ok, 64)) == 2
+        gap = {"epoch": 0, "shards": [{"p": 0, "cols": [0, 30], "file": "a",
+                                       "sha256": "x"}]}
+        with pytest.raises(CorruptArtifactError, match="covers 30 of 64"):
+            validate_shard_plan(gap, 64)
+        overlap = {
+            "epoch": 0,
+            "shards": [
+                {"p": 0, "cols": [0, 40], "file": "a", "sha256": "x"},
+                {"p": 1, "cols": [32, 64], "file": "b", "sha256": "y"},
+            ],
+        }
+        with pytest.raises(CorruptArtifactError, match="torn"):
+            validate_shard_plan(overlap, 64)
+
+    def test_two_process_stage_and_rendezvous(self, tmp_path):
+        """Coordinator awaits both shards, then commits a record whose
+        shard digests pin the staged files — the multi-host protocol
+        run with a worker thread standing in for process 1."""
+        telemetry.configure(None)
+        led = EpochLedger(str(tmp_path))
+        lam = np.arange(2 * 64, dtype=np.float32).reshape(2, 64)
+        led.begin(
+            0, kind="stream-train", sources=["a"],
+            payloads=[shard_filename(0, 0), shard_filename(0, 1)],
+            process_count=2,
+        )
+
+        def worker():
+            EpochLedger(str(tmp_path)).stage_shard(
+                0, 1, 2, cols=(32, 64), step=1, lam=lam[:, 32:64],
+            )
+
+        t = threading.Thread(target=worker)
+        t.start()
+        spec0 = led.stage_shard(0, 0, 2, cols=(0, 32), step=1,
+                                lam=lam[:, :32])
+        specs = led.await_shards(0, 2, timeout_s=30.0)
+        t.join()
+        assert [s["p"] for s in specs] == [0, 1]
+        assert specs[0] == spec0
+        rec = led.commit(
+            0, kind="stream-train", sources=["a"], shards=specs,
+            process_count=2, step=1,
+        )
+        assert len(validate_shard_plan(rec, 64)) == 2
+        # workers rendezvous on the commit point
+        assert EpochLedger(str(tmp_path)).await_committed(
+            0, timeout_s=5.0
+        )["epoch"] == 0
+
+    def test_torn_two_process_checkpoint_rolls_back(self, tmp_path):
+        """One process staged its shard, the other never did, the
+        coordinator never committed: the rendezvous times out and
+        recovery quarantines the half-written checkpoint instead of any
+        process loading it."""
+        telemetry.configure(None)
+        led = EpochLedger(str(tmp_path))
+        led.begin(
+            0, kind="stream-train", sources=["a"],
+            payloads=[shard_filename(0, 0), shard_filename(0, 1)],
+            process_count=2,
+        )
+        lam = np.ones((2, 64), np.float32)
+        led.stage_shard(0, 0, 2, cols=(0, 32), step=1, lam=lam[:, :32])
+        with pytest.raises(ResilienceError, match="1/2 shards"):
+            led.await_shards(0, 2, timeout_s=0.2, poll_s=0.01)
+        # process died here; restart recovers
+        rep = EpochLedger(str(tmp_path)).recover()
+        assert rep.rolled_back == [0]
+        assert not os.path.exists(
+            os.path.join(str(tmp_path), shard_filename(0, 0))
+        )
+        qdir = tmp_path / "quarantined_epochs" / "epoch-000000"
+        assert (qdir / shard_filename(0, 0)).exists()
+        assert EpochLedger(str(tmp_path)).last_committed() == -1
+
+
+# ---------------------------------------------------------------------------
+# Trainer integration: ledgered resume, elastic resume, torn refusal
+# ---------------------------------------------------------------------------
+DOCS_A = [
+    "piano violin orchestra symphony concerto melody rhythm harmony",
+    "violin cello orchestra conductor symphony opera melody chord",
+]
+DOCS_B = [
+    "electron proton neutron quantum particle physics energy atom",
+    "quantum photon particle electron wavelength physics momentum atom",
+]
+
+
+def _trainer(ck, **kw):
+    from spark_text_clustering_tpu.config import Params
+    from spark_text_clustering_tpu.streaming import StreamingOnlineLDA
+
+    base = dict(
+        num_features=64, lemmatize=False, batch_capacity=8, row_len=32,
+        checkpoint_every=1,
+    )
+    base.update(kw)
+    return StreamingOnlineLDA(
+        Params(k=2, algorithm="online", seed=0, checkpoint_dir=ck),
+        **base,
+    )
+
+
+def _mb(texts, bid=0):
+    from spark_text_clustering_tpu.streaming import MicroBatch
+
+    return MicroBatch(bid, [f"d{bid}-{i}" for i in range(len(texts))], texts)
+
+
+class TestTrainerLedger:
+    def test_commit_per_epoch_and_resume(self, tmp_path):
+        telemetry.configure(None)
+        ck = str(tmp_path / "ck")
+        t1 = _trainer(ck)
+        t1.process(_mb(DOCS_A + DOCS_B, 0))
+        t1.process(_mb(DOCS_B + DOCS_A, 1))
+        led = EpochLedger(ck)
+        recs = led.records()
+        assert [r["epoch"] for r in recs] == [0, 1]
+        assert all(r["kind"] == "stream-train" for r in recs)
+        assert recs[-1]["step"] == 2
+        # only the newest epoch's shards survive GC
+        shards = [n for n in os.listdir(ck) if n.startswith("stream_state-e")]
+        assert {n.split(".")[0] for n in shards} == {
+            shard_filename(1, 0).split(".")[0]
+        }
+
+        t2 = _trainer(ck)
+        assert int(t2.state.step) == 2
+        assert t2.docs_seen == t1.docs_seen
+        assert t2.batches_seen == t1.batches_seen
+        np.testing.assert_allclose(
+            np.asarray(t2.model().lam), np.asarray(t1.model().lam)
+        )
+
+    def test_empty_epoch_not_committed(self, tmp_path):
+        telemetry.configure(None)
+        ck = str(tmp_path / "ck")
+        t1 = _trainer(ck)
+        t1.process(_mb(DOCS_A, 0))
+        before = EpochLedger(ck).last_committed()
+        assert t1.checkpoint() is False      # nothing new since commit
+        assert EpochLedger(ck).last_committed() == before
+
+    def test_elastic_resume_two_to_one(self, tmp_path):
+        """A checkpoint committed by a 2-process topology resumes on 1
+        process: the ledger's shard plan re-slices transparently."""
+        telemetry.configure(None)
+        ck = str(tmp_path / "ck")
+        ref = _trainer(str(tmp_path / "ref"))
+        ref.process(_mb(DOCS_A + DOCS_B, 0))
+        lam = np.asarray(ref.model().lam)       # [2, 64] ground truth
+        lam_pad = np.zeros((2, ref._v_pad), np.float32)
+        lam_pad[:, : lam.shape[1]] = lam
+
+        from spark_text_clustering_tpu.resilience.resume import (
+            vocab_fingerprint,
+        )
+
+        led = EpochLedger(ck)
+        led.begin(
+            0, kind="stream-train", sources=["a", "b"],
+            payloads=[shard_filename(0, 0), shard_filename(0, 1)],
+            process_count=2,
+        )
+        specs = [
+            led.stage_shard(
+                0, p, 2, cols=shard_span(ref._v_pad, p, 2),
+                step=int(ref.state.step),
+                lam=lam_pad[:, slice(*shard_span(ref._v_pad, p, 2))],
+                docs_seen=np.int64(ref.docs_seen),
+                batches_seen=np.int64(ref.batches_seen),
+                vocab_fp=np.int64(vocab_fingerprint(ref.vocab)),
+            )
+            for p in range(2)
+        ]
+        led.commit(
+            0, kind="stream-train", sources=["a", "b"], shards=specs,
+            process_count=2, step=int(ref.state.step),
+            docs_seen=ref.docs_seen, batches_seen=ref.batches_seen,
+        )
+
+        t = _trainer(ck)                        # 1-process restart
+        assert int(t.state.step) == int(ref.state.step)
+        assert t.docs_seen == ref.docs_seen
+        np.testing.assert_allclose(np.asarray(t.model().lam), lam)
+        # and it keeps training from there
+        t.process(_mb(DOCS_B, 1))
+        assert int(t.state.step) == int(ref.state.step) + 1
+
+    def test_corrupt_committed_shard_refused_not_loaded(self, tmp_path):
+        telemetry.configure(None)
+        ck = str(tmp_path / "ck")
+        t1 = _trainer(ck)
+        t1.process(_mb(DOCS_A, 0))
+        (fname,) = [
+            n for n in os.listdir(ck)
+            if n.startswith("stream_state-e") and n.endswith(".npz")
+        ]
+        with open(os.path.join(ck, fname), "r+b") as f:
+            f.truncate(24)
+        with pytest.raises(CorruptArtifactError, match="torn"):
+            _trainer(ck)
+
+    def test_legacy_checkpoint_dir_still_loads(self, tmp_path):
+        """A pre-ledger dir (bare stream_state.npz, no epochs.jsonl)
+        must keep resuming — format-versioned backward compatibility."""
+        from spark_text_clustering_tpu.models.persistence import (
+            save_train_state,
+        )
+        from spark_text_clustering_tpu.resilience.resume import (
+            vocab_fingerprint,
+        )
+
+        telemetry.configure(None)
+        ck = str(tmp_path / "ck")
+        os.makedirs(ck)
+        ref = _trainer(str(tmp_path / "ref"))
+        ref.process(_mb(DOCS_A, 0))
+        lam_pad = np.asarray(ref.state.lam)
+        save_train_state(
+            os.path.join(ck, "stream_state.npz"),
+            int(ref.state.step),
+            lam=lam_pad,
+            docs_seen=np.int64(ref.docs_seen),
+            batches_seen=np.int64(ref.batches_seen),
+            vocab_fp=np.int64(vocab_fingerprint(ref.vocab)),
+        )
+        t = _trainer(ck)
+        assert int(t.state.step) == int(ref.state.step)
+        assert t.docs_seen == ref.docs_seen
+        np.testing.assert_allclose(
+            np.asarray(t.model().lam), np.asarray(ref.model().lam)
+        )
+
+
+class TestElasticResumeGate:
+    def _params(self):
+        from spark_text_clustering_tpu.config import Params
+
+        return Params(input="x", k=4, seed=0)
+
+    def test_process_count_change_needs_ledger(self, tmp_path):
+        d = str(tmp_path)
+        write_resume_meta(d, self._params(), 1, process_count=2)
+        with pytest.raises(ResumeMismatchError, match="elastic"):
+            validate_resume_meta(d, self._params(), 1, process_count=1)
+        # same topology: fine even without a ledger
+        validate_resume_meta(d, self._params(), 1, process_count=2)
+
+    def test_ledgered_dir_allows_elastic(self, tmp_path):
+        d = str(tmp_path)
+        write_resume_meta(
+            d, self._params(), 1, process_count=2, ledger=True,
+        )
+        meta = validate_resume_meta(d, self._params(), 1, process_count=1)
+        assert meta["process_count"] == 2 and meta["ledger"] is True
+
+    def test_callers_without_process_count_unaffected(self, tmp_path):
+        d = str(tmp_path)
+        write_resume_meta(d, self._params(), 1, process_count=2)
+        validate_resume_meta(d, self._params(), 1)      # batch-train path
+
+
+# ---------------------------------------------------------------------------
+# Subprocess chaos sweeps: kill at EVERY ledger fault site, resume, compare
+# ---------------------------------------------------------------------------
+def _run_cli(args, faults=None, seed=0, cwd=None):
+    env = dict(os.environ)
+    env.pop(faultinject.ENV_SPEC, None)
+    if faults:
+        env[faultinject.ENV_SPEC] = faults
+        env[faultinject.ENV_SEED] = str(seed)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    return subprocess.run(
+        [sys.executable, "-m", "spark_text_clustering_tpu.cli", *args],
+        cwd=cwd or REPO, env=env, capture_output=True, text=True,
+        timeout=300,
+    )
+
+
+def _watch_corpus(tmp_path, n=4):
+    watch = tmp_path / "watch"
+    watch.mkdir()
+    pools = ["piano violin orchestra symphony concerto melody",
+             "electron proton neutron quantum particle physics"]
+    for i in range(n):
+        (watch / f"doc{i:02d}.txt").write_text(f"{pools[i % 2]} tok{i}")
+    return str(watch)
+
+
+def _stream_train_args(watch, models, ckpt, resume=False):
+    return [
+        "stream-train", "--watch-dir", watch, "--idle-timeout", "0",
+        "--poll-interval", "0.01", "--k", "2", "--hash-features", "64",
+        "--no-lemmatize", "--models-dir", models, "--checkpoint-dir",
+        ckpt, "--checkpoint-interval", "1", "--max-files-per-trigger",
+        "2", "--seed", "3",
+        *(["--resume"] if resume else []),
+    ]
+
+
+class TestExactlyOnceTrainSweep:
+    def test_kill_at_every_site_resume_matches_uninterrupted(self, tmp_path):
+        """The acceptance drill: SIGKILL-equivalent crashes at the
+        stage write, the shard (payload) write, the commit append, and
+        after a clean commit — every resume converges to the
+        uninterrupted run's state with no file trained twice."""
+        from spark_text_clustering_tpu.models.persistence import (
+            latest_model_dir,
+            load_model,
+        )
+
+        watch = _watch_corpus(tmp_path)
+        models_u = str(tmp_path / "models_u")
+        ru = _run_cli(_stream_train_args(
+            watch, models_u, str(tmp_path / "ck_u")
+        ))
+        assert ru.returncode == 0, ru.stderr[-2000:]
+        lam_u = load_model(latest_model_dir(models_u, "EN")).lam
+        rec_u = EpochLedger(str(tmp_path / "ck_u")).records()
+        docs_u = max(r.get("docs_seen", 0) for r in rec_u)
+
+        sweep = [
+            ("stage", "ledger.stage:kill@1"),
+            ("payload", "ckpt.write:kill@1"),
+            ("commit", "ledger.commit:kill@1"),
+            ("post-commit", "ledger.stage:kill@2"),
+        ]
+        for label, faults in sweep:
+            models = str(tmp_path / f"models_{label}")
+            ckpt = str(tmp_path / f"ck_{label}")
+            rk = _run_cli(
+                _stream_train_args(watch, models, ckpt), faults=faults,
+            )
+            assert rk.returncode == 137, (label, rk.stderr[-2000:])
+            rr = _run_cli(
+                _stream_train_args(watch, models, ckpt, resume=True),
+            )
+            assert rr.returncode == 0, (label, rr.stderr[-2000:])
+            lam = load_model(latest_model_dir(models, "EN")).lam
+            np.testing.assert_allclose(
+                lam, lam_u, rtol=1e-5, atol=1e-5, err_msg=label,
+            )
+            recs = EpochLedger(ckpt).records()
+            # no source committed twice (exactly-once consumption)...
+            all_sources = [
+                s for r in recs for s in r.get("sources", ())
+            ]
+            assert len(all_sources) == len(set(all_sources)), label
+            # ...and nothing lost: the resumed run trained every doc
+            assert max(
+                r.get("docs_seen", 0) for r in recs
+            ) == docs_u, label
+
+
+def _stream_score_args(watch, models, out, ckpt):
+    return [
+        "stream-score", "--watch-dir", watch, "--idle-timeout", "0",
+        "--poll-interval", "0.01", "--no-lemmatize", "--models-dir",
+        models, "--output-dir", out, "--checkpoint-dir", ckpt,
+        "--max-files-per-trigger", "2",
+    ]
+
+
+class TestExactlyOnceScoreSweep:
+    @pytest.fixture()
+    def scored_model_dir(self, tmp_path):
+        """A committed model to score against (built in-process: the
+        subprocess sweep only needs the artifact)."""
+        from spark_text_clustering_tpu.streaming import MemoryStreamSource
+
+        telemetry.configure(None)
+        trainer = _trainer(None, checkpoint_every=None)
+        src = MemoryStreamSource()
+        src.add(DOCS_A + DOCS_B)
+        trainer.run(src)
+        models = str(tmp_path / "models")
+        trainer.model().save(os.path.join(models, "LdaModel_EN_1000"))
+        return models
+
+    def test_kill_sweep_reports_byte_identical(
+        self, tmp_path, scored_model_dir
+    ):
+        """Resumed stream-score emits each per-epoch report EXACTLY
+        once, byte-for-byte what the uninterrupted run emits — zero
+        duplicates, zero losses, orphans quarantined not re-emitted."""
+        watch = _watch_corpus(tmp_path)
+        out_u = str(tmp_path / "out_u")
+        ru = _run_cli(_stream_score_args(
+            watch, scored_model_dir, out_u, str(tmp_path / "sck_u")
+        ))
+        assert ru.returncode == 0, ru.stderr[-2000:]
+        want = {
+            n: open(os.path.join(out_u, n)).read()
+            for n in sorted(os.listdir(out_u))
+        }
+        assert len(want) == 2           # 4 files / 2 per trigger
+
+        sweep = [
+            ("stage", "ledger.stage:kill@1"),
+            ("payload", "report.write:kill@1"),
+            ("commit", "ledger.commit:kill@1"),
+            ("post-commit", "ledger.stage:kill@2"),
+        ]
+        for label, faults in sweep:
+            out = str(tmp_path / f"out_{label}")
+            ckpt = str(tmp_path / f"sck_{label}")
+            rk = _run_cli(
+                _stream_score_args(watch, scored_model_dir, out, ckpt),
+                faults=faults,
+            )
+            assert rk.returncode == 137, (label, rk.stderr[-2000:])
+            rr = _run_cli(
+                _stream_score_args(watch, scored_model_dir, out, ckpt),
+            )
+            assert rr.returncode == 0, (label, rr.stderr[-2000:])
+            got = {
+                n: open(os.path.join(out, n)).read()
+                for n in sorted(os.listdir(out))
+            }
+            assert got == want, label   # exactly-once, byte-for-byte
+            if label == "commit":
+                # the orphan report the crash stranded was quarantined,
+                # not trusted: it lives under quarantined_epochs now
+                qdir = os.path.join(
+                    ckpt, "quarantined_epochs", "epoch-000000",
+                )
+                assert os.path.isdir(qdir) and os.listdir(qdir), label
+
+    def test_resume_suppresses_committed_replays(
+        self, tmp_path, scored_model_dir
+    ):
+        watch = _watch_corpus(tmp_path)
+        out = str(tmp_path / "out")
+        ckpt = str(tmp_path / "sck")
+        args = _stream_score_args(watch, scored_model_dir, out, ckpt)
+        assert _run_cli(args).returncode == 0
+        before = {
+            n: os.path.getmtime(os.path.join(out, n))
+            for n in os.listdir(out)
+        }
+        r2 = _run_cli(args + ["--telemetry-file",
+                              str(tmp_path / "run.jsonl")])
+        assert r2.returncode == 0
+        after = {
+            n: os.path.getmtime(os.path.join(out, n))
+            for n in os.listdir(out)
+        }
+        assert after == before          # nothing re-emitted
+        events = [
+            json.loads(ln)
+            for ln in open(str(tmp_path / "run.jsonl"))
+        ]
+        (snap,) = [e for e in events if e.get("event") == "registry"]
+        assert snap["snapshot"]["counters"][
+            "ledger.replays_suppressed"
+        ] == 4
+
+
+# ---------------------------------------------------------------------------
+# stream requeue (dead-letter replay)
+# ---------------------------------------------------------------------------
+class TestRequeue:
+    def _quarantined(self, tmp_path, n=2):
+        from spark_text_clustering_tpu.resilience import Quarantine
+
+        telemetry.configure(None)
+        q = Quarantine(str(tmp_path / "dlq"))
+        for i in range(n):
+            q.put(f"doc{i}.txt", f"text {i}", ValueError("boom"),
+                  stage="vectorize", batch_id=i)
+        return str(tmp_path / "dlq")
+
+    def test_requeue_moves_payloads_archives_sidecars(self, tmp_path):
+        dlq = self._quarantined(tmp_path)
+        watch = str(tmp_path / "watch")
+        res = requeue(dlq, watch)
+        assert len(res["replayed"]) == 2 and not res["skipped"]
+        assert sorted(os.listdir(watch)) == [
+            os.path.basename(p) for p in res["replayed"]
+        ]
+        archive = os.path.join(dlq, ".archive")
+        assert len(os.listdir(archive)) == 2
+        # quarantine dir is drained of both payloads and sidecars
+        left = [n for n in os.listdir(dlq) if n != ".archive"]
+        assert left == []
+        snap = telemetry.get_registry().snapshot()
+        assert snap["counters"]["requeue.replayed"] == 2
+        assert snap["counters"]["requeue.archived"] == 2
+
+    def test_dry_run_touches_nothing(self, tmp_path):
+        dlq = self._quarantined(tmp_path)
+        watch = str(tmp_path / "watch")
+        res = requeue(dlq, watch, dry_run=True)
+        assert len(res["replayed"]) == 2
+        assert not os.path.exists(watch)
+        assert len([n for n in os.listdir(dlq) if n.endswith(".txt")]) == 2
+
+    def test_cli_verb_end_to_end(self, tmp_path, capsys):
+        from spark_text_clustering_tpu.cli import main
+
+        dlq = self._quarantined(tmp_path)
+        watch = str(tmp_path / "watch")
+        rc = main([
+            "stream", "requeue", "--quarantine-dir", dlq,
+            "--watch-dir", watch, "--dry-run",
+        ])
+        assert rc == 0
+        assert "would replay" in capsys.readouterr().out
+        rc = main([
+            "stream", "requeue", "--quarantine-dir", dlq,
+            "--watch-dir", watch,
+        ])
+        assert rc == 0
+        assert len(os.listdir(watch)) == 2
+        # replayed files are NEW paths: a stream source re-ingests them
+        from spark_text_clustering_tpu.streaming import FileStreamSource
+
+        src = FileStreamSource(watch)
+        mb = src.poll()
+        assert mb is not None and len(mb) == 2
+
+
+# ---------------------------------------------------------------------------
+# --verify-deep model selection
+# ---------------------------------------------------------------------------
+class TestVerifyDeep:
+    def _model(self, v=6, seed=0):
+        from spark_text_clustering_tpu.models.base import LDAModel
+
+        rng = np.random.default_rng(seed)
+        return LDAModel(
+            lam=rng.random((2, v)).astype(np.float32) + 0.1,
+            vocab=[f"term{i}" for i in range(v)],
+            alpha=np.full(2, 0.5, np.float32),
+            eta=0.1,
+        )
+
+    def test_falls_back_past_corrupt_committed_dir(self, tmp_path):
+        from spark_text_clustering_tpu.models.persistence import (
+            latest_model_dir,
+        )
+
+        telemetry.configure(None)
+        base = str(tmp_path)
+        self._model().save(os.path.join(base, "LdaModel_EN_100"))
+        newest = os.path.join(base, "LdaModel_EN_900")
+        self._model().save(newest)
+        # bit-rot AFTER sealing: COMMIT still present, hash now wrong
+        with open(os.path.join(newest, "arrays.npz"), "r+b") as f:
+            f.truncate(10)
+        # cheap selection trusts COMMIT and picks the rotten dir...
+        assert latest_model_dir(base, "EN") == newest
+        # ...deep verification skips it and falls back
+        got = latest_model_dir(base, "EN", verify_deep=True)
+        assert got.endswith("LdaModel_EN_100")
+        snap = telemetry.get_registry().snapshot()
+        assert snap["counters"]["resilience.artifacts_skipped"] == 1
+
+    def test_cli_flag_scores_with_fallback(self, tmp_path):
+        from spark_text_clustering_tpu.cli import main
+
+        models = str(tmp_path / "models")
+        m = self._model(v=8)
+        m.save(os.path.join(models, "LdaModel_EN_100"))
+        bad = os.path.join(models, "LdaModel_EN_900")
+        m.save(bad)
+        with open(os.path.join(bad, "arrays.npz"), "r+b") as f:
+            f.truncate(16)
+        books = tmp_path / "books"
+        books.mkdir()
+        (books / "a.txt").write_text("term0 term1 term2")
+        out = str(tmp_path / "out")
+        rc = main([
+            "score", "--books", str(books), "--models-dir", models,
+            "--output-dir", out, "--no-lemmatize", "--verify-deep",
+        ])
+        assert rc == 0
+        assert os.listdir(out)
+
+    def test_artifact_ledger_cross_reference(self, tmp_path):
+        """save_model(ledger_ref=...) lands in meta.json and
+        artifact_ref pins the sealed manifest — both directions of the
+        artifact<->ledger link."""
+        from spark_text_clustering_tpu.models.persistence import (
+            load_model,
+            save_model,
+        )
+        from spark_text_clustering_tpu.resilience import (
+            artifact_ref,
+            file_sha256,
+        )
+
+        d = str(tmp_path / "LdaModel_EN_100")
+        save_model(
+            self._model(), d, ledger_ref={"dir": "ck", "epoch": 7},
+        )
+        with open(os.path.join(d, "meta.json")) as f:
+            meta = json.load(f)
+        assert meta["ledger_ref"] == {"dir": "ck", "epoch": 7}
+        load_model(d)                   # still verifies + loads
+        ref = artifact_ref(d)
+        assert ref["path"] == d
+        assert ref["manifest_sha256"] == file_sha256(
+            os.path.join(d, "MANIFEST.json")
+        )
