@@ -508,6 +508,67 @@ def _roofline(flops: float, hbm_bytes: float, seconds: float) -> dict:
     return out
 
 
+def _measured_rooflines(prefix: str):
+    """MEASURED per-executable roofline rows for one dispatch-label
+    family (telemetry.roofline.rows_live): the analytic ``_roofline``
+    above models the sweep's FLOPs by hand; these rows join the live
+    dispatch records' wall+sync seconds with XLA's own cost_analysis —
+    the `dispatch.*` numbers ROADMAP open item 2 asks the bench to
+    carry.  None when the family recorded nothing (attribution is
+    best-effort by contract)."""
+    try:
+        from spark_text_clustering_tpu.telemetry.roofline import rows_live
+
+        rows = [
+            {
+                k: r.get(k)
+                for k in (
+                    "label", "digest", "calls", "seconds",
+                    "achieved_flops_per_s", "frac_peak_flops",
+                    "achieved_bytes_per_s", "frac_peak_bytes",
+                    "roofline_frac", "bound", "mem_peak_bytes",
+                    "cost_source", "available",
+                )
+            }
+            for r in rows_live(prefix=prefix)
+        ]
+        return rows or None
+    except Exception as exc:
+        sys.stderr.write(
+            f"# measured roofline unavailable ({prefix}): {exc!r}\n"
+        )
+        return None
+
+
+def _peak_memory_fields() -> dict:
+    """Live device/host memory for the BENCH record tail: device
+    memory_stats when the backend reports them (TPU/GPU), host RSS
+    always, plus the largest per-executable memory_analysis peak the
+    dispatch layer attributed (telemetry.memory)."""
+    from spark_text_clustering_tpu.telemetry import dispatch as _disp
+    from spark_text_clustering_tpu.telemetry import memory as _mem
+
+    out: dict = {}
+    rss = _mem.host_rss_bytes()
+    if rss is not None:
+        out["host_rss_bytes"] = rss
+    dev = _mem.device_stats()
+    if dev is None:
+        out["device"] = "unavailable"
+    else:
+        out.update({f"device_{k}": v for k, v in dev.items()})
+    exec_peaks = {
+        rec.label: rec.mem_bytes["peak_bytes"]
+        for rec in _disp.records().values()
+        if rec.mem_bytes and "peak_bytes" in rec.mem_bytes
+    }
+    if exec_peaks:
+        worst = max(exec_peaks, key=lambda lbl: exec_peaks[lbl])
+        out["exec_peak_bytes_max"] = exec_peaks[worst]
+        out["exec_peak_label"] = worst
+    return out
+
+
 def _bench_online():
     """BASELINE.md row-1 shape: online VB docs/sec + final log-perplexity."""
     import jax
@@ -1009,6 +1070,15 @@ def _bench_scale():
     }
 
 
+def _compile_signature_fields() -> dict:
+    """Distinct compiled signatures per dispatch label (the recompile
+    sentinel's view of this bench run) — a retrace regression shows up
+    as a count jump in `metrics diff BENCH_rNN.json BENCH_rMM.json`."""
+    from spark_text_clustering_tpu.telemetry import compilation as _comp
+
+    return _comp.signatures()
+
+
 def child_main() -> None:
     # Ambient 1-min load BEFORE any bench work: on this 1-core sandbox
     # the sklearn baseline (and our host-side packing) measured
@@ -1016,6 +1086,13 @@ def child_main() -> None:
     # carries the load the capture STARTED under (sampling at emission
     # would mostly read the bench's own multi-minute footprint)
     ambient_load = os.getloadavg()[0]
+
+    # registry-only telemetry: the dispatch layer then attributes every
+    # hot-loop executable (calls, compile signatures, memory_analysis,
+    # wall+sync seconds) so the record can carry MEASURED rooflines next
+    # to the analytic ones; no run stream is written from the child (the
+    # parent owns bench_events.jsonl)
+    telemetry.configure(None)
 
     import jax
 
@@ -1032,6 +1109,7 @@ def child_main() -> None:
     enable_persistent_compile_cache(cache_root=CACHE)
 
     s_per_iter, em_roofline = _bench_em("EN", BASELINE_S_PER_ITER)
+    em_roofline["measured"] = _measured_rooflines("em.")
     ge_s_per_iter = None
     ge_roofline = None
     try:
@@ -1040,17 +1118,20 @@ def child_main() -> None:
         sys.stderr.write(f"# GE bench skipped: {exc!r}\n")
     (docs_per_sec, log_perp, log_perp_conv, bsz, online_roofline,
      rows, eval_rows) = _bench_online()
+    online_roofline["measured"] = _measured_rooflines("online.")
 
     baseline = _bench_sklearn_baseline(rows, eval_rows, bsz)
 
     nmf_rec = None
     try:
         nmf_rec = _bench_nmf(rows)
+        nmf_rec["roofline"]["measured"] = _measured_rooflines("nmf.")
     except Exception as exc:
         sys.stderr.write(f"# nmf bench skipped: {exc!r}\n")
     stream_rec = None
     try:
         stream_rec = _bench_streaming(rows)
+        stream_rec["measured_roofline"] = _measured_rooflines("stream.")
     except Exception as exc:
         sys.stderr.write(f"# streaming bench skipped: {exc!r}\n")
     scale_rec = None
@@ -1114,6 +1195,8 @@ def child_main() -> None:
                 "nmf": nmf_rec,
                 "streaming": stream_rec,
                 "scale": scale_rec,
+                "peak_memory": _peak_memory_fields(),
+                "compile_signatures": _compile_signature_fields(),
             }
         )
     )

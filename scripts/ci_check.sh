@@ -29,12 +29,18 @@
 #      tier-1 as tests/test_ledger.py), resumed, and the resumed run's
 #      ledger counters (commits, rollbacks) gated against the committed
 #      baseline via `metrics check --include ledger.`
+#   9. recompile sentinel: the gate-5 train stream plus a score run are
+#      checked against scripts/records/compile_baseline.json (`metrics
+#      compile-check`) — more distinct compiled signatures per dispatch
+#      label than committed means an unbucketed shape is re-tracing a
+#      hot loop; a planted retrace storm must gate red (self-test)
 #
 # Usage:
-#   scripts/ci_check.sh                 # run all eight gates
+#   scripts/ci_check.sh                 # run all nine gates
 #   scripts/ci_check.sh --rebaseline    # recapture ALL baselines
 #                                       # (metrics + lint waivers +
-#                                       # lint counters; commit the
+#                                       # lint counters + compile
+#                                       # signatures; commit the
 #                                       # result deliberately)
 set -uo pipefail
 cd "$(dirname "$0")/.."
@@ -45,14 +51,18 @@ export JAX_PLATFORMS=cpu
 # at the same topology (the tier-1 8-device harness)
 export XLA_FLAGS="--xla_force_host_platform_device_count=8"
 BASELINE=scripts/records/ci_metrics_baseline.json
+COMPILE_BASELINE=scripts/records/compile_baseline.json
 # exclude machine-dependent wall-time metrics from the gate; counters and
 # event counts must stay exact across machines.  dispatch cost-model
 # estimates (est_*/device_*_total gauges) are backend/version-dependent
-# and excluded too; dispatch CALL counters stay exact.
+# and excluded too; dispatch CALL counters stay exact.  mem.* byte
+# GAUGES (host RSS, memory_analysis sizes) are machine/XLA-version
+# dependent — the mem.* COUNTERS (samples, device_stats_unavailable)
+# and compile.<label>.signatures gauges stay exact.
 EXCLUDES=(--exclude seconds --exclude _ms --exclude _s_ --exclude
           s_per_iter --exclude duration_s --exclude docs_per_s
           --exclude .est_ --exclude device_seconds_total --exclude
-          device_bytes_total)
+          device_bytes_total --exclude gauge.mem.)
 
 run_ci_train() {
     # tiny deterministic corpus + train: same flags as the baseline was
@@ -117,6 +127,38 @@ EOF
         --telemetry-file "$workdir/ledger_drill.jsonl" >/dev/null
 }
 
+run_ci_score() {
+    # score the gate-5 model with telemetry on: the scoring path's
+    # dispatch labels (score.*) join the sentinel check so train+score
+    # both stay bucketed
+    local workdir="$1"
+    python -m spark_text_clustering_tpu.cli score \
+        --books "$workdir/books" --models-dir "$workdir/models" \
+        --lang EN --no-lemmatize --output-dir "$workdir/score_out" \
+        --telemetry-file "$workdir/score.jsonl" >/dev/null
+}
+
+make_retrace_storm() {
+    # planted self-test stream: one committed label re-announced under
+    # many distinct signatures — compile-check MUST gate red on it
+    local workdir="$1"
+    python - "$workdir" <<'EOF'
+import sys
+
+from spark_text_clustering_tpu.telemetry import TelemetryWriter
+
+workdir = sys.argv[1]
+w = TelemetryWriter(f"{workdir}/storm.jsonl", run_id="ci-storm")
+w.write_manifest(kind="ci-storm")
+for i in range(32):
+    w.emit(
+        "dispatch_executable", digest=f"storm{i:04d}",
+        label="online.chunk_runner", signature=f"f32[{i},64]",
+    )
+w.close()
+EOF
+}
+
 make_skew_streams() {
     # two synthetic per-process streams: balanced pair + a pair with a
     # planted straggler/retry divergence on p1 (the merge gate's fixture)
@@ -164,7 +206,13 @@ if [[ "${1:-}" == "--rebaseline" ]]; then
     run_ledger_drill "$work" || exit 1
     python -m spark_text_clustering_tpu.cli metrics check \
         "$work/ledger_drill.jsonl" --baseline "$BASELINE" \
-        --write-baseline --tolerance 0.0 --include ledger.
+        --write-baseline --tolerance 0.0 --include ledger. || exit 1
+    # recapture the recompile sentinel's expected-signature table from
+    # the same train run plus a score run (gate 9's fixture pair)
+    run_ci_score "$work" || exit 1
+    python -m spark_text_clustering_tpu.cli metrics compile-check \
+        "$work/run.jsonl" "$work/score.jsonl" \
+        --baseline "$COMPILE_BASELINE" --write-baseline
     exit $?
 fi
 
@@ -172,12 +220,12 @@ fail=0
 work=$(mktemp -d)
 trap 'rm -rf "$work"' EXIT
 
-echo "== [1/8] stc lint (AST rules + jaxpr audit) =="
+echo "== [1/9] stc lint (AST rules + jaxpr audit) =="
 python -m spark_text_clustering_tpu.cli lint \
     --telemetry-file "$work/lint.jsonl"
 if [[ $? -ne 0 ]]; then echo "FAIL: stc lint"; fail=1; fi
 
-echo "== [2/8] ruff (generic-Python tier) =="
+echo "== [2/9] ruff (generic-Python tier) =="
 if command -v ruff >/dev/null 2>&1; then
     ruff check spark_text_clustering_tpu
     if [[ $? -ne 0 ]]; then echo "FAIL: ruff"; fail=1; fi
@@ -185,17 +233,17 @@ else
     echo "ruff not installed — skipped (stc lint STC101/102/006 cover it)"
 fi
 
-echo "== [3/8] tier-1 tests =="
+echo "== [3/9] tier-1 tests =="
 timeout -k 10 870 python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors -p no:cacheprovider \
     -p no:xdist -p no:randomly
 if [[ $? -ne 0 ]]; then echo "FAIL: tier-1"; fail=1; fi
 
-echo "== [4/8] telemetry overhead budget =="
+echo "== [4/9] telemetry overhead budget =="
 python scripts/check_telemetry_overhead.py
 if [[ $? -ne 0 ]]; then echo "FAIL: telemetry overhead"; fail=1; fi
 
-echo "== [5/8] metrics regression gate =="
+echo "== [5/9] metrics regression gate =="
 if run_ci_train "$work"; then
     # lint. and ledger. families are captured by their own gates (1/6
     # and 8) — a batch train run never touches either
@@ -208,7 +256,7 @@ else
     fail=1
 fi
 
-echo "== [6/8] lint metrics gate (waiver count version-gated) =="
+echo "== [6/9] lint metrics gate (waiver count version-gated) =="
 if [[ -s "$work/lint.jsonl" ]]; then
     python -m spark_text_clustering_tpu.cli metrics check "$work/lint.jsonl" \
         --baseline "$BASELINE" --include lint.
@@ -218,7 +266,7 @@ else
     fail=1
 fi
 
-echo "== [7/8] cross-host skew gate (metrics merge) =="
+echo "== [7/9] cross-host skew gate (metrics merge) =="
 if make_skew_streams "$work"; then
     python -m spark_text_clustering_tpu.cli metrics merge \
         "$work/skew-p0.jsonl" "$work/skew-p1.jsonl" --fail-on-skew \
@@ -239,7 +287,7 @@ else
     fail=1
 fi
 
-echo "== [8/8] exactly-once ledger chaos drill (STC_FAULTS) =="
+echo "== [8/9] exactly-once ledger chaos drill (STC_FAULTS) =="
 if run_ledger_drill "$work"; then
     python -m spark_text_clustering_tpu.cli metrics check \
         "$work/ledger_drill.jsonl" --baseline "$BASELINE" \
@@ -247,6 +295,32 @@ if run_ledger_drill "$work"; then
     if [[ $? -ne 0 ]]; then echo "FAIL: ledger drill metrics"; fail=1; fi
 else
     echo "FAIL: ledger chaos drill run"
+    fail=1
+fi
+
+echo "== [9/9] recompile sentinel (metrics compile-check) =="
+if [[ -s "$work/run.jsonl" ]] && run_ci_score "$work"; then
+    python -m spark_text_clustering_tpu.cli metrics compile-check \
+        "$work/run.jsonl" "$work/score.jsonl" \
+        --baseline "$COMPILE_BASELINE"
+    if [[ $? -ne 0 ]]; then
+        echo "FAIL: compiled signatures beyond $COMPILE_BASELINE"
+        fail=1
+    fi
+    if make_retrace_storm "$work"; then
+        python -m spark_text_clustering_tpu.cli metrics compile-check \
+            "$work/storm.jsonl" --baseline "$COMPILE_BASELINE" \
+            >/dev/null
+        if [[ $? -ne 1 ]]; then
+            echo "FAIL: planted retrace storm not flagged"
+            fail=1
+        fi
+    else
+        echo "FAIL: could not build retrace-storm fixture"
+        fail=1
+    fi
+else
+    echo "FAIL: no train stream / score run for the sentinel gate"
     fail=1
 fi
 

@@ -383,20 +383,41 @@ class _FnEntry:
     rel: str
     node: ast.AST          # FunctionDef / AsyncFunctionDef
     params: Set[str]
+    cls: Optional[str] = None   # enclosing class (qualname context)
+
+
+def _fn_params(node) -> Set[str]:
+    args = node.args
+    return {
+        a.arg
+        for a in (args.posonlyargs + args.args + args.kwonlyargs)
+    }
 
 
 def _collect_functions(mod: ModuleInfo) -> Dict[str, _FnEntry]:
+    """Function table keyed QUALNAME-AWARE: class methods register under
+    ``Class.method`` (the key ``self.method(...)`` calls resolve to) AND
+    under their simple name (first definition wins, so free functions
+    keep shadowing like before).  Both keys share one entry object, so
+    reachability marks and finding dedup see one function."""
     fns: Dict[str, _FnEntry] = {}
+    by_node: Dict[int, _FnEntry] = {}
+    for cls_node in ast.walk(mod.tree):
+        if not isinstance(cls_node, ast.ClassDef):
+            continue
+        for node in cls_node.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                entry = _FnEntry(
+                    mod.relpath, node, _fn_params(node), cls=cls_node.name
+                )
+                fns[f"{cls_node.name}.{node.name}"] = entry
+                by_node[id(node)] = entry
     for node in ast.walk(mod.tree):
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            args = node.args
-            params = {
-                a.arg
-                for a in (
-                    args.posonlyargs + args.args + args.kwonlyargs
-                )
-            }
-            fns.setdefault(node.name, _FnEntry(mod.relpath, node, params))
+            entry = by_node.get(id(node))
+            if entry is None:
+                entry = _FnEntry(mod.relpath, node, _fn_params(node))
+            fns.setdefault(node.name, entry)
     return fns
 
 
@@ -432,27 +453,48 @@ def _is_jit_decorator(dec: ast.AST) -> bool:
 
 def _check_host_syncs(idx: LintIndex) -> List[Finding]:
     out: List[Finding] = []
-    # package-wide function table keyed (module, simple name)
+    # package-wide function table keyed (module, name-or-qualname)
     fn_tables = {
         rel: _collect_functions(mod) for rel, mod in idx.modules.items()
     }
-    # per-module import map: local name -> (target module rel, orig name)
+    # per-module import maps:
+    #   import_maps:  local name  -> (target module rel, orig fn name)
+    #   module_maps:  local alias -> target module rel (so the resolver
+    #                 can walk through ``module.helper(x)`` calls)
     import_maps: Dict[str, Dict[str, Tuple[str, str]]] = {}
+    module_maps: Dict[str, Dict[str, str]] = {}
     for rel, mod in idx.modules.items():
         imap: Dict[str, Tuple[str, str]] = {}
+        mmap: Dict[str, str] = {}
         pkg_parts = rel.split("/")[:-1]  # dirs of this module
         for node in ast.walk(mod.tree):
-            if not isinstance(node, ast.ImportFrom) or not node.level:
+            if isinstance(node, ast.Import):
+                # import <pkg>.ops.sparse [as sp]
+                for a in node.names:
+                    cand = "/".join(a.name.split(".")) + ".py"
+                    if a.asname and cand in idx.modules:
+                        mmap[a.asname] = cand
                 continue
-            base_parts = pkg_parts[: len(pkg_parts) - (node.level - 1)]
-            target = "/".join(
-                base_parts + (node.module or "").split(".")
-            ) + ".py"
-            if target not in idx.modules:
+            if not isinstance(node, ast.ImportFrom):
                 continue
+            if node.level:
+                base_parts = pkg_parts[: len(pkg_parts) - (node.level - 1)]
+            elif (node.module or "").split(".")[0] == PACKAGE:
+                base_parts = []
+            else:
+                continue
+            mod_parts = [p for p in (node.module or "").split(".") if p]
+            target = "/".join(base_parts + mod_parts) + ".py"
             for a in node.names:
-                imap[a.asname or a.name] = (target, a.name)
+                # ``from .ops import sparse``: the bound name may be a
+                # MODULE, not a function — check the file side first
+                sub = "/".join(base_parts + mod_parts + [a.name]) + ".py"
+                if sub in idx.modules:
+                    mmap[a.asname or a.name] = sub
+                elif target in idx.modules:
+                    imap[a.asname or a.name] = (target, a.name)
         import_maps[rel] = imap
+        module_maps[rel] = mmap
 
     # roots: decorated jitted fns + fns wrapped via jax.jit(...) chains
     roots: List[Tuple[str, str]] = []
@@ -519,23 +561,41 @@ def _check_host_syncs(idx: LintIndex) -> List[Finding]:
         for node in ast.walk(entry.node):
             if not isinstance(node, ast.Call):
                 continue
-            callee = None
             if isinstance(node.func, ast.Name):
                 callee = node.func.id
-            if callee is None:
+                # chase one local assignment (sharded = shard_map(_f, ..))
+                if callee not in fn_tables[rel] and callee in assigns:
+                    callee = _unwrap_jit_target(assigns[callee]) or callee
+                if callee in fn_tables[rel]:
+                    frontier.append((rel, callee))
+                elif callee in import_maps[rel]:
+                    t_rel, t_name = import_maps[rel][callee]
+                    if t_name in fn_tables.get(t_rel, {}):
+                        frontier.append((t_rel, t_name))
                 continue
-            # chase one local assignment (sharded = shard_map(_f, ...))
-            if callee not in fn_tables[rel] and callee in assigns:
-                callee = _unwrap_jit_target(assigns[callee]) or callee
-            if callee in fn_tables[rel]:
-                frontier.append((rel, callee))
-            elif callee in import_maps[rel]:
-                t_rel, t_name = import_maps[rel][callee]
-                if t_name in fn_tables.get(t_rel, {}):
-                    frontier.append((t_rel, t_name))
+            # qualname-aware resolution (the STC005 carry-over):
+            # ``self.helper(x)`` / ``cls.helper(x)`` resolve inside the
+            # enclosing class; ``module.helper(x)`` resolves through the
+            # module-alias import map
+            if isinstance(node.func, ast.Attribute) and isinstance(
+                node.func.value, ast.Name
+            ):
+                base, attr = node.func.value.id, node.func.attr
+                if base in ("self", "cls") and entry.cls:
+                    qkey = f"{entry.cls}.{attr}"
+                    if qkey in fn_tables[rel]:
+                        frontier.append((rel, qkey))
+                elif base in module_maps[rel]:
+                    t_rel = module_maps[rel][base]
+                    if attr in fn_tables.get(t_rel, {}):
+                        frontier.append((t_rel, attr))
 
+    seen_nodes: Set[int] = set()
     for rel, name in sorted(reached):
         entry = fn_tables[rel][name]
+        if id(entry.node) in seen_nodes:
+            continue  # reached under both its qualname and simple name
+        seen_nodes.add(id(entry.node))
         for node in ast.walk(entry.node):
             if not isinstance(node, ast.Call):
                 continue

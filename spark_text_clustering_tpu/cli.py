@@ -364,6 +364,12 @@ def cmd_score(args: argparse.Namespace) -> int:
         print(f"no model for lang {args.lang} under {args.models_dir}",
               file=sys.stderr)
         return 2
+    own_telemetry = bool(getattr(args, "telemetry_file", None))
+    if own_telemetry:
+        # scoring runs carry the same dispatch/compile/memory telemetry
+        # train runs do — `metrics roofline` and the recompile-sentinel
+        # CI gate read both sides of a train+score pair
+        telemetry.configure(args.telemetry_file)
     # Generic loader: scoring works with whichever estimator trained the
     # artifact (LDA or NMF) — both expose topic_distribution/describe_topics.
     # A truncated/uncommitted artifact fails HERE with a typed error and a
@@ -374,6 +380,11 @@ def cmd_score(args: argparse.Namespace) -> int:
         print(f"error: {exc}", file=sys.stderr)
         return 2
     print(f"loaded model {model_path}: k={model.k}, V={model.vocab_size}")
+    if own_telemetry:
+        telemetry.manifest(
+            kind="score", model=model_path,
+            vocab_width=model.vocab_size,
+        )
 
     books_dir = args.books
     if books_dir is None and args.books_root:
@@ -415,6 +426,12 @@ def cmd_score(args: argparse.Namespace) -> int:
     print(text)
     path = write_scoring_report(text, args.output_dir, args.lang)
     print(f"report written to {path}")
+    if own_telemetry:
+        telemetry.sample_memory("score")
+        telemetry.event(
+            "scored", documents=len(docs), report=path,
+        )
+        telemetry.shutdown()
     return 0
 
 
@@ -885,6 +902,10 @@ def build_parser() -> argparse.ArgumentParser:
                          "manifest at selection time instead of trusting "
                          "its COMMIT marker; corrupt dirs fall back to "
                          "the next newest committed one")
+    sc.add_argument("--telemetry-file", default=None,
+                    help="telemetry run stream (dispatch/compile/memory "
+                         "attribution for the scoring path) as JSONL — "
+                         "consumed by `metrics roofline`/`compile-check`")
     sc.set_defaults(fn=cmd_score)
 
     ss = sub.add_parser(

@@ -18,6 +18,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import telemetry
 from ..ops.lda_math import (
     approx_bound,
     dirichlet_expectation,
@@ -29,6 +30,14 @@ from ..ops.lda_math import (
 from ..ops.sparse import DocTermBatch, batch_from_rows, bucket_by_length
 
 __all__ = ["LDAModel"]
+
+# score-side dispatch attribution: the unsharded scoring paths go through
+# these wrapped twins so a `score` run carries the same per-executable
+# digests (calls / compile signatures / roofline joins) the training
+# loops get; zero-cost when telemetry is off (telemetry.dispatch)
+topic_inference = telemetry.instrument_dispatch(
+    "score.topic_inference", topic_inference
+)
 
 
 @dataclass
@@ -306,6 +315,10 @@ class LDAModel:
     ) -> np.ndarray:
         from ..ops.lda_math import topic_inference_segments
         from ..ops.sparse import next_pow2
+
+        topic_inference_segments = telemetry.instrument_dispatch(
+            "score.topic_inference_segments", topic_inference_segments
+        )
 
         n = len(rows)
         if n == 0:

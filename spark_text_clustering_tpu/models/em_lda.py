@@ -417,6 +417,12 @@ def make_em_packed_runner(
     def run_chunk(n_wk, n_dk, ids_t, cts_t, seg_t, m: int):
         return _run_chunk(n_wk, n_dk, ids_t, cts_t, seg_t, m, *plan_dev)
 
+    # keep the jitted AOT surface reachable through the plan-binding
+    # closure: dispatch attribution (cost_analysis + memory_analysis)
+    # lowers the wrapped callable with the caller's operands
+    run_chunk.lower = lambda n_wk, n_dk, ids_t, cts_t, seg_t, m: (
+        _run_chunk.lower(n_wk, n_dk, ids_t, cts_t, seg_t, m, *plan_dev)
+    )
     return run_chunk
 
 

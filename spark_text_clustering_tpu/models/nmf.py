@@ -138,6 +138,11 @@ def frobenius_loss(
     return x2 - 2.0 * cross + wh2
 
 
+# the fit-path alias carries dispatch attribution; direct importers of
+# ``frobenius_loss`` (tests, notebooks) keep the bare jitted fn
+_loss_fn = telemetry.instrument_dispatch("nmf.loss", frobenius_loss)
+
+
 @partial(jax.jit, static_argnames=("n_iter",))
 def _solve_w(
     batch: DocTermBatch, h: jnp.ndarray, w0: jnp.ndarray, n_iter: int = 100
@@ -299,8 +304,14 @@ class NMF:
         state = NMFTrainState(w, h)
 
         if self._step_fn is None:
-            # one step fn per estimator; jit re-specializes per shape
-            self._step_fn = make_nmf_train_step(self.mesh)
+            # one step fn per estimator; jit re-specializes per shape.
+            # dispatch attribution (telemetry.dispatch): calls, compile
+            # signatures, and the measured roofline seconds per digest —
+            # the same wrapping every other hot loop carries, closing
+            # the gap the NMF-0.22x diagnosis needs (ROADMAP item 2)
+            self._step_fn = telemetry.instrument_dispatch(
+                "nmf.train_step", make_nmf_train_step(self.mesh)
+            )
         step_fn = self._step_fn
         if self._chunk_fn is None:
             # whole-run lax.scan per dispatch (models/dispatch.py): NMF
@@ -313,7 +324,9 @@ class NMF:
                 st, _ = jax.lax.scan(body, state, None, length=m)
                 return st
 
-            self._chunk_fn = run_chunk
+            self._chunk_fn = telemetry.instrument_dispatch(
+                "nmf.chunk_runner", run_chunk
+            )
         timer = IterationTimer()
         self.last_dispatches = 0
         interval = resolve_dispatch_interval(
@@ -337,7 +350,7 @@ class NMF:
                 print(f"nmf iter {it}: {timer.times[-1]:.3f}s")
             it += m
 
-        loss = float(frobenius_loss(batch, state.w, state.h))
+        loss = float(_loss_fn(batch, state.w, state.h))
         self.last_loss = loss
         telemetry.emit_fit(
             "nmf", timer.times, kind=timer.kind,

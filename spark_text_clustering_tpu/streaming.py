@@ -496,6 +496,9 @@ class StreamingScorer:
             "micro_batch", role="score", batch_id=mb.batch_id,
             docs=len(mb), seconds=round(dt, 6),
         )
+        # trigger boundary = memory-pressure sample point (mem.device.*
+        # / mem.host.rss_bytes gauges; no-op when telemetry is off)
+        telemetry.sample_memory("stream.score")
         return out
 
     # -- terminal outputs ------------------------------------------------
@@ -699,6 +702,9 @@ class StreamingOnlineLDA:
                 docs=len(rows), seconds=round(dt, 6),
                 docs_seen=self.docs_seen, step=int(self.state.step),
             )
+            # trigger boundary = memory-pressure sample point
+            # (mem.device.* / mem.host.rss_bytes gauges)
+            telemetry.sample_memory("stream.train")
         return wrote_ckpt
 
     def _update(self, chunk) -> None:

@@ -49,6 +49,7 @@ from contextlib import contextmanager
 from typing import Iterable, Optional
 
 from .dispatch import instrument as instrument_dispatch
+from .dispatch import note_sync as _note_sync
 from .events import (
     SCHEMA_VERSION,
     JsonlSink,
@@ -95,6 +96,7 @@ __all__ = [
     "gauge",
     "observe",
     "device_sync",
+    "sample_memory",
     "emit_fit",
 ]
 
@@ -221,7 +223,23 @@ def device_sync(x, label: str = "train"):
     dt = time.perf_counter() - t0
     _registry.histogram(f"device_sync.{label}.seconds").observe(dt)
     _registry.counter(f"device_sync.{label}.calls").inc()
+    # the wait belongs to the executable dispatched just before it —
+    # complete that digest's measured roofline seconds (dispatch.note_sync)
+    _note_sync(dt)
     return x
+
+
+def sample_memory(label: str = ""):
+    """Live device-memory + host-RSS gauges (``mem.device.*`` /
+    ``mem.host.rss_bytes``) and one ``memory_sample`` event — call at
+    epoch/trigger boundaries.  No-op when telemetry is off; backends
+    without ``memory_stats`` (CPU) degrade to an explicit
+    ``device: "unavailable"`` marker (telemetry.memory)."""
+    if not _enabled:
+        return None
+    from .memory import sample
+
+    return sample(label)
 
 
 def emit_fit(
@@ -242,6 +260,9 @@ def emit_fit(
     """
     if not _enabled:
         return
+    # fit end is an epoch boundary: one live memory sample so every
+    # training run's registry snapshot carries device/host pressure
+    sample_memory(optimizer)
     for i, s in enumerate(times):
         _registry.histogram(
             f"train.{optimizer}.iteration_seconds"

@@ -1,0 +1,173 @@
+"""Recompile sentinel: distinct compiled signatures per dispatch label.
+
+``telemetry.dispatch`` already keys every instrumented call to a stable
+(label, abstract-signature) digest.  This module watches that stream for
+the failure mode the digests make visible: a hot loop whose operand
+shapes are NOT bucketed re-traces (and re-compiles) on every new shape —
+the "retrace storm" that turns a 20 ms dispatch into a 20 s compile at
+V=10M scale (ROADMAP open item 3, STC200-205 follow-up).
+
+Per first call of each digest it records:
+
+  * ``compile.<label>.signatures``       (gauge) distinct compiled
+    signatures seen for this dispatch label so far
+  * ``compile.<digest>.compile_seconds`` (gauge) wall time of the first
+    instrumented call — trace + XLA compile + dispatch enqueue (jit
+    compiles synchronously on first call; execution itself is async, so
+    this is compile-dominated for any non-trivial program)
+  * ``compile.retraces``                 (counter) signatures beyond the
+    first per label — 0 in a perfectly bucketed run
+
+and stamps ``compile_ordinal``/``compile_seconds`` onto the digest's
+``dispatch_executable`` event so a run stream carries the full
+signature history.
+
+The committed expectation lives in
+``scripts/records/compile_baseline.json`` (same UX as the lint and
+metrics baselines): ``metrics compile-check run.jsonl --baseline ...``
+fails when any label exceeds its committed signature count or a new
+label appears uncommitted; ``--write-baseline`` recaptures deliberately.
+ci_check.sh gate 9 runs it over a short train+score plus a planted
+retrace-storm self-test.
+
+jax-free at import, like every telemetry module.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from typing import Dict, List, Optional, Set
+
+__all__ = [
+    "DEFAULT_BASELINE_PATH",
+    "note_first_call",
+    "signatures",
+    "reset",
+    "load_baseline",
+    "write_baseline",
+    "check_counts",
+]
+
+DEFAULT_BASELINE_PATH = "scripts/records/compile_baseline.json"
+
+_lock = threading.Lock()
+# label -> [digest, ...] in first-seen order (the ordinal is the index+1)
+_label_digests: Dict[str, List[str]] = {}
+
+
+def signatures() -> Dict[str, int]:
+    """Live label -> distinct-signature count (tests / REPL triage)."""
+    with _lock:
+        return {lbl: len(ds) for lbl, ds in _label_digests.items()}
+
+
+def reset() -> None:
+    with _lock:
+        _label_digests.clear()
+
+
+def note_first_call(rec) -> None:
+    """Record a digest's first instrumented call (dispatch calls this
+    once per ExecutableRecord, after the call that traced/compiled)."""
+    from . import get_registry
+
+    with _lock:
+        seen = _label_digests.setdefault(rec.label, [])
+        if rec.digest in seen:
+            return
+        seen.append(rec.digest)
+        ordinal = len(seen)
+    rec.compile_ordinal = ordinal
+    reg = get_registry()
+    reg.gauge(f"compile.{rec.label}.signatures").set(ordinal)
+    if rec.compile_seconds is not None:
+        reg.gauge(f"compile.{rec.digest}.compile_seconds").set(
+            rec.compile_seconds
+        )
+    if ordinal > 1:
+        reg.counter("compile.retraces").inc()
+
+
+# ---------------------------------------------------------------------------
+# baseline (the committed expected-signature table)
+# ---------------------------------------------------------------------------
+def load_baseline(path: str) -> Dict:
+    with open(path, "r", encoding="utf-8") as f:
+        base = json.load(f)
+    if not isinstance(base.get("labels"), dict):
+        raise ValueError(
+            f"{path}: compile baseline needs a 'labels' object "
+            "(label -> max expected signatures)"
+        )
+    return base
+
+
+def write_baseline(
+    path: str, counts: Dict[str, int], source: str,
+    previous: Optional[Dict] = None,
+) -> Dict:
+    """Capture ``counts`` into ``path``, merging over any existing
+    baseline: labels observed now are refreshed (max of old/new — a
+    partial run must not silently LOWER a committed expectation),
+    labels not exercised by this capture stay put."""
+    labels = dict((previous or {}).get("labels", {}))
+    for lbl, n in counts.items():
+        labels[lbl] = max(int(n), int(labels.get(lbl, 0)))
+    base = {
+        "schema": 1,
+        "source": source,
+        "labels": {k: labels[k] for k in sorted(labels)},
+    }
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(base, f, indent=2, sort_keys=True)
+        f.write("\n")
+    return base
+
+
+def check_counts(
+    counts: Dict[str, int], baseline: Dict
+) -> List[Dict]:
+    """Findings for labels beyond the committed expectation.
+
+    Two failure kinds, both deliberate-commit-gated like lint waivers:
+    ``retrace_storm`` (more distinct signatures than committed — an
+    unbucketed shape is re-tracing) and ``unknown_label`` (a dispatch
+    label with no committed expectation at all)."""
+    allowed = baseline.get("labels", {})
+    finds: List[Dict] = []
+    for lbl in sorted(counts):
+        n = counts[lbl]
+        if lbl not in allowed:
+            finds.append({
+                "kind": "unknown_label", "label": lbl,
+                "signatures": n, "allowed": None,
+            })
+        elif n > int(allowed[lbl]):
+            finds.append({
+                "kind": "retrace_storm", "label": lbl,
+                "signatures": n, "allowed": int(allowed[lbl]),
+            })
+    return finds
+
+
+def counts_from_run(events, metrics) -> Dict[str, Set[str]]:
+    """Per-label distinct digest sets from one run's events, with the
+    registry-snapshot gauges as a floor (an event-truncated stream must
+    not under-report a storm its snapshot recorded)."""
+    per_label: Dict[str, Set[str]] = {}
+    for e in events:
+        if e.get("event") != "dispatch_executable":
+            continue
+        per_label.setdefault(str(e.get("label")), set()).add(
+            str(e.get("digest"))
+        )
+    for k, v in metrics.items():
+        pre, suf = "gauge.compile.", ".signatures"
+        if k.startswith(pre) and k.endswith(suf):
+            lbl = k[len(pre):-len(suf)]
+            have = per_label.setdefault(lbl, set())
+            # synthesize placeholder digests up to the gauge count
+            for i in range(len(have), int(v)):
+                have.add(f"<snapshot-{i}>")
+    return per_label

@@ -18,9 +18,19 @@ records per digest:
     callable exposes the AOT ``lower()`` path
   * ``dispatch.<digest>.device_seconds_total`` / ``.device_bytes_total``
     (gauges) the estimates multiplied by the live call counter
+  * ``dispatch.<digest>.wall_seconds_total`` / ``.sync_seconds_total``
+    (gauges) measured in-call wall time plus the ``device_sync`` waits
+    attributed back to the last-dispatched digest — the measured side
+    of the ``metrics roofline`` join (telemetry.roofline)
 
 plus one ``dispatch_executable`` event per digest per run stream mapping
-the digest back to its human label and argument signature.
+the digest back to its human label and argument signature (now also
+carrying the first-call compile seconds, the label's signature ordinal
+from the recompile sentinel, and the ``memory_analysis`` peak bytes).
+The first call per digest also feeds ``telemetry.compilation`` (the
+``compile.*`` recompile sentinel) and ``telemetry.memory`` (the
+``mem.<digest>.*`` attribution, captured on the same AOT retrace the
+cost analysis already pays).
 
 jax 0.4.x caveats (docs/OBSERVABILITY.md "dispatch attribution"):
 ``cost_analysis`` needs a second trace via ``fn.lower(...).compile()``
@@ -43,6 +53,7 @@ import hashlib
 import os
 import sys
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, Optional
 
@@ -52,6 +63,7 @@ __all__ = [
     "records",
     "reset",
     "note_collective",
+    "note_sync",
     "cost_tracing",
 ]
 
@@ -75,6 +87,21 @@ class ExecutableRecord:
     est_bytes: Optional[float] = None
     est_seconds: Optional[float] = None
     cost_source: str = "pending"
+    # first-call wall time: trace + XLA compile + dispatch enqueue (jit
+    # compiles synchronously on the first call) — the recompile
+    # sentinel's per-signature compile cost (telemetry.compilation)
+    compile_seconds: Optional[float] = None
+    # nth distinct signature for this label (1 = no retrace yet)
+    compile_ordinal: Optional[int] = None
+    # accumulated in-call wall time + device_sync waits attributed back
+    # to this digest — the measured side of the roofline join
+    wall_seconds: float = 0.0
+    sync_seconds: float = 0.0
+    # compiled.memory_analysis() attribution (telemetry.memory):
+    # {arg,out,temp,code,peak}_bytes, or None with the reason in
+    # mem_source
+    mem_bytes: Optional[Dict[str, int]] = None
+    mem_source: str = "pending"
     announced_to: Optional[int] = None
     _capturing: bool = field(default=False, repr=False)
 
@@ -88,8 +115,12 @@ def records() -> Dict[str, ExecutableRecord]:
 
 
 def reset() -> None:
+    from . import compilation
+
     with _lock:
         _records.clear()
+    _tls.last_record = None
+    compilation.reset()
 
 
 # -- trace-context plumbing (collectives._acct calls in) --------------------
@@ -115,7 +146,26 @@ def note_collective(nbytes: int) -> None:
         rec = st[-1]
         if rec.collective_bytes_per_call is None:
             rec.collective_bytes_per_call = 0
-        rec.collective_bytes_per_call += int(nbytes)
+        rec.collective_bytes_per_call += int(nbytes)  # stc-lint: disable=STC005 -- nbytes is the host-side byte count collectives derive from abstract shapes at trace time, never a traced value
+
+
+def note_sync(seconds: float) -> None:
+    """Attribute a ``telemetry.device_sync`` wait to the digest this
+    thread dispatched LAST (one-shot: the hot loops pair every dispatch
+    with exactly one sync, and clearing the slot keeps an unrelated
+    later sync from landing on a stale digest).  The sum completes the
+    measured side of the roofline join: wall_seconds is the host-side
+    dispatch time, sync_seconds the wait for the device to drain it."""
+    rec = getattr(_tls, "last_record", None)
+    if rec is None:
+        return
+    _tls.last_record = None
+    rec.sync_seconds += float(seconds)
+    from . import get_registry
+
+    get_registry().gauge(
+        f"dispatch.{rec.digest}.sync_seconds_total"
+    ).set(rec.sync_seconds)
 
 
 # -- signature / digest ------------------------------------------------------
@@ -180,12 +230,16 @@ def _normalize_cost(raw) -> Dict[str, float]:
 
 
 def _analyze_cost(rec: ExecutableRecord, fn, args, kwargs) -> None:
+    from .memory import attribute_compiled
+
     if os.environ.get("STC_DISPATCH_COST", "1") == "0":
         rec.cost_source = "disabled"
+        rec.mem_source = "disabled"
         return
     lower = getattr(fn, "lower", None)
     if lower is None:
         rec.cost_source = "no_lower"
+        rec.mem_source = "unavailable:no_lower"
         return
     _tls.cost_tracing = True
     try:
@@ -195,12 +249,17 @@ def _analyze_cost(rec: ExecutableRecord, fn, args, kwargs) -> None:
         rec.est_bytes = cost.get("est_bytes")
         rec.est_seconds = cost.get("est_seconds")
         rec.cost_source = "cost_analysis" if cost else "empty"
+        # the same AOT executable answers the memory question too —
+        # one retrace buys both attributions (telemetry.memory)
+        attribute_compiled(rec, compiled)
     except Exception as exc:
         # attribution is best-effort by contract: a backend that cannot
         # lower/compile AOT (or rejects the static-arg calling
         # convention) degrades to calls-only counting, with the reason
         # kept on the record for triage
         rec.cost_source = f"error:{type(exc).__name__}"
+        if rec.mem_source == "pending":
+            rec.mem_source = f"unavailable:{type(exc).__name__}"
     finally:
         _tls.cost_tracing = False
 
@@ -230,10 +289,12 @@ def _account(rec: ExecutableRecord) -> None:
         )
     if rec.est_flops is not None:
         reg.gauge(f"dispatch.{d}.est_flops").set(rec.est_flops)
+    reg.gauge(f"dispatch.{d}.wall_seconds_total").set(rec.wall_seconds)
     w = get_writer()
     if w is not None and rec.announced_to != id(w):
         # once per run stream: the digest -> label mapping consumers
-        # (merge / trace / dashboards) join dispatch.* metrics against
+        # (merge / trace / roofline / dashboards) join dispatch.* and
+        # mem.* metrics against
         rec.announced_to = id(w)
         w.emit(
             "dispatch_executable",
@@ -245,6 +306,10 @@ def _account(rec: ExecutableRecord) -> None:
             est_bytes=rec.est_bytes,
             est_seconds=rec.est_seconds,
             cost_source=rec.cost_source,
+            compile_seconds=rec.compile_seconds,
+            compile_ordinal=rec.compile_ordinal,
+            mem_peak_bytes=(rec.mem_bytes or {}).get("peak_bytes"),
+            mem_source=rec.mem_source,
         )
 
 
@@ -265,16 +330,28 @@ def _call_recorded(label: str, fn, args, kwargs):
         # collective hooks fire inside this frame and land on the record
         rec._capturing = True
         _stack().append(rec)
+        t0 = time.perf_counter()
         try:
             out = fn(*args, **kwargs)
         finally:
+            dt = time.perf_counter() - t0
             _stack().pop()
             rec._capturing = False
             if rec.collective_bytes_per_call is None:
                 rec.collective_bytes_per_call = 0  # warm cache: nothing seen
+        # timed BEFORE the AOT cost/memory retrace below so the compile
+        # gauge and the roofline wall total carry only the real call
+        rec.compile_seconds = dt
+        rec.wall_seconds += dt
         _analyze_cost(rec, fn, args, kwargs)
+        from .compilation import note_first_call
+
+        note_first_call(rec)
     else:
+        t0 = time.perf_counter()
         out = fn(*args, **kwargs)
+        rec.wall_seconds += time.perf_counter() - t0
+    _tls.last_record = rec
     _account(rec)
     return out
 

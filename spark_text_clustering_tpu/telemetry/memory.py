@@ -1,0 +1,171 @@
+"""Peak-memory attribution (the ``mem.*`` family).
+
+Two complementary views, both best-effort by contract (a backend that
+cannot report degrades to explicit ``unavailable`` markers, never a
+crash — the CPU sandbox must run the same instrumented code the chip
+does):
+
+  * **Per-executable attribution** — ``attribute_compiled`` reads
+    ``compiled.memory_analysis()`` during the one AOT retrace the
+    dispatch layer already pays for ``cost_analysis`` and publishes
+    ``mem.<digest>.arg_bytes`` / ``.out_bytes`` / ``.temp_bytes`` /
+    ``.code_bytes`` / ``.peak_bytes`` gauges (peak = arg + out + temp,
+    the buffer-assignment upper bound for one execution).  This is the
+    "which executable owns device memory" half the HBM budget needs
+    before V=10M (ROADMAP open item 3).
+  * **Live sampling** — ``sample`` reads ``device.memory_stats()`` on
+    every local device (``mem.device.bytes_in_use`` /
+    ``.peak_bytes_in_use`` / ``.bytes_limit``, summed across devices)
+    plus the host RSS (``mem.host.rss_bytes``), and emits one
+    ``memory_sample`` event.  CPU backends expose no ``memory_stats``;
+    the sample then carries ``device: "unavailable"`` and counts
+    ``mem.device_stats_unavailable`` so dashboards can tell "no
+    pressure" from "no data".  Call at epoch/trigger boundaries (the
+    ``telemetry.sample_memory`` facade gates on enabled).
+
+jax-free at import: jax is only touched if already loaded.
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import Dict, Optional
+
+__all__ = ["attribute_compiled", "sample", "host_rss_bytes", "device_stats"]
+
+# CompiledMemoryStats attribute -> gauge suffix
+_ANALYSIS_FIELDS = (
+    ("argument_size_in_bytes", "arg_bytes"),
+    ("output_size_in_bytes", "out_bytes"),
+    ("temp_size_in_bytes", "temp_bytes"),
+    ("generated_code_size_in_bytes", "code_bytes"),
+)
+
+# device.memory_stats() key -> gauge suffix (summed over local devices)
+_DEVICE_FIELDS = (
+    ("bytes_in_use", "bytes_in_use"),
+    ("peak_bytes_in_use", "peak_bytes_in_use"),
+    ("bytes_limit", "bytes_limit"),
+)
+
+
+def attribute_compiled(rec, compiled) -> None:
+    """``mem.<digest>.*`` gauges from one compiled executable's
+    ``memory_analysis()``; stamps ``rec.mem_bytes``/``rec.mem_source``."""
+    from . import get_registry
+
+    ma_fn = getattr(compiled, "memory_analysis", None)
+    if ma_fn is None:
+        rec.mem_source = "unavailable:no_memory_analysis"
+        return
+    try:
+        ma = ma_fn()
+    except Exception as exc:
+        # same degradation contract as cost_analysis: attribution never
+        # raises into the loop it observes; the reason stays on the
+        # record for triage
+        rec.mem_source = f"unavailable:{type(exc).__name__}"
+        return
+    if ma is None:
+        rec.mem_source = "unavailable:none"
+        return
+    out: Dict[str, int] = {}
+    for attr, name in _ANALYSIS_FIELDS:
+        v = getattr(ma, attr, None)
+        if isinstance(v, int) and not isinstance(v, bool) and v >= 0:
+            out[name] = v
+    if not out:
+        rec.mem_source = "unavailable:empty"
+        return
+    out["peak_bytes"] = (
+        out.get("arg_bytes", 0)
+        + out.get("out_bytes", 0)
+        + out.get("temp_bytes", 0)
+    )
+    reg = get_registry()
+    for name, v in out.items():
+        reg.gauge(f"mem.{rec.digest}.{name}").set(v)
+    rec.mem_bytes = out
+    rec.mem_source = "memory_analysis"
+
+
+def host_rss_bytes() -> Optional[int]:
+    """Current resident set size of this process; None when unreadable.
+
+    Linux reads /proc/self/status (current RSS); elsewhere falls back to
+    ``getrusage`` ru_maxrss, which is the PEAK — close enough for the
+    "did the host blow up" gauge this feeds."""
+    try:
+        with open("/proc/self/status", "r", encoding="ascii") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    return int(line.split()[1]) * 1024
+    except (OSError, ValueError, IndexError):
+        pass
+    try:
+        import resource
+
+        rss = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+        # linux reports KiB, macOS bytes; both are order-of-magnitude
+        # right for a fallback gauge — prefer the smaller interpretation
+        return int(rss) * (1024 if sys.platform != "darwin" else 1)
+    except (ImportError, OSError, ValueError):
+        return None
+
+
+def device_stats() -> Optional[Dict[str, int]]:
+    """Summed ``memory_stats()`` over local devices; None when no device
+    reports (the CPU backend) or jax was never imported."""
+    if "jax" not in sys.modules:
+        return None
+    import jax
+
+    totals: Dict[str, int] = {}
+    reported = 0
+    try:
+        devices = jax.local_devices()
+    except Exception:  # stc-lint: disable=STC002 -- sampling is a best-effort probe: ANY backend bring-up failure degrades to the explicit "unavailable" marker, never a raise into the loop being observed
+        return None
+    for d in devices:
+        stats_fn = getattr(d, "memory_stats", None)
+        if stats_fn is None:
+            continue
+        try:
+            stats = stats_fn()
+        except Exception:  # stc-lint: disable=STC002 -- per-device memory_stats is optional runtime support (absent/raising on CPU and some plugin backends); an unreporting device is skipped, not fatal
+            continue
+        if not stats:
+            continue
+        reported += 1
+        for key, name in _DEVICE_FIELDS:
+            v = stats.get(key)
+            if isinstance(v, (int, float)) and v >= 0:
+                totals[name] = totals.get(name, 0) + int(v)
+    return totals if reported else None
+
+
+def sample(label: str = "") -> Dict:
+    """One live memory sample: device + host gauges and a
+    ``memory_sample`` event.  Callers gate on ``telemetry.enabled()``
+    (use the ``telemetry.sample_memory`` facade)."""
+    from . import get_registry, get_writer
+
+    reg = get_registry()
+    reg.counter("mem.samples").inc()
+    result: Dict = {"label": label}
+    rss = host_rss_bytes()
+    if rss is not None:
+        reg.gauge("mem.host.rss_bytes").set(rss)
+        result["host_rss_bytes"] = rss
+    dev = device_stats()
+    if dev is None:
+        reg.counter("mem.device_stats_unavailable").inc()
+        result["device"] = "unavailable"
+    else:
+        for name, v in dev.items():
+            reg.gauge(f"mem.device.{name}").set(v)
+            result[f"device_{name}"] = v
+    w = get_writer()
+    if w is not None:
+        w.emit("memory_sample", **result)
+    return result

@@ -11,6 +11,11 @@ ad-hoc JSON spelunking:
         run/events-p0.jsonl run/events-p1.jsonl [--fail-on-skew]
     python -m spark_text_clustering_tpu.cli metrics trace \
         run/events-p*.jsonl --out trace.json     # Perfetto-loadable
+    python -m spark_text_clustering_tpu.cli metrics roofline run.jsonl \
+        [--peaks peaks.json]       # achieved-vs-peak per executable
+    python -m spark_text_clustering_tpu.cli metrics compile-check \
+        train.jsonl score.jsonl --baseline \
+        scripts/records/compile_baseline.json    # recompile sentinel
 
 Accepted inputs: a telemetry JSONL stream (manifest-first, the format
 ``telemetry.TelemetryWriter`` emits) OR a plain one-object JSON file
@@ -36,7 +41,7 @@ import json
 import math
 import os
 import sys
-from typing import Dict, List, Tuple
+from typing import Dict, List, Optional, Tuple
 
 from .events import read_events
 
@@ -47,11 +52,14 @@ __all__ = [
     "load_process_streams",
     "merge_metrics",
     "skew_findings",
+    "ledger_health",
     "cmd_summarize",
     "cmd_diff",
     "cmd_check",
     "cmd_merge",
     "cmd_trace",
+    "cmd_roofline",
+    "cmd_compile_check",
     "add_metrics_subparser",
 ]
 
@@ -444,6 +452,68 @@ def cmd_trace(args) -> int:
     return 0
 
 
+def ledger_health(events: List[Dict]) -> Optional[Dict]:
+    """Ledger-health summary from the ``ledger_*`` / replay events an
+    epoch-committed stream emits (docs/RESILIENCE.md "Epoch commit
+    ledger"): commit cadence, rollback rate, replays suppressed.  None
+    when the run never touched a ledger."""
+    commits = [e for e in events if e.get("event") == "ledger_commit"]
+    rollbacks = [e for e in events if e.get("event") == "ledger_rollback"]
+    replays = sum(
+        int(e.get("files", 0) or 0)
+        for e in events
+        if e.get("event") == "replays_suppressed"
+    )
+    if not commits and not rollbacks and not replays:
+        return None
+    out: Dict = {
+        "commits": len(commits),
+        "rollbacks": len(rollbacks),
+        "replays_suppressed": replays,
+    }
+    total = len(commits) + len(rollbacks)
+    out["rollback_rate"] = round(len(rollbacks) / total, 4) if total else 0.0
+    by_kind: Dict[str, int] = {}
+    for e in commits:
+        k = str(e.get("kind", "?"))
+        by_kind[k] = by_kind.get(k, 0) + 1
+    if by_kind:
+        out["commits_by_kind"] = by_kind
+    ts = sorted(
+        float(e["ts"]) for e in commits if _is_num(e.get("ts"))
+    )
+    if len(ts) >= 2:
+        out["commit_cadence_seconds"] = round(
+            (ts[-1] - ts[0]) / (len(ts) - 1), 6
+        )
+    reasons: Dict[str, int] = {}
+    for e in rollbacks:
+        r = str(e.get("reason", "?"))
+        reasons[r] = reasons.get(r, 0) + 1
+    if reasons:
+        out["rollbacks_by_reason"] = reasons
+    return out
+
+
+def _print_ledger_health(lh: Dict, file=None) -> None:
+    file = file if file is not None else sys.stdout
+    print("ledger health:", file=file)
+    print(
+        f"  commits: {lh['commits']}  rollbacks: {lh['rollbacks']}  "
+        f"rollback_rate: {lh['rollback_rate']:.2%}", file=file,
+    )
+    if "commit_cadence_seconds" in lh:
+        print(
+            f"  commit cadence: {lh['commit_cadence_seconds']:.3f} "
+            f"s/epoch (mean over {lh['commits']} commits)", file=file,
+        )
+    for k, n in sorted(lh.get("commits_by_kind", {}).items()):
+        print(f"  commits[{k}]: {n}", file=file)
+    for r, n in sorted(lh.get("rollbacks_by_reason", {}).items()):
+        print(f"  rollbacks[{r}]: {n}", file=file)
+    print(f"  replays suppressed: {lh['replays_suppressed']}", file=file)
+
+
 def _print_manifest(manifest: Dict, file=None) -> None:
     file = file if file is not None else sys.stdout
     if not manifest:
@@ -467,15 +537,19 @@ def cmd_summarize(args) -> int:
 def _cmd_summarize(args) -> int:
     manifest, events = load_run(args.run)
     metrics = run_metrics(events)
+    lh = ledger_health(events)
     if getattr(args, "json", False):
-        print(json.dumps(
-            {"manifest": manifest, "metrics": metrics}, sort_keys=True
-        ))
+        doc = {"manifest": manifest, "metrics": metrics}
+        if lh is not None:
+            doc["ledger_health"] = lh
+        print(json.dumps(doc, sort_keys=True))
         return 0
     print(f"run: {args.run}")
     print("manifest:")
     _print_manifest(manifest)
     print(f"events: {len(events)}")
+    if lh is not None:
+        _print_ledger_health(lh)
     print("metrics:")
     for k in sorted(metrics):
         v = metrics[k]
@@ -626,6 +700,186 @@ def cmd_check(args) -> int:
     return 1 if failures else 0
 
 
+def _fmt_rate(v: Optional[float], unit: str) -> str:
+    if v is None:
+        return "-"
+    return f"{v / 1e9:.2f} G{unit}"
+
+
+def _fmt_bytes(v) -> str:
+    if not _is_num(v):
+        return "-"
+    for scale, suffix in ((2**30, "G"), (2**20, "M"), (2**10, "K")):
+        if v >= scale:
+            return f"{v / scale:.1f}{suffix}"
+    return f"{int(v)}B"
+
+
+def cmd_roofline(args) -> int:
+    try:
+        return _cmd_roofline(args)
+    except BrokenPipeError:      # `... | head` closed the pipe
+        return 0
+
+
+def _cmd_roofline(args) -> int:
+    from .roofline import resolve_peaks, rows_from_run
+
+    manifest, events = load_run(args.run)
+    metrics = run_metrics(events)
+    override = None
+    if args.peaks:
+        try:
+            with open(args.peaks, "r", encoding="utf-8") as f:
+                override = json.load(f)
+        except (OSError, json.JSONDecodeError) as exc:
+            print(f"cannot read peaks table {args.peaks}: {exc}",
+                  file=sys.stderr)
+            return 2
+    key, peaks = resolve_peaks(
+        str(manifest.get("backend", "")),
+        str(manifest.get("device_kind", "")),
+        override,
+    )
+    rows = rows_from_run(manifest, metrics, events, peaks)
+    if getattr(args, "json", False):
+        print(json.dumps({
+            "run": args.run, "peaks_key": key, "peaks": peaks,
+            "rows": rows,
+        }, sort_keys=True))
+        return 0
+    if not rows:
+        print(
+            "no dispatch_executable events in this run — was the run "
+            "produced with --telemetry-file by an instrumented command?",
+            file=sys.stderr,
+        )
+        return 2
+    print(f"run: {args.run}")
+    print(
+        f"peaks [{key}]: {peaks['flops_per_s'] / 1e12:.1f} TFLOP/s, "
+        f"{peaks['bytes_per_s'] / 1e9:.0f} GB/s — {peaks['note']}"
+    )
+    w = max(len(r["label"]) for r in rows)
+    print(
+        f"{'label'.ljust(w)}  {'digest':>10}  {'calls':>6}  "
+        f"{'seconds':>9}  {'GFLOP/s':>9}  {'%peak':>6}  {'GB/s':>8}  "
+        f"{'%bw':>6}  {'%roof':>6}  {'bound':>7}  {'peak_mem':>9}"
+    )
+    for r in rows:
+        mem = _fmt_bytes(r.get("mem_peak_bytes"))
+        if not r["available"]:
+            print(
+                f"{r['label'].ljust(w)}  {r['digest']:>10}  "
+                f"{r['calls']:>6}  {r['seconds']:>9.4f}  "
+                f"[unavailable: {r['why_unavailable']}]  "
+                f"peak_mem={mem}"
+            )
+            continue
+        fb = r.get("frac_peak_bytes")
+        print(
+            f"{r['label'].ljust(w)}  {r['digest']:>10}  {r['calls']:>6}  "
+            f"{r['seconds']:>9.4f}  "
+            f"{r['achieved_flops_per_s'] / 1e9:>9.2f}  "
+            f"{r['frac_peak_flops']:>6.1%}  "
+            f"{_fmt_rate(r.get('achieved_bytes_per_s'), 'B/s'):>8}  "
+            f"{(f'{fb:.1%}' if fb is not None else '-'):>6}  "
+            f"{r['roofline_frac']:>6.1%}"
+            f"{'!' if r.get('overunity') else ' '}  "
+            f"{r.get('bound', '-'):>6}  {mem:>9}"
+        )
+    n_avail = sum(1 for r in rows if r["available"])
+    print(
+        f"# {len(rows)} executable(s), {n_avail} with a full roofline "
+        f"join (worst-first by % of attainable); '!' = over-unity: the "
+        f"measured window missed device time (unsynced async dispatch) "
+        f"or the peaks understate this host"
+    )
+    return 0
+
+
+def cmd_compile_check(args) -> int:
+    from .compilation import (
+        check_counts,
+        counts_from_run,
+        load_baseline,
+        write_baseline,
+    )
+
+    per_label: Dict[str, set] = {}
+    for path in args.runs:
+        try:
+            _, events = load_run(path)
+        except OSError as exc:
+            print(f"cannot read run {path}: {exc}", file=sys.stderr)
+            return 2
+        for lbl, digests in counts_from_run(
+            events, run_metrics(events)
+        ).items():
+            per_label.setdefault(lbl, set()).update(digests)
+    counts = {lbl: len(ds) for lbl, ds in sorted(per_label.items())}
+
+    if args.write_baseline:
+        prev = None
+        if os.path.exists(args.baseline):
+            try:
+                prev = load_baseline(args.baseline)
+            except (OSError, json.JSONDecodeError, ValueError) as exc:
+                print(
+                    f"cannot merge into baseline {args.baseline}: {exc}",
+                    file=sys.stderr,
+                )
+                return 2
+        base = write_baseline(
+            args.baseline, counts, source=" ".join(args.runs),
+            previous=prev,
+        )
+        print(
+            f"compile baseline captured: {args.baseline} "
+            f"({len(base['labels'])} label(s))"
+        )
+        return 0
+
+    try:
+        baseline = load_baseline(args.baseline)
+    except (OSError, json.JSONDecodeError, ValueError) as exc:
+        print(f"cannot read baseline {args.baseline}: {exc}",
+              file=sys.stderr)
+        return 2
+    finds = check_counts(counts, baseline)
+    allowed = baseline.get("labels", {})
+    w = max((len(x) for x in counts), default=5)
+    print(f"{'label'.ljust(w)}  {'signatures':>10}  {'allowed':>7}")
+    for lbl, n in counts.items():
+        a = allowed.get(lbl)
+        mark = ""
+        if a is None:
+            mark = "  <<unknown-label"
+        elif n > int(a):
+            mark = "  <<RETRACE STORM"
+        print(f"{lbl.ljust(w)}  {n:>10}  "
+              f"{('-' if a is None else a):>7}{mark}")
+    for f in finds:
+        if f["kind"] == "retrace_storm":
+            print(
+                f"FAIL {f['label']}: {f['signatures']} distinct compiled "
+                f"signatures, baseline allows {f['allowed']} — an "
+                f"unbucketed shape is re-tracing this hot loop"
+            )
+        else:
+            print(
+                f"FAIL {f['label']}: dispatch label not in "
+                f"{args.baseline} — commit its expected signature count "
+                f"deliberately (--write-baseline)"
+            )
+    status = "FAIL" if finds else "PASS"
+    print(
+        f"{status}: {len(counts) - len(finds)}/{len(counts)} label(s) "
+        f"within the committed signature baseline"
+    )
+    return 1 if finds else 0
+
+
 def add_metrics_subparser(sub) -> None:
     """Attach the ``metrics`` subcommand tree to the CLI's subparsers."""
     mt = sub.add_parser(
@@ -708,3 +962,39 @@ def add_metrics_subparser(sub) -> None:
         help="write the trace here (default: stdout)",
     )
     tc.set_defaults(fn=cmd_trace)
+
+    rf = msub.add_parser(
+        "roofline",
+        help="achieved-vs-peak FLOP/s and bytes/s per compiled "
+             "executable, worst-first (joins measured dispatch seconds "
+             "with cost-analysis estimates and a per-backend peaks "
+             "table)",
+    )
+    rf.add_argument("run", help="telemetry .jsonl from an instrumented run")
+    rf.add_argument("--json", action="store_true")
+    rf.add_argument(
+        "--peaks", default=None,
+        help="JSON file {flops_per_s, bytes_per_s[, note]} overriding "
+             "the built-in per-backend peaks table",
+    )
+    rf.set_defaults(fn=cmd_roofline)
+
+    cc = msub.add_parser(
+        "compile-check",
+        help="recompile sentinel gate: distinct compiled signatures "
+             "per dispatch label checked against the committed "
+             "scripts/records/compile_baseline.json",
+    )
+    cc.add_argument(
+        "runs", nargs="+",
+        help="telemetry .jsonl stream(s); label signature sets are "
+             "unioned across them (e.g. one train + one score run)",
+    )
+    cc.add_argument("--baseline", required=True)
+    cc.add_argument(
+        "--write-baseline", action="store_true",
+        help="capture the observed per-label signature counts INTO "
+             "--baseline (merging over existing labels) instead of "
+             "checking",
+    )
+    cc.set_defaults(fn=cmd_compile_check)

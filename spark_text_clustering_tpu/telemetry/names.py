@@ -82,7 +82,16 @@ PREFIXES: Dict[str, str] = {
     "probe.accelerator.": "utils.env: probe attempts by outcome class",
     "dispatch.":
         "telemetry.dispatch: per-compiled-executable calls / runtime "
-        "collective bytes / cost_analysis device-time estimates",
+        "collective bytes / cost_analysis device-time estimates / "
+        "measured wall+sync seconds (the roofline join)",
+    "compile.":
+        "telemetry.compilation: recompile sentinel — distinct compiled "
+        "signatures per dispatch label, first-call compile seconds, "
+        "retrace counter (gated vs scripts/records/compile_baseline.json)",
+    "mem.":
+        "telemetry.memory: per-digest memory_analysis attribution "
+        "(arg/out/temp/peak bytes) + live device memory_stats and "
+        "host-RSS gauges sampled at epoch/trigger boundaries",
     # CLI-derived families (written by `metrics merge`, never by a hot
     # path): cross-process aggregates and skew-report findings
     "merge.": "metrics merge: per-metric min/median/max across processes",

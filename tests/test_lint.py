@@ -215,6 +215,51 @@ def test_stc005_reaches_through_helpers_and_wrappers(tmp_path):
     assert lines == [8, 12, 13, 21, 24]
 
 
+def test_stc005_qualname_resolver_modules_and_methods(tmp_path):
+    """The STC005 carry-over: attribute-qualified calls
+    (``helpers.pull(x)`` through a module import) and method calls
+    (``self._pull(x)`` inside a class) must be walked too."""
+    import textwrap
+
+    root = _fixture_root(tmp_path, """
+        import jax
+
+        from . import helpers
+
+        class Trainer:
+            def _pull(self, y):
+                return y.item()
+
+            @jax.jit
+            def step(self, x):
+                return self._pull(x)
+
+            def not_reached(self, y):
+                return y.item()
+
+        @jax.jit
+        def via_module(x):
+            return helpers.pull(x)
+    """)
+    pkg = tmp_path / PACKAGE
+    (pkg / "helpers.py").write_text(textwrap.dedent("""
+        def pull(y):
+            return y.item()
+
+        def unreached(y):
+            return y.item()
+    """))
+    findings = run_ast_rules(root, rules=["STC005"])
+    planted = _hits(findings, "STC005")
+    # self._pull reached from the jitted method (line 8); the sibling
+    # method never called from a jitted root stays clean
+    assert sorted(h.line for h in planted) == [8]
+    helper_hits = _hits(findings, "STC005", name="helpers.py")
+    # helpers.pull reached through the module-qualified call (line 3);
+    # helpers.unreached stays clean
+    assert sorted(h.line for h in helper_hits) == [3]
+
+
 # ---------------------------------------------------------------------------
 # STC006 — mutable defaults + persistence key order
 # ---------------------------------------------------------------------------

@@ -1,0 +1,497 @@
+"""Compile & memory observatory (docs/OBSERVABILITY.md):
+
+  * per-digest memory attribution (``mem.<digest>.*`` from
+    ``memory_analysis()``) incl. the unsupported-backend degradation;
+  * live memory sampling (``mem.device.*`` / ``mem.host.rss_bytes``)
+    with the CPU ``memory_stats()``-absent fallback;
+  * the recompile sentinel (``compile.*`` gauges + the
+    ``metrics compile-check`` baseline gate, storm + unknown-label);
+  * the ``metrics roofline`` verb (achieved-vs-peak join, worst-first);
+  * ``metrics summarize`` ledger-health section;
+  * single-stream ``metrics merge``/``trace`` degrade gracefully.
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from spark_text_clustering_tpu import telemetry
+from spark_text_clustering_tpu.cli import main
+from spark_text_clustering_tpu.telemetry import compilation
+from spark_text_clustering_tpu.telemetry import dispatch as dispatch_attr
+from spark_text_clustering_tpu.telemetry import memory as mem
+from spark_text_clustering_tpu.telemetry.metrics_cli import ledger_health
+from spark_text_clustering_tpu.telemetry.roofline import (
+    resolve_peaks,
+    roofline_row,
+    rows_live,
+)
+
+
+@pytest.fixture(autouse=True)
+def _telemetry_reset():
+    telemetry.shutdown()
+    telemetry.get_registry().reset()
+    dispatch_attr.reset()
+    yield
+    telemetry.shutdown()
+    telemetry.get_registry().reset()
+    dispatch_attr.reset()
+
+
+def _gauges(prefix):
+    snap = telemetry.get_registry().snapshot()
+    return {
+        k: v for k, v in snap["gauges"].items() if k.startswith(prefix)
+    }
+
+
+def _counters(prefix=""):
+    snap = telemetry.get_registry().snapshot()
+    return {
+        k: v for k, v in snap["counters"].items() if k.startswith(prefix)
+    }
+
+
+# ---------------------------------------------------------------------------
+# memory attribution (mem.<digest>.*)
+# ---------------------------------------------------------------------------
+class TestMemoryAttribution:
+    def test_jit_call_attributes_memory(self):
+        telemetry.configure(None)
+        fn = telemetry.instrument_dispatch(
+            "t.mm", jax.jit(lambda x: x @ x.T)
+        )
+        fn(jnp.ones((8, 8)))
+        rec = next(iter(dispatch_attr.records().values()))
+        assert rec.mem_source == "memory_analysis"
+        assert rec.mem_bytes["arg_bytes"] > 0
+        assert rec.mem_bytes["peak_bytes"] >= rec.mem_bytes["arg_bytes"]
+        g = _gauges(f"mem.{rec.digest}.")
+        assert g[f"mem.{rec.digest}.arg_bytes"] > 0
+        assert f"mem.{rec.digest}.peak_bytes" in g
+
+    def test_memory_analysis_unsupported_degrades(self):
+        """A backend whose compiled executable cannot answer
+        memory_analysis must leave an explicit marker, not crash."""
+        telemetry.configure(None)
+
+        class _Compiled:
+            def memory_analysis(self):
+                raise NotImplementedError("backend says no")
+
+        rec = dispatch_attr.ExecutableRecord("d0", "t.x", "f32(4,)")
+        mem.attribute_compiled(rec, _Compiled())
+        assert rec.mem_source == "unavailable:NotImplementedError"
+        assert rec.mem_bytes is None
+        assert _gauges("mem.d0.") == {}
+
+    def test_memory_analysis_absent_degrades(self):
+        rec = dispatch_attr.ExecutableRecord("d1", "t.x", "f32(4,)")
+        mem.attribute_compiled(rec, object())
+        assert rec.mem_source == "unavailable:no_memory_analysis"
+
+    def test_no_lower_marks_memory_unavailable(self):
+        telemetry.configure(None)
+        fn = telemetry.instrument_dispatch("t.plain", lambda x: x + 1)
+        fn(1)
+        rec = next(iter(dispatch_attr.records().values()))
+        assert rec.mem_source == "unavailable:no_lower"
+
+    def test_executable_event_carries_memory_fields(self, tmp_path):
+        p = str(tmp_path / "run.jsonl")
+        telemetry.configure(p)
+        telemetry.manifest(kind="t")
+        fn = telemetry.instrument_dispatch(
+            "t.evt", jax.jit(lambda x: x * 2)
+        )
+        fn(jnp.ones((4,)))
+        telemetry.shutdown()
+        ev = [
+            e for e in telemetry.read_events(p)
+            if e["event"] == "dispatch_executable"
+        ][0]
+        assert ev["mem_source"] == "memory_analysis"
+        assert ev["mem_peak_bytes"] > 0
+        assert ev["compile_seconds"] > 0
+        assert ev["compile_ordinal"] == 1
+
+
+# ---------------------------------------------------------------------------
+# live sampling (mem.device.* / mem.host.rss_bytes)
+# ---------------------------------------------------------------------------
+class TestMemorySampling:
+    def test_cpu_sample_degrades_to_unavailable_marker(self, tmp_path):
+        """CPU devices expose no memory_stats: the sample must still
+        produce the host gauge, count the unavailability, and emit an
+        explicit marker — never crash."""
+        p = str(tmp_path / "run.jsonl")
+        telemetry.configure(p)
+        telemetry.manifest(kind="t")
+        out = telemetry.sample_memory("epoch")
+        telemetry.shutdown()
+        assert out["device"] == "unavailable"
+        assert out["host_rss_bytes"] > 0
+        assert _counters("mem.")["mem.samples"] == 1
+        assert _counters("mem.")["mem.device_stats_unavailable"] == 1
+        evs = [
+            e for e in telemetry.read_events(p)
+            if e["event"] == "memory_sample"
+        ]
+        assert len(evs) == 1
+        assert evs[0]["label"] == "epoch"
+        assert evs[0]["device"] == "unavailable"
+
+    def test_disabled_sampling_is_a_noop(self):
+        assert telemetry.sample_memory("x") is None
+        assert _counters("mem.") == {}
+
+    def test_emit_fit_samples_memory(self, tmp_path):
+        p = str(tmp_path / "run.jsonl")
+        telemetry.configure(p)
+        telemetry.manifest(kind="t")
+        telemetry.emit_fit("em", [0.1, 0.2], log_likelihood=-1.0)
+        telemetry.shutdown()
+        evs = [
+            e for e in telemetry.read_events(p)
+            if e["event"] == "memory_sample"
+        ]
+        assert len(evs) == 1
+        assert evs[0]["label"] == "em"
+
+    def test_host_rss_readable(self):
+        assert mem.host_rss_bytes() > 0
+
+
+# ---------------------------------------------------------------------------
+# recompile sentinel (compile.*)
+# ---------------------------------------------------------------------------
+class TestRecompileSentinel:
+    def test_signatures_counted_per_label(self):
+        telemetry.configure(None)
+        fn = telemetry.instrument_dispatch(
+            "t.add", jax.jit(lambda x: x + 1)
+        )
+        fn(jnp.ones((4,)))
+        fn(jnp.ones((4,)))          # warm: no new signature
+        assert compilation.signatures() == {"t.add": 1}
+        assert _counters("compile.") == {}
+        fn(jnp.ones((8,)))          # retrace
+        fn(jnp.ones((16,)))         # retrace
+        assert compilation.signatures() == {"t.add": 3}
+        assert _gauges("compile.t.add.")[
+            "compile.t.add.signatures"
+        ] == 3
+        assert _counters("compile.")["compile.retraces"] == 2
+        secs = _gauges("compile.")
+        assert sum(
+            1 for k in secs if k.endswith(".compile_seconds")
+        ) == 3
+
+    def test_baseline_check_and_storm(self, tmp_path):
+        base = {"schema": 1, "labels": {"t.add": 2}}
+        assert compilation.check_counts({"t.add": 2}, base) == []
+        storm = compilation.check_counts({"t.add": 7}, base)
+        assert storm[0]["kind"] == "retrace_storm"
+        unknown = compilation.check_counts({"t.new": 1}, base)
+        assert unknown[0]["kind"] == "unknown_label"
+
+    def test_compile_check_cli_round_trip(self, tmp_path, capsys):
+        p = str(tmp_path / "run.jsonl")
+        telemetry.configure(p)
+        telemetry.manifest(kind="t")
+        fn = telemetry.instrument_dispatch(
+            "t.add", jax.jit(lambda x: x + 1)
+        )
+        fn(jnp.ones((4,)))
+        fn(jnp.ones((8,)))
+        telemetry.shutdown()
+        bp = str(tmp_path / "compile_baseline.json")
+        assert main([
+            "metrics", "compile-check", p, "--baseline", bp,
+            "--write-baseline",
+        ]) == 0
+        with open(bp) as f:
+            assert json.load(f)["labels"] == {"t.add": 2}
+        assert main(["metrics", "compile-check", p, "--baseline", bp]) == 0
+        # a planted storm (one label, many digests) must gate red
+        sp = str(tmp_path / "storm.jsonl")
+        w = telemetry.TelemetryWriter(sp, run_id="storm")
+        w.write_manifest(kind="storm")
+        for i in range(9):
+            w.emit(
+                "dispatch_executable", digest=f"s{i}", label="t.add",
+                signature=f"f32[{i}]",
+            )
+        w.close()
+        capsys.readouterr()
+        assert main(["metrics", "compile-check", sp, "--baseline", bp]) == 1
+        out = capsys.readouterr().out
+        assert "RETRACE STORM" in out
+
+    def test_unknown_label_gates_red(self, tmp_path, capsys):
+        sp = str(tmp_path / "new.jsonl")
+        w = telemetry.TelemetryWriter(sp, run_id="n")
+        w.write_manifest(kind="n")
+        w.emit("dispatch_executable", digest="d0", label="t.unseen")
+        w.close()
+        bp = str(tmp_path / "base.json")
+        with open(bp, "w") as f:
+            json.dump({"schema": 1, "labels": {}}, f)
+        assert main(["metrics", "compile-check", sp, "--baseline", bp]) == 1
+        assert "unknown" in capsys.readouterr().out.lower()
+
+    def test_snapshot_gauge_floors_truncated_streams(self):
+        """A stream whose dispatch_executable events were lost must
+        still report the snapshot's signature gauge count."""
+        events = [{
+            "event": "registry",
+            "snapshot": {"gauges": {"compile.t.f.signatures": 4.0},
+                         "counters": {}, "histograms": {}},
+        }]
+        from spark_text_clustering_tpu.telemetry.metrics_cli import (
+            run_metrics,
+        )
+
+        counts = compilation.counts_from_run(events, run_metrics(events))
+        assert len(counts["t.f"]) == 4
+
+
+# ---------------------------------------------------------------------------
+# roofline
+# ---------------------------------------------------------------------------
+class TestRoofline:
+    def test_resolve_peaks(self):
+        key, p = resolve_peaks("cpu")
+        assert key == "cpu" and p["flops_per_s"] > 0
+        key, _ = resolve_peaks("tpu", "TPU v5e")
+        assert key == "tpu-v5e"
+        key, _ = resolve_peaks("tpu", "TPU v4")
+        assert key == "tpu-v4"
+        key, _ = resolve_peaks("tpu", "TPU weird99")
+        assert key == "tpu-v5e"    # unknown generation -> default
+        key, p = resolve_peaks(
+            "cpu", override={"flops_per_s": 1e9, "bytes_per_s": 1e9}
+        )
+        assert key == "override" and p["flops_per_s"] == 1e9
+
+    def test_row_math(self):
+        peaks = {"flops_per_s": 100.0, "bytes_per_s": 10.0}
+        # intensity 2 FLOPs/byte -> attainable = min(100, 2*10) = 20
+        r = roofline_row(
+            digest="d", label="l", calls=4, seconds=2.0,
+            est_flops=10.0, est_bytes=5.0, peaks=peaks,
+        )
+        assert r["available"]
+        assert r["achieved_flops_per_s"] == pytest.approx(20.0)
+        assert r["frac_peak_flops"] == pytest.approx(0.2)
+        assert r["attainable_flops_per_s"] == pytest.approx(20.0)
+        assert r["roofline_frac"] == pytest.approx(1.0)
+        assert r["bound"] == "memory"
+
+    def test_row_unavailable_without_cost_model(self):
+        r = roofline_row(
+            digest="d", label="l", calls=3, seconds=1.0,
+            est_flops=None, est_bytes=None,
+            peaks={"flops_per_s": 1.0, "bytes_per_s": 1.0},
+            cost_source="error:X",
+        )
+        assert not r["available"]
+        assert "cost model" in r["why_unavailable"]
+
+    def test_rows_live_joins_dispatch_records(self):
+        telemetry.configure(None)
+        fn = telemetry.instrument_dispatch(
+            "t.mm", jax.jit(lambda x: x @ x.T)
+        )
+        out = fn(jnp.ones((16, 16)))     # compiling call: excluded
+        telemetry.device_sync(out, "t")
+        fn(jnp.ones((16, 16)))           # warm call: the measurement
+        rows = rows_live(prefix="t.")
+        assert len(rows) == 1
+        r = rows[0]
+        assert r["available"]
+        assert r["warm_calls"] == 1
+        assert r["seconds"] > 0
+        assert r["mem_peak_bytes"] > 0
+
+    def test_compile_only_digest_reports_unavailable(self):
+        telemetry.configure(None)
+        fn = telemetry.instrument_dispatch(
+            "t.once", jax.jit(lambda x: x + 1)
+        )
+        fn(jnp.ones((4,)))               # only the compiling call
+        r = rows_live(prefix="t.once")[0]
+        assert not r["available"]
+        assert r["why_unavailable"] == "only the compiling call ran"
+
+    def test_roofline_cli_on_instrumented_run(self, tmp_path, capsys):
+        p = str(tmp_path / "run.jsonl")
+        telemetry.configure(p)
+        telemetry.manifest(kind="t")
+        fn = telemetry.instrument_dispatch(
+            "t.mm", jax.jit(lambda x: x @ x.T)
+        )
+        out = fn(jnp.ones((16, 16)))
+        telemetry.device_sync(out, "t")
+        fn(jnp.ones((16, 16)))
+        telemetry.shutdown()
+        assert main(["metrics", "roofline", p]) == 0
+        txt = capsys.readouterr().out
+        assert "t.mm" in txt and "peaks [cpu]" in txt
+        assert main(["metrics", "roofline", p, "--json"]) == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["peaks_key"] == "cpu"
+        row = doc["rows"][0]
+        assert row["label"] == "t.mm"
+        assert row["calls"] == 2
+        assert row["available"] and row["roofline_frac"] > 0
+
+    def test_roofline_cli_without_dispatch_events(self, tmp_path):
+        p = str(tmp_path / "empty.jsonl")
+        w = telemetry.TelemetryWriter(p, run_id="e")
+        w.write_manifest(kind="e")
+        w.close()
+        assert main(["metrics", "roofline", p]) == 2
+
+    def test_peaks_override_file(self, tmp_path, capsys):
+        p = str(tmp_path / "run.jsonl")
+        telemetry.configure(p)
+        telemetry.manifest(kind="t")
+        fn = telemetry.instrument_dispatch(
+            "t.add", jax.jit(lambda x: x + 1)
+        )
+        telemetry.device_sync(fn(jnp.ones((4,))), "t")
+        telemetry.shutdown()
+        pk = str(tmp_path / "peaks.json")
+        with open(pk, "w") as f:
+            json.dump({"flops_per_s": 1e6, "bytes_per_s": 1e6,
+                       "note": "calibrated"}, f)
+        assert main([
+            "metrics", "roofline", p, "--peaks", pk, "--json",
+        ]) == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["peaks_key"] == "override"
+
+
+# ---------------------------------------------------------------------------
+# sync attribution (the measured side of the join)
+# ---------------------------------------------------------------------------
+class TestSyncAttribution:
+    def test_device_sync_lands_on_last_digest_once(self):
+        telemetry.configure(None)
+        fn = telemetry.instrument_dispatch(
+            "t.add", jax.jit(lambda x: x + 1)
+        )
+        out = fn(jnp.ones((4,)))
+        rec = next(iter(dispatch_attr.records().values()))
+        assert rec.sync_seconds == 0.0
+        telemetry.device_sync(out, "t")
+        s1 = rec.sync_seconds
+        assert s1 > 0
+        # a second, unpaired sync must NOT land on the stale digest
+        telemetry.device_sync(out, "t")
+        assert rec.sync_seconds == s1
+        assert _gauges(f"dispatch.{rec.digest}.")[
+            f"dispatch.{rec.digest}.sync_seconds_total"
+        ] == pytest.approx(s1)
+
+    def test_wall_seconds_accumulate(self):
+        telemetry.configure(None)
+        fn = telemetry.instrument_dispatch(
+            "t.add", jax.jit(lambda x: x + 1)
+        )
+        fn(jnp.ones((4,)))
+        rec = next(iter(dispatch_attr.records().values()))
+        w1 = rec.wall_seconds
+        assert w1 > 0
+        fn(jnp.ones((4,)))
+        assert rec.wall_seconds > w1
+
+
+# ---------------------------------------------------------------------------
+# ledger health + single-stream merge/trace degradation
+# ---------------------------------------------------------------------------
+class TestLedgerHealth:
+    def _ledgered_run(self, tmp_path):
+        p = str(tmp_path / "run.jsonl")
+        w = telemetry.TelemetryWriter(p, run_id="lh")
+        w.write_manifest(kind="stream-train")
+        for e in range(4):
+            w.emit("ledger_commit", epoch=e, kind="stream-train",
+                   sources=2, payloads=1)
+        w.emit("ledger_commit", epoch=4, kind="model-publish",
+               sources=0, payloads=0)
+        w.emit("ledger_rollback", reason="uncommitted_epoch", epoch=5)
+        w.emit("replays_suppressed", files=3, ledger="ck")
+        w.close()
+        return p
+
+    def test_health_fields(self, tmp_path):
+        _, events = __import__(
+            "spark_text_clustering_tpu.telemetry.metrics_cli",
+            fromlist=["load_run"],
+        ).load_run(self._ledgered_run(tmp_path))
+        lh = ledger_health(events)
+        assert lh["commits"] == 5
+        assert lh["rollbacks"] == 1
+        assert lh["rollback_rate"] == pytest.approx(1 / 6, abs=1e-4)
+        assert lh["replays_suppressed"] == 3
+        assert lh["commits_by_kind"] == {
+            "stream-train": 4, "model-publish": 1,
+        }
+        assert lh["rollbacks_by_reason"] == {"uncommitted_epoch": 1}
+        assert "commit_cadence_seconds" in lh
+
+    def test_summarize_shows_section(self, tmp_path, capsys):
+        p = self._ledgered_run(tmp_path)
+        assert main(["metrics", "summarize", p]) == 0
+        out = capsys.readouterr().out
+        assert "ledger health:" in out
+        assert "rollback_rate" in out
+        assert "replays suppressed: 3" in out
+
+    def test_summarize_json_carries_health(self, tmp_path, capsys):
+        p = self._ledgered_run(tmp_path)
+        assert main(["metrics", "summarize", p, "--json"]) == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["ledger_health"]["commits"] == 5
+
+    def test_unledgered_run_has_no_section(self, tmp_path, capsys):
+        p = str(tmp_path / "plain.jsonl")
+        w = telemetry.TelemetryWriter(p, run_id="x")
+        w.write_manifest(kind="train")
+        w.emit("span", name="a", seconds=0.1)
+        w.close()
+        assert main(["metrics", "summarize", p]) == 0
+        out = capsys.readouterr().out
+        assert "ledger health:" not in out
+        assert main(["metrics", "summarize", p, "--json"]) == 0
+        assert "ledger_health" not in json.loads(capsys.readouterr().out)
+
+
+class TestSingleStreamDegradation:
+    def _stream(self, tmp_path):
+        p = str(tmp_path / "solo.jsonl")
+        w = telemetry.TelemetryWriter(p, run_id="solo")
+        w.write_manifest(kind="t", process_index=0, process_count=1)
+        w.emit("span", name="train.em", seconds=0.2)
+        w.close()
+        return p
+
+    def test_merge_single_stream_is_clean(self, tmp_path, capsys):
+        p = self._stream(tmp_path)
+        assert main(["metrics", "merge", p, "--fail-on-skew"]) == 0
+        out = capsys.readouterr().out
+        assert "merged 1 process stream(s)" in out
+        assert "no cross-host skew beyond threshold" in out
+
+    def test_trace_single_stream(self, tmp_path, capsys):
+        p = self._stream(tmp_path)
+        out_f = str(tmp_path / "trace.json")
+        assert main(["metrics", "trace", p, "--out", out_f]) == 0
+        with open(out_f) as f:
+            doc = json.load(f)
+        assert doc["traceEvents"]
