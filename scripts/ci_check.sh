@@ -34,9 +34,17 @@
 #      compile-check`) — more distinct compiled signatures per dispatch
 #      label than committed means an unbucketed shape is re-tracing a
 #      hot loop; a planted retrace storm must gate red (self-test)
+#  10. supervisor drill: a 2-worker `stc supervise` stream-score fleet
+#      with one worker wedged mid-epoch under STC_FAULTS
+#      (worker.heartbeat:hang — alive, silent, SIGTERM-deaf); the
+#      supervisor must detect the expired lease, SIGKILL, roll back,
+#      respawn, and reconverge with every source committed exactly
+#      once and zero quarantined-epoch re-emissions; the drill's
+#      fleet.* counters (spawns/respawns/lease_expiries/preemptions)
+#      gate against the committed baseline
 #
 # Usage:
-#   scripts/ci_check.sh                 # run all nine gates
+#   scripts/ci_check.sh                 # run all ten gates
 #   scripts/ci_check.sh --rebaseline    # recapture ALL baselines
 #                                       # (metrics + lint waivers +
 #                                       # lint counters + compile
@@ -159,6 +167,81 @@ w.close()
 EOF
 }
 
+run_supervisor_drill() {
+    # gate 10: supervise a 2-worker stream-score fleet, wedge worker 0
+    # mid-epoch (heartbeat hang via the chaos harness), assert the
+    # lease-expiry -> SIGKILL -> recover -> respawn ladder reconverges
+    # exactly-once
+    local workdir="$1"
+    python - "$workdir" <<'EOF'
+import os, sys
+import numpy as np
+
+from spark_text_clustering_tpu.models.base import LDAModel
+
+workdir = sys.argv[1]
+watch = os.path.join(workdir, "fleet_watch")
+os.makedirs(watch, exist_ok=True)
+pools = ["piano violin orchestra symphony concerto melody",
+         "electron proton neutron quantum particle physics"]
+for i in range(4):
+    with open(os.path.join(watch, f"doc{i:02d}.txt"), "w") as f:
+        f.write(f"{pools[i % 2]} tok{i}")
+rng = np.random.default_rng(0)
+m = LDAModel(
+    lam=rng.random((2, 64)).astype(np.float32) + 0.1,
+    vocab=[f"h{i}" for i in range(64)],
+    alpha=np.full(2, 0.5, np.float32), eta=0.1,
+)
+m.save(os.path.join(workdir, "fleet_models", "LdaModel_EN_1000"))
+EOF
+    python -m spark_text_clustering_tpu.cli supervise \
+        --role stream-score --watch-dir "$workdir/fleet_watch" \
+        --fleet-dir "$workdir/fleet" --workers 2 \
+        --chaos-worker 0:worker.heartbeat:hang@3 \
+        --heartbeat-interval 0.2 --lease-timeout 2.5 \
+        --grace-seconds 1.0 --sweep-interval 0.15 \
+        --poll-interval 0.05 --idle-timeout 0.8 \
+        --max-files-per-trigger 2 --no-lemmatize \
+        --model "$workdir/fleet_models/LdaModel_EN_1000" \
+        --output-dir "$workdir/fleet_out" \
+        --telemetry-file "$workdir/fleet_drill.jsonl" \
+        >/dev/null || return 1
+    # exactly-once across the respawn, and zero quarantined-epoch
+    # re-emissions (every emitted report belongs to a committed epoch;
+    # the rolled-back orphan lives in quarantined_epochs/, not the
+    # output dir)
+    python - "$workdir" <<'EOF'
+import os, sys
+
+from spark_text_clustering_tpu.resilience import EpochLedger
+
+workdir = sys.argv[1]
+fleet = os.path.join(workdir, "fleet")
+wdirs = [
+    os.path.join(fleet, n) for n in sorted(os.listdir(fleet))
+    if n.startswith("w") and os.path.isdir(os.path.join(fleet, n))
+]
+per = []
+for wd in wdirs:
+    for r in EpochLedger(wd).records():
+        per.extend(r.get("sources", ()))
+assert len(per) == len(set(per)), "a source committed twice"
+watch = os.path.join(workdir, "fleet_watch")
+want = {os.path.join(watch, n) for n in os.listdir(watch)}
+assert set(per) == want, "sources lost or foreign"
+reports = []
+for d, _, files in os.walk(os.path.join(workdir, "fleet_out")):
+    reports.extend(files)
+committed = sum(EpochLedger(wd).last_committed() + 1 for wd in wdirs)
+assert len(reports) == committed, (
+    f"{len(reports)} reports vs {committed} committed epochs — a "
+    f"quarantined epoch re-emitted or a report was lost"
+)
+print(f"fleet drill: {committed} committed epochs, exactly-once")
+EOF
+}
+
 make_skew_streams() {
     # two synthetic per-process streams: balanced pair + a pair with a
     # planted straggler/retry divergence on p1 (the merge gate's fixture)
@@ -207,6 +290,12 @@ if [[ "${1:-}" == "--rebaseline" ]]; then
     python -m spark_text_clustering_tpu.cli metrics check \
         "$work/ledger_drill.jsonl" --baseline "$BASELINE" \
         --write-baseline --tolerance 0.0 --include ledger. || exit 1
+    # fold the supervisor drill's fleet counters the same way
+    run_supervisor_drill "$work" || exit 1
+    python -m spark_text_clustering_tpu.cli metrics check \
+        "$work/fleet_drill.jsonl" --baseline "$BASELINE" \
+        --write-baseline --tolerance 0.0 --include counter.fleet. \
+        || exit 1
     # recapture the recompile sentinel's expected-signature table from
     # the same train run plus a score run (gate 9's fixture pair)
     run_ci_score "$work" || exit 1
@@ -220,12 +309,12 @@ fail=0
 work=$(mktemp -d)
 trap 'rm -rf "$work"' EXIT
 
-echo "== [1/9] stc lint (AST rules + jaxpr audit) =="
+echo "== [1/10] stc lint (AST rules + jaxpr audit) =="
 python -m spark_text_clustering_tpu.cli lint \
     --telemetry-file "$work/lint.jsonl"
 if [[ $? -ne 0 ]]; then echo "FAIL: stc lint"; fail=1; fi
 
-echo "== [2/9] ruff (generic-Python tier) =="
+echo "== [2/10] ruff (generic-Python tier) =="
 if command -v ruff >/dev/null 2>&1; then
     ruff check spark_text_clustering_tpu
     if [[ $? -ne 0 ]]; then echo "FAIL: ruff"; fail=1; fi
@@ -233,30 +322,30 @@ else
     echo "ruff not installed — skipped (stc lint STC101/102/006 cover it)"
 fi
 
-echo "== [3/9] tier-1 tests =="
+echo "== [3/10] tier-1 tests =="
 timeout -k 10 870 python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors -p no:cacheprovider \
     -p no:xdist -p no:randomly
 if [[ $? -ne 0 ]]; then echo "FAIL: tier-1"; fail=1; fi
 
-echo "== [4/9] telemetry overhead budget =="
+echo "== [4/10] telemetry overhead budget =="
 python scripts/check_telemetry_overhead.py
 if [[ $? -ne 0 ]]; then echo "FAIL: telemetry overhead"; fail=1; fi
 
-echo "== [5/9] metrics regression gate =="
+echo "== [5/10] metrics regression gate =="
 if run_ci_train "$work"; then
-    # lint. and ledger. families are captured by their own gates (1/6
-    # and 8) — a batch train run never touches either
+    # lint., ledger., and fleet. families are captured by their own
+    # gates (1/6, 8, and 10) — a batch train run never touches them
     python -m spark_text_clustering_tpu.cli metrics check "$work/run.jsonl" \
         --baseline "$BASELINE" "${EXCLUDES[@]}" --exclude lint. \
-        --exclude ledger.
+        --exclude ledger. --exclude fleet.
     if [[ $? -ne 0 ]]; then echo "FAIL: metrics check"; fail=1; fi
 else
     echo "FAIL: CI training run"
     fail=1
 fi
 
-echo "== [6/9] lint metrics gate (waiver count version-gated) =="
+echo "== [6/10] lint metrics gate (waiver count version-gated) =="
 if [[ -s "$work/lint.jsonl" ]]; then
     python -m spark_text_clustering_tpu.cli metrics check "$work/lint.jsonl" \
         --baseline "$BASELINE" --include lint.
@@ -266,7 +355,7 @@ else
     fail=1
 fi
 
-echo "== [7/9] cross-host skew gate (metrics merge) =="
+echo "== [7/10] cross-host skew gate (metrics merge) =="
 if make_skew_streams "$work"; then
     python -m spark_text_clustering_tpu.cli metrics merge \
         "$work/skew-p0.jsonl" "$work/skew-p1.jsonl" --fail-on-skew \
@@ -287,7 +376,7 @@ else
     fail=1
 fi
 
-echo "== [8/9] exactly-once ledger chaos drill (STC_FAULTS) =="
+echo "== [8/10] exactly-once ledger chaos drill (STC_FAULTS) =="
 if run_ledger_drill "$work"; then
     python -m spark_text_clustering_tpu.cli metrics check \
         "$work/ledger_drill.jsonl" --baseline "$BASELINE" \
@@ -298,7 +387,7 @@ else
     fail=1
 fi
 
-echo "== [9/9] recompile sentinel (metrics compile-check) =="
+echo "== [9/10] recompile sentinel (metrics compile-check) =="
 if [[ -s "$work/run.jsonl" ]] && run_ci_score "$work"; then
     python -m spark_text_clustering_tpu.cli metrics compile-check \
         "$work/run.jsonl" "$work/score.jsonl" \
@@ -321,6 +410,20 @@ if [[ -s "$work/run.jsonl" ]] && run_ci_score "$work"; then
     fi
 else
     echo "FAIL: no train stream / score run for the sentinel gate"
+    fail=1
+fi
+
+echo "== [10/10] supervisor drill (lease expiry -> SIGKILL -> respawn) =="
+if run_supervisor_drill "$work"; then
+    # the ladder's counters are deterministic: 3 spawns (2 + 1
+    # respawn), 1 lease expiry, 1 preemption (the drain SIGTERM the
+    # wedged worker ignored), 0 crashes/resizes
+    python -m spark_text_clustering_tpu.cli metrics check \
+        "$work/fleet_drill.jsonl" --baseline "$BASELINE" \
+        --include counter.fleet.
+    if [[ $? -ne 0 ]]; then echo "FAIL: fleet drill metrics"; fail=1; fi
+else
+    echo "FAIL: supervisor drill run"
     fail=1
 fi
 

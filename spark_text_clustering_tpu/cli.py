@@ -438,6 +438,10 @@ def cmd_score(args: argparse.Namespace) -> int:
 def cmd_stream_score(args: argparse.Namespace) -> int:
     """Watch a directory and score arriving books incrementally (the
     LDALoader flow as a micro-batch stream; north-star "streaming" row)."""
+    # fleet wiring FIRST: the initial lease beat must land before the
+    # slow jax-touching imports below, or a supervisor with a tight
+    # startup grace would declare a perfectly healthy worker stuck
+    preempt, lease, fence, partition = _fleet_worker_context(args)
     from .streaming import FileStreamSource, StreamingScorer
 
     model_path = args.model or latest_model_dir(
@@ -473,9 +477,16 @@ def cmd_stream_score(args: argparse.Namespace) -> int:
     if args.checkpoint_dir:
         from .resilience import EpochLedger
 
-        ledger = EpochLedger(args.checkpoint_dir)
+        ledger = EpochLedger(args.checkpoint_dir, fence=fence)
         ledger.recover()
-        preseen = sorted(ledger.committed_sources())
+        if args.fleet_dir:
+            # fleet-wide seen-set: a file committed by ANY worker —
+            # including one retired by a resize — must never re-score
+            from .resilience.supervisor import fleet_committed_sources
+
+            preseen = sorted(fleet_committed_sources(args.fleet_dir))
+        else:
+            preseen = sorted(ledger.committed_sources())
         if preseen:
             telemetry.count("ledger.replays_suppressed", len(preseen))
             telemetry.event(
@@ -488,6 +499,7 @@ def cmd_stream_score(args: argparse.Namespace) -> int:
         max_files_per_trigger=args.max_files_per_trigger,
         min_file_age_s=args.min_file_age,
         preseen=preseen,
+        partition=partition,
     )
     controller = _make_trigger_controller(args)
     scorer = StreamingScorer(
@@ -505,48 +517,72 @@ def cmd_stream_score(args: argparse.Namespace) -> int:
 
     import time as _time
 
-    for mb in src.stream(
-        poll_interval=args.poll_interval, idle_timeout=args.idle_timeout
-    ):
-        t0 = _time.perf_counter()
-        out = scorer.process(mb)
-        for sd in out:
-            print(f"[batch {mb.batch_id}] "
-                  f"{os.path.basename(sd.name)} -> topic {sd.topic}")
-        if ledger is not None:
-            epoch = ledger.next_epoch()
-            fname = f"Result_{args.lang}_epoch-{epoch:06d}"
-            path = os.path.join(args.output_dir, fname)
-            ledger.begin(
-                epoch, kind="stream-score",
-                sources=mb.names, payloads=[path],
-            )
-            text = format_scoring_report(
-                model,
-                [sd.name for sd in out],
-                np.stack([sd.distribution for sd in out])
-                if out else np.zeros((0, model.k)),
-                [sd.row for sd in out],
-            )
-            write_scoring_report(
-                text, args.output_dir, args.lang, filename=fname
-            )
-            ledger.commit(
-                epoch, kind="stream-score",
-                sources=mb.names, payloads={fname: path},
-                model_ref=model_path,
-            )
-            print(f"[epoch {epoch}] report committed: {path}")
-        if controller is not None:
-            controller.update(
-                src.last_queue_depth, _time.perf_counter() - t0
-            )
-            controller.apply(src)
+    from .resilience import FencedEpochError
+
+    try:
+        for mb in src.stream(
+            poll_interval=args.poll_interval,
+            idle_timeout=args.idle_timeout,
+            heartbeat=lease.heartbeat_callback() if lease else None,
+            stop=preempt,
+        ):
+            t0 = _time.perf_counter()
+            out = scorer.process(mb)
+            for sd in out:
+                print(f"[batch {mb.batch_id}] "
+                      f"{os.path.basename(sd.name)} -> topic {sd.topic}")
+            if ledger is not None:
+                epoch = ledger.next_epoch()
+                fname = f"Result_{args.lang}_epoch-{epoch:06d}"
+                path = os.path.join(args.output_dir, fname)
+                ledger.begin(
+                    epoch, kind="stream-score",
+                    sources=mb.names, payloads=[path],
+                )
+                text = format_scoring_report(
+                    model,
+                    [sd.name for sd in out],
+                    np.stack([sd.distribution for sd in out])
+                    if out else np.zeros((0, model.k)),
+                    [sd.row for sd in out],
+                )
+                write_scoring_report(
+                    text, args.output_dir, args.lang, filename=fname
+                )
+                ledger.commit(
+                    epoch, kind="stream-score",
+                    sources=mb.names, payloads={fname: path},
+                    model_ref=model_path,
+                )
+                print(f"[epoch {epoch}] report committed: {path}")
+                if lease is not None:
+                    lease.beat(queue_depth=src.last_queue_depth,
+                               epoch=epoch)
+            if controller is not None:
+                controller.update(
+                    src.last_queue_depth, _time.perf_counter() - t0
+                )
+                controller.apply(src)
+    except FencedEpochError as exc:
+        # a resize superseded this incarnation mid-flight: the staged
+        # epoch stays uncommitted (the new generation's recover()
+        # quarantines it) and this zombie exits typed, never merged
+        print(f"error: {exc}", file=sys.stderr)
+        if lease is not None:
+            lease.mark_done("fenced")
+        if own_telemetry:
+            telemetry.shutdown()
+        return 3
     for t, c in enumerate(scorer.tallies):
         print(f"topic {t}: {c} books")
     if scorer.results and not args.no_report and ledger is None:
         path = scorer.write_report(args.output_dir, args.lang)
         print(f"report written to {path}")
+    if preempt:
+        print("preemption notice honored: in-flight trigger drained, "
+              "stream stopped cleanly")
+    if lease is not None:
+        lease.mark_done("preempted" if preempt else "idle")
     if own_telemetry:
         telemetry.shutdown()
     return 0
@@ -554,10 +590,14 @@ def cmd_stream_score(args: argparse.Namespace) -> int:
 
 def cmd_stream_train(args: argparse.Namespace) -> int:
     """Continuous online-VB training over a watched directory; saves the
-    final model like ``train`` does.  Single-process only: multi-host
-    would need cross-process agreement on which files each poll tick
-    ingests, or the first collective deadlocks — batch ``train`` is the
-    multi-host path."""
+    final model like ``train`` does.  Single-JAX-process per worker:
+    jax.distributed multi-host would need cross-process agreement on
+    which files each poll tick ingests — a SUPERVISED fleet
+    (``stc supervise --role stream-train``) instead partitions the
+    watch dir deterministically (sha256 of basename) so each worker
+    trains its own partition through its own epoch ledger, with the
+    fence/lease lifecycle handling the machines that come and go."""
+    preempt, lease, fence, partition = _fleet_worker_context(args)
     from .streaming import FileStreamSource, StreamingOnlineLDA
 
     params = Params(
@@ -611,6 +651,7 @@ def cmd_stream_train(args: argparse.Namespace) -> int:
         corpus_size_hint=args.corpus_size_hint,
         checkpoint_every=args.checkpoint_interval,
         quarantine_dir=args.quarantine_dir,
+        fence=fence,
     )
     # Source progress is EXACTLY-ONCE through the trainer's epoch commit
     # ledger: committed source paths seed the seen-set (never re-ingested,
@@ -620,7 +661,14 @@ def cmd_stream_train(args: argparse.Namespace) -> int:
     # each epoch commit) for backward compatibility.
     preseen: list = []
     if trainer.ledger is not None:
-        preseen = sorted(trainer.ledger.committed_sources())
+        if args.fleet_dir:
+            # fleet-wide seen-set: a file committed by ANY worker —
+            # including one retired by a resize — never re-trains
+            from .resilience.supervisor import fleet_committed_sources
+
+            preseen = sorted(fleet_committed_sources(args.fleet_dir))
+        else:
+            preseen = sorted(trainer.ledger.committed_sources())
         if preseen:
             telemetry.count("ledger.replays_suppressed", len(preseen))
             telemetry.event(
@@ -633,20 +681,44 @@ def cmd_stream_train(args: argparse.Namespace) -> int:
         max_files_per_trigger=args.max_files_per_trigger,
         min_file_age_s=args.min_file_age,
         preseen=preseen,
+        partition=partition,
         state_path=(
             os.path.join(args.checkpoint_dir, "seen_files.txt")
             if args.checkpoint_dir
             else None
         ),
     )
-    trainer.run(
-        src,
-        controller=_make_trigger_controller(args),
-        poll_interval=args.poll_interval,
-        idle_timeout=args.idle_timeout,
-    )
+    from .resilience import FencedEpochError
+
+    try:
+        trainer.run(
+            src,
+            controller=_make_trigger_controller(args),
+            poll_interval=args.poll_interval,
+            idle_timeout=args.idle_timeout,
+            heartbeat=lease.heartbeat_callback() if lease else None,
+            stop=preempt,
+        )
+    except FencedEpochError as exc:
+        print(f"error: {exc}", file=sys.stderr)
+        if lease is not None:
+            lease.mark_done("fenced")
+        if own_telemetry:
+            telemetry.shutdown()
+        return 3
     print(f"stream ended: {trainer.docs_seen} docs / "
           f"{trainer.batches_seen} micro-batches")
+    if preempt:
+        # simulated preemption notice: the in-flight epoch is already
+        # committed (or will roll back) through the ledger — no model
+        # publish, the respawned incarnation resumes and publishes
+        print("preemption notice honored: epoch committed, model "
+              "publish deferred to the resumed worker")
+        if lease is not None:
+            lease.mark_done("preempted")
+        if own_telemetry:
+            telemetry.shutdown()
+        return 0
     model = trainer.model()
     for i, topic in enumerate(model.describe_topics_terms(10)):
         print(f"TOPIC {i}: " + ", ".join(t for t, _ in topic))
@@ -677,6 +749,8 @@ def cmd_stream_train(args: argparse.Namespace) -> int:
     else:
         model.save(out_dir)
     print(f"model saved to {out_dir}")
+    if lease is not None:
+        lease.mark_done("idle")
     if own_telemetry:
         telemetry.event(
             "model_saved", path=out_dir, k=model.k,
@@ -710,6 +784,168 @@ def cmd_stream_requeue(args: argparse.Namespace) -> int:
         f"{len(res['archived'])} {averb}, {len(res['skipped'])} skipped"
     )
     return 1 if res["skipped"] else 0
+
+
+def cmd_stream_compact(args: argparse.Namespace) -> int:
+    """Fold a stream checkpoint dir's committed ``epochs.jsonl`` history
+    into ONE checksummed snapshot record (ROADMAP carry-over): resume
+    stays O(1) on long-lived streams — the seen-set union, the newest
+    shard plan, and the training counters survive; per-epoch report
+    digests (already-durable output) are dropped."""
+    from .resilience import CorruptArtifactError, EpochLedger
+
+    led = EpochLedger(args.checkpoint_dir)
+    rep = led.recover()
+    if rep.rolled_back or rep.truncated_lines:
+        print(
+            f"recover: rolled back {len(rep.rolled_back)} uncommitted "
+            f"epoch(s), truncated {rep.truncated_lines} torn append(s)"
+        )
+    try:
+        snap = led.compact()
+    except CorruptArtifactError as exc:
+        print(f"error: {exc}", file=sys.stderr)
+        return 2
+    if snap is None:
+        print(
+            f"nothing to compact in {args.checkpoint_dir} "
+            f"(fewer than two committed records)"
+        )
+        return 0
+    print(
+        f"compacted {snap['compacted_epochs']} committed records into "
+        f"one snapshot (epoch {snap['epoch']}, "
+        f"{len(snap['sources'])} sources"
+        + (f", {len(snap['shards'])} shard(s)" if snap.get("shards")
+           else "")
+        + ")"
+    )
+    return 0
+
+
+def cmd_supervise(args: argparse.Namespace) -> int:
+    """Run an elastic, preemption-tolerant worker fleet over a watch
+    directory (docs/RESILIENCE.md "Fleet supervision"): N
+    ``stream-train`` / ``stream-score`` subprocesses partitioned over
+    the arriving files, heartbeat-leased, SIGTERM→SIGKILL escalated on
+    lease expiry, resized between committed epochs with fence tokens so
+    zombie writes are refused typed."""
+    from .resilience import FleetSupervisor, ResilienceError
+    from .resilience.supervisor import worker_dir
+
+    own_telemetry = bool(getattr(args, "telemetry_file", None))
+    if own_telemetry:
+        telemetry.configure(args.telemetry_file)
+        telemetry.manifest(
+            kind="supervise", role=args.role,
+            watch_dir=args.watch_dir, fleet_dir=args.fleet_dir,
+        )
+
+    def build_argv(index, count, generation, spawn_id):
+        argv = [
+            sys.executable, "-m", "spark_text_clustering_tpu.cli",
+            args.role,
+            "--watch-dir", args.watch_dir,
+            "--checkpoint-dir", worker_dir(args.fleet_dir, index),
+            "--fleet-dir", args.fleet_dir,
+            "--worker-index", str(index),
+            "--worker-count", str(count),
+            "--fleet-generation", str(generation),
+            "--fleet-spawn-id", str(spawn_id),
+            "--heartbeat-interval", str(args.heartbeat_interval),
+            "--lease-timeout", str(args.lease_timeout),
+            "--poll-interval", str(args.poll_interval),
+            "--idle-timeout", str(args.idle_timeout),
+            "--lang", args.lang,
+        ]
+        if args.max_files_per_trigger is not None:
+            argv += ["--max-files-per-trigger",
+                     str(args.max_files_per_trigger)]
+        if args.no_lemmatize:
+            argv.append("--no-lemmatize")
+        if args.include_all:
+            argv.append("--include-all")
+        if args.stop_words:
+            argv += ["--stop-words", args.stop_words]
+        if args.quarantine_dir:
+            argv += ["--quarantine-dir", args.quarantine_dir]
+        if args.role == "stream-score":
+            argv += [
+                "--output-dir",
+                os.path.join(args.output_dir, f"w{index:03d}"),
+            ]
+            if args.model:
+                argv += ["--model", args.model]
+            else:
+                argv += ["--models-dir", args.models_dir]
+        else:
+            argv += [
+                "--k", str(args.k),
+                "--hash-features", str(args.hash_features),
+                "--seed", str(args.seed),
+                "--checkpoint-interval", str(args.checkpoint_interval),
+                "--models-dir",
+                os.path.join(args.models_dir, f"w{index:03d}"),
+            ]
+        argv += args.worker_arg or []
+        return argv
+
+    worker_faults = {}
+    for spec in args.chaos_worker or []:
+        idx_s, _, fault = spec.partition(":")
+        if not fault:
+            print(f"bad --chaos-worker {spec!r} "
+                  f"(want <index>:<site>:<kind>[@arg])", file=sys.stderr)
+            return 2
+        worker_faults[int(idx_s)] = fault
+    resize_plan = []
+    for spec in args.resize_at or []:
+        at_s, _, n_s = spec.partition(":")
+        try:
+            resize_plan.append(
+                {"at_epochs": int(at_s), "workers": int(n_s)}
+            )
+        except ValueError:
+            print(f"bad --resize-at {spec!r} (want <epochs>:<workers>)",
+                  file=sys.stderr)
+            return 2
+
+    sup = FleetSupervisor(
+        args.fleet_dir,
+        build_argv,
+        workers=args.workers,
+        min_workers=args.min_workers,
+        max_workers=args.max_workers,
+        heartbeat_interval=args.heartbeat_interval,
+        lease_timeout=args.lease_timeout,
+        grace_seconds=args.grace_seconds,
+        startup_grace_seconds=args.startup_grace,
+        sweep_interval=args.sweep_interval,
+        scale_out_depth=args.scale_out_depth,
+        scale_out_sweeps=args.scale_out_sweeps,
+        scale_in_sweeps=args.scale_in_sweeps,
+        max_respawns=args.max_respawns,
+        resize_plan=resize_plan,
+        worker_faults=worker_faults,
+    )
+    try:
+        rep = sup.run()
+    except ResilienceError as exc:
+        print(f"error: {exc}", file=sys.stderr)
+        if own_telemetry:
+            telemetry.shutdown()
+        return 1
+    print(
+        f"fleet converged: {rep.committed_epochs} committed epoch(s) "
+        f"across {rep.final_workers} worker(s) — "
+        f"{rep.spawns} spawn(s), {rep.respawns} respawn(s), "
+        f"{rep.resizes} resize(s), {rep.lease_expiries} lease "
+        f"expiry(ies), {rep.preemptions} preemption(s) survived, "
+        f"{rep.crashes} crash(es)"
+    )
+    if own_telemetry:
+        telemetry.shutdown()
+    return 0
 
 
 def cmd_doctor(args: argparse.Namespace) -> int:
@@ -752,6 +988,58 @@ def cmd_doctor(args: argparse.Namespace) -> int:
     print(f"  gamma backend: "
           f"{forced or 'auto (pallas on TPU, xla elsewhere)'}")
     return 0
+
+
+def _fleet_worker_context(args: argparse.Namespace):
+    """Supervised-worker wiring shared by ``stream-score`` and
+    ``stream-train``: the SIGTERM drain notice (installed for EVERY
+    stream — a preemption notice must end the stream after the
+    in-flight trigger, committed or rolled back, never mid-batch), and
+    — when the supervisor's fleet flags are present — the heartbeat
+    lease, the fence token every ledger write re-verifies, the
+    deterministic file-partition slice, and the lease-bounded retry
+    deadline (a worker stuck retrying past its heartbeat deadline looks
+    alive to nobody and dead to everybody).
+
+    Returns ``(preempt, lease, fence, partition)``; the last three are
+    None for unsupervised streams.
+    """
+    from .resilience.supervisor import (
+        FleetFence,
+        PreemptionNotice,
+        WorkerLease,
+        lease_path,
+    )
+
+    preempt = PreemptionNotice().install()
+    fleet_dir = getattr(args, "fleet_dir", None)
+    if not fleet_dir:
+        return preempt, None, None, None
+    idx = int(getattr(args, "worker_index", 0) or 0)
+    count = max(1, int(getattr(args, "worker_count", 1) or 1))
+    generation = int(getattr(args, "fleet_generation", 0) or 0)
+    spawn_id = int(getattr(args, "fleet_spawn_id", 0) or 0)
+    lease = WorkerLease(
+        lease_path(fleet_dir, idx),
+        interval=float(getattr(args, "heartbeat_interval", 0.5)),
+        worker_index=idx,
+        generation=generation,
+        spawn_id=spawn_id,
+    )
+    fence = FleetFence(
+        fleet_dir=fleet_dir,
+        generation=generation,
+        worker_index=idx,
+        spawn_id=spawn_id,
+    )
+    partition = (idx, count) if count > 1 else None
+    lease_timeout = getattr(args, "lease_timeout", None)
+    if lease_timeout:
+        from .resilience import configure_lease_deadline
+
+        configure_lease_deadline(float(lease_timeout))
+    lease.beat(force=True)          # visible before the slow jax import
+    return preempt, lease, fence, partition
 
 
 def _make_trigger_controller(args: argparse.Namespace):
@@ -808,6 +1096,29 @@ def _add_stream_args(p: argparse.ArgumentParser) -> None:
                    help="dead-letter dir for per-document failures: the "
                         "offending doc + a structured .error.json sidecar "
                         "land here instead of killing the stream")
+    # fleet-worker flags (normally injected by `stc supervise`, not
+    # typed by hand): identity + fence token + lease cadence
+    p.add_argument("--fleet-dir", default=None,
+                   help="fleet dir of a supervising `stc supervise` "
+                        "process: enables the heartbeat lease, the "
+                        "fence-token check on every ledger write, and "
+                        "the deterministic file-partition slice")
+    p.add_argument("--worker-index", type=int, default=0,
+                   help="this worker's index in the fleet")
+    p.add_argument("--worker-count", type=int, default=1,
+                   help="fleet width (files partition by "
+                        "sha256(basename) %% count)")
+    p.add_argument("--fleet-generation", type=int, default=0,
+                   help="fence token: topology generation at spawn")
+    p.add_argument("--fleet-spawn-id", type=int, default=0,
+                   help="fence token: this incarnation's spawn id")
+    p.add_argument("--heartbeat-interval", type=float, default=0.5,
+                   help="seconds between lease renewals")
+    p.add_argument("--lease-timeout", type=float, default=None,
+                   help="supervisor's lease timeout: installed as the "
+                        "process-wide retry deadline so no retry loop "
+                        "outlives the lease "
+                        "(resilience.deadline_giveups)")
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -956,7 +1267,8 @@ def build_parser() -> argparse.ArgumentParser:
 
     stream = sub.add_parser(
         "stream",
-        help="stream maintenance verbs (requeue quarantined documents)",
+        help="stream maintenance verbs (requeue quarantined documents, "
+             "compact a long-lived epoch ledger)",
     )
     stream_sub = stream.add_subparsers(dest="stream_cmd", required=True)
     rq = stream_sub.add_parser(
@@ -972,6 +1284,95 @@ def build_parser() -> argparse.ArgumentParser:
     rq.add_argument("--dry-run", action="store_true",
                     help="list what would move without touching anything")
     rq.set_defaults(fn=cmd_stream_requeue)
+    cp = stream_sub.add_parser(
+        "compact",
+        help="fold a stream checkpoint dir's committed epochs.jsonl "
+             "history into one checksummed snapshot record (resume "
+             "stays O(1) on long-lived streams)",
+    )
+    cp.add_argument("--checkpoint-dir", required=True,
+                    help="epoch-ledger checkpoint dir to compact")
+    cp.set_defaults(fn=cmd_stream_compact)
+
+    sv = sub.add_parser(
+        "supervise",
+        help="run an elastic, preemption-tolerant stream worker fleet "
+             "(heartbeat leases, SIGTERM/SIGKILL escalation, "
+             "ledger-gated resize with zombie fencing)",
+    )
+    sv.add_argument("--role", default="stream-score",
+                    choices=["stream-score", "stream-train"],
+                    help="worker verb the fleet runs")
+    sv.add_argument("--watch-dir", required=True)
+    sv.add_argument("--fleet-dir", required=True,
+                    help="fleet state dir: fleet.jsonl (fence records), "
+                         "leases/, and per-worker checkpoint dirs "
+                         "w000/, w001/, ...")
+    sv.add_argument("--workers", type=int, default=2,
+                    help="initial worker count")
+    sv.add_argument("--min-workers", type=int, default=1)
+    sv.add_argument("--max-workers", type=int, default=8)
+    sv.add_argument("--heartbeat-interval", type=float, default=0.5)
+    sv.add_argument("--lease-timeout", type=float, default=5.0,
+                    help="seconds without a lease renewal before a "
+                         "worker counts as stuck/dead (escalation "
+                         "starts)")
+    sv.add_argument("--grace-seconds", type=float, default=3.0,
+                    help="drain window between SIGTERM and SIGKILL")
+    sv.add_argument("--startup-grace", type=float, default=60.0,
+                    help="lease budget before the FIRST heartbeat "
+                         "(covers jax import + compile)")
+    sv.add_argument("--sweep-interval", type=float, default=0.25)
+    sv.add_argument("--scale-out-depth", type=int, default=None,
+                    help="scale out when the fleet's total queue depth "
+                         "sustains at/above this for "
+                         "--scale-out-sweeps sweeps")
+    sv.add_argument("--scale-out-sweeps", type=int, default=3)
+    sv.add_argument("--scale-in-sweeps", type=int, default=None,
+                    help="scale in after this many consecutive "
+                         "all-idle sweeps (default: disabled)")
+    sv.add_argument("--max-respawns", type=int, default=5,
+                    help="fleet-wide respawn budget before supervision "
+                         "aborts (a crash loop must fail loudly)")
+    sv.add_argument("--resize-at", action="append", default=[],
+                    metavar="EPOCHS:WORKERS",
+                    help="scripted resize: once the fleet's total "
+                         "committed epochs reach EPOCHS, resize to "
+                         "WORKERS (repeatable; drills + planned "
+                         "scaling)")
+    sv.add_argument("--chaos-worker", action="append", default=[],
+                    metavar="INDEX:SITE:KIND[@ARG]",
+                    help="arm an STC_FAULTS spec on ONE generation-0 "
+                         "worker (respawns always run clean)")
+    sv.add_argument("--poll-interval", type=float, default=1.0)
+    sv.add_argument("--idle-timeout", type=float, default=30.0,
+                    help="workers exit cleanly after this many idle "
+                         "seconds; the fleet converges when every "
+                         "worker has finished")
+    sv.add_argument("--max-files-per-trigger", type=int, default=None)
+    sv.add_argument("--lang", default="EN", choices=sorted(LANG_DIRS))
+    sv.add_argument("--stop-words", default=None)
+    sv.add_argument("--no-lemmatize", action="store_true")
+    sv.add_argument("--include-all", action="store_true")
+    sv.add_argument("--quarantine-dir", default=None)
+    sv.add_argument("--models-dir", default="models")
+    sv.add_argument("--model", default=None,
+                    help="explicit model dir for stream-score workers")
+    sv.add_argument("--output-dir", default="TestOutput",
+                    help="stream-score report root (per-worker "
+                         "subdirs w000/, w001/, ...)")
+    sv.add_argument("--k", type=int, default=5)
+    sv.add_argument("--hash-features", type=int, default=1 << 18)
+    sv.add_argument("--seed", type=int, default=0)
+    sv.add_argument("--checkpoint-interval", type=int, default=1)
+    sv.add_argument("--telemetry-file", default=None,
+                    help="supervisor telemetry run stream (fleet_* "
+                         "events + fleet.* counters) — consumed by "
+                         "`metrics summarize` fleet health")
+    sv.add_argument("--worker-arg", action="append", default=[],
+                    help="extra argv appended verbatim to every worker "
+                         "command (repeatable)")
+    sv.set_defaults(fn=cmd_supervise)
 
     dr = sub.add_parser(
         "doctor", help="environment health report (hang-proof probes)"
@@ -1003,9 +1404,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     # `metrics` is a pure host-side reader: it must not import jax at all
     # `lint` pins JAX_PLATFORMS=cpu itself before its jaxpr layer brings
     # jax up — the cache helper here would initialize the backend first
-    # `stream` (requeue) is pure filesystem maintenance: no jax either
+    # `stream` (requeue/compact) is pure filesystem maintenance: no jax
+    # `supervise` is pure subprocess-and-files machinery: its WORKERS
+    # bring jax up; the supervisor must survive anything they do to it
     if (
-        args.cmd not in ("doctor", "metrics", "lint", "stream")
+        args.cmd not in ("doctor", "metrics", "lint", "stream",
+                         "supervise")
         and getattr(args, "coordinator", None) is None
     ):
         from .utils.env import enable_persistent_compile_cache

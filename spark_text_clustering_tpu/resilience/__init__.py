@@ -24,6 +24,7 @@ quarantine layout, and the fault-spec grammar.
 from . import faultinject
 from .errors import (
     CorruptArtifactError,
+    FencedEpochError,
     ResilienceError,
     ResumeMismatchError,
 )
@@ -54,6 +55,7 @@ from .resume import (
     write_resume_meta,
 )
 from .retry import (
+    DEADLINE_GIVEUPS_COUNTER,
     GIVEUPS_COUNTER,
     IO_POLICY,
     RETRIES_COUNTER,
@@ -61,8 +63,20 @@ from .retry import (
     RetryGiveUp,
     RetryPolicy,
     backoff_delays,
+    configure_lease_deadline,
     retry_call,
     sleep,
+)
+from .supervisor import (
+    FleetFence,
+    FleetLedger,
+    FleetSupervisor,
+    PreemptionNotice,
+    WorkerLease,
+    fleet_committed_sources,
+    lease_path,
+    partition_of,
+    worker_dir,
 )
 
 __all__ = [
@@ -97,8 +111,20 @@ __all__ = [
     "retry_call",
     "backoff_delays",
     "sleep",
+    "configure_lease_deadline",
     "IO_POLICY",
     "TELEMETRY_POLICY",
     "RETRIES_COUNTER",
     "GIVEUPS_COUNTER",
+    "DEADLINE_GIVEUPS_COUNTER",
+    "FencedEpochError",
+    "FleetFence",
+    "FleetLedger",
+    "FleetSupervisor",
+    "PreemptionNotice",
+    "WorkerLease",
+    "fleet_committed_sources",
+    "lease_path",
+    "partition_of",
+    "worker_dir",
 ]

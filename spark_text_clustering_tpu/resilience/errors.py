@@ -14,6 +14,7 @@ __all__ = [
     "ResilienceError",
     "CorruptArtifactError",
     "ResumeMismatchError",
+    "FencedEpochError",
 ]
 
 
@@ -45,4 +46,23 @@ class ResumeMismatchError(ResilienceError):
         self.checkpoint_dir = checkpoint_dir
         super().__init__(
             f"cannot resume from {checkpoint_dir!r}: {reason}"
+        )
+
+
+class FencedEpochError(ResilienceError):
+    """A ledger write arrived under a SUPERSEDED fleet fence token — the
+    writer is a zombie worker from a pre-resize (or pre-respawn)
+    generation.  Its staged shards must be REFUSED, typed, instead of
+    silently merged into the new topology's shard plan: the supervisor
+    already rolled this epoch back and re-sliced the work.
+
+    ``fleet_dir`` is the fleet ledger that fenced the write; the message
+    names both the writer's stale token and the current one so the
+    operator can see which resize/respawn superseded it.
+    """
+
+    def __init__(self, fleet_dir: str, reason: str) -> None:
+        self.fleet_dir = fleet_dir
+        super().__init__(
+            f"fenced ledger write (fleet {fleet_dir!r}): {reason}"
         )

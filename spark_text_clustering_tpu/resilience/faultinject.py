@@ -22,6 +22,12 @@ Spec grammar (semicolon-separated rules)::
                   trainer looks like to the artifacts on disk
     partial[@n]   on the n-th hit, ``corrupt()`` truncates the named file
                   to half its size (a torn write that survived)
+    hang[@n]      on the n-th hit, block for ~an hour (through the
+                  injectable ``retry.sleep``) — a live-but-stuck worker:
+                  the process keeps its pid, stops heartbeating, and
+                  ignores a drain-style SIGTERM (the handler sets a flag
+                  nothing is polling), so only the supervisor's
+                  SIGKILL escalation can reclaim it
 
 Sites are dotted names owned by the code they live in: ``artifact.file``
 (between files of a model artifact write), ``ckpt.write``,
@@ -53,7 +59,7 @@ __all__ = [
 ENV_SPEC = "STC_FAULTS"
 ENV_SEED = "STC_FAULT_SEED"
 
-KINDS = ("ioerror", "fail", "kill", "partial")
+KINDS = ("ioerror", "fail", "kill", "partial", "hang")
 
 # Canonical registry of every injection point the production code owns.
 # ``stc lint`` rule STC003 enforces BOTH directions against this table:
@@ -71,6 +77,9 @@ SITES = frozenset({
     "telemetry.write",    # telemetry run-stream append
     "ledger.stage",       # before an epoch intent record is staged
     "ledger.commit",      # before the epoch ledger append (commit point)
+    "supervisor.spawn",   # before the supervisor spawns a worker process
+    "worker.heartbeat",   # before a worker's lease heartbeat write
+    "worker.kill",        # before the supervisor's SIGKILL escalation
 })
 
 
@@ -168,6 +177,14 @@ def check(site: str) -> None:
         if rule.kind == "kill":
             # a real crash: bypass interpreter shutdown entirely
             os._exit(137)
+        if rule.kind == "hang":
+            # a live-but-stuck process: hold the pid, never return in
+            # any realistic supervision window (late import: retry.py
+            # owns the one injectable sleep)
+            from .retry import sleep as _sleep
+
+            _sleep(3600.0)
+            continue
         raise InjectedIOError(
             f"injected fault at {site} (hit {rule.hits}, "
             f"kind {rule.kind})"

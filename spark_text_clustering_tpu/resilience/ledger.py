@@ -78,6 +78,7 @@ __all__ = [
     "LEDGER_NAME",
     "QUARANTINE_DIRNAME",
     "LEDGER_SCHEMA",
+    "SNAPSHOT_KIND",
     "EpochLedger",
     "RecoveryReport",
     "record_checksum",
@@ -89,9 +90,11 @@ __all__ = [
 LEDGER_NAME = "epochs.jsonl"
 QUARANTINE_DIRNAME = "quarantined_epochs"
 LEDGER_SCHEMA = 1
+SNAPSHOT_KIND = "snapshot"
 
 COMMITS_COUNTER = "ledger.commits"
 ROLLBACKS_COUNTER = "ledger.rollbacks"
+COMPACTIONS_COUNTER = "ledger.compactions"
 
 
 def record_checksum(record: Dict) -> str:
@@ -170,9 +173,20 @@ class EpochLedger:
     the latest committed state.
     """
 
-    def __init__(self, directory: str) -> None:
+    def __init__(self, directory: str, *, fence=None) -> None:
+        # ``fence``: any object with a ``verify()`` raising
+        # ``FencedEpochError`` when this writer's fleet token has been
+        # superseded (resilience.supervisor.FleetFence).  Checked before
+        # every mutating phase — a zombie worker from a pre-resize
+        # generation gets its staged shards refused typed instead of
+        # corrupting the new topology's shard plan.
         self.directory = directory
+        self.fence = fence
         self.path = os.path.join(directory, LEDGER_NAME)
+
+    def _check_fence(self) -> None:
+        if self.fence is not None:
+            self.fence.verify()
 
     # -- reading ---------------------------------------------------------
     def _read_lines(self) -> Tuple[List[Dict], int]:
@@ -264,6 +278,7 @@ class EpochLedger:
         path = self._intent_path(epoch)
 
         def _write() -> None:
+            self._check_fence()
             faultinject.check("ledger.stage")
             os.makedirs(self.directory, exist_ok=True)
             atomic_write_text(
@@ -318,6 +333,11 @@ class EpochLedger:
         line = json.dumps(record, sort_keys=True) + "\n"
 
         def _append() -> None:
+            # the fence check sits INSIDE the commit critical section:
+            # as close to the append as a filesystem protocol allows, so
+            # a resize that lands between a zombie's begin() and its
+            # commit() still refuses the stale epoch
+            self._check_fence()
             faultinject.check("ledger.commit")
             os.makedirs(self.directory, exist_ok=True)
             with open(self.path, "a", encoding="utf-8") as f:
@@ -371,17 +391,21 @@ class EpochLedger:
         ]
 
     def _gc_shards(self) -> None:
-        """Delete state shards of epochs OLDER than the newest committed
-        epoch that carries shards — only the latest shard set is a
+        """Delete state shards NOT referenced by the newest committed
+        record that carries shards — only the latest shard set is a
         resume point, and shard-less epochs (``model-publish``) must not
-        orphan it.  Reports and other payloads outside the ledger dir
-        are never touched — they ARE the exactly-once output."""
-        keep = max(
-            (r["epoch"] for r in self.records() if r.get("shards")),
-            default=None,
-        )
-        if keep is None:
+        orphan it.  Keyed on the referenced FILENAMES (not record
+        epochs) because a compacted snapshot record keeps its original
+        shard files under an older epoch number.  Reports and other
+        payloads outside the ledger dir are never touched — they ARE
+        the exactly-once output."""
+        newest = None
+        for r in self.records():
+            if r.get("shards"):
+                newest = r
+        if newest is None:
             return
+        keep = {s["file"] for s in newest["shards"]}
         try:
             names = os.listdir(self.directory)
         except FileNotFoundError:
@@ -389,11 +413,8 @@ class EpochLedger:
         for n in names:
             if not (n.startswith("stream_state-e") and ".npz" in n):
                 continue
-            try:
-                e = int(n[len("stream_state-e"):].split("-", 1)[0])
-            except ValueError:
-                continue
-            if e < keep:
+            base = n[: -len(".sha256")] if n.endswith(".sha256") else n
+            if base not in keep:
                 try:
                     os.unlink(os.path.join(self.directory, n))
                 except OSError:
@@ -448,14 +469,21 @@ class EpochLedger:
             self._rollback(epoch, ipath, report)
         # orphan shards/markers with no intent AND no committed record
         # (a crash between payload write and... impossible under the
-        # protocol, but a defensive sweep keeps the dir explicable)
+        # protocol, but a defensive sweep keeps the dir explicable).
+        # "committed" is judged by referenced shard FILENAMES as well as
+        # epoch numbers: a compacted snapshot record owns shard files
+        # named for an older epoch.
+        referenced = {
+            s["file"] for r in records for s in r.get("shards", ())
+        }
         for n in sorted(os.listdir(self.directory)):
             if n.startswith("stream_state-e"):
                 try:
                     e = int(n[len("stream_state-e"):].split("-", 1)[0])
                 except ValueError:
                     continue
-                if e not in committed:
+                base = n[: -len(".sha256")] if n.endswith(".sha256") else n
+                if e not in committed and base not in referenced:
                     self._quarantine_file(
                         e, os.path.join(self.directory, n), report
                     )
@@ -503,6 +531,88 @@ class EpochLedger:
             return
         report.quarantined.append(dest)
 
+    # -- compaction ------------------------------------------------------
+    def compact(self) -> Optional[Dict]:
+        """Fold the committed history into ONE checksummed snapshot
+        record (kind ``snapshot``) — resume stays O(1) on long-lived
+        streams instead of re-parsing one line per trigger epoch.
+
+        The snapshot preserves everything resume reads: the union of
+        committed source paths (the exactly-once seen-set), the newest
+        epoch number (``next_epoch`` keeps counting from there), and the
+        newest shard-bearing record's shard plan + training counters
+        (``step``/``docs_seen``/``batches_seen``), still pointing at the
+        SAME shard files on disk.  Per-epoch payload digests of already-
+        emitted reports are dropped — the reports themselves are the
+        durable output; only their sources matter for replay
+        suppression.  Run ``recover()`` first: compaction refuses to run
+        over an open transaction (a staged intent).
+
+        Returns the snapshot record, or None when there is nothing to
+        fold (fewer than two committed records).
+        """
+        from .. import telemetry
+
+        records, torn = self._read_lines()
+        if torn:
+            raise CorruptArtifactError(
+                self.path,
+                "torn trailing append — run recover() before compacting",
+            )
+        try:
+            names = os.listdir(self.directory)
+        except FileNotFoundError:
+            names = []
+        intents = [n for n in names if n.endswith(".intent.json")]
+        if intents:
+            raise ResilienceError(
+                f"{self.path}: staged intent(s) outstanding "
+                f"({', '.join(sorted(intents))}) — compaction only runs "
+                f"between committed epochs; recover() first"
+            )
+        if len(records) < 2:
+            return None
+        sources: Set[str] = set()
+        for r in records:
+            sources.update(r.get("sources", ()))
+        newest = records[-1]
+        shard_rec = None
+        for r in records:
+            if r.get("shards"):
+                shard_rec = r
+        model_rec = None
+        for r in records:
+            if r.get("model_ref"):
+                model_rec = r
+        snapshot = {
+            "schema": LEDGER_SCHEMA,
+            "epoch": max(r["epoch"] for r in records),
+            "kind": SNAPSHOT_KIND,
+            "sources": sorted(sources),
+            "compacted_epochs": len(records),
+            "process_count": int(
+                (shard_rec or newest).get("process_count", 1)
+            ),
+        }
+        if shard_rec is not None:
+            for k in ("shards", "step", "docs_seen", "batches_seen"):
+                if k in shard_rec:
+                    snapshot[k] = shard_rec[k]
+        if model_rec is not None:
+            snapshot["model_ref"] = model_rec["model_ref"]
+        snapshot["checksum"] = record_checksum(snapshot)
+        atomic_write_text(
+            self.path, json.dumps(snapshot, sort_keys=True) + "\n"
+        )
+        telemetry.count(COMPACTIONS_COUNTER)
+        telemetry.event(
+            "ledger_compact",
+            epoch=snapshot["epoch"],
+            compacted=len(records),
+            sources=len(snapshot["sources"]),
+        )
+        return snapshot
+
     # -- multi-host staging rendezvous ----------------------------------
     def stage_shard(
         self,
@@ -520,6 +630,7 @@ class EpochLedger:
         Returns the shard spec the commit record will embed."""
         from ..models.persistence import save_train_state
 
+        self._check_fence()
         fname = shard_filename(epoch, process_index)
         path = os.path.join(self.directory, fname)
         os.makedirs(self.directory, exist_ok=True)

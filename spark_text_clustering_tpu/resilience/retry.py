@@ -34,10 +34,13 @@ __all__ = [
     "backoff_delays",
     "retry_call",
     "sleep",
+    "configure_lease_deadline",
+    "lease_deadline",
 ]
 
 RETRIES_COUNTER = "resilience.retries"
 GIVEUPS_COUNTER = "resilience.giveups"
+DEADLINE_GIVEUPS_COUNTER = "resilience.deadline_giveups"
 
 
 def sleep(seconds: float) -> None:
@@ -55,14 +58,24 @@ def sleep(seconds: float) -> None:
 
 class RetryGiveUp(ResilienceError):
     """A retry policy exhausted its attempts/deadline; ``last`` is the
-    final underlying exception (also chained as ``__cause__``)."""
+    final underlying exception (also chained as ``__cause__``).
+    ``deadline_exceeded`` distinguishes a budget exhausted on the clock
+    (the lease-bounded case) from one exhausted on attempts."""
 
-    def __init__(self, site: str, attempts: int, last: BaseException) -> None:
+    def __init__(
+        self,
+        site: str,
+        attempts: int,
+        last: BaseException,
+        deadline_exceeded: bool = False,
+    ) -> None:
         self.site = site
         self.attempts = attempts
         self.last = last
+        self.deadline_exceeded = deadline_exceeded
+        why = "deadline expired" if deadline_exceeded else "gave up"
         super().__init__(
-            f"{site}: gave up after {attempts} attempt(s): {last!r}"
+            f"{site}: {why} after {attempts} attempt(s): {last!r}"
         )
 
 
@@ -73,6 +86,15 @@ class RetryPolicy:
     Delay before attempt ``i`` (0-based; attempt 0 is immediate)::
 
         min(max_delay, base_delay * multiplier**(i-1)) * (1 ± jitter)
+
+    ``deadline_seconds`` is a wall-clock budget over the WHOLE retry
+    loop: once it elapses, no further attempt starts and ``RetryGiveUp``
+    raises with ``deadline_exceeded=True`` (counted separately in
+    ``resilience.deadline_giveups``).  Call sites running under a
+    supervisor lease additionally respect the process-wide cap from
+    ``configure_lease_deadline`` — a worker stuck retrying past its
+    heartbeat deadline looks alive to nobody and dead to everybody, so
+    its retries must fail fast instead of outliving the lease.
     """
 
     attempts: int = 4
@@ -80,7 +102,7 @@ class RetryPolicy:
     max_delay: float = 2.0
     multiplier: float = 2.0
     jitter: float = 0.25            # fraction of the delay, uniform ±
-    deadline_s: Optional[float] = None
+    deadline_seconds: Optional[float] = None
     retry_on: Tuple[Type[BaseException], ...] = (OSError,)
     # False: count retries in the registry but emit no ``retry`` run
     # event — REQUIRED for the telemetry sink's own retries (an event
@@ -101,6 +123,35 @@ class RetryPolicy:
 # I/O micro-retry: absorbs transient filesystem hiccups without making a
 # genuinely-dead disk stall the caller for more than ~a second.
 IO_POLICY = RetryPolicy(attempts=4, base_delay=0.05, max_delay=0.5)
+
+# Process-wide retry-budget cap installed by supervised workers: every
+# retry_call's effective deadline is min(policy.deadline_seconds, this).
+# None = unbounded (the default for unsupervised runs).
+_lease_deadline: Optional[float] = None
+
+
+def configure_lease_deadline(seconds: Optional[float]) -> None:
+    """Cap EVERY retry loop in this process at ``seconds`` of wall
+    clock.  Supervised workers install their lease timeout here at
+    startup, so no retry site can stall longer than the supervisor
+    waits before declaring the lease expired and escalating to SIGKILL
+    — the retry either succeeds inside the lease or fails typed
+    (``RetryGiveUp(deadline_exceeded=True)``) while the worker can
+    still heartbeat, drain, and die cleanly."""
+    global _lease_deadline
+    _lease_deadline = float(seconds) if seconds is not None else None
+
+
+def lease_deadline() -> Optional[float]:
+    return _lease_deadline
+
+
+def _effective_deadline(policy: "RetryPolicy") -> Optional[float]:
+    if policy.deadline_seconds is None:
+        return _lease_deadline
+    if _lease_deadline is None:
+        return policy.deadline_seconds
+    return min(policy.deadline_seconds, _lease_deadline)
 # Telemetry writes are best-effort: one quick second chance, never a
 # stall, and no retry events (they would re-enter the failing sink).
 TELEMETRY_POLICY = RetryPolicy(
@@ -148,17 +199,24 @@ def retry_call(
     """
     rng = _site_rng(site)
     t0 = time.monotonic()
+    deadline = _effective_deadline(policy)
     last: Optional[BaseException] = None
+    deadline_hit = False
+    attempts_made = 0
     for attempt in range(policy.attempts):
         d = policy.delay(attempt, rng)
+        if deadline is not None and (
+            time.monotonic() - t0 + d >= deadline
+        ):
+            # the budget would expire during (or before) this backoff:
+            # don't sleep past the lease just to discover it's too late
+            deadline_hit = attempt > 0 or deadline <= 0
+            if deadline_hit:
+                break
         if d:
             sleep(d)
-        if (
-            policy.deadline_s is not None
-            and time.monotonic() - t0 > policy.deadline_s
-        ):
-            break
         try:
+            attempts_made += 1
             return fn(*args, **kwargs)
         except policy.retry_on as exc:
             last = exc
@@ -169,6 +227,16 @@ def retry_call(
                 )
             else:
                 _count(RETRIES_COUNTER)
-    assert last is not None
+    if last is None:
+        # deadline expired before the first attempt could even run
+        # (a zero/negative budget): still a typed give-up, never an
+        # AssertionError
+        last = TimeoutError(
+            f"retry budget of {deadline}s expired before any attempt"
+        )
     _count(GIVEUPS_COUNTER)
-    raise RetryGiveUp(site, policy.attempts, last) from last
+    if deadline_hit:
+        _count(DEADLINE_GIVEUPS_COUNTER)
+    raise RetryGiveUp(
+        site, attempts_made, last, deadline_exceeded=deadline_hit
+    ) from last

@@ -145,6 +145,12 @@ class FileStreamSource:
     caught mid-write is read truncated and never re-read.  When atomic
     renames can't be guaranteed, set ``min_file_age_s`` so a file is only
     picked up once its mtime has settled for that long.
+
+    ``partition=(index, count)`` restricts the source to the files a
+    fleet worker owns (``resilience.supervisor.partition_of`` on the
+    basename): every worker derives the same deterministic assignment,
+    so a supervised fleet splits a watch dir with no agreement protocol
+    and a resize re-slices by simply changing ``count``.
     """
 
     def __init__(
@@ -158,6 +164,7 @@ class FileStreamSource:
         min_file_age_s: float = 0.0,
         state_path: Optional[str] = None,
         preseen: Optional[Sequence[str]] = None,
+        partition: Optional[Tuple[int, int]] = None,
     ) -> None:
         self.directory = directory
         self.suffix = suffix
@@ -182,6 +189,7 @@ class FileStreamSource:
         # exactly-once, because the same append that commits the
         # training/report payloads commits the consumed paths.
         self.state_path = state_path
+        self.partition = partition
         self._seen: set = set(preseen or ())
         self._pending: List[str] = []
         self._next_id = 0
@@ -226,6 +234,12 @@ class FileStreamSource:
         for name in sorted(entries):
             if not self.include_all and not name.endswith(self.suffix):
                 continue
+            if self.partition is not None:
+                from .resilience.supervisor import partition_of
+
+                idx, count = self.partition
+                if partition_of(name, count) != idx:
+                    continue
             p = os.path.join(self.directory, name)
             if os.path.isfile(p) and p not in self._seen:
                 out.append(p)
@@ -291,12 +305,25 @@ class FileStreamSource:
         self,
         poll_interval: float = 1.0,
         idle_timeout: Optional[float] = 30.0,
+        heartbeat=None,
+        stop=None,
     ) -> Iterator[MicroBatch]:
         """Generator of micro-batches; stops after ``idle_timeout`` seconds
-        without new data (None = run forever)."""
+        without new data (None = run forever).
+
+        ``heartbeat(queue_depth)`` is called once per poll — supervised
+        workers renew their lease here, so an IDLE worker still looks
+        alive.  ``stop()`` is checked before each poll (and between
+        yields): the drain hook — a SIGTERM preemption notice ends the
+        stream cleanly after the in-flight trigger instead of
+        mid-batch."""
         last_data = time.monotonic()
         while True:
+            if stop is not None and stop():
+                return
             mb = self.poll()
+            if heartbeat is not None:
+                heartbeat(self.last_queue_depth)
             if mb is not None:
                 last_data = time.monotonic()
                 yield mb
@@ -552,6 +579,7 @@ class StreamingOnlineLDA:
         quarantine_dir: Optional[str] = None,
         process_index: Optional[int] = None,
         process_count: Optional[int] = None,
+        fence=None,
     ) -> None:
         import jax
         import jax.numpy as jnp
@@ -624,8 +652,12 @@ class StreamingOnlineLDA:
         self.process_count = (
             jax.process_count() if process_count is None else process_count
         )
+        # ``fence``: a supervised fleet worker's token (resilience.
+        # supervisor.FleetFence) — every ledger write re-verifies it, so
+        # a zombie incarnation's staged shards are refused typed instead
+        # of merged into a newer generation's shard plan
         self.ledger = (
-            EpochLedger(params.checkpoint_dir)
+            EpochLedger(params.checkpoint_dir, fence=fence)
             if params.checkpoint_dir else None
         )
         self._pending_sources: List[str] = []

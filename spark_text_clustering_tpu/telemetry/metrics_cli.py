@@ -53,6 +53,7 @@ __all__ = [
     "merge_metrics",
     "skew_findings",
     "ledger_health",
+    "fleet_health",
     "cmd_summarize",
     "cmd_diff",
     "cmd_check",
@@ -495,6 +496,103 @@ def ledger_health(events: List[Dict]) -> Optional[Dict]:
     return out
 
 
+def fleet_health(events: List[Dict]) -> Optional[Dict]:
+    """Fleet-health summary from the ``fleet_*`` events a supervisor
+    run emits (docs/RESILIENCE.md "Fleet supervision"): worker count
+    over time, resizes, preemptions survived, mean lease slack.  None
+    when the run never supervised a fleet."""
+    by = {}
+    for e in events:
+        n = e.get("event", "")
+        if isinstance(n, str) and n.startswith("fleet_"):
+            by.setdefault(n, []).append(e)
+    if not by:
+        return None
+    out: Dict = {
+        "spawns": len(by.get("fleet_spawn", ())),
+        "respawns": len(by.get("fleet_respawn", ())),
+        "crashes": len(by.get("fleet_crash", ())),
+        "lease_expiries": len(by.get("fleet_lease_expired", ())),
+        "preemptions": len(by.get("fleet_preempt", ()))
+        + len(by.get("fleet_preempted_externally", ())),
+    }
+    resizes = [
+        {
+            "from": e.get("workers_from"),
+            "to": e.get("workers_to"),
+            "why": e.get("why"),
+        }
+        for e in by.get("fleet_resize", ())
+    ]
+    out["resizes"] = len(resizes)
+    if resizes:
+        out["resize_history"] = resizes
+    sweeps = by.get("fleet_sweep", ())
+    counts = [
+        int(e["workers"]) for e in sweeps if _is_num(e.get("workers"))
+    ]
+    if counts:
+        out["workers"] = {
+            "min": min(counts), "max": max(counts),
+            "final": counts[-1], "sweeps": len(counts),
+        }
+    slacks = [
+        float(e["lease_slack_min"])
+        for e in sweeps
+        if _is_num(e.get("lease_slack_min"))
+    ]
+    if slacks:
+        out["mean_lease_slack_seconds"] = round(
+            sum(slacks) / len(slacks), 6
+        )
+        out["min_lease_slack_seconds"] = round(min(slacks), 6)
+    conv = by.get("fleet_converged", ())
+    if conv:
+        out["converged"] = True
+        if _is_num(conv[-1].get("committed_epochs")):
+            out["committed_epochs"] = int(conv[-1]["committed_epochs"])
+    return out
+
+
+def _print_fleet_health(fh: Dict, file=None) -> None:
+    file = file if file is not None else sys.stdout
+    print("fleet health:", file=file)
+    w = fh.get("workers")
+    if w:
+        print(
+            f"  workers over time: min {w['min']}  max {w['max']}  "
+            f"final {w['final']}  ({w['sweeps']} sweeps)", file=file,
+        )
+    print(
+        f"  spawns: {fh['spawns']}  respawns: {fh['respawns']}  "
+        f"crashes: {fh['crashes']}", file=file,
+    )
+    print(
+        f"  resizes: {fh['resizes']}  preemptions survived: "
+        f"{fh['preemptions']}  lease expiries: {fh['lease_expiries']}",
+        file=file,
+    )
+    for r in fh.get("resize_history", ()):
+        print(
+            f"  resize: {r['from']} -> {r['to']} ({r['why']})",
+            file=file,
+        )
+    if "mean_lease_slack_seconds" in fh:
+        print(
+            f"  lease slack: mean {fh['mean_lease_slack_seconds']:.3f}s"
+            f"  min {fh['min_lease_slack_seconds']:.3f}s", file=file,
+        )
+    if fh.get("converged"):
+        print(
+            f"  converged: yes"
+            + (
+                f" ({fh['committed_epochs']} committed epochs)"
+                if "committed_epochs" in fh else ""
+            ),
+            file=file,
+        )
+
+
 def _print_ledger_health(lh: Dict, file=None) -> None:
     file = file if file is not None else sys.stdout
     print("ledger health:", file=file)
@@ -538,10 +636,13 @@ def _cmd_summarize(args) -> int:
     manifest, events = load_run(args.run)
     metrics = run_metrics(events)
     lh = ledger_health(events)
+    fh = fleet_health(events)
     if getattr(args, "json", False):
         doc = {"manifest": manifest, "metrics": metrics}
         if lh is not None:
             doc["ledger_health"] = lh
+        if fh is not None:
+            doc["fleet_health"] = fh
         print(json.dumps(doc, sort_keys=True))
         return 0
     print(f"run: {args.run}")
@@ -550,6 +651,8 @@ def _cmd_summarize(args) -> int:
     print(f"events: {len(events)}")
     if lh is not None:
         _print_ledger_health(lh)
+    if fh is not None:
+        _print_fleet_health(fh)
     print("metrics:")
     for k in sorted(metrics):
         v = metrics[k]

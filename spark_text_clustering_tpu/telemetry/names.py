@@ -40,6 +40,9 @@ METRICS: Dict[str, str] = {
     # -- resilience (docs/RESILIENCE.md) --------------------------------
     "resilience.retries": "transient failures absorbed by retry_call",
     "resilience.giveups": "retry policies exhausted (RetryGiveUp raised)",
+    "resilience.deadline_giveups":
+        "retry loops stopped by a wall-clock deadline budget (the "
+        "lease-bounded subset of resilience.giveups)",
     "resilience.quarantined": "documents routed to a dead-letter dir",
     "resilience.artifacts_skipped":
         "uncommitted/corrupt model dirs skipped by latest_model_dir",
@@ -53,6 +56,25 @@ METRICS: Dict[str, str] = {
     "ledger.replays_suppressed":
         "committed source files suppressed from re-emission at resume "
         "(the exactly-once half the at-least-once window used to replay)",
+    "ledger.compactions":
+        "committed epoch histories folded into a snapshot record "
+        "(stc stream compact)",
+    "ledger.fence_refusals":
+        "ledger writes refused under a superseded fleet fence token "
+        "(FencedEpochError raised at a zombie worker)",
+    # -- fleet supervision (docs/RESILIENCE.md "Fleet supervision") -----
+    "fleet.workers": "live supervised workers after the last sweep",
+    "fleet.spawns": "worker subprocesses spawned (initial + respawns)",
+    "fleet.respawns": "workers respawned after a death or preemption",
+    "fleet.resizes": "ledger-gated topology changes (scale out/in/plan)",
+    "fleet.preemptions":
+        "drain SIGTERMs observed: escalations, resize drains, and "
+        "externally-preempted workers that drained cleanly",
+    "fleet.lease_expiries":
+        "heartbeat leases that went stale past the timeout (stuck or "
+        "dead worker detected)",
+    "fleet.crashes": "workers that died without a terminal done-lease",
+    "fleet.heartbeats": "lease renewals written by workers",
     # -- quarantine requeue (stc stream requeue) ------------------------
     "requeue.replayed":
         "quarantined documents replayed back into a watch directory",

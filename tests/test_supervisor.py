@@ -1,0 +1,801 @@
+"""Elastic fleet supervisor: preemption-tolerant worker lifecycle.
+
+Covers the fleet ledger + fence tokens (zombie writes refused typed),
+the worker lease/heartbeat protocol, SIGTERM drain (the simulated
+preemption notice), the supervisor loop against stub workers (lease
+expiry -> SIGTERM -> SIGKILL escalation, external-preemption respawn,
+queue-depth scale-out), ledger compaction round trips, the retry
+deadline budget, and the real-worker subprocess chaos sweeps: kills at
+spawn / mid-epoch / at-heartbeat / at-resize for both scale-out and
+scale-in, asserting the resumed ``stream-score`` output is
+byte-identical to an uninterrupted run and no source is ever committed
+twice.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from spark_text_clustering_tpu import telemetry
+from spark_text_clustering_tpu.resilience import (
+    EpochLedger,
+    FencedEpochError,
+    RetryGiveUp,
+    RetryPolicy,
+    configure_lease_deadline,
+    faultinject,
+    retry_call,
+)
+from spark_text_clustering_tpu.resilience.supervisor import (
+    FleetFence,
+    FleetLedger,
+    FleetSupervisor,
+    PreemptionNotice,
+    WorkerLease,
+    fleet_committed_sources,
+    lease_path,
+    partition_of,
+    read_lease,
+    worker_dir,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults_and_registry():
+    faultinject.reset()
+    telemetry.get_registry().reset()
+    configure_lease_deadline(None)
+    yield
+    faultinject.reset()
+    telemetry.shutdown()
+    telemetry.get_registry().reset()
+    configure_lease_deadline(None)
+
+
+# ---------------------------------------------------------------------------
+# Partition, fleet ledger, fence
+# ---------------------------------------------------------------------------
+class TestPartition:
+    def test_deterministic_and_complete(self):
+        names = [f"doc{i:02d}.txt" for i in range(40)]
+        for count in (1, 2, 3, 5):
+            owners = [partition_of(n, count) for n in names]
+            assert owners == [partition_of(n, count) for n in names]
+            assert all(0 <= o < count for o in owners)
+            # every worker owns SOMETHING (a partition that starves a
+            # worker defeats the resize controller it feeds)
+            assert len(set(owners)) == count
+
+    def test_keyed_on_basename(self):
+        assert partition_of("/a/b/doc.txt", 3) == partition_of(
+            "/x/doc.txt", 3
+        )
+
+
+class TestFleetLedger:
+    def test_append_and_current(self, tmp_path):
+        fl = FleetLedger(str(tmp_path))
+        assert fl.current() is None
+        fl.append(kind="spawn", generation=0, worker_count=2,
+                  spawn_ids={0: 0, 1: 1})
+        fl.append(kind="resize", generation=1, worker_count=3,
+                  spawn_ids={0: 2, 1: 3, 2: 4})
+        cur = fl.current()
+        assert cur["generation"] == 1 and cur["worker_count"] == 3
+        assert cur["spawn_ids"] == {"0": 2, "1": 3, "2": 4}
+
+    def test_torn_tail_tolerated(self, tmp_path):
+        fl = FleetLedger(str(tmp_path))
+        fl.append(kind="spawn", generation=0, worker_count=1,
+                  spawn_ids={0: 0})
+        with open(fl.path, "a") as f:
+            f.write('{"kind": "resize", "torn mid-ap')
+        assert FleetLedger(str(tmp_path)).current()["generation"] == 0
+
+
+class TestFence:
+    def _fleet(self, tmp_path):
+        fl = FleetLedger(str(tmp_path))
+        fl.append(kind="spawn", generation=0, worker_count=2,
+                  spawn_ids={0: 0, 1: 1})
+        return fl
+
+    def test_valid_token_passes(self, tmp_path):
+        telemetry.configure(None)
+        self._fleet(tmp_path)
+        fence = FleetFence(str(tmp_path), 0, 0, 0)
+        led = EpochLedger(worker_dir(str(tmp_path), 0), fence=fence)
+        led.begin(0, kind="stream-score", sources=["a"], payloads=[])
+        led.commit(0, kind="stream-score", sources=["a"])
+        assert led.last_committed() == 0
+
+    def test_superseded_spawn_id_refused_typed(self, tmp_path):
+        """The zombie scenario: a respawn bumped worker 0's spawn id;
+        the old incarnation's next ledger write must raise
+        FencedEpochError — refused, never merged."""
+        telemetry.configure(None)
+        fl = self._fleet(tmp_path)
+        zombie = FleetFence(str(tmp_path), 0, 0, 0)
+        led = EpochLedger(worker_dir(str(tmp_path), 0), fence=zombie)
+        led.begin(0, kind="stream-score", sources=["a"], payloads=[])
+        fl.append(kind="respawn", generation=0, worker_count=2,
+                  spawn_ids={0: 2, 1: 1})
+        with pytest.raises(FencedEpochError, match="superseded"):
+            led.commit(0, kind="stream-score", sources=["a"])
+        snap = telemetry.get_registry().snapshot()
+        assert snap["counters"]["ledger.fence_refusals"] == 1
+
+    def test_resize_generation_fences_all_old_tokens(self, tmp_path):
+        telemetry.configure(None)
+        fl = self._fleet(tmp_path)
+        fl.append(kind="resize", generation=1, worker_count=3,
+                  spawn_ids={0: 2, 1: 3, 2: 4})
+        old = FleetFence(str(tmp_path), 0, 1, 1)
+        led = EpochLedger(worker_dir(str(tmp_path), 1), fence=old)
+        with pytest.raises(FencedEpochError):
+            led.begin(0, kind="stream-score", sources=[], payloads=[])
+        new = FleetFence(str(tmp_path), 1, 1, 3)
+        led2 = EpochLedger(worker_dir(str(tmp_path), 1), fence=new)
+        led2.begin(0, kind="stream-score", sources=[], payloads=[])
+
+    def test_staged_shard_refused_under_stale_fence(self, tmp_path):
+        telemetry.configure(None)
+        fl = self._fleet(tmp_path)
+        fence = FleetFence(str(tmp_path), 0, 0, 0)
+        led = EpochLedger(worker_dir(str(tmp_path), 0), fence=fence)
+        led.begin(0, kind="stream-train", sources=["a"],
+                  payloads=["stream_state-e000000-p0.npz"])
+        fl.append(kind="respawn", generation=0, worker_count=2,
+                  spawn_ids={0: 9, 1: 1})
+        with pytest.raises(FencedEpochError):
+            led.stage_shard(
+                0, 0, 1, cols=(0, 4), step=1,
+                lam=np.ones((2, 4), np.float32),
+            )
+
+
+# ---------------------------------------------------------------------------
+# Lease + preemption notice
+# ---------------------------------------------------------------------------
+class TestWorkerLease:
+    def test_beat_rate_limited_and_readable(self, tmp_path):
+        telemetry.configure(None)
+        lp = str(tmp_path / "lease.json")
+        lease = WorkerLease(lp, interval=10.0, worker_index=1,
+                            generation=2, spawn_id=3)
+        assert lease.beat(queue_depth=5, epoch=7) is True
+        assert lease.beat(queue_depth=9) is False       # rate limited
+        got = read_lease(lp)
+        assert got["worker"] == 1 and got["generation"] == 2
+        assert got["spawn_id"] == 3 and got["queue_depth"] == 5
+        assert got["epoch"] == 7 and got["pid"] == os.getpid()
+        snap = telemetry.get_registry().snapshot()
+        assert snap["counters"]["fleet.heartbeats"] == 1
+
+    def test_mark_done_terminal_state(self, tmp_path):
+        telemetry.configure(None)
+        lp = str(tmp_path / "lease.json")
+        lease = WorkerLease(lp, interval=10.0)
+        lease.mark_done("preempted", epoch=4)
+        got = read_lease(lp)
+        assert got["done"] is True and got["reason"] == "preempted"
+
+    def test_heartbeat_fault_site_fires(self, tmp_path):
+        telemetry.configure(None)
+        faultinject.configure("worker.heartbeat:ioerror@1.0")
+        lease = WorkerLease(str(tmp_path / "l.json"), interval=0.0)
+        with pytest.raises(RetryGiveUp):
+            lease.beat(force=True)
+
+    def test_torn_lease_reads_as_absent(self, tmp_path):
+        lp = tmp_path / "lease.json"
+        lp.write_text('{"pid": 1, "torn')
+        assert read_lease(str(lp)) is None
+        assert read_lease(str(tmp_path / "missing.json")) is None
+
+
+class TestPreemptionNotice:
+    def test_sigterm_sets_flag_and_stream_drains(self, tmp_path):
+        from spark_text_clustering_tpu.streaming import FileStreamSource
+
+        telemetry.configure(None)
+        watch = tmp_path / "watch"
+        watch.mkdir()
+        for i in range(4):
+            (watch / f"d{i}.txt").write_text(f"doc {i}")
+        notice = PreemptionNotice().install()
+        src = FileStreamSource(str(watch), max_files_per_trigger=1)
+        seen = []
+        for mb in src.stream(poll_interval=0.01, idle_timeout=5.0,
+                             stop=notice):
+            seen.append(mb.names[0])
+            if len(seen) == 2:
+                os.kill(os.getpid(), signal.SIGTERM)
+        # the in-flight trigger finished; the stream ended cleanly
+        # instead of running the source dry
+        assert len(seen) == 2
+        assert notice.requested
+
+
+# ---------------------------------------------------------------------------
+# Retry deadline budget (the lease-bounded retry satellite)
+# ---------------------------------------------------------------------------
+class TestRetryDeadline:
+    def _boom(self):
+        raise OSError("injected")
+
+    def test_deadline_seconds_bounds_the_loop(self):
+        telemetry.configure(None)
+        t0 = time.monotonic()
+        with pytest.raises(RetryGiveUp) as ei:
+            retry_call(
+                self._boom, site="dl",
+                policy=RetryPolicy(
+                    attempts=1000, base_delay=0.02, max_delay=0.05,
+                    deadline_seconds=0.2,
+                ),
+            )
+        assert ei.value.deadline_exceeded
+        assert time.monotonic() - t0 < 2.0
+        snap = telemetry.get_registry().snapshot()
+        assert snap["counters"]["resilience.deadline_giveups"] == 1
+        assert snap["counters"]["resilience.giveups"] == 1
+
+    def test_lease_cap_bounds_every_policy(self):
+        telemetry.configure(None)
+        configure_lease_deadline(0.15)
+        with pytest.raises(RetryGiveUp) as ei:
+            retry_call(
+                self._boom, site="dl2",
+                policy=RetryPolicy(attempts=1000, base_delay=0.02,
+                                   max_delay=0.05),
+            )
+        assert ei.value.deadline_exceeded
+
+    def test_attempt_exhaustion_is_not_a_deadline_giveup(self):
+        telemetry.configure(None)
+        with pytest.raises(RetryGiveUp) as ei:
+            retry_call(
+                self._boom, site="dl3",
+                policy=RetryPolicy(attempts=2, base_delay=0.0),
+            )
+        assert not ei.value.deadline_exceeded
+        snap = telemetry.get_registry().snapshot()
+        assert "resilience.deadline_giveups" not in snap["counters"]
+
+    def test_zero_budget_raises_typed_not_assert(self):
+        telemetry.configure(None)
+        with pytest.raises(RetryGiveUp) as ei:
+            retry_call(
+                self._boom, site="dl4",
+                policy=RetryPolicy(attempts=3, deadline_seconds=0.0),
+            )
+        assert ei.value.deadline_exceeded
+
+
+# ---------------------------------------------------------------------------
+# Ledger compaction round trip
+# ---------------------------------------------------------------------------
+class TestCompaction:
+    def test_score_ledger_resume_after_compact_equals_before(
+        self, tmp_path
+    ):
+        telemetry.configure(None)
+        d = str(tmp_path)
+        led = EpochLedger(d)
+        for e in range(4):
+            p = os.path.join(d, f"r{e}")
+            with open(p, "w") as f:
+                f.write(f"report {e}")
+            led.begin(e, kind="stream-score", sources=[f"s{e}"],
+                      payloads=[p])
+            led.commit(e, kind="stream-score", sources=[f"s{e}"],
+                       payloads={f"r{e}": p})
+        before = (led.last_committed(), led.committed_sources(),
+                  led.next_epoch())
+        snap = led.compact()
+        assert snap["compacted_epochs"] == 4
+        assert len(open(led.path).read().splitlines()) == 1
+        led2 = EpochLedger(d)
+        assert (led2.last_committed(), led2.committed_sources(),
+                led2.next_epoch()) == before
+        # recover() must not roll anything back post-compact
+        rep = led2.recover()
+        assert rep.rolled_back == [] and rep.quarantined == []
+        reg = telemetry.get_registry().snapshot()
+        assert reg["counters"]["ledger.compactions"] == 1
+
+    def test_trainer_resume_after_compact_equals_before(self, tmp_path):
+        """The satellite's round-trip proof: a trainer resumed from a
+        compacted ledger is state-identical to one resumed from the
+        full history — shards, step, and counters all survive the
+        fold."""
+        from spark_text_clustering_tpu.config import Params
+        from spark_text_clustering_tpu.streaming import (
+            MicroBatch,
+            StreamingOnlineLDA,
+        )
+
+        telemetry.configure(None)
+        ck = str(tmp_path / "ck")
+
+        def trainer():
+            return StreamingOnlineLDA(
+                Params(k=2, algorithm="online", seed=0,
+                       checkpoint_dir=ck),
+                num_features=64, lemmatize=False, batch_capacity=8,
+                row_len=32, checkpoint_every=1,
+            )
+
+        docs = [
+            "piano violin orchestra symphony concerto melody",
+            "electron proton neutron quantum particle physics",
+        ]
+        t1 = trainer()
+        t1.process(MicroBatch(0, ["a", "b"], docs))
+        t1.process(MicroBatch(1, ["c", "d"], list(reversed(docs))))
+        ref = trainer()                     # resume BEFORE compact
+        snap = EpochLedger(ck).compact()
+        assert snap is not None and snap.get("shards")
+        t2 = trainer()                      # resume AFTER compact
+        assert int(t2.state.step) == int(ref.state.step)
+        assert t2.docs_seen == ref.docs_seen
+        assert t2.batches_seen == ref.batches_seen
+        np.testing.assert_allclose(
+            np.asarray(t2.model().lam), np.asarray(ref.model().lam)
+        )
+        # and training continues: the epoch counter keeps counting
+        t2.process(MicroBatch(2, ["e", "f"], docs))
+        assert EpochLedger(ck).last_committed() == snap["epoch"] + 1
+
+    def test_compact_refuses_open_transaction(self, tmp_path):
+        from spark_text_clustering_tpu.resilience import ResilienceError
+
+        telemetry.configure(None)
+        led = EpochLedger(str(tmp_path))
+        led.begin(0, kind="t", sources=[], payloads=[])
+        led.commit(0, kind="t", sources=[])
+        led.begin(1, kind="t", sources=[], payloads=[])
+        led.commit(1, kind="t", sources=[])
+        led.begin(2, kind="t", sources=["x"], payloads=[])
+        with pytest.raises(ResilienceError, match="intent"):
+            led.compact()
+
+    def test_compact_nothing_to_fold(self, tmp_path):
+        telemetry.configure(None)
+        led = EpochLedger(str(tmp_path))
+        assert led.compact() is None
+        led.begin(0, kind="t", sources=[], payloads=[])
+        led.commit(0, kind="t", sources=[])
+        assert led.compact() is None        # single record: no-op
+
+    def test_cli_verb(self, tmp_path, capsys):
+        from spark_text_clustering_tpu.cli import main
+
+        telemetry.configure(None)
+        d = str(tmp_path)
+        led = EpochLedger(d)
+        for e in range(3):
+            led.begin(e, kind="t", sources=[f"s{e}"], payloads=[])
+            led.commit(e, kind="t", sources=[f"s{e}"])
+        rc = main(["stream", "compact", "--checkpoint-dir", d])
+        assert rc == 0
+        assert "compacted 3 committed records" in capsys.readouterr().out
+        assert EpochLedger(d).committed_sources() == {"s0", "s1", "s2"}
+
+
+# ---------------------------------------------------------------------------
+# Supervisor loop against stub workers (no jax — fast lifecycle tests)
+# ---------------------------------------------------------------------------
+STUB = r"""
+import json, os, signal, sys, time
+
+lease, gen, sid = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+mode = os.environ.get("STUB_MODE", "clean")
+beats = int(os.environ.get("STUB_BEATS", "4"))
+depth = int(os.environ.get("STUB_DEPTH", "0"))
+signal.signal(signal.SIGTERM, lambda s, f: None)   # ignore drains
+
+def write(**kw):
+    payload = {"pid": os.getpid(), "generation": gen, "spawn_id": sid,
+               "ts": time.time(), "queue_depth": depth, **kw}
+    tmp = lease + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(payload, f)
+    os.replace(tmp, lease)
+
+write()
+if mode == "hang" and sid < 10:
+    time.sleep(3600)
+if mode == "preempt" and sid < 10:
+    write(done=True, reason="preempted")
+    sys.exit(0)
+if mode == "crash" and sid < 10:
+    os._exit(137)
+for _ in range(beats):
+    time.sleep(0.08)
+    write()
+write(done=True, reason="idle")
+"""
+
+
+def _stub_argv_builder(tmp_path, fleet):
+    stub = tmp_path / "stub.py"
+    stub.write_text(STUB)
+
+    def build(index, count, generation, spawn_id):
+        return [sys.executable, str(stub), lease_path(fleet, index),
+                str(generation), str(spawn_id)]
+
+    return build
+
+
+def _sup(tmp_path, fleet, mode, **kw):
+    env = {
+        k: v for k, v in os.environ.items()
+        if k not in (faultinject.ENV_SPEC, faultinject.ENV_SEED)
+    }
+    env["STUB_MODE"] = mode
+    env.update(kw.pop("stub_env", {}))
+    base = dict(
+        workers=2, lease_timeout=1.0, grace_seconds=0.4,
+        sweep_interval=0.1, startup_grace_seconds=10.0, env=env,
+    )
+    base.update(kw)
+    return FleetSupervisor(
+        fleet, _stub_argv_builder(tmp_path, fleet), **base
+    )
+
+
+class TestSupervisorStubFleet:
+    def test_clean_fleet_converges(self, tmp_path):
+        telemetry.configure(None)
+        fleet = str(tmp_path / "fleet")
+        rep = _sup(tmp_path, fleet, "clean").run()
+        assert rep.converged and rep.spawns == 2
+        assert rep.respawns == 0 and rep.lease_expiries == 0
+        cur = FleetLedger(fleet).current()
+        assert cur["kind"] == "spawn" and cur["worker_count"] == 2
+
+    def test_hung_worker_escalates_and_respawns(self, tmp_path):
+        """The full ladder: a worker that stops heartbeating (alive,
+        SIGTERM-deaf) is detected by lease expiry, SIGKILLed, recovered,
+        and respawned under a fresh spawn id — spawn ids >= 10 run the
+        stub clean, so only the original incarnation hangs."""
+        telemetry.configure(None)
+        fleet = str(tmp_path / "fleet")
+        sup = _sup(tmp_path, fleet, "hang")
+        sup._next_spawn_id = 9      # spawn ids 9,10 -> only w0 hangs
+        rep = sup.run()
+        assert rep.converged
+        assert rep.lease_expiries == 1 and rep.respawns == 1
+        snap = telemetry.get_registry().snapshot()
+        assert snap["counters"]["fleet.lease_expiries"] == 1
+        assert snap["counters"]["fleet.spawns"] == 3
+        # the respawn superseded the hung incarnation in the fence log
+        cur = FleetLedger(fleet).current()
+        assert cur["kind"] == "respawn"
+
+    def test_crashed_worker_respawns(self, tmp_path):
+        telemetry.configure(None)
+        fleet = str(tmp_path / "fleet")
+        sup = _sup(tmp_path, fleet, "crash")
+        sup._next_spawn_id = 9
+        rep = sup.run()
+        assert rep.converged and rep.crashes == 1 and rep.respawns == 1
+
+    def test_external_preemption_survived(self, tmp_path):
+        """A worker that drains after an EXTERNAL SIGTERM (done lease,
+        reason=preempted, supervisor never asked) is respawned and the
+        survival is counted."""
+        telemetry.configure(None)
+        fleet = str(tmp_path / "fleet")
+        sup = _sup(tmp_path, fleet, "preempt")
+        sup._next_spawn_id = 9
+        rep = sup.run()
+        assert rep.converged and rep.preemptions == 1
+        assert rep.respawns == 1
+
+    def test_queue_depth_scale_out(self, tmp_path):
+        telemetry.configure(None)
+        fleet = str(tmp_path / "fleet")
+        rep = _sup(
+            tmp_path, fleet, "clean",
+            stub_env={"STUB_DEPTH": "8", "STUB_BEATS": "12"},
+            scale_out_depth=10, scale_out_sweeps=2, max_workers=3,
+        ).run()
+        assert rep.converged and rep.resizes >= 1
+        assert rep.resize_history[0] == 3
+        cur = FleetLedger(fleet).current()
+        assert cur["worker_count"] == 3 and cur["generation"] >= 1
+
+    def test_respawn_budget_aborts_loudly(self, tmp_path):
+        from spark_text_clustering_tpu.resilience import ResilienceError
+
+        telemetry.configure(None)
+        fleet = str(tmp_path / "fleet")
+        sup = _sup(tmp_path, fleet, "crash", max_respawns=2, workers=1)
+        # every incarnation crashes: spawn ids stay < 10
+        with pytest.raises(ResilienceError, match="respawn budget"):
+            sup.run()
+        # no orphan processes left behind
+        for w in sup._procs.values():
+            assert w.proc.poll() is not None
+
+
+# ---------------------------------------------------------------------------
+# Real-worker subprocess sweeps (stream-score fleets through the CLI)
+# ---------------------------------------------------------------------------
+def _run_cli(args, timeout=300):
+    env = dict(os.environ)
+    env.pop(faultinject.ENV_SPEC, None)
+    env.pop(faultinject.ENV_SEED, None)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    return subprocess.run(
+        [sys.executable, "-m", "spark_text_clustering_tpu.cli", *args],
+        cwd=REPO, env=env, capture_output=True, text=True,
+        timeout=timeout,
+    )
+
+
+@pytest.fixture(scope="module")
+def fleet_fixture(tmp_path_factory):
+    """One trained model + a 6-file watch corpus shared by every fleet
+    run in the module."""
+    from spark_text_clustering_tpu.models.base import LDAModel
+
+    root = tmp_path_factory.mktemp("fleet")
+    rng = np.random.default_rng(0)
+    v = 64
+    model = LDAModel(
+        lam=rng.random((2, v)).astype(np.float32) + 0.1,
+        vocab=[f"h{i}" for i in range(v)],
+        alpha=np.full(2, 0.5, np.float32),
+        eta=0.1,
+    )
+    model_dir = str(root / "models" / "LdaModel_EN_1000")
+    model.save(model_dir)
+    watch = root / "watch"
+    watch.mkdir()
+    pools = ["piano violin orchestra symphony concerto melody",
+             "electron proton neutron quantum particle physics"]
+    for i in range(6):
+        (watch / f"doc{i:02d}.txt").write_text(f"{pools[i % 2]} tok{i}")
+    return {"root": root, "watch": str(watch), "model": model_dir}
+
+
+def _supervise_args(fx, tag, workers=2, extra=()):
+    root = fx["root"]
+    return [
+        "supervise", "--role", "stream-score",
+        "--watch-dir", fx["watch"],
+        "--fleet-dir", str(root / f"fleet_{tag}"),
+        "--workers", str(workers),
+        "--heartbeat-interval", "0.2", "--lease-timeout", "2.5",
+        "--grace-seconds", "1.0", "--sweep-interval", "0.15",
+        "--poll-interval", "0.05", "--idle-timeout", "0.8",
+        "--max-files-per-trigger", "1", "--no-lemmatize",
+        "--model", fx["model"],
+        "--output-dir", str(root / f"out_{tag}"),
+        "--telemetry-file", str(root / f"sup_{tag}.jsonl"),
+        *extra,
+    ]
+
+
+def _out_tree(root, tag):
+    base = str(root / f"out_{tag}")
+    tree = {}
+    for d, _, files in os.walk(base):
+        for n in files:
+            p = os.path.join(d, n)
+            tree[os.path.relpath(p, base)] = open(p).read()
+    return tree
+
+
+def _assert_exactly_once(fx, tag):
+    fleet = str(fx["root"] / f"fleet_{tag}")
+    srcs = sorted(fleet_committed_sources(fleet))
+    per = []
+    for n in sorted(os.listdir(fleet)):
+        wd = os.path.join(fleet, n)
+        if n.startswith("w") and os.path.isdir(wd):
+            for r in EpochLedger(wd).records():
+                per.extend(r.get("sources", ()))
+    assert len(per) == len(set(per)), f"{tag}: a source committed twice"
+    watched = {
+        os.path.join(fx["watch"], n)
+        for n in os.listdir(fx["watch"])
+    }
+    assert set(srcs) == watched, f"{tag}: sources lost or foreign"
+
+
+@pytest.fixture(scope="module")
+def uninterrupted(fleet_fixture):
+    r = _run_cli(_supervise_args(fleet_fixture, "ref"))
+    assert r.returncode == 0, r.stderr[-2000:]
+    return _out_tree(fleet_fixture["root"], "ref")
+
+
+class TestFleetChaosSweep:
+    @pytest.mark.parametrize(
+        "phase,chaos",
+        [
+            # killed before any work: dies at the very first lease beat
+            ("spawn", "0:worker.heartbeat:kill@1"),
+            # killed mid-epoch: at the commit append (the commit point)
+            ("mid_epoch", "0:ledger.commit:kill@1"),
+            # live-but-stuck: stops heartbeating, ignores the drain,
+            # only the SIGKILL escalation reclaims it
+            ("heartbeat", "0:worker.heartbeat:hang@3"),
+        ],
+    )
+    def test_kill_sweep_byte_identical(
+        self, fleet_fixture, uninterrupted, phase, chaos
+    ):
+        """The acceptance drill: for every injected fault the fleet
+        reconverges and the final report tree is byte-for-byte the
+        uninterrupted run's."""
+        fx = fleet_fixture
+        r = _run_cli(_supervise_args(
+            fx, phase, extra=["--chaos-worker", chaos],
+        ))
+        assert r.returncode == 0, (phase, r.stderr[-2000:])
+        assert _out_tree(fx["root"], phase) == uninterrupted, phase
+        _assert_exactly_once(fx, phase)
+        summary = r.stdout.strip().splitlines()[-1]
+        assert "fleet converged" in summary, (phase, summary)
+        if phase == "heartbeat":
+            assert "1 lease expiry" in summary, summary
+
+    @pytest.mark.parametrize(
+        "tag,workers,plan,chaos",
+        [
+            # scale-out 2->3 with a worker hung when the drain arrives:
+            # the resize SIGKILLs it mid-drain, rolls its epoch back,
+            # and the new partition re-ingests the lost files
+            ("resize_out", 2, "2:3", "0:worker.heartbeat:hang@4"),
+            # scale-in 3->2, kill at a commit append en route
+            ("resize_in", 3, "2:2", "1:ledger.commit:kill@1"),
+        ],
+    )
+    def test_resize_sweep_exactly_once(
+        self, fleet_fixture, uninterrupted, tag, workers, plan, chaos
+    ):
+        """Kill-during-resize for both directions.  Which worker scores
+        which file depends on when the resize lands, so equivalence is
+        asserted at the CONTENT level: one file per trigger means each
+        report's bytes are a pure function of its document — the
+        multiset of report contents must match the uninterrupted run's
+        exactly (no duplicates, no losses, no zombie merges)."""
+        fx = fleet_fixture
+        r = _run_cli(_supervise_args(
+            fx, tag, workers=workers,
+            extra=["--resize-at", plan, "--chaos-worker", chaos,
+                   "--grace-seconds", "0.6"],
+        ))
+        assert r.returncode == 0, (tag, r.stderr[-2000:])
+        got = sorted(_out_tree(fx["root"], tag).values())
+        want = sorted(uninterrupted.values())
+        assert got == want, tag
+        _assert_exactly_once(fx, tag)
+        assert "1 resize" in r.stdout, r.stdout.splitlines()[-1:]
+        fleet = str(fx["root"] / f"fleet_{tag}")
+        kinds = [rec["kind"] for rec in FleetLedger(fleet).records()]
+        assert "resize" in kinds
+
+    def test_supervisor_telemetry_readable(self, fleet_fixture,
+                                           uninterrupted):
+        """The ref run's supervisor stream carries a fleet-health
+        section (metrics summarize satellite)."""
+        from spark_text_clustering_tpu.telemetry.metrics_cli import (
+            fleet_health,
+            load_run,
+        )
+
+        _, events = load_run(
+            str(fleet_fixture["root"] / "sup_ref.jsonl")
+        )
+        fh = fleet_health(events)
+        assert fh is not None and fh["converged"]
+        assert fh["spawns"] == 2 and fh["respawns"] == 0
+        assert fh["workers"]["max"] == 2
+        assert "mean_lease_slack_seconds" in fh
+
+
+class TestTrainFleet:
+    def test_supervised_train_fleet_chaos_exactly_once(
+        self, fleet_fixture
+    ):
+        """A stream-train fleet under a kill-at-commit fault: the
+        supervisor respawns the crashed worker, no file is ever
+        double-trained, and every worker publishes a loadable model at
+        convergence."""
+        from spark_text_clustering_tpu.models.persistence import (
+            latest_model_dir,
+            load_model,
+        )
+
+        fx = fleet_fixture
+        root = fx["root"]
+        r = _run_cli([
+            "supervise", "--role", "stream-train",
+            "--watch-dir", fx["watch"],
+            "--fleet-dir", str(root / "fleet_train"),
+            "--workers", "2",
+            "--heartbeat-interval", "0.2", "--lease-timeout", "2.5",
+            "--grace-seconds", "1.0", "--sweep-interval", "0.15",
+            "--poll-interval", "0.05", "--idle-timeout", "0.8",
+            "--max-files-per-trigger", "1", "--no-lemmatize",
+            "--k", "2", "--hash-features", "64",
+            "--checkpoint-interval", "1",
+            "--chaos-worker", "0:ledger.commit:kill@1",
+            "--models-dir", str(root / "models_train"),
+        ])
+        assert r.returncode == 0, r.stderr[-2000:]
+        assert "fleet converged" in r.stdout
+        _assert_exactly_once(fx, "train")
+        for w in ("w000", "w001"):
+            d = latest_model_dir(str(root / "models_train" / w), "EN")
+            assert d is not None
+            assert load_model(d).k == 2
+
+
+class TestStandalonePreemption:
+    def test_sigterm_drains_and_resume_completes(self, fleet_fixture):
+        """The simulated preemption notice against a BARE (unsupervised)
+        stream-score: SIGTERM ends the stream cleanly after the
+        in-flight trigger; a resumed run emits exactly the reports the
+        uninterrupted run would."""
+        fx = fleet_fixture
+        root = fx["root"]
+        out = str(root / "out_preempt")
+        ckpt = str(root / "ck_preempt")
+        args = [
+            "stream-score", "--watch-dir", fx["watch"],
+            "--model", fx["model"], "--output-dir", out,
+            "--checkpoint-dir", ckpt, "--no-lemmatize",
+            "--max-files-per-trigger", "1",
+            "--poll-interval", "0.05", "--idle-timeout", "30",
+        ]
+        env = dict(os.environ)
+        env.pop(faultinject.ENV_SPEC, None)
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "spark_text_clustering_tpu.cli",
+             *args],
+            cwd=REPO, env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE, text=True,
+        )
+        # preempt once the first report landed (the stream is live)
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            if os.path.isdir(out) and os.listdir(out):
+                break
+            time.sleep(0.05)
+        else:
+            proc.kill()
+            pytest.fail("stream never produced a first report")
+        proc.send_signal(signal.SIGTERM)
+        stdout, stderr = proc.communicate(timeout=120)
+        assert proc.returncode == 0, stderr[-2000:]
+        assert "preemption notice honored" in stdout
+        emitted = set(os.listdir(out))
+        assert emitted                      # partial output, committed
+        # resume with a short idle timeout: finishes the remainder
+        r2 = _run_cli(args[:-1] + ["0.5"])
+        assert r2.returncode == 0, r2.stderr[-2000:]
+        assert len(os.listdir(out)) == 6    # 6 files, 1 per trigger
+        # nothing re-emitted: the preempted run's reports survive as-is
+        led = EpochLedger(ckpt)
+        srcs = [
+            s for rec in led.records() for s in rec.get("sources", ())
+        ]
+        assert len(srcs) == len(set(srcs)) == 6
