@@ -652,6 +652,8 @@ def _bench_online():
     )
     roofline["inner_iters_early_final"] = inners
     roofline["token_layout"] = opt.last_layout
+    roofline["gamma_backend"] = opt.last_gamma_backend
+    roofline["dispatches"] = opt.last_dispatches
     roofline["batch_cells"] = int(cells)
     sys.stderr.write(
         f"# online: {len(rows)} docs, V={ONLINE_NUM_FEATURES}, k={ONLINE_K}, "
@@ -807,7 +809,12 @@ def _bench_sklearn_baseline(rows, eval_rows, bsz: int):
 def _bench_nmf(rows):
     """BASELINE.md row-4: our MU NMF vs sklearn's MU solver on the same
     20NG-shaped rows — same update rule, k, iteration count, and init
-    family, so the ratio compares implementations."""
+    family, so the ratio compares implementations.  The primary row is
+    the packed/fused tier (auto layout — ROADMAP item 2); the PADDED
+    unfused path (the BENCH_r05 0.22x configuration) rides along as an
+    in-record A/B so the fusion win is attributed, and `metrics
+    roofline` sees both executables (nmf.packed_chunk/nmf.fused_chunk
+    vs nmf.chunk_runner)."""
     import jax
 
     from spark_text_clustering_tpu.config import Params
@@ -837,6 +844,24 @@ def _bench_nmf(rows):
         ),
         seconds=t / NMF_ITERS,
     )
+    roofline["token_layout"] = est.last_layout
+    roofline["mu_backend"] = est.last_mu_backend
+    roofline["cells"] = int(est.last_cells)
+
+    # fused-vs-unfused A/B: the same fit forced onto the padded grid
+    est_u = NMF(params.replace(token_layout="padded"), mesh=mesh)
+    est_u.fit(rows, vocab)        # warm
+    t0 = time.perf_counter()
+    est_u.fit(rows, vocab)
+    t_unfused = time.perf_counter() - t0
+    unfused = {
+        "token_layout": "padded",
+        "seconds": round(t_unfused, 2),
+        "docs_per_sec": round(NMF_ITERS * len(rows) / t_unfused, 1),
+        "frobenius_err": round(float(np.sqrt(est_u.last_loss)), 2),
+        "cells": int(est_u.last_cells),
+        "speedup_fused_vs_unfused": round(t_unfused / t, 2),
+    }
 
     import scipy.sparse as sp
     from sklearn.decomposition import NMF as SkNMF
@@ -870,7 +895,9 @@ def _bench_nmf(rows):
         "iterations": NMF_ITERS,
         "docs_per_sec": round(docs_per_sec, 1),
         "frobenius_err": round(err_ours, 2),
+        "dispatches": est.last_dispatches,
         "roofline": roofline,
+        "unfused_baseline": unfused,
         "cpu_baseline": {
             "tool": "sklearn NMF solver=mu (same rule/k/iters)",
             "seconds": round(t_sk, 2),
@@ -884,7 +911,9 @@ def _bench_nmf(rows):
         rec["vs_baseline"] = ratio
     sys.stderr.write(
         f"# nmf: {NMF_ITERS} iters, ours {t:.1f}s ({docs_per_sec:.0f} "
-        f"docs/s, err {err_ours:.1f}), sklearn {t_sk:.1f}s "
+        f"docs/s, err {err_ours:.1f}, {est.last_layout}/"
+        f"{est.last_mu_backend}), unfused {t_unfused:.1f}s "
+        f"({unfused['docs_per_sec']:.0f} docs/s), sklearn {t_sk:.1f}s "
         f"({sk_docs_per_sec:.0f} docs/s, err {err_sk:.1f})\n"
     )
     return rec

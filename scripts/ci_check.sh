@@ -29,11 +29,14 @@
 #      tier-1 as tests/test_ledger.py), resumed, and the resumed run's
 #      ledger counters (commits, rollbacks) gated against the committed
 #      baseline via `metrics check --include ledger.`
-#   9. recompile sentinel: the gate-5 train stream plus a score run are
-#      checked against scripts/records/compile_baseline.json (`metrics
-#      compile-check`) — more distinct compiled signatures per dispatch
-#      label than committed means an unbucketed shape is re-tracing a
-#      hot loop; a planted retrace storm must gate red (self-test)
+#   9. recompile sentinel: the gate-5 train stream plus a score run and
+#      an NMF fit+transform run (the packed chunk + the BUCKETED
+#      nmf.solve_w transform path) are checked against
+#      scripts/records/compile_baseline.json (`metrics compile-check`)
+#      — more distinct compiled signatures per dispatch label than
+#      committed means an unbucketed shape (or an unbucketed
+#      n_iter) is re-tracing a hot loop; a planted retrace storm must
+#      gate red (self-test)
 #  10. supervisor drill: a 2-worker `stc supervise` stream-score fleet
 #      with one worker wedged mid-epoch under STC_FAULTS
 #      (worker.heartbeat:hang — alive, silent, SIGTERM-deaf); the
@@ -144,6 +147,41 @@ run_ci_score() {
         --books "$workdir/books" --models-dir "$workdir/models" \
         --lang EN --no-lemmatize --output-dir "$workdir/score_out" \
         --telemetry-file "$workdir/score.jsonl" >/dev/null
+}
+
+run_ci_nmf() {
+    # tiny deterministic NMF fit + transform under the compile
+    # sentinel: the packed-chunk fit path and the BUCKETED nmf.solve_w
+    # transform path both announce their signatures — a solve_w
+    # recompile storm (the pre-bucketing hazard: one executable per
+    # distinct n_iter) now gates red at stage 9
+    local workdir="$1"
+    python - "$workdir" <<'EOF'
+import sys
+
+import numpy as np
+
+from spark_text_clustering_tpu import telemetry
+from spark_text_clustering_tpu.config import Params
+from spark_text_clustering_tpu.models.nmf import NMF
+
+workdir = sys.argv[1]
+telemetry.configure(f"{workdir}/nmf.jsonl")
+telemetry.manifest(kind="ci-nmf")
+rng = np.random.default_rng(0)
+rows = []
+for d in range(12):
+    ids = np.sort(rng.choice(64, size=int(rng.integers(4, 20)),
+                             replace=False)).astype(np.int32)
+    rows.append((ids, rng.random(ids.size).astype(np.float32) + 0.5))
+model = NMF(
+    Params(k=2, max_iterations=6, seed=3, token_layout="packed")
+).fit(rows, [f"t{i}" for i in range(64)])
+# two n_iter values, ONE pow2 bucket -> one solve_w signature
+model.topic_distribution(rows[:4], n_iter=5)
+model.topic_distribution(rows[:4], n_iter=7)
+telemetry.shutdown()
+EOF
 }
 
 make_retrace_storm() {
@@ -297,10 +335,12 @@ if [[ "${1:-}" == "--rebaseline" ]]; then
         --write-baseline --tolerance 0.0 --include counter.fleet. \
         || exit 1
     # recapture the recompile sentinel's expected-signature table from
-    # the same train run plus a score run (gate 9's fixture pair)
+    # the same train run plus a score run and an NMF fit+transform run
+    # (gate 9's fixture triple)
     run_ci_score "$work" || exit 1
+    run_ci_nmf "$work" || exit 1
     python -m spark_text_clustering_tpu.cli metrics compile-check \
-        "$work/run.jsonl" "$work/score.jsonl" \
+        "$work/run.jsonl" "$work/score.jsonl" "$work/nmf.jsonl" \
         --baseline "$COMPILE_BASELINE" --write-baseline
     exit $?
 fi
@@ -388,9 +428,10 @@ else
 fi
 
 echo "== [9/10] recompile sentinel (metrics compile-check) =="
-if [[ -s "$work/run.jsonl" ]] && run_ci_score "$work"; then
+if [[ -s "$work/run.jsonl" ]] && run_ci_score "$work" \
+    && run_ci_nmf "$work"; then
     python -m spark_text_clustering_tpu.cli metrics compile-check \
-        "$work/run.jsonl" "$work/score.jsonl" \
+        "$work/run.jsonl" "$work/score.jsonl" "$work/nmf.jsonl" \
         --baseline "$COMPILE_BASELINE"
     if [[ $? -ne 0 ]]; then
         echo "FAIL: compiled signatures beyond $COMPILE_BASELINE"

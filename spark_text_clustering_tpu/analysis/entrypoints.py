@@ -141,6 +141,78 @@ def _build_nmf_train_step():
     return fn, (state, _batch())
 
 
+def _build_nmf_packed_chunk():
+    import numpy as np
+
+    from ..models.nmf import make_nmf_packed_runner
+
+    import functools
+
+    # flat layout (d=None): seg_t holds shard-LOCAL doc positions; the
+    # static sweep count m binds via partial (make_jaxpr would otherwise
+    # feed the static argname a tracer)
+    fn = functools.partial(make_nmf_packed_runner(_mesh()), m=2)
+    ids_t = np.zeros((T,), np.int32)
+    cts_t = np.ones((T,), np.float32)
+    seg_t = np.tile(np.arange(B, dtype=np.int32), T // B)
+    return fn, (
+        _f32((B, K)), _f32((K, V)), ids_t, cts_t, seg_t,
+        np.float32(1.0),
+    )
+
+
+def _build_nmf_fused_chunk():
+    import numpy as np
+
+    from ..models.nmf import make_nmf_packed_runner
+
+    import functools
+
+    # tiles layout: W in tile-slot order, the Mosaic kernel interpreted
+    # (tracing registers the wrapper exactly as the CPU test path runs)
+    n_tiles, tt, d = 2, 16, 4
+    fn = functools.partial(
+        make_nmf_packed_runner(_mesh(), d=d, interpret=True), m=2
+    )
+    ids_t = np.zeros((n_tiles, tt), np.int32)
+    cts_t = np.ones((n_tiles, tt), np.float32)
+    seg_t = np.zeros((n_tiles, tt), np.int32)
+    return fn, (
+        _f32((n_tiles * d, K)), _f32((K, V)), ids_t, cts_t, seg_t,
+        np.float32(1.0),
+    )
+
+
+def _build_nmf_solve_w():
+    import functools
+
+    import numpy as np
+
+    from ..models.nmf import _solve_w
+
+    fn = functools.partial(_solve_w, cap=8)
+    return fn, (
+        _batch(), _f32((K, V)), _f32((B, K)), np.int32(5),
+    )
+
+
+def _build_pallas_nmf_mu_update():
+    import functools
+
+    import numpy as np
+
+    from ..ops.pallas_nmf import nmf_mu_update_tiles
+
+    n_tiles, tt, d = 2, 16, 4
+    fn = functools.partial(
+        nmf_mu_update_tiles, d=d, eps=1e-9, interpret=True
+    )
+    hg_kt = _f32((K, n_tiles * tt))
+    cts = _f32((n_tiles, tt))
+    seg = np.zeros((n_tiles, tt), np.int32)
+    return fn, (hg_kt, cts, seg, _f32((n_tiles * d, K)), _f32((K, K)))
+
+
 def _build_sharded_topic_inference():
     import numpy as np
 
@@ -214,6 +286,35 @@ def _build_pallas_packed_tiles():
     return fn, (eb_kt, cts, seg, alpha, gamma0)
 
 
+def _build_online_tiles_resident_chunk():
+    import numpy as np
+
+    from ..models.online_lda import (
+        TrainState,
+        make_online_tiles_resident_chunk,
+    )
+
+    # the XLA gamma twin (gamma_backend="xla") — the CPU/default tier's
+    # lowering; the Mosaic kernel wrapper is audited separately via
+    # ops.pallas_packed.gamma_fixed_point_tiles
+    n_tiles, tt, d = 2, 16, 4
+    fn = make_online_tiles_resident_chunk(
+        _mesh(), alpha=0.1, eta=0.01, tau0=1024.0, kappa=0.51, k=K,
+        gamma_shape=100.0, seed=0, d=d, n_docs=B, max_inner=5,
+        tol=1e-3, interpret=True, gamma_backend="xla",
+    )
+    state = TrainState(_f32((K, V)), np.int32(0))
+    ids_res = np.zeros((n_tiles, tt), np.int32)
+    cts_res = np.ones((n_tiles, tt), np.float32)
+    seg_res = np.zeros((n_tiles, tt), np.int32)
+    doc_res = np.zeros((n_tiles, d), np.int32)
+    picks = np.zeros((2, 1, 1), np.int32)
+    return fn, (
+        state, ids_res, cts_res, seg_res, doc_res, picks,
+        np.float32(float(B)),
+    )
+
+
 def _build_lda_math_e_step():
     import functools
 
@@ -236,6 +337,13 @@ ENTRYPOINTS: Tuple[EntryPoint, ...] = (
     EntryPoint("online_lda.estep", True, _build_online_estep),
     EntryPoint("online_lda.mstep", True, _build_online_mstep),
     EntryPoint("nmf.train_step", True, _build_nmf_train_step),
+    EntryPoint("nmf.packed_chunk", True, _build_nmf_packed_chunk),
+    EntryPoint("nmf.fused_chunk", True, _build_nmf_fused_chunk),
+    EntryPoint("nmf.solve_w", False, _build_nmf_solve_w),
+    EntryPoint(
+        "online_lda.tiles_resident_chunk", True,
+        _build_online_tiles_resident_chunk,
+    ),
     EntryPoint(
         "sharded_eval.topic_inference", True,
         _build_sharded_topic_inference,
@@ -255,6 +363,10 @@ ENTRYPOINTS: Tuple[EntryPoint, ...] = (
     EntryPoint(
         "ops.pallas_packed.gamma_fixed_point_tiles", False,
         _build_pallas_packed_tiles,
+    ),
+    EntryPoint(
+        "ops.pallas_nmf.mu_update_tiles", False,
+        _build_pallas_nmf_mu_update,
     ),
     EntryPoint("ops.lda_math.e_step", False, _build_lda_math_e_step),
 )

@@ -72,8 +72,12 @@ class LDAModel:
         pipelines then stay on-chip, and the one-time device->host
         download happens here, on the first host-side consumer
         (topics_matrix / save / export), not inside the timed fit.
+        The ``handoff.downloads`` counter (vs the fit-side
+        ``handoff.deferred_bytes`` gauge) says how many deferred models
+        actually paid the download.
         """
         if not isinstance(self.lam, np.ndarray):
+            telemetry.count("handoff.downloads")
             self.lam = np.asarray(jax.device_get(self.lam))
 
     # ---- shape accessors (MLlib: model.k, model.vocabSize) -------------

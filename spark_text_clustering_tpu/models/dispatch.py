@@ -23,7 +23,31 @@ caused.  The policy:
 
 from __future__ import annotations
 
-__all__ = ["resolve_dispatch_interval", "save_cadence"]
+__all__ = ["donate_carry", "resolve_dispatch_interval", "save_cadence"]
+
+
+def donate_carry(*argnums: int):
+    """``donate_argnums`` for a chunk runner's state carry.
+
+    The chunked loops thread a state pytree (lambda / W / H /
+    sufficient-stat carries) through every dispatch and never read the
+    input again — donating it lets XLA update the buffers in place
+    instead of holding input and output alive simultaneously (at the
+    CC-News lambda width that doubling is the difference between fitting
+    HBM and not).  XLA:CPU implements no donation and warns once per
+    compile, so the helper returns ``()`` there: same executables, quiet
+    logs, and the sandbox's CPU tier-1 runs stay representative.
+
+    CONTRACT for callers: a donated state must never be passed to two
+    dispatches — probe/autotune paths must copy first (see
+    ``OnlineLDA._fit_packed``); tests/test_nmf_fused.py pins the
+    no-use-after-donate discipline by deleting inputs post-call.
+    """
+    import jax
+
+    if jax.default_backend() == "cpu":
+        return ()
+    return tuple(argnums)
 
 
 def resolve_dispatch_interval(

@@ -13,7 +13,7 @@ TPU mapping (same mesh contract as online_lda.py):
   * W [B, k]   — doc factors, sharded over "data" (each chip owns its docs'
                  rows, like Spark's RDD partitions).
   * H [k, V]   — topic factors, V-sharded over "model" (the lambda layout).
-  * X          — the padded sparse batch, doc-sharded over "data".
+  * X          — the sparse batch, doc-sharded over "data".
 
 Per iteration, both multiplicative updates reduce to gathers + one
 scatter-add + tiny [k, k] matmuls:
@@ -23,10 +23,26 @@ scatter-add + tiny [k, k] matmuls:
                                       W^T W: [k, k] psum over "data"
 
 No driver round-trips, and the full [k, V] H never materializes on any
-device (same memory contract as the LDA steps).  Cross-chip traffic per
-step: the [B, L, k] token-row ownership gather over "model", two [k, k]
-psums, and the W^T X sufficient-statistics psum over "data" — a
-[k, V/model_shards] slab, the same reduction the LDA steps pay.
+device (same memory contract as the LDA steps).
+
+Layouts (ROADMAP item 2 — the fused-kernel tier EM sits on):
+
+  * ``token_layout="padded"`` — the original [B, L] grid: per-iteration
+    FLOPs/bandwidth scale with B * max_nnz.  BENCH_r05 measured this
+    path at 0.22x sklearn `solver=mu` (0.32 GB/s achieved HBM) because
+    the [B, L, k] gathered-H slab carries 10-20x padding waste on
+    heavy-tailed corpora.  Kept as the bench A/B baseline and fallback.
+  * ``token_layout="packed"`` (auto at >=2x padding waste, the EM
+    threshold — both layouts are one dispatch per sweep, so any cell
+    reduction is pure win) — the corpus lives as flat doc-contiguous
+    per-shard token arrays (the EM packed plan); work scales with the
+    TRUE token count.  On TPU the W-side update runs the fused Mosaic
+    kernel (``ops.pallas_nmf``: one-hot MXU matmuls, accumulators
+    VMEM-resident over corpus tiles); elsewhere the XLA segment ops.
+    Either way a fit is ONE device dispatch: ``lax.scan`` runs every
+    sweep AND the final Frobenius loss inside one jitted chunk with the
+    (W, H) carry donated — no per-iteration dispatch, no separate loss
+    dispatch, no buffer copy per sweep.
 """
 
 from __future__ import annotations
@@ -42,11 +58,14 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .. import telemetry
 from ..config import Params
-from .dispatch import resolve_dispatch_interval
-from ..ops.sparse import DocTermBatch, batch_from_rows
+from .dispatch import donate_carry, resolve_dispatch_interval
+from ..ops.lda_math import _resolve_gamma_backend
+from ..ops.sparse import DocTermBatch, batch_from_rows, next_pow2
 from ..parallel.collectives import (
     data_shard_batch,
     gather_model_rows,
+    gather_model_rows_kbl,
+    model_handoff,
     psum_data,
     psum_model,
     scatter_add_model_shard,
@@ -55,7 +74,13 @@ from ..parallel.mesh import DATA_AXIS, MODEL_AXIS, make_mesh, model_sharding
 from ..utils import jax_compat  # noqa: F401  (installs jax.shard_map shim)
 from ..utils.timing import IterationTimer
 
-__all__ = ["NMF", "NMFModel", "make_nmf_train_step", "frobenius_loss"]
+__all__ = [
+    "NMF",
+    "NMFModel",
+    "make_nmf_train_step",
+    "make_nmf_packed_runner",
+    "frobenius_loss",
+]
 
 _EPS = 1e-9  # multiplicative-update guard; keeps factors strictly >= 0
 
@@ -73,7 +98,9 @@ def _gather_h(h: jnp.ndarray, ids: jnp.ndarray) -> jnp.ndarray:
 def make_nmf_train_step(
     mesh: Mesh,
 ) -> Callable[[NMFTrainState, DocTermBatch], NMFTrainState]:
-    """Build the jitted, shard_mapped multiplicative-update step.
+    """Build the jitted, shard_mapped multiplicative-update step over the
+    PADDED [B, L] grid (the unfused baseline; the packed/fused training
+    tier is ``make_nmf_packed_runner``).
 
     ``batch`` must be doc-sharded over "data"; H is V-sharded over
     "model" (shard widths come from H itself).  Pad docs (all weights 0)
@@ -123,6 +150,143 @@ def make_nmf_train_step(
     return train_step
 
 
+def make_nmf_packed_runner(
+    mesh: Mesh,
+    *,
+    d: Optional[int] = None,
+    interpret: Optional[bool] = None,
+    eps: float = _EPS,
+):
+    """The packed-layout multi-sweep runner: ONE jitted dispatch executes
+    ``m`` whole-corpus Lee-Seung sweeps via ``lax.scan`` and computes the
+    final Frobenius objective inside the same executable, with the (W, H)
+    carry DONATED (``models.dispatch.donate_carry``) so the update is
+    in-place on accelerators — the EM recipe (packed tokens + whole-run
+    scan chunking + donation) ported to NMF.
+
+    ``d=None`` — FLAT layout (the XLA tier): per-shard doc-contiguous
+    token arrays ``ids_t/cts_t/seg_t`` flat [S * T_max] with ``seg_t``
+    the shard-LOCAL doc position (the EM packed plan); W is
+    [S * d_max, k] doc-sharded.  Segment ops are ``segment_sum`` + one
+    doc-axis gather per sweep.
+
+    ``d=<tile doc slots>`` — TILES layout (the fused Mosaic tier): the
+    corpus is tile-planned (``ops.pallas_packed.plan_corpus_tiles``),
+    ``ids_t/cts_t/seg_t`` are [n_tiles, tt] with tile-LOCAL doc slots,
+    W is [n_tiles * d, k] in tile-slot order, and the whole W side of
+    each sweep (numerator, denominator, the token re-expansion feeding
+    the H scatter) runs in ``ops.pallas_nmf.nmf_mu_update_tiles`` with
+    its accumulators VMEM-resident.  Both layouts share the H update and
+    the loss block, and run the same math as the padded step — parity is
+    pinned by tests/test_nmf_fused.py.
+
+    Returned fn: ``(w, h, ids_t, cts_t, seg_t, x2, m) -> (w', h', loss)``
+    with ``x2 = sum(X^2)`` (a host constant of the corpus) and ``m``
+    static.  Pad token slots (cts == 0) and pad doc slots/rows (W == 0)
+    contribute exactly zero.
+    """
+    tiles = d is not None
+    if tiles:
+        from ..ops.pallas_nmf import nmf_mu_update_tiles
+
+        interp = (
+            jax.default_backend() != "tpu" if interpret is None
+            else interpret
+        )
+
+    def _slot_ids(seg_t):
+        """Tile-layout flat token -> W-slot index; pad tokens are pointed
+        at a real slot but carry cts == 0 (numerically inert)."""
+        nt_l, tt = seg_t.shape
+        tile_idx = jax.lax.broadcasted_iota(jnp.int32, (nt_l, tt), 0)
+        return (tile_idx * d + jnp.minimum(seg_t, d - 1)).reshape(-1)
+
+    def _sweep(w, h_shard, ids_t, cts_t, seg_t):
+        hht = psum_model(h_shard @ h_shard.T)                  # [k, k]
+        if tiles:
+            flat_ids = ids_t.reshape(-1)
+            hg_kt = gather_model_rows_kbl(h_shard, flat_ids)   # [k, T]
+            w, vals = nmf_mu_update_tiles(
+                hg_kt, cts_t, seg_t, w, hht,
+                d=d, eps=eps, interpret=interp,
+            )
+        else:
+            flat_ids = ids_t
+            hg = gather_model_rows(h_shard, ids_t)             # [T, k]
+            xht = jax.ops.segment_sum(
+                cts_t[:, None] * hg, seg_t, num_segments=w.shape[0]
+            )                                                  # [d_max, k]
+            w = w * xht / (w @ hht + eps)
+            vals = cts_t[:, None] * w[seg_t]                   # [T, k]
+
+        # --- H update (shared by both layouts) -------------------------
+        wtw = psum_data(w.T @ w)                               # [k, k]
+        wtx_shard = psum_data(
+            scatter_add_model_shard(flat_ids, vals, h_shard.shape[-1])
+        )                                                      # [k, V/s]
+        h_shard = h_shard * wtx_shard / (wtw @ h_shard + eps)
+        return w, h_shard
+
+    def _loss(w, h_shard, ids_t, cts_t, seg_t, x2):
+        # ||X - W H||_F^2 without densifying X (frobenius_loss, in the
+        # packed layout): folded into the chunk so a fit never pays a
+        # separate loss dispatch.
+        if tiles:
+            flat_ids = ids_t.reshape(-1)
+            flat_cts = cts_t.reshape(-1)
+            w_tok = w[_slot_ids(seg_t)]                        # [T, k]
+        else:
+            flat_ids, flat_cts = ids_t, cts_t
+            w_tok = w[seg_t]                                   # [T, k]
+        hg = gather_model_rows(h_shard, flat_ids)              # [T, k]
+        cross = psum_data(((hg * w_tok).sum(-1) * flat_cts).sum())
+        wtw = psum_data(w.T @ w)
+        hht = psum_model(h_shard @ h_shard.T)
+        return x2 - 2.0 * cross + (wtw * hht).sum()
+
+    tok_spec = P(DATA_AXIS, None) if tiles else P(DATA_AXIS)
+    sweep_sharded = jax.shard_map(
+        _sweep,
+        mesh=mesh,
+        in_specs=(
+            P(DATA_AXIS, None),       # w (doc slots / tile slots)
+            P(None, MODEL_AXIS),      # h shard
+            tok_spec, tok_spec, tok_spec,
+        ),
+        out_specs=(P(DATA_AXIS, None), P(None, MODEL_AXIS)),
+        check_vma=False,
+    )
+    loss_sharded = jax.shard_map(
+        _loss,
+        mesh=mesh,
+        in_specs=(
+            P(DATA_AXIS, None),
+            P(None, MODEL_AXIS),
+            tok_spec, tok_spec, tok_spec,
+            P(),
+        ),
+        out_specs=P(),
+        check_vma=False,
+    )
+
+    @partial(
+        jax.jit,
+        static_argnames=("m",),
+        donate_argnums=donate_carry(0, 1),
+    )
+    def run_chunk(w, h, ids_t, cts_t, seg_t, x2, m: int):
+        def body(carry, _):
+            return sweep_sharded(*carry, ids_t, cts_t, seg_t), None
+
+        (w, h), _ = jax.lax.scan(body, (w, h), None, length=m)
+        loss = loss_sharded(
+            w, h, ids_t, cts_t, seg_t, jnp.asarray(x2, jnp.float32)
+        )
+        return w, h, loss
+
+    return run_chunk
+
+
 @partial(jax.jit, static_argnames=())
 def frobenius_loss(
     batch: DocTermBatch, w: jnp.ndarray, h: jnp.ndarray
@@ -143,20 +307,38 @@ def frobenius_loss(
 _loss_fn = telemetry.instrument_dispatch("nmf.loss", frobenius_loss)
 
 
-@partial(jax.jit, static_argnames=("n_iter",))
+@partial(jax.jit, static_argnames=("cap",))
 def _solve_w(
-    batch: DocTermBatch, h: jnp.ndarray, w0: jnp.ndarray, n_iter: int = 100
+    batch: DocTermBatch,
+    h: jnp.ndarray,
+    w0: jnp.ndarray,
+    n_iter: jnp.ndarray,
+    cap: int,
 ) -> jnp.ndarray:
-    """Fixed-H W solve (the transform path): iterate only the W update."""
+    """Fixed-H W solve (the transform path): iterate only the W update.
+
+    ``n_iter`` is a DYNAMIC operand; only the power-of-two bucket ``cap``
+    (>= n_iter) is a compile key — the EM shape-bucketing recipe applied
+    to the iteration count.  ``n_iter`` used to be a static argname, so
+    every distinct caller value compiled a fresh executable (the
+    recompile hazard the compile sentinel now gates: distinct
+    ``nmf.solve_w`` signatures stay logarithmic in the requested
+    depth).  Iterations past ``n_iter`` keep W unchanged, so results are
+    exactly the requested depth's.
+    """
     ids, wts = batch.token_ids, batch.token_weights
     hg = _gather_h(h, ids)                                     # [B, L, k]
     xht = jnp.einsum("blk,bl->bk", hg, wts)                    # [B, k]
     hht = h @ h.T
 
-    def body(_, w):
-        return w * xht / (w @ hht + _EPS)
+    def body(i, w):
+        w_new = w * xht / (w @ hht + _EPS)
+        return jnp.where(i < n_iter, w_new, w)
 
-    return jax.lax.fori_loop(0, n_iter, body, w0)
+    return jax.lax.fori_loop(0, cap, body, w0)
+
+
+_solve_w_fn = telemetry.instrument_dispatch("nmf.solve_w", _solve_w)
 
 
 # ---------------------------------------------------------------------------
@@ -166,15 +348,26 @@ class NMFModel:
 
     The topic-facing API mirrors LDAModel (describe_topics, transform) so
     pipelines can swap estimators without downstream changes — the
-    north-star "estimator swap" capability."""
+    north-star "estimator swap" capability.  ``h`` may arrive
+    DEVICE-RESIDENT from a single-process fit (collectives.model_handoff
+    — the same deferred download LDAModel carries): the transform path
+    then stays on-chip, and ``ensure_host`` materializes once on the
+    first host-side consumer."""
 
-    h: np.ndarray                      # [k, V] float32
+    h: np.ndarray                      # [k, V] float32 (or device array)
     vocab: List[str]
     loss: float = float("nan")         # final Frobenius objective
     iteration_times: List[float] = field(default_factory=list)
     # see LDAModel.iteration_times_kind: interval means vs real samples
     iteration_times_kind: str = "per_iteration"
     step: int = 0
+
+    def ensure_host(self) -> None:
+        """Materialize ``h`` to host numpy IN PLACE (idempotent) — the
+        one-time download deferred by the fit handoff."""
+        if not isinstance(self.h, np.ndarray):
+            telemetry.count("handoff.downloads")
+            self.h = np.asarray(jax.device_get(self.h))
 
     @property
     def k(self) -> int:
@@ -186,6 +379,7 @@ class NMFModel:
 
     def topics_matrix(self) -> np.ndarray:
         """Row-normalized topic-term distributions [k, V]."""
+        self.ensure_host()
         h = np.asarray(self.h, np.float64)
         return h / np.maximum(h.sum(axis=1, keepdims=True), _EPS)
 
@@ -211,21 +405,38 @@ class NMFModel:
         self,
         docs: Union[DocTermBatch, Sequence[Tuple[np.ndarray, np.ndarray]]],
         n_iter: int = 100,
+        mesh=None,
     ) -> np.ndarray:
-        """Doc factors W [B, k] for new docs with H fixed."""
+        """Doc factors W [B, k] for new docs with H fixed.
+
+        A device-resident ``h`` feeds the solve without any host
+        round-trip (the training->scoring pipeline stays on-chip).
+        ``mesh`` is accepted for the estimator-agnostic scoring surface
+        (cli score passes it to every model): the W solve is a [B, k]
+        fixed point against a gathered H and currently runs unsharded —
+        a V-sharded solve is the LDAModel mesh path's job."""
         batch = (
             docs
             if isinstance(docs, DocTermBatch)
             else batch_from_rows(list(docs))
         )
         w0 = jnp.full((batch.num_docs, self.k), 1.0 / self.k, jnp.float32)
-        w = _solve_w(batch, jnp.asarray(self.h, jnp.float32), w0, n_iter)
+        w = _solve_w_fn(
+            batch,
+            jnp.asarray(self.h, jnp.float32),
+            w0,
+            jnp.asarray(n_iter, jnp.int32),
+            max(8, next_pow2(int(n_iter))),
+        )
         return np.asarray(w)
 
-    def topic_distribution(self, docs, n_iter: int = 100) -> np.ndarray:
+    def topic_distribution(
+        self, docs, n_iter: int = 100, mesh=None
+    ) -> np.ndarray:
         """Row-normalized W — the LDAModel.topic_distribution analogue, so
-        scoring/report code is estimator-agnostic.  Empty docs get uniform."""
-        w = self.transform(docs, n_iter=n_iter)
+        scoring/report code is estimator-agnostic (cli score drives any
+        loaded model through this surface).  Empty docs get uniform."""
+        w = self.transform(docs, n_iter=n_iter, mesh=mesh)
         totals = w.sum(axis=1, keepdims=True)
         uniform = np.full_like(w, 1.0 / self.k)
         return np.where(totals > 0, w / np.maximum(totals, _EPS), uniform)
@@ -234,6 +445,7 @@ class NMFModel:
     def save(self, path: str) -> None:
         from .persistence import save_nmf_model
 
+        self.ensure_host()
         save_nmf_model(self, path)
 
     @classmethod
@@ -263,7 +475,209 @@ class NMF:
         # same vocab size skip shard_map construction + XLA retrace.
         self._step_fn = None
         self._chunk_fn = None
+        # packed runners keyed by layout: ("flat",) | ("tiles", d)
+        self._packed_fns: dict = {}
         self.last_dispatches = 0
+        self.last_layout: str = "padded"
+        # which W-update backend the packed fit ran: "xla" segment ops or
+        # the fused Mosaic kernel ("pallas_tiles"); "none" for padded
+        self.last_mu_backend: str = "none"
+        self.last_cells: Optional[int] = None
+
+    def _w_init(self, n_true: int, k: int, v: int, batch_weight_sum: float):
+        """Scaled-uniform init: E[(W H)_ij] == mean(X) at iteration 0, the
+        standard scheme that keeps early updates well-conditioned.  Scale
+        and H's vocab extent use the UNPADDED n_true/v so the init (and
+        hence the trajectory) is mesh- and layout-independent."""
+        p = self.params
+        mean_x = batch_weight_sum / max(n_true * v, 1)
+        scale = np.sqrt(max(mean_x, _EPS) / k)
+        kw, kh = jax.random.split(jax.random.PRNGKey(p.seed))
+        w = scale * (
+            0.5 + np.asarray(
+                jax.random.uniform(kw, (n_true, k), jnp.float32)
+            )
+        )
+        h = scale * (
+            0.5 + np.asarray(
+                jax.random.uniform(kh, (k, v), jnp.float32)
+            )
+        )
+        return w.astype(np.float32), h.astype(np.float32)
+
+    def _packed_plan(self, rows, n: int):
+        """Doc-contiguous token packing (the EM packed plan, without the
+        per-token init keys): greedy nnz-balanced assignment of whole
+        documents to data shards.  Returns (ids_t, cts_t, seg_t flat
+        [S*T_max] with seg the shard-LOCAL doc position, slot [n] mapping
+        global doc -> packed W row, d_max docs/shard, cells)."""
+        n_data = self.mesh.shape[DATA_AXIS]
+        order = sorted(range(n), key=lambda doc: -len(rows[doc][0]))
+        shard_docs: List[List[int]] = [[] for _ in range(n_data)]
+        loads = [0] * n_data
+        for doc in order:
+            s = loads.index(min(loads))
+            shard_docs[s].append(doc)
+            loads[s] += max(1, len(rows[doc][0]))
+        d_max = max(1, max(len(sd) for sd in shard_docs))
+        # token-axis rounding: pow2 while small (jit-cache friendly
+        # across refits), 8192-multiples beyond — a pow2 round-up at the
+        # bench shape padded 652k live tokens to 1M (1.6x), and every
+        # [T, k] pass in the sweep scales with this width
+        t_need = max(8, max(loads))
+        t_max = (
+            next_pow2(t_need) if t_need <= 8192
+            else ((t_need + 8191) // 8192) * 8192
+        )
+        ids_t = np.zeros((n_data, t_max), np.int32)
+        cts_t = np.zeros((n_data, t_max), np.float32)
+        seg_t = np.zeros((n_data, t_max), np.int32)
+        slot = np.zeros(n, np.int64)
+        for s, sdocs in enumerate(shard_docs):
+            o = 0
+            for j, doc in enumerate(sdocs):
+                i, w = rows[doc]
+                ids_t[s, o:o + len(i)] = i
+                cts_t[s, o:o + len(i)] = w
+                seg_t[s, o:o + len(i)] = j
+                o += len(i)
+                slot[doc] = s * d_max + j
+        return (
+            ids_t.reshape(-1),
+            cts_t.reshape(-1),
+            seg_t.reshape(-1),
+            slot,
+            d_max,
+            n_data * t_max,
+        )
+
+    def _fit_packed(
+        self, rows, vocab, p, n_true, v, k, v_pad, verbose,
+    ) -> NMFModel:
+        """Packed-layout fit: tile-planned + fused Mosaic W update when
+        the kernel backend resolves (TPU / STC_GAMMA_BACKEND override),
+        flat XLA segment ops otherwise; either way the whole fit —
+        every sweep plus the final loss — is ONE donated-carry scan
+        dispatch (no checkpointing exists for NMF)."""
+        n_data = self.mesh.shape[DATA_AXIS]
+        flat_doc_ids = (
+            np.concatenate([np.asarray(i, np.int32) for i, _ in rows])
+            if rows else np.zeros(0, np.int32)
+        )
+        flat_doc_cts = (
+            np.concatenate([np.asarray(c, np.float32) for _, c in rows])
+            if rows else np.zeros(0, np.float32)
+        )
+        x2 = float((flat_doc_cts.astype(np.float64) ** 2).sum())
+        w_doc, h0 = self._w_init(
+            n_true, k, v, float(flat_doc_cts.sum())
+        )
+        h0 = np.pad(h0, ((0, 0), (0, v_pad - v)))
+
+        # tile plan (the fused Mosaic tier) when the kernel backend
+        # resolves and a tile geometry fits the VMEM budget; the flat
+        # XLA segment layout otherwise — same auto/override switch as
+        # every kernel-vs-XLA choice in this package.
+        plan = None
+        if _resolve_gamma_backend("auto") == "pallas":
+            from ..ops.pallas_packed import plan_corpus_tiles
+
+            offsets = np.zeros(n_true + 1, np.int64)
+            np.cumsum([len(i) for i, _ in rows], out=offsets[1:])
+            plan = plan_corpus_tiles(
+                flat_doc_ids, flat_doc_cts, offsets,
+                n_shards=n_data, k=k,
+            )
+
+        tok_spec_flat = NamedSharding(self.mesh, P(DATA_AXIS))
+        tok_spec_tile = NamedSharding(self.mesh, P(DATA_AXIS, None))
+        w_spec = NamedSharding(self.mesh, P(DATA_AXIS, None))
+
+        if plan is not None:
+            self.last_mu_backend = "pallas_tiles"
+            n_tiles = plan.ids.shape[0]
+            self.last_cells = n_tiles * plan.tt
+            # W rows in tile-slot order (pad slots stay exactly 0: their
+            # numerator is 0 and the update is multiplicative)
+            w0 = np.zeros((n_tiles * plan.d, k), np.float32)
+            live = plan.doc_ids.reshape(-1) < n_true
+            w0[live] = w_doc[plan.doc_ids.reshape(-1)[live]]
+            ids_dev = jax.device_put(plan.ids, tok_spec_tile)
+            cts_dev = jax.device_put(plan.cts, tok_spec_tile)
+            seg_dev = jax.device_put(plan.seg, tok_spec_tile)
+            fn_key = ("tiles", plan.d)
+            label = "nmf.fused_chunk"
+            make = partial(make_nmf_packed_runner, self.mesh, d=plan.d)
+        else:
+            self.last_mu_backend = "xla"
+            ids_f, cts_f, seg_f, slot, d_max, cells = self._packed_plan(
+                rows, n_true
+            )
+            self.last_cells = cells
+            w0 = np.zeros((n_data * d_max, k), np.float32)
+            w0[slot] = w_doc
+            ids_dev = jax.device_put(ids_f, tok_spec_flat)
+            cts_dev = jax.device_put(cts_f, tok_spec_flat)
+            seg_dev = jax.device_put(seg_f, tok_spec_flat)
+            fn_key = ("flat",)
+            label = "nmf.packed_chunk"
+            make = partial(make_nmf_packed_runner, self.mesh)
+
+        if fn_key not in self._packed_fns:
+            # dispatch attribution (telemetry.dispatch): calls, compile
+            # signatures, and the measured roofline seconds per digest —
+            # the numbers `metrics roofline` joins for the fused-vs-
+            # unfused A/B (bench.py)
+            self._packed_fns[fn_key] = telemetry.instrument_dispatch(
+                label, make()
+            )
+        run = self._packed_fns[fn_key]
+
+        w = jax.device_put(w0, w_spec)
+        h = jax.device_put(h0, model_sharding(self.mesh))
+
+        timer = IterationTimer()
+        self.last_dispatches = 0
+        interval = resolve_dispatch_interval(
+            p, ckpt_path=None, verbose=verbose, n_iters=p.max_iterations,
+        )
+        loss_dev = None
+        it = 0
+        while it < p.max_iterations:
+            m = min(interval, p.max_iterations - it)
+            timer.start()
+            w, h, loss_dev = run(w, h, ids_dev, cts_dev, seg_dev, x2, m)
+            telemetry.device_sync(h, "nmf")
+            timer.stop()
+            self.last_dispatches += 1
+            if m > 1:
+                timer.split_last(m)
+            if verbose:
+                print(f"nmf iter {it}: {timer.times[-1]:.3f}s (packed)")
+            it += m
+
+        loss = float(np.asarray(jax.device_get(loss_dev)))
+        self.last_loss = loss
+        telemetry.emit_fit(
+            "nmf", timer.times, kind=timer.kind,
+            loss=loss,
+            layout=self.last_layout,
+            mu_backend=self.last_mu_backend,
+            cells=self.last_cells,
+            dispatches=self.last_dispatches,
+            k=k, vocab_width=v, docs=n_true,
+        )
+        # device-resident handoff (single-process): the [k, V] download
+        # is deferred to the model's first host-side consumer
+        h_out = model_handoff(h, v)
+        return NMFModel(
+            h=h_out,
+            vocab=list(vocab),
+            loss=loss,
+            iteration_times=list(timer.times),
+            iteration_times_kind=timer.kind,
+            step=p.max_iterations,
+        )
 
     def fit(
         self,
@@ -275,40 +689,50 @@ class NMF:
         k, v = p.k, len(vocab)
         n_model = self.mesh.shape[MODEL_AXIS]
         v_pad = ((v + n_model - 1) // n_model) * n_model
-
         n_true = len(rows)
+
+        if p.token_layout not in ("padded", "packed", "auto"):
+            raise ValueError(
+                f"unknown token_layout {p.token_layout!r} "
+                "(use 'padded'|'packed'|'auto')"
+            )
+        n_data = self.mesh.shape[DATA_AXIS]
+        max_nnz = max((len(i) for i, _ in rows), default=1)
+        total_nnz = sum(len(i) for i, _ in rows)
+        b_pad = ((n_true + n_data - 1) // n_data) * n_data
+        padded_cells = b_pad * max(8, next_pow2(max_nnz))
+        self.last_layout = "padded"
+        self.last_mu_backend = "none"
+        self.last_cells = padded_cells
+        # auto threshold mirrors EM's 2x: both layouts run the whole fit
+        # as one dispatch, so any padded-cell reduction is pure win
+        use_packed = p.token_layout == "packed" or (
+            p.token_layout == "auto"
+            and padded_cells >= 2.0 * max(1, total_nnz)
+        )
+        if use_packed and n_true:
+            self.last_layout = "packed"
+            return self._fit_packed(
+                rows, vocab, p, n_true, v, k, v_pad, verbose
+            )
+
         batch = batch_from_rows(list(rows))
         batch = data_shard_batch(self.mesh, batch)
         b = batch.num_docs
 
-        # Scaled-uniform init: E[(W H)_ij] == mean(X) at iteration 0, the
-        # standard scheme that keeps early updates well-conditioned.  Scale
-        # and H's vocab extent use the UNPADDED n_true/v so the init (and
-        # hence the trajectory) is mesh-shape independent: pad columns of H
-        # start at 0 and multiplicative updates keep them there.
-        mean_x = float(np.asarray(batch.token_weights.sum())) / max(
-            n_true * v, 1
+        w_np, h_np0 = self._w_init(
+            n_true, k, v, float(np.asarray(batch.token_weights.sum()))
         )
-        scale = np.sqrt(max(mean_x, _EPS) / k)
-        kw, kh = jax.random.split(jax.random.PRNGKey(p.seed))
-        w = scale * (
-            0.5 + jax.random.uniform(kw, (n_true, k), jnp.float32)
-        )
-        w = jnp.pad(w, ((0, b - n_true), (0, 0)))  # pad docs: W rows stay 0
-        h = scale * (
-            0.5 + jax.random.uniform(kh, (k, v), jnp.float32)
-        )
-        h = jnp.pad(h, ((0, 0), (0, v_pad - v)))
+        w = jnp.pad(
+            jnp.asarray(w_np), ((0, b - n_true), (0, 0))
+        )  # pad docs: W rows stay 0
+        h = jnp.pad(jnp.asarray(h_np0), ((0, 0), (0, v_pad - v)))
         w = jax.device_put(w, NamedSharding(self.mesh, P(DATA_AXIS, None)))
         h = jax.device_put(h, model_sharding(self.mesh))
         state = NMFTrainState(w, h)
 
         if self._step_fn is None:
-            # one step fn per estimator; jit re-specializes per shape.
-            # dispatch attribution (telemetry.dispatch): calls, compile
-            # signatures, and the measured roofline seconds per digest —
-            # the same wrapping every other hot loop carries, closing
-            # the gap the NMF-0.22x diagnosis needs (ROADMAP item 2)
+            # one step fn per estimator; jit re-specializes per shape
             self._step_fn = telemetry.instrument_dispatch(
                 "nmf.train_step", make_nmf_train_step(self.mesh)
             )
@@ -316,7 +740,7 @@ class NMF:
         if self._chunk_fn is None:
             # whole-run lax.scan per dispatch (models/dispatch.py): NMF
             # has no mid-run checkpointing, so with no per-iteration
-            # observability the fit is ONE host dispatch
+            # observability the sweep loop is ONE host dispatch
             @partial(jax.jit, static_argnames=("m",))
             def run_chunk(state, batch, m: int):
                 def body(st, _):
@@ -355,12 +779,14 @@ class NMF:
         telemetry.emit_fit(
             "nmf", timer.times, kind=timer.kind,
             loss=loss,
+            layout=self.last_layout,
+            cells=self.last_cells,
             dispatches=self.last_dispatches,
             k=k, vocab_width=v, docs=n_true,
         )
-        h_np = np.asarray(jax.device_get(state.h))[:, :v]
+        h_out = model_handoff(state.h, v)
         return NMFModel(
-            h=h_np,
+            h=h_out,
             vocab=list(vocab),
             loss=loss,
             iteration_times=list(timer.times),

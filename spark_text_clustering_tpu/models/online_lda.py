@@ -26,6 +26,7 @@ from __future__ import annotations
 import hashlib
 import os
 import time
+from functools import partial
 from typing import Callable, List, NamedTuple, Optional, Sequence, Tuple
 
 import jax
@@ -65,7 +66,7 @@ from ..parallel.mesh import (
 from ..utils import jax_compat  # noqa: F401  (installs jax.shard_map shim)
 from ..utils.timing import IterationTimer
 from .base import LDAModel
-from .dispatch import resolve_dispatch_interval, save_cadence
+from .dispatch import donate_carry, resolve_dispatch_interval, save_cadence
 from .persistence import load_train_state, save_train_state
 
 __all__ = [
@@ -482,13 +483,15 @@ def make_online_resident_chunk(
     cost a network round trip each when the chip sits behind a tunnel
     (see ``make_em_chunk_runner``); here the host only draws pick indices
     and dispatches once per interval.  jit-cached per (m, B) — at most
-    the interval and one remainder."""
+    the interval and one remainder.  The state carry is DONATED
+    (``models.dispatch.donate_carry``): the fit loop rebinds it every
+    dispatch and never reads the old buffers again."""
     sharded = _make_resident_sharded(
         mesh, alpha=alpha, eta=eta, tau0=tau0, kappa=kappa, k=k,
         gamma_shape=gamma_shape, seed=seed, max_inner=max_inner, tol=tol,
     )
 
-    @jax.jit
+    @partial(jax.jit, donate_argnums=donate_carry(0))
     def resident_chunk(
         state: TrainState, ids_res, wts_res, picks, corpus_sz
     ) -> TrainState:
@@ -606,7 +609,7 @@ def make_online_packed_chunk(
         check_vma=False,
     )
 
-    @jax.jit
+    @partial(jax.jit, donate_argnums=donate_carry(0))
     def packed_chunk(
         state: TrainState, tok_ids, tok_cts, tok_seg, picks, batch_docs,
         corpus_sz,
@@ -642,12 +645,17 @@ def make_online_packed_tiles_chunk(
     max_inner: int = 100,
     tol: float = 1e-3,
     interpret: bool = False,
+    gamma_backend: str = "pallas",
 ):
-    """The packed chunk runner with the gamma loop on the PALLAS TILE
-    kernel (``ops.pallas_packed``) instead of the XLA segment fixed
-    point — the TPU default: the XLA lowering re-streams the gathered
-    eb slab from HBM every inner iteration (~4.5x measured on the padded
-    twin), the kernel keeps each tile's block VMEM-resident.
+    """The packed chunk runner with the gamma loop on the TILE layout:
+    the PALLAS kernel (``ops.pallas_packed``, ``gamma_backend="pallas"``
+    — the TPU default: the XLA lowering re-streams the gathered eb slab
+    from HBM every inner iteration, ~4.5x measured on the padded twin,
+    while the kernel keeps each tile's block VMEM-resident) or the XLA
+    segment fixed point over the SAME tile-slot layout
+    (``gamma_backend="xla"`` — the CPU/default tier: one shared
+    machinery, two lowerings, so the non-TPU path rides the identical
+    packing/sharding instead of a separate code path).
 
     Minibatches arrive TILE-PLANNED (``plan_tile_pack_uniform``): ids /
     cts / seg are [m, n_tiles, tt] with tile-local doc slots, doc_ids
@@ -658,7 +666,7 @@ def make_online_packed_tiles_chunk(
     gamma inits (keyed by global doc id), same M-step blend; parity with
     the flat path is pinned by tests/test_packed_tiles_training.py.
     """
-    from ..ops.lda_math import _PHI_EPS
+    from ..ops.lda_math import _PHI_EPS, gamma_fixed_point_segments
     from ..ops.pallas_packed import (
         docs_gamma_to_tiles,
         gamma_fixed_point_tiles,
@@ -684,21 +692,33 @@ def make_online_packed_tiles_chunk(
         # doc-ordered inits -> tile-slot order (pad slots read the
         # all-ones overflow row; their gamma is discarded)
         g0_tiles = docs_gamma_to_tiles(gamma0, doc_t)     # [k, nt*d]
-        gamma_tiles = gamma_fixed_point_tiles(
-            eb_kt, cts_t, seg_t, alpha_arr, g0_tiles,
-            d=d, max_inner=max_inner, tol=tol, interpret=interpret,
-        )                                                 # [k, nt*d]
-        # final responsibilities -> sstats ∘ eb, scattered V-shard-local
-        elog = _digamma(gamma_tiles) - _digamma(
-            gamma_tiles.sum(axis=0, keepdims=True)
-        )
-        exp_et_slots = jnp.exp(elog)                      # [k, nt*d]
         tile_idx = jax.lax.broadcasted_iota(
             jnp.int32, (n_tiles_l, tt), 0
         )
         slot = (
             tile_idx * d + jnp.minimum(seg_t, d - 1)
         ).reshape(-1)                                     # [T]
+        if gamma_backend == "pallas":
+            gamma_tiles = gamma_fixed_point_tiles(
+                eb_kt, cts_t, seg_t, alpha_arr, g0_tiles,
+                d=d, max_inner=max_inner, tol=tol, interpret=interpret,
+            )                                             # [k, nt*d]
+        else:
+            # XLA twin over the tile-slot segments: pad tokens carry
+            # cts == 0 (inert even though ``slot`` clamps them onto a
+            # real slot), pad slots converge to alpha in one iteration,
+            # and no document straddles a shard so the segment sums
+            # need no collective (reduce_fn=None).
+            gamma_s, _ = gamma_fixed_point_segments(
+                eb_kt.T, cts_t.reshape(-1), slot, alpha_arr,
+                g0_tiles.T, max_inner, tol,
+            )                                             # [nt*d, k]
+            gamma_tiles = gamma_s.T
+        # final responsibilities -> sstats ∘ eb, scattered V-shard-local
+        elog = _digamma(gamma_tiles) - _digamma(
+            gamma_tiles.sum(axis=0, keepdims=True)
+        )
+        exp_et_slots = jnp.exp(elog)                      # [k, nt*d]
         et_tok = exp_et_slots[:, slot]                    # [k, T]
         phinorm = (eb_kt * et_tok).sum(axis=0) + _PHI_EPS
         vals_kt = (
@@ -733,7 +753,7 @@ def make_online_packed_tiles_chunk(
         check_vma=False,
     )
 
-    @jax.jit
+    @partial(jax.jit, donate_argnums=donate_carry(0))
     def tiles_chunk(
         state: TrainState, tile_ids, tile_cts, tile_seg, tile_doc,
         picks, batch_docs, corpus_sz,
@@ -771,6 +791,7 @@ def make_online_tiles_resident_chunk(
     max_inner: int = 100,
     tol: float = 1e-3,
     interpret: bool = False,
+    gamma_backend: str = "pallas",
 ):
     """DEVICE-RESIDENT tiled training (``token_layout="tiles"``): the
     corpus is tiled ONCE in doc order (``plan_corpus_tiles``), uploaded
@@ -780,7 +801,10 @@ def make_online_tiles_resident_chunk(
     per dispatch instead of packing and transferring token slabs (the
     host-streaming packed path's per-fit cost was ~0.5s of pack+plan+
     25 MB transfer on the 20NG bench shape; here it is a one-time
-    ~10 MB upload).
+    ~10 MB upload).  ``gamma_backend`` switches the tile gamma loop
+    between the Mosaic kernel and its XLA segment twin exactly like
+    ``make_online_packed_tiles_chunk`` — the CPU/default path rides the
+    SAME resident machinery with the XLA lowering.
 
     Sampling semantics: a BLOCK-STRATIFIED epoch — each shard permutes
     its own resident tiles per epoch and walks them in fixed-size
@@ -794,7 +818,7 @@ def make_online_tiles_resident_chunk(
     so per-doc gamma inits stay keyed by global id exactly like every
     other layout.
     """
-    from ..ops.lda_math import _PHI_EPS
+    from ..ops.lda_math import _PHI_EPS, gamma_fixed_point_segments
     from ..ops.pallas_packed import gamma_fixed_point_tiles
 
     alpha_arr = jnp.asarray(alpha, jnp.float32)
@@ -825,18 +849,27 @@ def make_online_tiles_resident_chunk(
         g0_slots = init_gamma_rows(
             key_it, doc_t.reshape(-1), k, gamma_shape
         ).T                                               # [k, tb_l*d]
-        gamma_tiles = gamma_fixed_point_tiles(
-            eb_kt, cts_t, seg_t, alpha_arr, g0_slots,
-            d=d, max_inner=max_inner, tol=tol, interpret=interpret,
-        )                                                 # [k, tb_l*d]
-        elog = _digamma(gamma_tiles) - _digamma(
-            gamma_tiles.sum(axis=0, keepdims=True)
-        )
-        exp_et_slots = jnp.exp(elog)
         tile_idx = jax.lax.broadcasted_iota(jnp.int32, (tb_l, tt), 0)
         slot = (
             tile_idx * d + jnp.minimum(seg_t, d - 1)
         ).reshape(-1)                                     # [T]
+        if gamma_backend == "pallas":
+            gamma_tiles = gamma_fixed_point_tiles(
+                eb_kt, cts_t, seg_t, alpha_arr, g0_slots,
+                d=d, max_inner=max_inner, tol=tol, interpret=interpret,
+            )                                             # [k, tb_l*d]
+        else:
+            # XLA segment twin over tile slots (see
+            # make_online_packed_tiles_chunk): shard-local, no psum
+            gamma_s, _ = gamma_fixed_point_segments(
+                eb_kt.T, cts_t.reshape(-1), slot, alpha_arr,
+                g0_slots.T, max_inner, tol,
+            )
+            gamma_tiles = gamma_s.T
+        elog = _digamma(gamma_tiles) - _digamma(
+            gamma_tiles.sum(axis=0, keepdims=True)
+        )
+        exp_et_slots = jnp.exp(elog)
         et_tok = exp_et_slots[:, slot]                    # [k, T]
         # pad token slots carry cts == 0 -> contribute nothing
         phinorm = (eb_kt * et_tok).sum(axis=0) + _PHI_EPS
@@ -875,7 +908,7 @@ def make_online_tiles_resident_chunk(
         check_vma=False,
     )
 
-    @jax.jit
+    @partial(jax.jit, donate_argnums=donate_carry(0))
     def tiles_resident_chunk(
         state: TrainState, ids_res, cts_res, seg_res, doc_res, picks,
         corpus_sz,
@@ -975,6 +1008,22 @@ class OnlineLDA:
         offsets = np.zeros(n + 1, np.int64)
         np.cumsum([len(i) for i, _ in rows], out=offsets[1:])
         n_data = self.mesh.shape[DATA_AXIS]
+        # the tile gamma loop: Mosaic kernel where the pallas backend
+        # resolves (TPU / explicit override), its XLA segment twin
+        # elsewhere — the CPU/default AUTO tier rides the SAME resident
+        # machinery instead of falling back to host streaming.  An
+        # EXPLICIT token_layout="tiles" keeps the kernel (interpret mode
+        # off-TPU — the parity grid in tests/test_tiles_resident.py
+        # exercises the real kernel on the CPU mesh) unless
+        # STC_GAMMA_BACKEND=xla overrides.  The XLA twin's slot axis has
+        # no Mosaic lane constraint, so its plan drops the 128-doc-slot
+        # floor (measured ~7x pad-slot waste on the CPU tier).
+        backend = (
+            "pallas"
+            if _resolve_gamma_backend("auto") == "pallas"
+            or (forced and os.environ.get("STC_GAMMA_BACKEND") != "xla")
+            else "xla"
+        )
         # Plan + resident upload cached across fits of the SAME corpus
         # (repeat fits / warm bench runs): keyed by CONTENT — doc count,
         # token total, and a hash of three sample rows.  Not id(rows):
@@ -986,7 +1035,9 @@ class OnlineLDA:
         for i in ((0, n // 2, n - 1) if n else ()):
             fp.update(np.asarray(rows[i][0], np.int32).tobytes())
             fp.update(np.asarray(rows[i][1], np.float32).tobytes())
-        cache_key = (fp.hexdigest(), n, int(offsets[-1]), n_data, k)
+        cache_key = (
+            fp.hexdigest(), n, int(offsets[-1]), n_data, k, backend
+        )
         cached = getattr(self, "_tiles_corpus_cache", None)
         if cached is not None and cached[0] == cache_key:
             plan, reals, resident = cached[1]
@@ -1002,7 +1053,8 @@ class OnlineLDA:
                 if rows else np.zeros(0, np.float32)
             )
             plan = plan_corpus_tiles(
-                flat_ids, flat_cts, offsets, n_shards=n_data, k=k
+                flat_ids, flat_cts, offsets, n_shards=n_data, k=k,
+                min_tile_docs=1 if backend == "xla" else 128,
             )
             reals = resident = None
         if plan is None:
@@ -1014,6 +1066,19 @@ class OnlineLDA:
         if resident_bytes > p.resident_budget_bytes:
             return None
         n_tiles = plan.ids.shape[0]
+        if backend == "xla" and not forced:
+            # Pad-slot profitability guard for the XLA twin: the Mosaic
+            # kernel's pad slots converge in ~2 VMEM-resident iterations,
+            # but the XLA lowering pays full digamma/exp per SLOT per
+            # inner iteration.  On heavy-tailed corpora tiny docs pack
+            # densely, the fullest tile sets d for every tile, and slot
+            # waste explodes — measured 8x SLOWER than the flat packed
+            # path at the 20NG bench shape (slots/doc ~25).  Auto mode
+            # only keeps the resident tier where the slot axis stays
+            # close to the true doc count; past the bound the flat
+            # packed path (gamma exactly [B, k]) wins and we fall back.
+            if n_tiles * plan.d > 3.0 * max(1, n):
+                return None
         shard_rows = n_tiles // n_data
         if reals is None:
             # real (non-all-pad) tiles per shard: the doc-order plan puts
@@ -1057,7 +1122,7 @@ class OnlineLDA:
             )
         ids_res, cts_res, seg_res, doc_res = resident
 
-        key_fn = (plan.d, n)
+        key_fn = (plan.d, n, backend)
         if self._tiles_res_fn is None or self._tiles_res_key != key_fn:
             # dispatch attribution: calls + runtime collective bytes per
             # compiled executable (telemetry.dispatch)
@@ -1069,6 +1134,7 @@ class OnlineLDA:
                     seed=p.seed, d=plan.d, n_docs=n,
                     max_inner=p.estep_max_inner, tol=p.estep_tol,
                     interpret=jax.default_backend() != "tpu",
+                    gamma_backend=backend,
                 ),
             )
             self._tiles_res_key = key_fn
@@ -1115,7 +1181,9 @@ class OnlineLDA:
         # honest in bench.py
         self.last_batch_size = int(round(n * n_data * tb_l / n_real))
         self.last_layout = "tiles_resident"
-        self.last_gamma_backend = "pallas_tiles"
+        self.last_gamma_backend = (
+            "pallas_tiles" if backend == "pallas" else "xla_tiles"
+        )
         self.last_batch_cells = n_data * tb_l * plan.tt
         self.last_tiles = {
             "n_tiles": n_tiles, "tt": plan.tt, "d": plan.d,
@@ -1345,10 +1413,16 @@ class OnlineLDA:
                     # both ways on a v5e).  Run this chunk through both
                     # paths — first dispatch warms the compile, second is
                     # timed — and keep the faster for the rest of the fit.
-                    _, _ = dispatch_tiles(state)[:2]
-                    _t_st, t_tiles = dispatch_tiles(state)
-                    dispatch_flat(state)
-                    _f_st, t_flat, _ = dispatch_flat(state)
+                    # Probes run on COPIES: the chunk runners donate the
+                    # state carry, so the real ``state`` must reach
+                    # exactly one dispatch (models.dispatch.donate_carry).
+                    def _fresh():
+                        return TrainState(state.lam + 0, state.step + 0)
+
+                    _, _ = dispatch_tiles(_fresh())[:2]
+                    _t_st, t_tiles = dispatch_tiles(_fresh())
+                    dispatch_flat(_fresh())
+                    _f_st, t_flat, _ = dispatch_flat(_fresh())
                     self._packed_gamma_choice = (
                         "tiles" if t_tiles <= t_flat else "xla"
                     )
@@ -1588,12 +1662,17 @@ class OnlineLDA:
                 "stream over resident corpus tiles)"
             )
         self.last_batch_cells = bsz * row_len
-        # DEVICE-RESIDENT tiled epoch training: the TPU-native flagship
-        # path — corpus tiled once and resident, per-iteration input is
-        # a tiny tile-index pick.  Explicit token_layout="tiles" forces
-        # it (interpret-mode kernel off-TPU, for tests); "auto" takes it
-        # on TPU (resolved pallas backend) when padding waste says
-        # packed and the tiled corpus fits the resident budget.
+        # DEVICE-RESIDENT tiled epoch training: the flagship path —
+        # corpus tiled once and resident, per-iteration input is a tiny
+        # tile-index pick.  Explicit token_layout="tiles" forces it
+        # (interpret-mode kernel off-TPU, for tests); "auto" takes it on
+        # ANY backend when padding waste says packed and the tiled
+        # corpus fits the resident budget: the gamma loop lowers to the
+        # Mosaic kernel where the pallas backend resolves and to its XLA
+        # segment twin elsewhere (_fit_tiles_resident), so the CPU/
+        # default tier rides the same packed layout + tiles-resident
+        # machinery instead of re-packing token slabs host-side every
+        # chunk (ROADMAP item 2).
         if (
             p.sampling == "epoch"
             and p.device_resident is not False
@@ -1602,7 +1681,6 @@ class OnlineLDA:
                 or (
                     p.token_layout == "auto"
                     and row_len >= 4.0 * mean_nnz
-                    and _resolve_gamma_backend("auto") == "pallas"
                 )
             )
         ):

@@ -163,6 +163,10 @@ def init_gamma_rows(
     draws the same init regardless of how the batch was bucketed, sharded,
     or ordered — the property that makes bucketed and unbucketed training
     runs comparable."""
+    # f32 anchor: a python-float shape param reaches random.gamma's inner
+    # jit as a weak f64 scalar under x64 (STC201); random.gamma converts
+    # to the f32 draw dtype either way, so the value is unchanged
+    gamma_shape = jnp.float32(gamma_shape)
     keys = jax.vmap(lambda i: jax.random.fold_in(key, i))(doc_ids)
     draw = jax.vmap(
         lambda kk: jax.random.gamma(kk, gamma_shape, (k,), jnp.float32)

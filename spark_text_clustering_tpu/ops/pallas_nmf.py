@@ -1,0 +1,143 @@
+"""Pallas TPU kernel for the TOKEN-PACKED NMF multiplicative update.
+
+ROADMAP open item 2 / the BENCH_r05 "NMF 0.22x" diagnosis
+(docs/OBSERVABILITY.md): the dense per-minibatch update in
+``models/nmf.py`` re-gathers H rows into a padded [B, L, k] slab and
+runs unfused XLA ops — measured 0.32 GB/s achieved HBM bandwidth,
+because the slab is built (and re-streamed) twice per iteration at
+10-20x padding waste.  This module is the NMF twin of the proven EM/VB
+recipe (``ops.pallas_packed`` / ``ops.pallas_emsweep``): the corpus is
+tiled ONCE into fixed [tt-token x d-doc-slot] tiles with no document
+straddling a tile (``plan_corpus_tiles``), and one Mosaic kernel per
+sweep computes the whole W-side of the Lee-Seung update with its
+numerator/denominator accumulators VMEM-resident:
+
+  * the tile's gathered-H block ``hg [k, tt]`` is read from HBM exactly
+    once per sweep (the XLA path re-streams it per einsum);
+  * segment operations become ONE-HOT MATMULS on the MXU (the
+    ``pallas_packed`` trick): the per-token doc-slot one-hot turns
+      - X H^T   (numerator)    into  ``onehot @ (hg * cts)^T``  [d, k]
+      - W rows -> token rows   into  ``onehot^T @ w_new``       [tt, k]
+    — no dynamic gather/scatter inside the kernel (Mosaic has none);
+  * the denominator ``w @ (H H^T)`` rides the same MXU pass (H H^T is a
+    tiny [k, k] computed once per sweep outside and broadcast in);
+  * the kernel also emits the H-update's scatter VALUES
+    ``cts * w_new[slot]`` in token order, so the vocab-side scatter-add
+    (which stays in XLA — it is vocab-, not doc-, indexed) needs no
+    separate [T, k] doc gather.
+
+Pad token slots carry ``seg == d`` (outside the one-hot range) and
+``cts == 0``; pad doc slots start at W == 0 and the multiplicative
+update keeps them there — padding is numerically inert, exactly like
+the padded path's zero-weight rows.
+
+``interpret=True`` runs the identical kernel on CPU (tests, parity
+pins); on TPU it compiles via Mosaic.  Semantics are pinned against the
+flat XLA segment path and the dense numpy reference by
+tests/test_nmf_fused.py.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+__all__ = ["nmf_mu_update_tiles"]
+
+
+def _mu_kernel(hg_ref, cts_ref, seg_ref, w_ref, hht_ref,
+               w_out_ref, vals_out_ref, *, d: int, eps: float):
+    """One tile: hg [k, tt] + the one-hot stay VMEM-resident across both
+    accumulations; every segment op is an MXU matmul against the one-hot.
+    cts/seg arrive as [1, 1, tt] blocks (the unit middle axis keeps the
+    trailing block dims Mosaic-legal — see ``pallas_packed``)."""
+    hg = hg_ref[:]                       # [k, tt]
+    cts = cts_ref[:].reshape(1, -1)      # [1, tt]
+    seg = seg_ref[:].reshape(1, -1)      # [1, tt] (pad slots == d)
+    w = w_ref[:]                         # [d, k]
+    hht = hht_ref[:]                     # [k, k]
+
+    onehot = (
+        jax.lax.broadcasted_iota(jnp.int32, (d, seg.shape[1]), 0)
+        == seg
+    ).astype(jnp.float32)                                      # [d, tt]
+
+    # W numerator (X H^T restricted to this tile's docs): one-hot matmul
+    # is an EXACT f32 selection-sum — the same precision contract as the
+    # EM sweep's doc-side formulation (em_lda: MXU bf16 passes drift).
+    xht = jax.lax.dot_general(
+        onehot, (hg * cts).T,
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )                                                          # [d, k]
+    denom = jax.lax.dot_general(
+        w, hht,
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )                                                          # [d, k]
+    w_new = w * xht / (denom + eps)
+    w_out_ref[:] = w_new
+
+    # H-update scatter values in token order: cts * w_new[slot] — the
+    # doc->token expansion is the one-hot's adjoint, so the XLA side
+    # never gathers over the doc axis.
+    w_tok = jax.lax.dot_general(
+        onehot, w_new,
+        dimension_numbers=(((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )                                                          # [tt, k]
+    vals_out_ref[:] = w_tok * cts.reshape(-1, 1)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("d", "eps", "interpret")
+)
+def nmf_mu_update_tiles(
+    hg_kt: jnp.ndarray,      # [k, n_tiles * tt] gathered H at token ids
+    cts: jnp.ndarray,        # [n_tiles, tt] token weights (X values)
+    seg: jnp.ndarray,        # [n_tiles, tt] tile-local doc slots
+    w_slots: jnp.ndarray,    # [n_tiles * d, k] tile-slot-ordered W
+    hht: jnp.ndarray,        # [k, k] H H^T (psum'd over "model")
+    d: int,
+    eps: float = 1e-9,
+    interpret: bool = False,
+):
+    """One fused W multiplicative update over a tile-planned corpus.
+
+    Returns ``(w_new [n_tiles * d, k], vals [n_tiles * tt, k])`` where
+    ``vals = cts * w_new[slot]`` are the H-update's scatter-add values
+    in token order (feed them straight to ``scatter_add_model_shard``).
+    """
+    n_tiles, tt = cts.shape
+    k = hg_kt.shape[0]
+
+    kernel = functools.partial(_mu_kernel, d=d, eps=eps)
+    return pl.pallas_call(
+        kernel,
+        grid=(n_tiles,),
+        in_specs=[
+            pl.BlockSpec((k, tt), lambda i: (0, i)),
+            pl.BlockSpec((1, 1, tt), lambda i: (i, 0, 0)),
+            pl.BlockSpec((1, 1, tt), lambda i: (i, 0, 0)),
+            pl.BlockSpec((d, k), lambda i: (i, 0)),
+            pl.BlockSpec((k, k), lambda i: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((d, k), lambda i: (i, 0)),
+            pl.BlockSpec((tt, k), lambda i: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n_tiles * d, k), jnp.float32),
+            jax.ShapeDtypeStruct((n_tiles * tt, k), jnp.float32),
+        ],
+        interpret=interpret,
+    )(
+        hg_kt,
+        cts.reshape(n_tiles, 1, tt),
+        seg.astype(jnp.int32).reshape(n_tiles, 1, tt),
+        w_slots,
+        hht,
+    )

@@ -105,6 +105,7 @@ def plan_tile_pack(
     tile_tokens: Optional[int] = None,
     max_docs: Optional[int] = None,
     k: int = 0,
+    min_tile_docs: int = _MIN_TILE_DOCS,
 ) -> Optional[TilePlan]:
     """Greedy first-fit of a doc-contiguous token stream into fixed
     [tt-token x d-doc] tiles with no document straddling a tile.
@@ -148,14 +149,18 @@ def plan_tile_pack(
     fence = fences(None)
     n_tiles = max(1, len(fence) - 1)
     d = _pow2(int(np.diff(fence).max()) if len(fence) > 1 else 1)
-    d = max(d, _MIN_TILE_DOCS)  # Mosaic lane width for the gamma block
+    # Mosaic lane width for the gamma block; the XLA segment twin
+    # (online_lda gamma_backend="xla") passes min_tile_docs=1 — its
+    # slot axis has no lane constraint, and the 128-slot floor was
+    # measured as ~7x pad-slot waste on the CPU tier
+    d = max(d, min_tile_docs)
     # tiles with more docs than the pow2 rounding should carry are split
     # by the doc cap instead
     if max_docs is not None and d > max_docs:
         fence = fences(max_docs)
         n_tiles = max(1, len(fence) - 1)
         d = max(
-            _MIN_TILE_DOCS,
+            min_tile_docs,
             _pow2(int(np.diff(fence).max()) if len(fence) > 1 else 1),
         )
     # resident blocks: onehot [d, tt] + cts/seg + eb and et_tok [k, tt]
@@ -314,6 +319,7 @@ def plan_corpus_tiles(
     tile_tokens: Optional[int] = None,
     n_shards: int = 1,
     k: int = 0,
+    min_tile_docs: int = _MIN_TILE_DOCS,
 ) -> Optional[TilePlan]:
     """Tile the WHOLE corpus once, in doc order, for the device-resident
     tiled training path (online_lda ``token_layout="tiles"``).
@@ -336,11 +342,12 @@ def plan_corpus_tiles(
     if max_nnz > tt:
         return None
     cap = _VMEM_TILE_BUDGET // (4 * tt) - 2 - 2 * k
-    if cap < _MIN_TILE_DOCS:
+    if cap < min_tile_docs:
         return None
     cap = 1 << (cap.bit_length() - 1)
     p = plan_tile_pack(
-        flat_ids, flat_cts, seg, n, tile_tokens=tt, max_docs=cap, k=k
+        flat_ids, flat_cts, seg, n, tile_tokens=tt, max_docs=cap, k=k,
+        min_tile_docs=min_tile_docs,
     )
     if p is None:
         return None

@@ -277,7 +277,13 @@ def model_handoff(x, v: int):
     where all processes participate.
     """
     if jax.process_count() == 1:
-        return x[:, :v]
+        out = x[:, :v]
+        # the download this handoff SAVED (deferred to ensure_host on
+        # the first host consumer, counted there as handoff.downloads)
+        telemetry.gauge(
+            "handoff.deferred_bytes", int(out.size) * out.dtype.itemsize
+        )
+        return out
     return fetch_global(x)[:, :v]
 
 

@@ -90,6 +90,13 @@ METRICS: Dict[str, str] = {
     "stream.train.micro_batch_seconds": "stream-train trigger wall time",
     # -- training loops -------------------------------------------------
     "train_iteration_seconds": "per-iteration wall time (IterationTimer)",
+    # -- device-resident model handoff (PERF.md item 2) -----------------
+    "handoff.deferred_bytes":
+        "model bytes left device-resident at the fit -> model handoff "
+        "(the [k, V] download a single-process fit defers)",
+    "handoff.downloads":
+        "deferred device-resident models materialized to host on their "
+        "first host-side consumer (ensure_host)",
     # -- static analysis (docs/STATIC_ANALYSIS.md) ----------------------
     "lint.findings": "unwaived stc lint findings in the last run",
     "lint.waived": "stc lint findings suppressed by pragma or baseline",

@@ -1,0 +1,434 @@
+"""The NMF / online-VB fused-kernel tier (ROADMAP item 2).
+
+Pins the EM recipe ported to the two laggard trainers:
+
+  * packed-layout NMF — flat XLA segment tier and the fused Mosaic
+    kernel tier (``ops.pallas_nmf``, interpret mode on CPU) — against
+    the padded baseline and a dense numpy reference;
+  * whole-run scan chunking: a fit is O(1) dispatches, verified through
+    the live ``dispatch.<digest>.calls`` counters, and a scan-chunked
+    run equals the same sweeps dispatched one at a time;
+  * the donation discipline: chunk runners donate their state carry
+    (``models.dispatch.donate_carry``), so the fit loops must never
+    touch an input state after dispatch — emulated here by DELETING the
+    input buffers post-call (what donation does on a real accelerator;
+    XLA:CPU ignores the request, so the discipline needs this pin);
+  * device-resident model handoff (NMFModel.ensure_host) and the
+    ``nmf.solve_w`` recompile-hazard fix (bucketed iteration cap);
+  * the online CPU/default tier riding the tiles-resident machinery
+    with the XLA gamma twin, at quality parity with the packed path.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from spark_text_clustering_tpu import telemetry
+from spark_text_clustering_tpu.config import Params
+from spark_text_clustering_tpu.models.nmf import (
+    NMF,
+    make_nmf_packed_runner,
+)
+from spark_text_clustering_tpu.models.online_lda import (
+    OnlineLDA,
+    TrainState,
+    make_online_tiles_resident_chunk,
+)
+from spark_text_clustering_tpu.parallel.mesh import make_mesh
+from spark_text_clustering_tpu.telemetry import dispatch as dispatch_attr
+
+
+@pytest.fixture(autouse=True)
+def _telemetry_reset():
+    telemetry.shutdown()
+    telemetry.get_registry().reset()
+    dispatch_attr.reset()
+    yield
+    telemetry.shutdown()
+    telemetry.get_registry().reset()
+    dispatch_attr.reset()
+
+
+def _mesh1():
+    return make_mesh(
+        data_shards=1, model_shards=1, devices=jax.devices("cpu")[:1]
+    )
+
+
+def _dense(rows, v):
+    x = np.zeros((len(rows), v), np.float32)
+    for d, (ids, wts) in enumerate(rows):
+        x[d, ids] = wts
+    return x
+
+
+def _numpy_nmf(x, w, h, iters, eps=1e-9):
+    for _ in range(iters):
+        w = w * (x @ h.T) / (w @ (h @ h.T) + eps)
+        h = h * (w.T @ x) / ((w.T @ w) @ h + eps)
+    return w, h
+
+
+def _nmf_init(rows, v, k, seed):
+    """The estimator's scaled-uniform init, rebuilt host-side."""
+    total = float(sum(c.sum() for _, c in rows))
+    mean_x = total / (len(rows) * v)
+    scale = np.sqrt(mean_x / k)
+    kw, kh = jax.random.split(jax.random.PRNGKey(seed))
+    w0 = scale * (
+        0.5 + np.asarray(jax.random.uniform(kw, (len(rows), k), jnp.float32))
+    )
+    h0 = scale * (
+        0.5 + np.asarray(jax.random.uniform(kh, (k, v), jnp.float32))
+    )
+    return w0, h0
+
+
+class TestNMFFusedParity:
+    def test_all_three_tiers_match_dense_reference(
+        self, tiny_corpus_rows, monkeypatch
+    ):
+        """padded / packed-flat / packed-fused(kernel, interpret) all
+        land on the dense float64 reference within fp32 drift."""
+        rows, vocab = tiny_corpus_rows
+        v, k, iters = len(vocab), 4, 15
+        x = _dense(rows, v)
+        w0, h0 = _nmf_init(rows, v, k, seed=3)
+        w_ref, h_ref = _numpy_nmf(x.astype(np.float64), w0, h0, iters)
+        loss_ref = float(((x - w_ref @ h_ref) ** 2).sum())
+
+        results = {}
+        for name, layout, env in (
+            ("padded", "padded", None),
+            ("flat", "packed", None),
+            ("fused", "packed", "pallas"),
+        ):
+            if env:
+                monkeypatch.setenv("STC_GAMMA_BACKEND", env)
+            else:
+                monkeypatch.delenv("STC_GAMMA_BACKEND", raising=False)
+            opt = NMF(
+                Params(k=k, max_iterations=iters, seed=3,
+                       token_layout=layout),
+                mesh=_mesh1(),
+            )
+            model = opt.fit(rows, vocab)
+            results[name] = (np.asarray(model.h), opt)
+            np.testing.assert_allclose(
+                results[name][0], h_ref, rtol=5e-2, atol=1e-4
+            )
+            assert opt.last_loss == pytest.approx(loss_ref, rel=5e-3)
+        assert results["flat"][1].last_mu_backend == "xla"
+        assert results["fused"][1].last_mu_backend == "pallas_tiles"
+        assert results["padded"][1].last_mu_backend == "none"
+        # the two packed tiers agree far tighter with EACH OTHER (same
+        # f32 math, only reduction layout differs) than with f64
+        np.testing.assert_allclose(
+            results["flat"][0], results["fused"][0], rtol=1e-3, atol=1e-5
+        )
+
+    def test_scan_chunked_equals_stepped(self, tiny_corpus_rows):
+        """One m=6 scan dispatch == six m=1 dispatches (state threading
+        is exact, not approximately convergent)."""
+        rows, vocab = tiny_corpus_rows
+        k, v = 3, len(vocab)
+        mesh = _mesh1()
+        run = make_nmf_packed_runner(mesh)
+        opt = NMF(Params(k=k, seed=1, token_layout="packed"), mesh=mesh)
+        ids_f, cts_f, seg_f, slot, d_max, _ = opt._packed_plan(
+            rows, len(rows)
+        )
+        w_doc, h0 = _nmf_init(rows, v, k, seed=1)
+        w0 = np.zeros((d_max, k), np.float32)
+        w0[slot] = w_doc
+        x2 = float((cts_f.astype(np.float64) ** 2).sum())
+
+        args = (jnp.asarray(ids_f), jnp.asarray(cts_f), jnp.asarray(seg_f))
+        w_a, h_a, loss_a = run(
+            jnp.asarray(w0), jnp.asarray(h0), *args, x2, 6
+        )
+        w_b, h_b = jnp.asarray(w0), jnp.asarray(h0)
+        for _ in range(6):
+            w_b, h_b, loss_b = run(w_b, h_b, *args, x2, 1)
+        np.testing.assert_allclose(
+            np.asarray(w_a), np.asarray(w_b), rtol=1e-5, atol=1e-7
+        )
+        np.testing.assert_allclose(
+            np.asarray(h_a), np.asarray(h_b), rtol=1e-5, atol=1e-7
+        )
+        assert float(loss_a) == pytest.approx(float(loss_b), rel=1e-5)
+
+    def test_fit_is_one_dispatch_with_loss_folded_in(
+        self, tiny_corpus_rows
+    ):
+        """Acceptance pin (ISSUE 8): a packed fit issues O(1) device
+        dispatches — ONE chunk call carrying every sweep AND the loss —
+        verified via the live dispatch.<digest>.calls counters."""
+        rows, vocab = tiny_corpus_rows
+        telemetry.configure(None)
+        opt = NMF(
+            Params(k=3, max_iterations=40, seed=0, token_layout="packed"),
+            mesh=_mesh1(),
+        )
+        opt.fit(rows, vocab)
+        assert opt.last_dispatches == 1
+        recs = [
+            r for r in dispatch_attr.records().values()
+            if r.label == "nmf.packed_chunk"
+        ]
+        assert len(recs) == 1 and recs[0].calls == 1
+        # no separate loss executable ran (the padded path's nmf.loss)
+        assert not any(
+            r.label == "nmf.loss" for r in dispatch_attr.records().values()
+        )
+        snap = telemetry.get_registry().snapshot()
+        calls = {
+            k: val for k, val in snap["counters"].items()
+            if k == f"dispatch.{recs[0].digest}.calls"
+        }
+        assert list(calls.values()) == [1]
+
+    def test_no_use_after_donate(self, tiny_corpus_rows):
+        """The fit loop must never touch a state it already dispatched:
+        emulate accelerator donation by deleting the donated operands
+        after each runner call (CPU ignores donate_argnums, so this is
+        the only way the discipline can regress-test on the sandbox)."""
+        rows, vocab = tiny_corpus_rows
+        opt = NMF(
+            Params(k=3, max_iterations=10, seed=0, token_layout="packed"),
+            mesh=_mesh1(),
+        )
+        opt.fit(rows, vocab)          # builds + caches the runner
+        (key, real), = opt._packed_fns.items()
+
+        def donating(w, h, *rest):
+            out = real(w, h, *rest)
+            for leaf in jax.tree_util.tree_leaves((w, h)):
+                leaf.delete()
+            return out
+
+        opt._packed_fns[key] = donating
+        model = opt.fit(rows, vocab)
+        assert np.isfinite(model.loss)
+        assert np.isfinite(np.asarray(model.h)).all()
+
+
+class TestNMFHandoffAndSolveW:
+    def test_device_resident_handoff(self, tiny_corpus_rows):
+        """Single-process fits hand over a DEVICE-backed H; transform
+        consumes it on-chip, ensure_host pays the download exactly once
+        and counts it."""
+        rows, vocab = tiny_corpus_rows
+        telemetry.configure(None)
+        model = NMF(
+            Params(k=3, max_iterations=10, seed=0), mesh=_mesh1()
+        ).fit(rows, vocab)
+        assert not isinstance(model.h, np.ndarray)
+        snap = telemetry.get_registry().snapshot()
+        assert snap["gauges"]["handoff.deferred_bytes"] > 0
+        # transform works straight off the device-resident factors
+        w = model.transform(rows[:4])
+        assert w.shape == (4, 3) and np.isfinite(w).all()
+        assert not isinstance(model.h, np.ndarray)  # still deferred
+        model.ensure_host()
+        assert isinstance(model.h, np.ndarray)
+        model.ensure_host()                          # idempotent
+        snap = telemetry.get_registry().snapshot()
+        assert snap["counters"]["handoff.downloads"] == 1
+        # the estimator-agnostic scoring surface: cli score passes
+        # mesh= to every loaded model (regressed pre-PR-8: NMF scoring
+        # raised TypeError on the kwarg)
+        d = model.topic_distribution(rows[:2], mesh=None)
+        assert d.shape == (2, 3)
+
+    def test_solve_w_buckets_iteration_count(self, tiny_corpus_rows):
+        """Distinct n_iter values inside one power-of-two bucket share
+        ONE compiled executable (the recompile hazard the compile
+        sentinel gates), and results keep EXACT requested-depth
+        semantics."""
+        rows, vocab = tiny_corpus_rows
+        telemetry.configure(None)
+        model = NMF(
+            Params(k=3, max_iterations=10, seed=0), mesh=_mesh1()
+        ).fit(rows, vocab)
+        for n_iter in (5, 6, 7, 8):    # one bucket: cap 8
+            model.transform(rows[:4], n_iter=n_iter)
+        solve_recs = [
+            r for r in dispatch_attr.records().values()
+            if r.label == "nmf.solve_w"
+        ]
+        assert len(solve_recs) == 1 and solve_recs[0].calls == 4
+        # a different bucket is a NEW signature (still logarithmic)
+        model.transform(rows[:4], n_iter=20)
+        solve_recs = [
+            r for r in dispatch_attr.records().values()
+            if r.label == "nmf.solve_w"
+        ]
+        assert len(solve_recs) == 2
+
+    def test_solve_w_exact_depth_semantics(self, tiny_corpus_rows):
+        """cap > n_iter must not run extra updates: n_iter=1 equals one
+        hand-rolled multiplicative W update."""
+        rows, vocab = tiny_corpus_rows
+        model = NMF(
+            Params(k=3, max_iterations=20, seed=0), mesh=_mesh1()
+        ).fit(rows, vocab)
+        model.ensure_host()
+        got = model.transform(rows[:3], n_iter=1)
+
+        from spark_text_clustering_tpu.ops.sparse import batch_from_rows
+
+        x = _dense(rows[:3], len(vocab))
+        h = model.h.astype(np.float64)
+        w0 = np.full((3, 3), 1.0 / 3)
+        want = w0 * (x @ h.T) / (w0 @ (h @ h.T) + 1e-9)
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-7)
+        # and the exported batch path agrees with the row path
+        got_b = model.transform(batch_from_rows(rows[:3]), n_iter=1)
+        np.testing.assert_allclose(got_b, got, rtol=1e-6)
+
+
+def _heavy_tailed_rows(rng, n_docs, v, planted_k=2):
+    """One fat doc forces >=4x padding waste (the packed/tiles auto
+    trigger) over an otherwise moderate-nnz body — moderate, not tiny,
+    so the tile plan's slot axis stays inside the XLA twin's pad-slot
+    profitability guard — with planted disjoint-vocab topics so quality
+    is checkable."""
+    rows = []
+    width = v // planted_k
+    for i in range(n_docs):
+        lo = (i % planted_k) * width
+        nnz = int(rng.integers(8, 17))
+        ids = np.sort(rng.choice(
+            np.arange(lo, lo + width), size=nnz, replace=False
+        )).astype(np.int32)
+        rows.append((ids, rng.integers(1, 5, nnz).astype(np.float32)))
+    # one fat doc: row_len -> >= 4x mean nnz, the packed/tiles trigger
+    ids = np.sort(rng.choice(v, size=min(v - 1, 256), replace=False))
+    rows[0] = (
+        ids.astype(np.int32),
+        rng.integers(1, 5, ids.size).astype(np.float32),
+    )
+    return rows, [f"t{i}" for i in range(v)]
+
+
+class TestOnlineCpuFusedTier:
+    def _fit(self, rows, vocab, **kw):
+        defaults = dict(
+            k=4, algorithm="online", max_iterations=6, sampling="epoch",
+            batch_size=120, seed=0,
+        )
+        defaults.update(kw)
+        opt = OnlineLDA(Params(**defaults), mesh=_mesh1())
+        model = opt.fit(rows, vocab)
+        return model, opt
+
+    def test_auto_epoch_routes_tiles_resident_xla(self):
+        """The CPU/default auto tier now rides the SAME tiles-resident
+        machinery the TPU path uses, lowered through the XLA gamma twin,
+        in ONE scanned dispatch."""
+        rows, vocab = _heavy_tailed_rows(
+            np.random.default_rng(7), 600, 1 << 10
+        )
+        telemetry.configure(None)
+        model, opt = self._fit(rows, vocab)
+        assert opt.last_layout == "tiles_resident"
+        assert opt.last_gamma_backend == "xla_tiles"
+        assert opt.last_dispatches == 1
+        recs = [
+            r for r in dispatch_attr.records().values()
+            if r.label == "online.tiles_resident_chunk"
+        ]
+        assert len(recs) == 1 and recs[0].calls == 1
+        lam = np.asarray(model.lam)
+        assert np.isfinite(lam).all() and (lam > 0).all()
+
+    def test_quality_parity_with_packed_path(self):
+        """Same corpus, same budget: the tiles-resident XLA tier must
+        land inside a tight log-perplexity band of the host-streaming
+        packed path (different minibatch grouping, same optimizer)."""
+        rows, vocab = _heavy_tailed_rows(
+            np.random.default_rng(3), 600, 1 << 10
+        )
+        m_tiles, o_tiles = self._fit(rows, vocab, max_iterations=20)
+        m_packed, o_packed = self._fit(
+            rows, vocab, max_iterations=20, token_layout="packed"
+        )
+        assert o_tiles.last_layout == "tiles_resident"
+        assert o_packed.last_layout == "packed"
+        lp_tiles = m_tiles.log_perplexity(rows[:128])
+        lp_packed = m_packed.log_perplexity(rows[:128])
+        assert lp_tiles == pytest.approx(lp_packed, rel=0.05)
+
+    def test_xla_tiles_gamma_matches_kernel_on_same_plan(self):
+        """Backend parity at the CHUNK level: identical tile inputs
+        through gamma_backend='xla' and the interpreted Mosaic kernel
+        train to the same lambda (same fixed point, same M-step)."""
+        rng = np.random.default_rng(5)
+        k, v, n_tiles, tt, d, n_docs = 4, 64, 2, 16, 4, 8
+        mesh = _mesh1()
+        lam0 = (
+            rng.random((k, v)).astype(np.float32) + 0.5
+        )
+        ids_res = rng.integers(0, v, (n_tiles, tt)).astype(np.int32)
+        cts_res = np.where(
+            rng.random((n_tiles, tt)) < 0.8,
+            rng.integers(1, 4, (n_tiles, tt)), 0
+        ).astype(np.float32)
+        seg_res = np.sort(
+            rng.integers(0, d, (n_tiles, tt)), axis=1
+        ).astype(np.int32)
+        doc_res = (
+            np.arange(n_tiles * d, dtype=np.int32).reshape(n_tiles, d)
+            % n_docs
+        )
+        picks = np.zeros((3, 1, 1), np.int32)
+        picks[1, 0, 0] = 1
+
+        outs = {}
+        for backend in ("xla", "pallas"):
+            fn = make_online_tiles_resident_chunk(
+                mesh, alpha=0.1, eta=0.01, tau0=1024.0, kappa=0.51,
+                k=k, gamma_shape=100.0, seed=0, d=d, n_docs=n_docs,
+                max_inner=40, tol=1e-5, interpret=True,
+                gamma_backend=backend,
+            )
+            st = fn(
+                TrainState(jnp.asarray(lam0), jnp.int32(0)),
+                jnp.asarray(ids_res), jnp.asarray(cts_res),
+                jnp.asarray(seg_res), jnp.asarray(doc_res),
+                jnp.asarray(picks), np.float32(n_docs),
+            )
+            outs[backend] = np.asarray(st.lam)
+        np.testing.assert_allclose(
+            outs["xla"], outs["pallas"], rtol=2e-3, atol=1e-5
+        )
+
+    def test_online_no_use_after_donate(self):
+        """Same donation discipline pin as NMF, for the tiles-resident
+        fit loop: delete the dispatched state post-call, fit survives."""
+        rows, vocab = _heavy_tailed_rows(
+            np.random.default_rng(11), 600, 1 << 10
+        )
+        opt = OnlineLDA(
+            Params(
+                k=4, algorithm="online", max_iterations=4,
+                sampling="epoch", batch_size=120, seed=0,
+            ),
+            mesh=_mesh1(),
+        )
+        opt.fit(rows, vocab)          # builds + caches the runner
+        real = opt._tiles_res_fn
+        assert real is not None
+
+        def donating(state, *rest):
+            out = real(state, *rest)
+            for leaf in jax.tree_util.tree_leaves(state):
+                leaf.delete()
+            return out
+
+        opt._tiles_res_fn = donating
+        model = opt.fit(rows, vocab)
+        assert np.isfinite(np.asarray(model.lam)).all()
