@@ -45,9 +45,17 @@
 #      once and zero quarantined-epoch re-emissions; the drill's
 #      fleet.* counters (spawns/respawns/lease_expiries/preemptions)
 #      gate against the committed baseline
+#  11. serve drill: an `stc serve` daemon starts against the gate-5
+#      trained model, concurrent HTTP clients score while a newer
+#      model publishes mid-traffic; the drill asserts every response
+#      attributes to exactly ONE published artifact (old or new, never
+#      a torn mix), the hot-swap lands, zero compile retraces after
+#      warmup (the sentinel's serving claim), and a SIGTERM drain
+#      exits 0; the deterministic serve counters (requests, swaps)
+#      gate against the committed baseline
 #
 # Usage:
-#   scripts/ci_check.sh                 # run all ten gates
+#   scripts/ci_check.sh                 # run all eleven gates
 #   scripts/ci_check.sh --rebaseline    # recapture ALL baselines
 #                                       # (metrics + lint waivers +
 #                                       # lint counters + compile
@@ -280,6 +288,151 @@ print(f"fleet drill: {committed} committed epochs, exactly-once")
 EOF
 }
 
+run_serve_drill() {
+    # gate 11: serve smoke + hot-swap + drain.  Requests are exact (16
+    # before the publish, 16 after the swap lands), so
+    # counter.serve.requests/swaps are machine-independent; batch
+    # counts depend on coalescing timing and stay out of the baseline.
+    local workdir="$1"
+    python - "$workdir" <<'EOF'
+import json
+import os
+import re
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+import numpy as np
+
+workdir = sys.argv[1]
+books = os.path.join(workdir, "books")
+models = os.path.join(workdir, "models")
+log_path = os.path.join(workdir, "serve_stdout.log")
+proc = subprocess.Popen(
+    [sys.executable, "-m", "spark_text_clustering_tpu.cli", "serve",
+     "--models-dir", models, "--port", "0", "--no-lemmatize",
+     "--max-batch", "8", "--linger-ms", "2",
+     "--model-poll-interval", "0.3",
+     "--token-bucket", "256", "--token-bucket", "1024",
+     "--telemetry-file", os.path.join(workdir, "serve.jsonl")],
+    stdout=open(log_path, "w"), stderr=subprocess.STDOUT,
+)
+port = None
+pat = re.compile(r"on http://127\.0\.0\.1:(\d+)")
+deadline = time.time() + 180
+while time.time() < deadline:
+    with open(log_path) as f:
+        m = pat.search(f.read())
+    if m:
+        port = int(m.group(1))
+        break
+    if proc.poll() is not None:
+        sys.exit(f"serve died during startup (rc={proc.returncode})")
+    time.sleep(0.2)
+assert port, "serve never announced its port"
+base = f"http://127.0.0.1:{port}"
+
+
+def post(texts):
+    req = urllib.request.Request(
+        base + "/score", data=json.dumps({"texts": texts}).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=60) as r:
+        return json.loads(r.read())["results"]
+
+
+def health():
+    with urllib.request.urlopen(base + "/healthz", timeout=10) as r:
+        return json.loads(r.read())
+
+
+texts = [
+    open(os.path.join(books, n)).read()
+    for n in sorted(os.listdir(books))
+]
+path_a = health()["model"]["model"]
+results = []
+lock = threading.Lock()
+
+
+def volley(round_id):
+    # 8 concurrent clients x 2 docs = 16 requests, exactly
+    def client(i):
+        for j in range(2):
+            out = post([texts[(i + j) % len(texts)]])
+            with lock:
+                results.extend(out)
+    ths = [
+        threading.Thread(target=client, args=(i,)) for i in range(8)
+    ]
+    for t in ths:
+        t.start()
+    for t in ths:
+        t.join()
+
+
+# publish a new model WHILE the first volley is in flight
+def publish():
+    from spark_text_clustering_tpu.models.persistence import (
+        load_model, save_model,
+    )
+    m = load_model(path_a)
+    m.lam = (np.asarray(m.lam) * 1.01 + 0.01).astype(np.float32)
+    save_model(
+        m, os.path.join(models, f"LdaModel_EN_{int(time.time()*1000)}")
+    )
+
+
+pub = threading.Thread(target=publish)
+pub.start()
+volley(0)
+pub.join()
+deadline = time.time() + 60
+path_b = None
+while time.time() < deadline:
+    cur = health()["model"]["model"]
+    if cur != path_a:
+        path_b = cur
+        break
+    time.sleep(0.2)
+assert path_b, "hot swap never landed"
+volley(1)
+for r in results:
+    assert "topic" in r, f"request failed: {r}"
+    assert r["model"]["model"] in (path_a, path_b), (
+        f"torn attribution: {r['model']}"
+    )
+assert any(r["model"]["model"] == path_b for r in results), \
+    "no response ever attributed to the new epoch"
+proc.send_signal(signal.SIGTERM)
+assert proc.wait(timeout=180) == 0, "drain did not exit 0"
+
+from spark_text_clustering_tpu.telemetry.metrics_cli import (
+    load_run, run_metrics, serving_health,
+)
+
+_, events = load_run(os.path.join(workdir, "serve.jsonl"))
+sh = serving_health(events, run_metrics(events))
+assert sh is not None, "no serving-health section in the run stream"
+assert sh["requests"] == 32, sh
+assert sh["hot_swaps"] == 1, sh
+assert sh["retraces_after_warmup"] == 0, (
+    f"steady state re-traced: {sh}"
+)
+assert sh["request_seconds"]["count"] == 32
+assert sh["request_seconds"]["p99"] > 0
+print(
+    f"serve drill: 32 requests, swap "
+    f"{os.path.basename(path_a)} -> {os.path.basename(path_b)}, "
+    f"0 recompiles after warmup, clean drain"
+)
+EOF
+}
+
 make_skew_streams() {
     # two synthetic per-process streams: balanced pair + a pair with a
     # planted straggler/retry divergence on p1 (the merge gate's fixture)
@@ -334,6 +487,13 @@ if [[ "${1:-}" == "--rebaseline" ]]; then
         "$work/fleet_drill.jsonl" --baseline "$BASELINE" \
         --write-baseline --tolerance 0.0 --include counter.fleet. \
         || exit 1
+    # fold the serve drill's deterministic counters the same way
+    run_serve_drill "$work" || exit 1
+    python -m spark_text_clustering_tpu.cli metrics check \
+        "$work/serve.jsonl" --baseline "$BASELINE" \
+        --write-baseline --tolerance 0.0 \
+        --include counter.serve.requests \
+        --include counter.serve.swaps || exit 1
     # recapture the recompile sentinel's expected-signature table from
     # the same train run plus a score run and an NMF fit+transform run
     # (gate 9's fixture triple)
@@ -349,12 +509,12 @@ fail=0
 work=$(mktemp -d)
 trap 'rm -rf "$work"' EXIT
 
-echo "== [1/10] stc lint (AST rules + jaxpr audit) =="
+echo "== [1/11] stc lint (AST rules + jaxpr audit) =="
 python -m spark_text_clustering_tpu.cli lint \
     --telemetry-file "$work/lint.jsonl"
 if [[ $? -ne 0 ]]; then echo "FAIL: stc lint"; fail=1; fi
 
-echo "== [2/10] ruff (generic-Python tier) =="
+echo "== [2/11] ruff (generic-Python tier) =="
 if command -v ruff >/dev/null 2>&1; then
     ruff check spark_text_clustering_tpu
     if [[ $? -ne 0 ]]; then echo "FAIL: ruff"; fail=1; fi
@@ -362,30 +522,31 @@ else
     echo "ruff not installed — skipped (stc lint STC101/102/006 cover it)"
 fi
 
-echo "== [3/10] tier-1 tests =="
+echo "== [3/11] tier-1 tests =="
 timeout -k 10 870 python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors -p no:cacheprovider \
     -p no:xdist -p no:randomly
 if [[ $? -ne 0 ]]; then echo "FAIL: tier-1"; fail=1; fi
 
-echo "== [4/10] telemetry overhead budget =="
+echo "== [4/11] telemetry overhead budget =="
 python scripts/check_telemetry_overhead.py
 if [[ $? -ne 0 ]]; then echo "FAIL: telemetry overhead"; fail=1; fi
 
-echo "== [5/10] metrics regression gate =="
+echo "== [5/11] metrics regression gate =="
 if run_ci_train "$work"; then
-    # lint., ledger., and fleet. families are captured by their own
-    # gates (1/6, 8, and 10) — a batch train run never touches them
+    # lint., ledger., fleet., and serve. families are captured by their
+    # own gates (1/6, 8, 10, and 11) — a batch train run never touches
+    # them
     python -m spark_text_clustering_tpu.cli metrics check "$work/run.jsonl" \
         --baseline "$BASELINE" "${EXCLUDES[@]}" --exclude lint. \
-        --exclude ledger. --exclude fleet.
+        --exclude ledger. --exclude fleet. --exclude serve.
     if [[ $? -ne 0 ]]; then echo "FAIL: metrics check"; fail=1; fi
 else
     echo "FAIL: CI training run"
     fail=1
 fi
 
-echo "== [6/10] lint metrics gate (waiver count version-gated) =="
+echo "== [6/11] lint metrics gate (waiver count version-gated) =="
 if [[ -s "$work/lint.jsonl" ]]; then
     python -m spark_text_clustering_tpu.cli metrics check "$work/lint.jsonl" \
         --baseline "$BASELINE" --include lint.
@@ -395,7 +556,7 @@ else
     fail=1
 fi
 
-echo "== [7/10] cross-host skew gate (metrics merge) =="
+echo "== [7/11] cross-host skew gate (metrics merge) =="
 if make_skew_streams "$work"; then
     python -m spark_text_clustering_tpu.cli metrics merge \
         "$work/skew-p0.jsonl" "$work/skew-p1.jsonl" --fail-on-skew \
@@ -416,7 +577,7 @@ else
     fail=1
 fi
 
-echo "== [8/10] exactly-once ledger chaos drill (STC_FAULTS) =="
+echo "== [8/11] exactly-once ledger chaos drill (STC_FAULTS) =="
 if run_ledger_drill "$work"; then
     python -m spark_text_clustering_tpu.cli metrics check \
         "$work/ledger_drill.jsonl" --baseline "$BASELINE" \
@@ -427,7 +588,7 @@ else
     fail=1
 fi
 
-echo "== [9/10] recompile sentinel (metrics compile-check) =="
+echo "== [9/11] recompile sentinel (metrics compile-check) =="
 if [[ -s "$work/run.jsonl" ]] && run_ci_score "$work" \
     && run_ci_nmf "$work"; then
     python -m spark_text_clustering_tpu.cli metrics compile-check \
@@ -454,7 +615,7 @@ else
     fail=1
 fi
 
-echo "== [10/10] supervisor drill (lease expiry -> SIGKILL -> respawn) =="
+echo "== [10/11] supervisor drill (lease expiry -> SIGKILL -> respawn) =="
 if run_supervisor_drill "$work"; then
     # the ladder's counters are deterministic: 3 spawns (2 + 1
     # respawn), 1 lease expiry, 1 preemption (the drain SIGTERM the
@@ -465,6 +626,20 @@ if run_supervisor_drill "$work"; then
     if [[ $? -ne 0 ]]; then echo "FAIL: fleet drill metrics"; fail=1; fi
 else
     echo "FAIL: supervisor drill run"
+    fail=1
+fi
+
+echo "== [11/11] serve drill (hot-swap + drain + zero-recompile) =="
+if [[ -d "$work/models" ]] && run_serve_drill "$work"; then
+    # requests (32 = two exact 16-doc volleys) and swaps (1) are
+    # machine-independent; batch counts depend on coalescing timing
+    # and stay unbaselined
+    python -m spark_text_clustering_tpu.cli metrics check \
+        "$work/serve.jsonl" --baseline "$BASELINE" \
+        --include counter.serve.requests --include counter.serve.swaps
+    if [[ $? -ne 0 ]]; then echo "FAIL: serve drill metrics"; fail=1; fi
+else
+    echo "FAIL: serve drill run"
     fail=1
 fi
 

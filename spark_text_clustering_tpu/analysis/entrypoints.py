@@ -329,6 +329,25 @@ def _build_lda_math_e_step():
     return fn, (_batch(), _f32((K, V)), alpha, _f32((B, K)))
 
 
+def _build_serve_topic_inference():
+    # the scoring service's frozen (per-document convergence) packed
+    # inference — the freeze=True trace is serving-only code, so the
+    # dtype/callback audit must see THIS branch, not just the default
+    import functools
+
+    import numpy as np
+
+    from ..ops.lda_math import topic_inference_segments
+
+    t = 32
+    fn = functools.partial(
+        topic_inference_segments, max_inner=5, freeze=True
+    )
+    alpha = np.full((K,), 0.1, np.float32)
+    seg = (np.arange(t, dtype=np.int32) % B).astype(np.int32)
+    return fn, (_f32((t, K)), _f32((t,)), seg, alpha, _f32((B, K)))
+
+
 ENTRYPOINTS: Tuple[EntryPoint, ...] = (
     EntryPoint("em_lda.bucket_step", True, _build_em_bucket_step),
     EntryPoint("em_lda.train_step", True, _build_em_train_step),
@@ -369,6 +388,10 @@ ENTRYPOINTS: Tuple[EntryPoint, ...] = (
         _build_pallas_nmf_mu_update,
     ),
     EntryPoint("ops.lda_math.e_step", False, _build_lda_math_e_step),
+    EntryPoint(
+        "serving.topic_inference_frozen", False,
+        _build_serve_topic_inference,
+    ),
 )
 
 

@@ -31,9 +31,9 @@ from .pipeline import (
 )
 from .models.base import LDAModel
 from .models.persistence import (
-    latest_model_dir,
     load_model,
     model_dir_name,
+    resolve_latest_model,
     train_state_valid,
 )
 from .resilience import (
@@ -356,26 +356,23 @@ def cmd_train(args: argparse.Namespace) -> int:
 
 
 def cmd_score(args: argparse.Namespace) -> int:
-    model_path = args.model or latest_model_dir(
-        args.models_dir, args.lang,
-        verify_deep=bool(getattr(args, "verify_deep", False)),
-    )
-    if model_path is None:
-        print(f"no model for lang {args.lang} under {args.models_dir}",
-              file=sys.stderr)
-        return 2
     own_telemetry = bool(getattr(args, "telemetry_file", None))
     if own_telemetry:
         # scoring runs carry the same dispatch/compile/memory telemetry
         # train runs do — `metrics roofline` and the recompile-sentinel
         # CI gate read both sides of a train+score pair
         telemetry.configure(args.telemetry_file)
-    # Generic loader: scoring works with whichever estimator trained the
-    # artifact (LDA or NMF) — both expose topic_distribution/describe_topics.
-    # A truncated/uncommitted artifact fails HERE with a typed error and a
+    # Shared selection + generic loader (models.persistence
+    # .resolve_latest_model, also the `serve` daemon's path): scoring
+    # works with whichever estimator trained the artifact (LDA or NMF) —
+    # both expose topic_distribution/describe_topics.  A missing or
+    # truncated/uncommitted artifact fails HERE with a typed error and a
     # non-zero exit — never a partial/garbage report.
     try:
-        model = load_model(model_path)
+        model_path, model = resolve_latest_model(
+            args.models_dir, args.lang, explicit=args.model,
+            verify_deep=bool(getattr(args, "verify_deep", False)),
+        )
     except CorruptArtifactError as exc:
         print(f"error: {exc}", file=sys.stderr)
         return 2
@@ -412,7 +409,15 @@ def cmd_score(args: argparse.Namespace) -> int:
         mesh = make_mesh(
             data_shards=args.data_shards, model_shards=args.model_shards
         )
-    dist = model.topic_distribution(rows, mesh=mesh)
+    per_doc = bool(getattr(args, "per_doc_convergence", False))
+    if per_doc and mesh is not None:
+        print("--per-doc-convergence does not support sharded scoring "
+              "(--data-shards/--model-shards)", file=sys.stderr)
+        return 2
+    dist = model.topic_distribution(
+        rows, mesh=mesh,
+        convergence="per_doc" if per_doc else "batch",
+    )
 
     text = format_scoring_report(
         model,
@@ -435,6 +440,91 @@ def cmd_score(args: argparse.Namespace) -> int:
     return 0
 
 
+def cmd_serve(args: argparse.Namespace) -> int:
+    """Persistent scoring service (docs/SERVING.md): load the newest
+    ledger-verified model ONCE, AOT-warm the scoring executables per
+    token bucket, coalesce concurrent requests into padded dispatches
+    (continuous batching), hot-swap atomically when a ``stream-train``
+    fleet publishes a newer model, and drain cleanly on SIGTERM — the
+    LDALoader flow as a resident process instead of a cold batch job."""
+    import threading
+    import time as _time
+
+    own_telemetry = bool(getattr(args, "telemetry_file", None))
+    # registry-only when no run stream is asked for: /metrics, the serve
+    # histograms, and the compile sentinel all need a live registry
+    telemetry.configure(args.telemetry_file if own_telemetry else None)
+
+    from .resilience.supervisor import PreemptionNotice
+    from .serving import ScoringService, make_http_server
+
+    preempt = PreemptionNotice().install()
+    buckets = tuple(args.token_bucket) or None
+    try:
+        service = ScoringService(
+            args.models_dir,
+            args.lang,
+            model=args.model,
+            verify_deep=not args.no_verify_deep,
+            stop_words=_load_stop_words(args.stop_words),
+            lemmatize=not args.no_lemmatize,
+            max_batch=args.max_batch,
+            linger_s=args.linger_ms / 1000.0,
+            **({"token_buckets": buckets} if buckets else {}),
+            model_poll_interval=args.model_poll_interval,
+            quarantine_dir=args.quarantine_dir,
+        )
+    except CorruptArtifactError as exc:
+        print(f"error: {exc}", file=sys.stderr)
+        return 2
+    scorer = service.scorer
+    if own_telemetry:
+        # the writer buffers pre-manifest events (serve_warmup), so the
+        # manifest still lands first in the stream
+        telemetry.manifest(
+            kind="serve", model=scorer.path, lang=args.lang,
+            vocab_width=scorer.model.vocab_size,
+        )
+    httpd = make_http_server(service, args.host, args.port)
+    host, port = httpd.server_address[:2]
+    wr = service.warmup_report
+    print(
+        f"serving {scorer.path} (k={scorer.model.k}, "
+        f"V={scorer.model.vocab_size}) on http://{host}:{port} — "
+        f"warmed buckets {wr['buckets']} in {wr['warmup_seconds']}s; "
+        f"POST /score, GET /healthz /metrics"
+    )
+    http_thread = threading.Thread(
+        target=httpd.serve_forever, name="stc-serve-http", daemon=True
+    )
+    http_thread.start()
+    from .resilience import sleep as _idle_sleep
+
+    deadline = (
+        _time.monotonic() + args.max_seconds
+        if args.max_seconds else None
+    )
+    while not preempt:
+        if deadline is not None and _time.monotonic() >= deadline:
+            break
+        _idle_sleep(0.1)
+    # preemption notice (or drill deadline): finish queued documents,
+    # refuse new ones (503), then take the port down — the PR 7 drain
+    # discipline applied to a server
+    report = service.begin_drain()
+    httpd.shutdown()
+    telemetry.event("serve_drained", **report)
+    print(
+        f"drain complete: {report['requests']} request(s) in "
+        f"{report['batches']} batch(es), {report['swaps']} hot-swap(s), "
+        f"{report['rejected']} refused while draining, "
+        f"{report['retraces_after_warmup']} recompile(s) after warmup"
+    )
+    if own_telemetry:
+        telemetry.shutdown()
+    return 0
+
+
 def cmd_stream_score(args: argparse.Namespace) -> int:
     """Watch a directory and score arriving books incrementally (the
     LDALoader flow as a micro-batch stream; north-star "streaming" row)."""
@@ -444,16 +534,11 @@ def cmd_stream_score(args: argparse.Namespace) -> int:
     preempt, lease, fence, partition = _fleet_worker_context(args)
     from .streaming import FileStreamSource, StreamingScorer
 
-    model_path = args.model or latest_model_dir(
-        args.models_dir, args.lang,
-        verify_deep=bool(getattr(args, "verify_deep", False)),
-    )
-    if model_path is None:
-        print(f"no model for lang {args.lang} under {args.models_dir}",
-              file=sys.stderr)
-        return 2
     try:
-        model = load_model(model_path)
+        model_path, model = resolve_latest_model(
+            args.models_dir, args.lang, explicit=args.model,
+            verify_deep=bool(getattr(args, "verify_deep", False)),
+        )
     except CorruptArtifactError as exc:
         print(f"error: {exc}", file=sys.stderr)
         return 2
@@ -1213,11 +1298,68 @@ def build_parser() -> argparse.ArgumentParser:
                          "manifest at selection time instead of trusting "
                          "its COMMIT marker; corrupt dirs fall back to "
                          "the next newest committed one")
+    sc.add_argument("--per-doc-convergence", action="store_true",
+                    help="freeze each document's gamma the iteration ITS "
+                         "OWN change drops below tol (instead of "
+                         "iterating every doc until the batch's worst "
+                         "converges): distributions become a pure "
+                         "function of each document — byte-identical to "
+                         "the `serve` daemon's responses regardless of "
+                         "batching (docs/SERVING.md)")
     sc.add_argument("--telemetry-file", default=None,
                     help="telemetry run stream (dispatch/compile/memory "
                          "attribution for the scoring path) as JSONL — "
                          "consumed by `metrics roofline`/`compile-check`")
     sc.set_defaults(fn=cmd_score)
+
+    se = sub.add_parser(
+        "serve",
+        help="persistent scoring service: load-once + AOT warmup, "
+             "continuous batching, atomic model hot-swap, SIGTERM drain",
+    )
+    se.add_argument("--models-dir", default="models")
+    se.add_argument("--model", default=None,
+                    help="pin an explicit model dir (disables hot-swap "
+                         "discovery)")
+    se.add_argument("--lang", default="EN", choices=sorted(LANG_DIRS))
+    se.add_argument("--host", default="127.0.0.1",
+                    help="bind address (localhost by design; put a real "
+                         "proxy in front for anything else)")
+    se.add_argument("--port", type=int, default=8765,
+                    help="TCP port (0 picks a free one and prints it)")
+    se.add_argument("--max-batch", type=int, default=64,
+                    help="coalescer batch capacity = the pinned doc axis "
+                         "of every serve dispatch")
+    se.add_argument("--linger-ms", type=float, default=5.0,
+                    help="max milliseconds a batch waits to fill after "
+                         "its first document arrives")
+    se.add_argument("--token-bucket", action="append", type=int,
+                    default=[], metavar="T",
+                    help="warmed pow2 token-bucket sizes (repeatable; "
+                         "default 256 1024 4096); requests beyond the "
+                         "largest bucket compile on demand")
+    se.add_argument("--model-poll-interval", type=float, default=2.0,
+                    help="seconds between hot-swap discovery polls of "
+                         "--models-dir")
+    se.add_argument("--no-verify-deep", action="store_true",
+                    help="trust COMMIT markers instead of re-verifying "
+                         "SHA256 manifests at model selection "
+                         "(verify-deep is the serve default)")
+    se.add_argument("--stop-words", default=None)
+    se.add_argument("--no-lemmatize", action="store_true")
+    se.add_argument("--quarantine-dir", default=None,
+                    help="dead-letter dir for documents that fail "
+                         "vectorize/score (they get error responses "
+                         "either way; this keeps the payloads)")
+    se.add_argument("--max-seconds", type=float, default=None,
+                    help="drain + exit after this many seconds (drills); "
+                         "default: run until SIGTERM")
+    se.add_argument("--telemetry-file", default=None,
+                    help="telemetry run stream (serve.* histograms, "
+                         "hot-swap events, dispatch/compile attribution) "
+                         "— `metrics summarize` renders its "
+                         "serving-health section from this")
+    se.set_defaults(fn=cmd_serve)
 
     ss = sub.add_parser(
         "stream-score",

@@ -257,6 +257,7 @@ class LDAModel:
         seed: Optional[int] = None,
         mesh=None,
         layout: str = "auto",
+        convergence: str = "batch",
     ) -> np.ndarray:
         """Per-doc posterior topic mixture [B, k]
         (``LocalLDAModel.topicDistribution``, LDALoader.scala:108).
@@ -280,7 +281,39 @@ class LDAModel:
         runs the WHOLE ragged corpus as one flat token batch
         (``topic_inference_segments``); "auto" picks packed on CPU
         (measured ~2x) and padded buckets on accelerators.
+
+        ``convergence``: "batch" (default) iterates every doc's gamma
+        until the WORST doc in the dispatch converges — a doc's result
+        then depends (by up to ~tol) on its batchmates; "per_doc"
+        freezes each doc the iteration ITS OWN mean|Δgamma| drops below
+        tol, making the distribution a pure function of the document —
+        byte-identical no matter how the corpus is grouped, padded, or
+        coalesced.  The scoring service serves under "per_doc"
+        (docs/SERVING.md); ``score --per-doc-convergence`` produces the
+        matching batch bytes.  Forces the packed layout; unsupported
+        with ``mesh``.
         """
+        if convergence not in ("batch", "per_doc"):
+            raise ValueError(
+                f"convergence must be 'batch' or 'per_doc', "
+                f"got {convergence!r}"
+            )
+        if convergence == "per_doc":
+            if mesh is not None:
+                raise ValueError(
+                    "convergence='per_doc' does not support mesh-backed "
+                    "scoring (the sharded path has no frozen fixed point)"
+                )
+            if isinstance(docs, DocTermBatch):
+                raise ValueError(
+                    "convergence='per_doc' scores row lists (it owns the "
+                    "packed layout); pass the (ids, weights) rows"
+                )
+            alpha = jnp.asarray(self.alpha, jnp.float32)
+            return self._topic_distribution_packed(
+                list(docs), self._exp_elog_beta(), alpha, seed,
+                max_inner, tol, freeze=True,
+            )
         if mesh is not None:
             return self._topic_distribution_sharded(
                 docs, max_inner, tol, seed, mesh
@@ -315,7 +348,7 @@ class LDAModel:
         )
 
     def _topic_distribution_packed(
-        self, rows, eb, alpha, seed, max_inner, tol
+        self, rows, eb, alpha, seed, max_inner, tol, freeze: bool = False
     ) -> np.ndarray:
         from ..ops.lda_math import topic_inference_segments
         from ..ops.sparse import next_pow2
@@ -352,6 +385,7 @@ class LDAModel:
             topic_inference_segments(
                 eb_tok, jnp.asarray(flat_c), jnp.asarray(seg),
                 alpha, gamma0, max_inner=max_inner, tol=tol,
+                freeze=freeze,
             )
         )
 

@@ -431,11 +431,20 @@ class NMFModel:
         return np.asarray(w)
 
     def topic_distribution(
-        self, docs, n_iter: int = 100, mesh=None
+        self, docs, n_iter: int = 100, mesh=None, convergence: str = "batch"
     ) -> np.ndarray:
-        """Row-normalized W — the LDAModel.topic_distribution analogue, so
+        """Row-normalized W — the LDAModel.topicDistribution analogue, so
         scoring/report code is estimator-agnostic (cli score drives any
-        loaded model through this surface).  Empty docs get uniform."""
+        loaded model through this surface).  Empty docs get uniform.
+        ``convergence`` is accepted for that same surface (cli score's
+        --per-doc-convergence): the fixed-depth MU solve has no adaptive
+        early exit, so its per-document rows are batch-composition
+        independent under either setting."""
+        if convergence not in ("batch", "per_doc"):
+            raise ValueError(
+                f"convergence must be 'batch' or 'per_doc', "
+                f"got {convergence!r}"
+            )
         w = self.transform(docs, n_iter=n_iter, mesh=mesh)
         totals = w.sum(axis=1, keepdims=True)
         uniform = np.full_like(w, 1.0 / self.k)

@@ -58,6 +58,7 @@ __all__ = [
     "train_state_valid",
     "model_dir_name",
     "latest_model_dir",
+    "resolve_latest_model",
 ]
 
 
@@ -126,6 +127,35 @@ def latest_model_dir(
             candidates=len(cands),
         )
     return None
+
+
+def resolve_latest_model(
+    models_dir: str,
+    lang: str,
+    explicit: Optional[str] = None,
+    verify_deep: bool = False,
+):
+    """Model discovery + load, the ONE selection path shared by
+    ``score`` / ``stream-score`` / ``serve``: an ``explicit`` dir wins
+    outright; otherwise the newest committed (optionally deep-verified)
+    artifact for ``lang`` under ``models_dir`` is chosen by
+    ``latest_model_dir``.  Returns ``(path, model)``.
+
+    Every failure mode raises ``CorruptArtifactError`` naming what was
+    searched — no model at all, or a chosen dir that fails to load —
+    so the three CLI callers share one typed error path instead of
+    three drifting copies (the seam PR 8's NMF ``mesh=`` kwarg bug
+    lived in).
+    """
+    path = explicit or latest_model_dir(
+        models_dir, lang, verify_deep=verify_deep
+    )
+    if path is None:
+        raise CorruptArtifactError(
+            models_dir or "<models-dir>",
+            f"no committed model for lang {lang}",
+        )
+    return path, load_model(path)
 
 
 def _write_artifact(

@@ -258,6 +258,7 @@ def gamma_fixed_point_segments(
     max_inner: int,
     tol: float,
     reduce_fn=None,
+    freeze: bool = False,
 ):
     """The gamma fixed point over a TOKEN-PACKED batch: tokens live flat
     in [T] with per-token document positions instead of a padded [B, L]
@@ -271,11 +272,19 @@ def gamma_fixed_point_segments(
     per-shard partial segment sums when the token axis is sharded —
     gamma [B, k] stays replicated.  Pad slots (cts == 0) contribute
     exactly 0 regardless of their seg value.
+
+    ``freeze`` (static) switches to PER-DOCUMENT convergence: a row stops
+    updating the iteration its own mean|Δgamma| drops below ``tol``, so
+    its final gamma is a pure function of its own tokens — independent of
+    whatever other documents share the dispatch.  The default loop runs
+    every row until the WORST row converges, which couples a document's
+    result to its batchmates (a solo score and a batched score differ by
+    up to ~tol); the frozen mode is the batch-composition-invariant
+    contract the scoring service serves under (docs/SERVING.md).
     """
     b = gamma0.shape[0]
 
-    def body(carry):
-        gamma, _, it = carry
+    def step(gamma):
         exp_etheta = jnp.exp(dirichlet_expectation(gamma))    # [B, k]
         et_tok = exp_etheta[seg]                              # [T, k]
         phinorm = (eb_tok * et_tok).sum(-1) + _PHI_EPS        # [T]
@@ -284,7 +293,40 @@ def gamma_fixed_point_segments(
         )                                                     # [B, k]
         if reduce_fn is not None:
             contrib = reduce_fn(contrib)
-        gamma_new = alpha + exp_etheta * contrib
+        return alpha + exp_etheta * contrib
+
+    if freeze:
+        def body(carry):
+            gamma, frozen, _, it = carry
+            gamma_new = step(gamma)
+            meanchange = jnp.abs(gamma_new - gamma).mean(axis=-1)
+            # a row freezes AT the update that converged it — the same
+            # value the default loop returns for a batch of one
+            gamma_out = jnp.where(frozen[:, None], gamma, gamma_new)
+            frozen_out = frozen | (meanchange < tol)
+            # f32 fill: a python-float 0.0 is weak f64 under enable_x64
+            # (the STC201 leak class the jaxpr audit pins)
+            worst = jnp.where(
+                frozen_out, jnp.float32(0.0), meanchange
+            ).max()
+            return gamma_out, frozen_out, worst, it + 1
+
+        def cond(carry):
+            _, _, worst, it = carry
+            return jnp.logical_and(it < max_inner, worst >= tol)
+
+        gamma, _, _, iters = lax.while_loop(
+            cond, body,
+            (
+                gamma0, jnp.zeros((b,), bool),
+                jnp.float32(jnp.inf), jnp.int32(0),
+            ),
+        )
+        return gamma, iters
+
+    def body(carry):
+        gamma, _, it = carry
+        gamma_new = step(gamma)
         meanchange = jnp.abs(gamma_new - gamma).mean(axis=-1)
         return gamma_new, meanchange.max(), it + 1
 
@@ -389,7 +431,7 @@ def topic_inference(
     return jnp.where(nonempty, dist, jnp.full_like(dist, 1.0 / k))
 
 
-@partial(jax.jit, static_argnames=("max_inner",))
+@partial(jax.jit, static_argnames=("max_inner", "freeze"))
 def topic_inference_segments(
     eb_tok: jnp.ndarray,     # [T, k] gathered exp(E[log beta]) per token
     cts: jnp.ndarray,        # [T]
@@ -398,15 +440,19 @@ def topic_inference_segments(
     gamma0: jnp.ndarray,     # [B, k]
     max_inner: int = 100,
     tol: float = 1e-3,
+    freeze: bool = False,
 ) -> jnp.ndarray:
     """``topic_inference`` over a TOKEN-PACKED batch — ONE dispatch for a
     whole ragged corpus with FLOPs/bandwidth scaling by the true token
     count (the scoring twin of the packed train paths; the padded [B, L,
     k] grid costs 10-20x more on skewed corpora).  Empty docs (no tokens
-    or all weights zero) get the uniform distribution, matching MLlib."""
+    or all weights zero) get the uniform distribution, matching MLlib.
+    ``freeze`` (static) selects per-document convergence — each doc's
+    distribution is then independent of its batchmates and of the
+    doc/token padding (the serving determinism contract)."""
     b, k = gamma0.shape
     gamma, _ = gamma_fixed_point_segments(
-        eb_tok, cts, seg, alpha, gamma0, max_inner, tol
+        eb_tok, cts, seg, alpha, gamma0, max_inner, tol, freeze=freeze
     )
     mass = jax.ops.segment_sum(cts, seg, num_segments=b)
     dist = gamma / gamma.sum(axis=-1, keepdims=True)

@@ -80,6 +80,9 @@ SITES = frozenset({
     "supervisor.spawn",   # before the supervisor spawns a worker process
     "worker.heartbeat",   # before a worker's lease heartbeat write
     "worker.kill",        # before the supervisor's SIGKILL escalation
+    "serve.accept",       # before the scoring service accepts a request
+    "serve.batch",        # before a coalesced serve batch dispatches
+    "serve.swap",         # before a verified model hot-swap installs
 })
 
 

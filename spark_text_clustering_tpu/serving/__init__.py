@@ -1,0 +1,50 @@
+"""The scoring service: a persistent, hot-swapping, continuously-batched
+``stc serve`` daemon (docs/SERVING.md).
+
+The reference's scoring path is a cold batch job — every run pays process
+startup, model load, and the full jit compile before the first document
+scores (LDALoader.scala).  This subsystem composes the rails earlier PRs
+built into a resident process:
+
+  * **load-once, hot-swap** — the newest ledger-verified model loads
+    exactly once through the shared ``resolve_latest_model`` selection
+    path (``--verify-deep`` manifests); when a ``stream-train`` fleet
+    publishes a new epoch's model, the watcher verifies + warms the new
+    model OFF the serving path and installs it atomically: in-flight
+    batches finish on the old model, new batches see the new one, and
+    every response names the model (path + publishing epoch) that
+    produced it.
+  * **warmup ahead of traffic** — scoring executables AOT-compile per
+    power-of-two token bucket before the port opens, committed to the
+    compile sentinel (``telemetry.compilation``) so the steady state is
+    provably zero-recompile for in-bucket shapes.
+  * **continuous batching** — concurrent documents coalesce into one
+    padded dispatch under a max-linger deadline
+    (``serving.coalescer.RequestCoalescer``), with per-document
+    ``serve.request_seconds`` / ``serve.queue_seconds`` /
+    ``serve.batch_fill`` telemetry in the shared registry.
+  * **graceful degradation** — SIGTERM drains (queued documents finish,
+    new ones are refused), per-document vectorize/score failures get
+    error responses instead of killing their batch, and the
+    ``serve.accept`` / ``serve.batch`` / ``serve.swap`` fault sites are
+    registered in the chaos harness.
+
+Transport is stdlib-only: ``http.server.ThreadingHTTPServer`` on
+localhost, JSON in/out, ``/score`` + ``/healthz`` + ``/metrics``.
+"""
+
+from .coalescer import PendingDoc, RequestCoalescer, ServiceDraining
+from .server import (
+    ScoringService,
+    ServeScorer,
+    make_http_server,
+)
+
+__all__ = [
+    "PendingDoc",
+    "RequestCoalescer",
+    "ServiceDraining",
+    "ScoringService",
+    "ServeScorer",
+    "make_http_server",
+]

@@ -1,0 +1,172 @@
+"""Request coalescer: continuous batching for the scoring service.
+
+Concurrent clients each carry one or a few documents; the device wants
+one well-filled dispatch.  The coalescer sits between them: submitted
+documents queue under a condition variable, a single batch worker pops
+up to ``max_batch`` of them — waiting at most ``linger_s`` after the
+first arrival for the batch to fill — and hands the batch to the
+service's dispatch function, which scores it in ONE device call and
+completes every document's event.  Under load the linger never fires
+(batches fill instantly); at low traffic a lone document pays at most
+the linger before it ships alone.
+
+Accounting per document: ``serve.queue_seconds`` (enqueue -> batch pop)
+and, at the service layer, ``serve.request_seconds`` (accept -> response
+ready).  Per batch: ``serve.batches`` and the ``serve.batch_fill`` ratio
+(live docs / max_batch).  ``serve.queue_depth`` gauges the backlog after
+every pop.
+
+A dispatch failure — including an armed ``serve.batch`` fault — marks
+every document in THAT batch with an error (the per-request quarantine
+discipline from PR 2) and the worker keeps serving; ``drain()`` stops
+intake, finishes the queue, and joins the worker (the SIGTERM half of
+the service lifecycle).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, List, Optional
+
+import numpy as np
+
+from .. import telemetry
+from ..resilience import ResilienceError, faultinject
+
+__all__ = ["PendingDoc", "RequestCoalescer", "ServiceDraining"]
+
+# batch_fill is a ratio in (0, 1]; the default log2-seconds buckets
+# would fold everything above 0.32 into one bin
+_FILL_BUCKETS = tuple(i / 16 for i in range(1, 17))
+
+
+class ServiceDraining(ResilienceError):
+    """The service received its preemption notice: queued documents
+    finish, new ones are refused (HTTP 503)."""
+
+
+@dataclass
+class PendingDoc:
+    """One document in flight through the coalescer."""
+
+    name: str
+    row: tuple                       # (ids, weights) over the model vocab
+    enqueued_at: float = field(default_factory=time.perf_counter)
+    done: threading.Event = field(default_factory=threading.Event)
+    distribution: Optional[np.ndarray] = None     # [k] on success
+    error: Optional[str] = None                   # repr on failure
+    served_by: Optional[dict] = None              # model attribution
+
+    def fail(self, error: BaseException) -> None:
+        self.error = repr(error)
+        self.done.set()
+
+
+class RequestCoalescer:
+    """Queue + single batch worker implementing continuous batching.
+
+    ``dispatch`` receives a non-empty ``List[PendingDoc]`` (at most
+    ``max_batch``) and must complete every document — set its result or
+    error and fire its event.  Exceptions it raises are converted to
+    per-document errors here, so one bad batch can never kill the
+    worker.
+    """
+
+    def __init__(
+        self,
+        dispatch: Callable[[List[PendingDoc]], None],
+        *,
+        max_batch: int = 64,
+        linger_s: float = 0.005,
+    ) -> None:
+        if max_batch < 1:
+            raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+        self.dispatch = dispatch
+        self.max_batch = int(max_batch)
+        self.linger_s = float(linger_s)
+        self._queue: List[PendingDoc] = []
+        self._cond = threading.Condition()
+        self._draining = False
+        self._worker = threading.Thread(
+            target=self._run, name="stc-serve-coalescer", daemon=True
+        )
+        self._worker.start()
+
+    # -- intake ----------------------------------------------------------
+    def submit(self, doc: PendingDoc) -> PendingDoc:
+        """Enqueue one document; raises ``ServiceDraining`` after the
+        preemption notice."""
+        with self._cond:
+            if self._draining:
+                raise ServiceDraining(
+                    "scoring service is draining (preemption notice "
+                    "received) — retry against another replica"
+                )
+            self._queue.append(doc)
+            self._cond.notify_all()
+        return doc
+
+    def queue_depth(self) -> int:
+        with self._cond:
+            return len(self._queue)
+
+    # -- worker ----------------------------------------------------------
+    def _pop_batch(self) -> Optional[List[PendingDoc]]:
+        """Block until a batch is ready (first arrival + fill-or-linger)
+        or the drain completes; None ends the worker."""
+        with self._cond:
+            while not self._queue:
+                if self._draining:
+                    return None
+                self._cond.wait(0.1)
+            deadline = time.perf_counter() + self.linger_s
+            while (
+                len(self._queue) < self.max_batch
+                and not self._draining
+            ):
+                left = deadline - time.perf_counter()
+                if left <= 0:
+                    break
+                self._cond.wait(left)
+            batch = self._queue[: self.max_batch]
+            del self._queue[: self.max_batch]
+            telemetry.gauge("serve.queue_depth", len(self._queue))
+            return batch
+
+    def _run(self) -> None:
+        while True:
+            batch = self._pop_batch()
+            if batch is None:
+                return
+            now = time.perf_counter()
+            for d in batch:
+                telemetry.observe(
+                    "serve.queue_seconds", now - d.enqueued_at
+                )
+            telemetry.count("serve.batches")
+            telemetry.observe(
+                "serve.batch_fill",
+                len(batch) / self.max_batch,
+                buckets=_FILL_BUCKETS,
+            )
+            try:
+                faultinject.check("serve.batch")
+                self.dispatch(batch)
+            except Exception as exc:
+                # the batch dies, its documents get error responses,
+                # the SERVICE keeps serving (PR 2 quarantine discipline)
+                telemetry.count("serve.quarantined", len(batch))
+                for d in batch:
+                    if not d.done.is_set():
+                        d.fail(exc)
+
+    # -- drain -----------------------------------------------------------
+    def drain(self, timeout: float = 60.0) -> None:
+        """Stop intake, finish every queued document, join the worker.
+        Idempotent; safe to call from a signal-driven main loop."""
+        with self._cond:
+            self._draining = True
+            self._cond.notify_all()
+        self._worker.join(timeout)

@@ -1,0 +1,507 @@
+"""The scoring service core: model host, warmup, hot-swap, HTTP front.
+
+Three moving parts (docs/SERVING.md has the protocol diagram):
+
+  * ``ServeScorer`` — an IMMUTABLE snapshot of one verified model plus
+    everything scoring needs (preprocessor, vectorizer, device-resident
+    ``exp(E[log beta])``, the instrumented packed-inference executable).
+    Built and WARMED off the serving path; the service swings one
+    reference between snapshots, so "which model answered" is decided
+    per batch by whichever snapshot the dispatch captured — never a torn
+    mix.
+  * ``ScoringService`` — accept -> vectorize -> coalesce -> dispatch ->
+    respond, plus the model watcher (polls the shared
+    ``resolve_latest_model`` selection path; a ``stream-train`` fleet's
+    model-publish lands as a newer committed artifact dir) and the drain
+    lifecycle (finish queued, refuse new, exit clean).
+  * ``make_http_server`` — stdlib ``ThreadingHTTPServer`` speaking JSON
+    on localhost: POST ``/score``, GET ``/healthz``, GET ``/metrics``.
+
+Determinism contract: LDA models score through the packed layout with
+PER-DOCUMENT convergence (``topic_inference_segments(freeze=True)``), so
+a response is a pure function of the document — independent of what
+traffic it coalesced with and byte-identical to
+``stc score --per-doc-convergence`` over the same books.  Non-LDA models
+(NMF) fall back to the estimator's own ``topic_distribution``; their
+fixed iteration depth is batch-invariant by construction but the
+byte-level pin is only asserted for LDA.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from .. import telemetry
+from ..models.persistence import resolve_latest_model
+from ..resilience import CorruptArtifactError, Quarantine, faultinject
+from ..resilience.retry import sleep as _sleep
+from .coalescer import PendingDoc, RequestCoalescer, ServiceDraining
+
+__all__ = ["ServeScorer", "ScoringService", "make_http_server"]
+
+# default warmup grid: pow2 token buckets a book-sized request lands in
+DEFAULT_TOKEN_BUCKETS = (256, 1024, 4096)
+
+
+def _read_meta(path: str) -> dict:
+    try:
+        with open(os.path.join(path, "meta.json"), encoding="utf-8") as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return {}
+
+
+class ServeScorer:
+    """One verified model, frozen into a servable snapshot."""
+
+    def __init__(
+        self,
+        model,
+        path: str,
+        *,
+        generation: int,
+        stop_words: frozenset = frozenset(),
+        lemmatize: bool = True,
+        max_batch: int = 64,
+        token_buckets: Sequence[int] = DEFAULT_TOKEN_BUCKETS,
+    ) -> None:
+        from ..models.base import LDAModel
+        from ..pipeline import TextPreprocessor, make_vectorizer
+
+        self.model = model
+        self.path = path
+        self.max_batch = int(max_batch)
+        self.token_buckets = tuple(sorted(int(t) for t in token_buckets))
+        self.pre = TextPreprocessor(
+            stop_words=stop_words, lemmatize=lemmatize
+        )
+        self.rows_for = make_vectorizer(model.vocab)
+        meta = _read_meta(path)
+        ledger_ref = meta.get("ledger_ref")
+        # every response carries this verbatim: which artifact answered,
+        # and — for stream-published models — which committed epoch
+        # published it (the ledger back-reference in meta.json)
+        self.attribution = {
+            "model": path,
+            "epoch": (ledger_ref or {}).get("epoch"),
+            "ledger_ref": ledger_ref,
+            "step": meta.get("step"),
+            "generation": int(generation),
+        }
+        self._lda = isinstance(model, LDAModel)
+        if self._lda:
+            import jax.numpy as jnp
+
+            from ..ops.lda_math import topic_inference_segments
+
+            self._eb_tok_table = jnp.moveaxis(
+                model._exp_elog_beta(), 0, -1
+            )                                           # [V, k]
+            self._alpha = jnp.asarray(model.alpha, jnp.float32)
+            self._gamma0 = jnp.ones(
+                (self.max_batch, model.k), jnp.float32
+            )
+            self._infer = telemetry.instrument_dispatch(
+                "serve.topic_inference", topic_inference_segments
+            )
+
+    @property
+    def k(self) -> int:
+        return int(self.model.k)
+
+    def _bucket(self, total_tokens: int) -> int:
+        from ..ops.sparse import next_pow2
+
+        want = next_pow2(max(8, total_tokens))
+        for t in self.token_buckets:
+            if t >= want:
+                return t
+        return want          # oversize: exact pow2, counted as a retrace
+
+    def score_rows(self, rows: List[tuple]) -> np.ndarray:
+        """Distributions [n, k] for up to ``max_batch`` vectorized rows.
+
+        LDA path: the ``_topic_distribution_packed`` packing recipe
+        (docs contiguous, pads trailing with seg 0 / weight 0) at a
+        PINNED doc axis (``max_batch``) and a bucketed token axis, run
+        with per-document frozen convergence — so the bytes match the
+        batch CLI's ``--per-doc-convergence`` output no matter how
+        traffic coalesced, and every in-bucket dispatch reuses one
+        compiled executable."""
+        n = len(rows)
+        if n > self.max_batch:
+            raise ValueError(f"{n} rows > max_batch {self.max_batch}")
+        if n == 0:
+            return np.zeros((0, self.k), np.float32)
+        if not self._lda:
+            return np.asarray(
+                self.model.topic_distribution(rows), np.float32
+            )
+        import jax.numpy as jnp
+
+        t_pad = self._bucket(sum(len(i) for i, _ in rows))
+        flat_i = np.zeros(t_pad, np.int32)
+        flat_c = np.zeros(t_pad, np.float32)
+        seg = np.zeros(t_pad, np.int32)
+        o = 0
+        for d, (ids, wts) in enumerate(rows):
+            flat_i[o:o + len(ids)] = ids
+            flat_c[o:o + len(ids)] = wts
+            seg[o:o + len(ids)] = d
+            o += len(ids)
+        out = self._infer(
+            self._eb_tok_table[jnp.asarray(flat_i)],
+            jnp.asarray(flat_c),
+            jnp.asarray(seg),
+            self._alpha,
+            self._gamma0,
+            freeze=True,
+        )
+        return np.asarray(out)[:n]
+
+    def warmup(self) -> dict:
+        """AOT-compile one executable per configured token bucket BEFORE
+        traffic arrives, committing the signatures to the compile
+        sentinel — past this point an in-bucket dispatch can never pay a
+        trace/compile (``compile.retraces`` must not move)."""
+        from ..telemetry import compilation
+
+        t0 = time.perf_counter()
+        v = max(1, self.model.vocab_size)
+        for t in self.token_buckets:
+            live = max(1, t // 2 + 1)    # lands exactly in bucket t
+            ids = (np.arange(live, dtype=np.int32) % v).astype(np.int32)
+            self.score_rows([(ids, np.ones(live, np.float32))])
+        retraces = telemetry.get_registry().counter(
+            "compile.retraces"
+        ).value
+        report = {
+            "buckets": list(self.token_buckets),
+            "warmup_seconds": round(time.perf_counter() - t0, 6),
+            "signatures": compilation.signatures(),
+            "retraces_at_warmup": int(retraces),
+        }
+        return report
+
+
+class ScoringService:
+    """Accept -> coalesce -> dispatch -> respond, with hot-swap + drain."""
+
+    def __init__(
+        self,
+        models_dir: str,
+        lang: str,
+        *,
+        model: Optional[str] = None,
+        verify_deep: bool = True,
+        stop_words: frozenset = frozenset(),
+        lemmatize: bool = True,
+        max_batch: int = 64,
+        linger_s: float = 0.005,
+        token_buckets: Sequence[int] = DEFAULT_TOKEN_BUCKETS,
+        model_poll_interval: float = 2.0,
+        quarantine_dir: Optional[str] = None,
+        request_timeout: float = 60.0,
+    ) -> None:
+        self.models_dir = models_dir
+        self.lang = lang
+        self.explicit_model = model
+        self.verify_deep = verify_deep
+        self._scorer_kw = dict(
+            stop_words=stop_words,
+            lemmatize=lemmatize,
+            max_batch=max_batch,
+            token_buckets=token_buckets,
+        )
+        self.model_poll_interval = float(model_poll_interval)
+        self.request_timeout = float(request_timeout)
+        self.quarantine = Quarantine(quarantine_dir)
+        self.started_at = time.time()
+        self.draining = False
+        self._swap_lock = threading.Lock()
+        self._stop_watcher = threading.Event()
+
+        path, mdl = resolve_latest_model(
+            models_dir, lang, explicit=model, verify_deep=verify_deep,
+        )
+        self._scorer = ServeScorer(
+            mdl, path, generation=0, **self._scorer_kw
+        )
+        self.warmup_report = self._scorer.warmup()
+        telemetry.event(
+            "serve_warmup", model=path, **{
+                k: v for k, v in self.warmup_report.items()
+                if k != "signatures"
+            },
+        )
+        self.coalescer = RequestCoalescer(
+            self._dispatch, max_batch=max_batch, linger_s=linger_s,
+        )
+        self._watcher = None
+        if model is None:
+            # an explicitly pinned --model never swaps; discovery mode
+            # polls the selection path for a newer published artifact
+            self._watcher = threading.Thread(
+                target=self._watch, name="stc-serve-watcher", daemon=True
+            )
+            self._watcher.start()
+
+    # -- attribution / health -------------------------------------------
+    @property
+    def scorer(self) -> ServeScorer:
+        return self._scorer
+
+    def health(self) -> dict:
+        reg = telemetry.get_registry()
+        return {
+            "status": "draining" if self.draining else "ok",
+            "model": self._scorer.attribution,
+            "uptime_s": round(time.time() - self.started_at, 3),
+            "queue_depth": self.coalescer.queue_depth(),
+            "requests": reg.counter("serve.requests").value,
+            "batches": reg.counter("serve.batches").value,
+            "swaps": reg.counter("serve.swaps").value,
+            "warmup": {
+                k: v for k, v in self.warmup_report.items()
+                if k != "signatures"
+            },
+        }
+
+    # -- request path ----------------------------------------------------
+    def submit_texts(
+        self,
+        texts: Sequence[str],
+        names: Optional[Sequence[str]] = None,
+    ) -> List[dict]:
+        """Score ``texts``; returns one result dict per document, in
+        order.  Raises ``ServiceDraining`` after the preemption notice.
+        Called from HTTP handler threads (and directly by tests/bench);
+        blocks until every document's batch completed."""
+        faultinject.check("serve.accept")
+        if self.draining:
+            telemetry.count("serve.rejected", len(texts))
+            raise ServiceDraining(
+                "scoring service is draining (preemption notice "
+                "received) — retry against another replica"
+            )
+        names = list(names or [f"doc{i}" for i in range(len(texts))])
+        t0 = time.perf_counter()
+        scorer = self._scorer       # vectorize against ONE vocabulary
+        pending: List[Optional[PendingDoc]] = []
+        results: List[Optional[dict]] = [None] * len(texts)
+        for i, (name, text) in enumerate(zip(names, texts)):
+            try:
+                (row,) = scorer.rows_for(
+                    scorer.pre.transform({"texts": [text]})["tokens"]
+                )
+            except Exception as exc:
+                # one malformed document gets an error response; its
+                # batchmates (and the daemon) are untouched
+                telemetry.count("serve.quarantined")
+                self.quarantine.put(name, text, exc, stage="vectorize")
+                results[i] = {"name": name, "error": repr(exc)}
+                pending.append(None)
+                continue
+            telemetry.count("serve.requests")
+            pending.append(
+                self.coalescer.submit(PendingDoc(name=name, row=row))
+            )
+        for i, doc in enumerate(pending):
+            if doc is None:
+                continue
+            if not doc.done.wait(self.request_timeout):
+                results[i] = {
+                    "name": doc.name,
+                    "error": f"timeout after {self.request_timeout}s",
+                }
+                continue
+            if doc.error is not None:
+                results[i] = {"name": doc.name, "error": doc.error}
+            else:
+                dist = doc.distribution
+                results[i] = {
+                    "name": doc.name,
+                    "topic": int(np.argmax(dist)),
+                    "distribution": [float(x) for x in dist],
+                    "model": doc.served_by,
+                }
+            telemetry.observe(
+                "serve.request_seconds", time.perf_counter() - t0
+            )
+        return [r for r in results if r is not None]
+
+    def _dispatch(self, batch: List[PendingDoc]) -> None:
+        # ONE snapshot per batch: the whole dispatch — and therefore
+        # every response in it — is attributable to exactly this model,
+        # however the watcher swings ``self._scorer`` mid-flight
+        scorer = self._scorer
+        dist = scorer.score_rows([d.row for d in batch])
+        for d, row in zip(batch, dist):
+            d.distribution = np.asarray(row)
+            d.served_by = scorer.attribution
+            d.done.set()
+
+    # -- hot swap --------------------------------------------------------
+    def poll_model_once(self) -> bool:
+        """One watcher step: if the selection path now resolves to a
+        NEWER artifact, verify + load + warm it off the serving path and
+        install it atomically.  Returns True when a swap landed.  Any
+        failure — corrupt candidate, warmup error, an armed
+        ``serve.swap`` fault — leaves the previous verified model
+        serving (``serve.swap_failures``)."""
+        from ..models.persistence import latest_model_dir
+
+        # cheap pre-check: don't re-load (or deep-verify) a [k, V] model
+        # every poll tick when the selection still resolves to the
+        # artifact already serving
+        probe = self.explicit_model or latest_model_dir(
+            self.models_dir, self.lang
+        )
+        if probe is None or probe == self._scorer.path:
+            return False
+        try:
+            path, mdl = resolve_latest_model(
+                self.models_dir, self.lang,
+                explicit=self.explicit_model,
+                verify_deep=self.verify_deep,
+            )
+        except CorruptArtifactError:
+            return False      # nothing newer and loadable; keep serving
+        if path == self._scorer.path:
+            return False
+        old = self._scorer.attribution
+        try:
+            nxt = ServeScorer(
+                mdl, path,
+                generation=old["generation"] + 1,
+                **self._scorer_kw,
+            )
+            nxt.warmup()      # compile BEFORE traffic sees the model
+            with self._swap_lock:
+                faultinject.check("serve.swap")
+                self._scorer = nxt
+        except Exception as exc:
+            telemetry.count("serve.swap_failures")
+            telemetry.event(
+                "serve_swap_failed", candidate=path, error=repr(exc),
+                serving=old["model"],
+            )
+            return False
+        telemetry.count("serve.swaps")
+        telemetry.event(
+            "serve_swap",
+            from_model=old["model"], to_model=path,
+            epoch=nxt.attribution["epoch"],
+            generation=nxt.attribution["generation"],
+        )
+        return True
+
+    def _watch(self) -> None:
+        while not self._stop_watcher.is_set():
+            _sleep(self.model_poll_interval)
+            if self._stop_watcher.is_set():
+                return
+            self.poll_model_once()
+
+    # -- drain -----------------------------------------------------------
+    def begin_drain(self, timeout: float = 60.0) -> dict:
+        """The preemption notice: refuse new documents, finish queued
+        ones, stop the watcher.  Returns the drain report the CLI emits
+        as the ``serve_drained`` event."""
+        self.draining = True
+        self._stop_watcher.set()
+        self.coalescer.drain(timeout)
+        reg = telemetry.get_registry()
+        retraces = reg.counter("compile.retraces").value
+        report = {
+            "requests": reg.counter("serve.requests").value,
+            "batches": reg.counter("serve.batches").value,
+            "swaps": reg.counter("serve.swaps").value,
+            "quarantined": reg.counter("serve.quarantined").value,
+            "rejected": reg.counter("serve.rejected").value,
+            "retraces_total": int(retraces),
+            "retraces_after_warmup": int(
+                retraces - self.warmup_report["retraces_at_warmup"]
+            ),
+        }
+        return report
+
+
+# ---------------------------------------------------------------------------
+# HTTP front (stdlib only)
+# ---------------------------------------------------------------------------
+class _ServeHandler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    # the default handler logs every request to stderr; the service's
+    # telemetry stream is the intended log
+    def log_message(self, fmt, *args):  # noqa: A003
+        pass
+
+    def _send(self, code: int, doc: dict) -> None:
+        body = json.dumps(doc).encode("utf-8")
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):  # noqa: N802 (http.server API)
+        service: ScoringService = self.server.service
+        if self.path == "/healthz":
+            self._send(200, service.health())
+        elif self.path == "/metrics":
+            self._send(200, telemetry.get_registry().snapshot())
+        else:
+            self._send(404, {"error": f"no route {self.path}"})
+
+    def do_POST(self):  # noqa: N802
+        service: ScoringService = self.server.service
+        if self.path != "/score":
+            self._send(404, {"error": f"no route {self.path}"})
+            return
+        try:
+            length = int(self.headers.get("Content-Length", "0"))
+            payload = json.loads(self.rfile.read(length) or b"{}")
+            texts = payload.get("texts")
+            if texts is None and "text" in payload:
+                texts = [payload["text"]]
+            if not isinstance(texts, list) or not texts:
+                raise ValueError(
+                    "body must carry 'text' or a non-empty 'texts' list"
+                )
+            names = payload.get("names")
+        except (ValueError, json.JSONDecodeError) as exc:
+            self._send(400, {"error": f"bad request: {exc}"})
+            return
+        try:
+            results = service.submit_texts(texts, names)
+        except ServiceDraining as exc:
+            self._send(503, {"error": str(exc), "status": "draining"})
+            return
+        self._send(
+            200,
+            {
+                "results": results,
+                "model": service.scorer.attribution,
+            },
+        )
+
+
+def make_http_server(
+    service: ScoringService, host: str = "127.0.0.1", port: int = 8765
+) -> ThreadingHTTPServer:
+    """Bind the JSON front; ``port=0`` picks a free port (tests/bench).
+    The caller owns ``serve_forever`` (usually on a thread) and
+    ``shutdown`` after the drain."""
+    httpd = ThreadingHTTPServer((host, port), _ServeHandler)
+    httpd.service = service
+    httpd.daemon_threads = True
+    return httpd

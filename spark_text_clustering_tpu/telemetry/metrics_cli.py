@@ -54,6 +54,7 @@ __all__ = [
     "skew_findings",
     "ledger_health",
     "fleet_health",
+    "serving_health",
     "cmd_summarize",
     "cmd_diff",
     "cmd_check",
@@ -174,7 +175,7 @@ def run_metrics(events: List[Dict]) -> Dict[str, float]:
                 if _is_num(v):
                     out[f"gauge.{k}"] = float(v)
             for k, h in snap.get("histograms", {}).items():
-                for f in ("count", "mean", "p50", "p95", "max"):
+                for f in ("count", "mean", "p50", "p95", "p99", "max"):
                     if _is_num(h.get(f)):
                         out[f"hist.{k}.{f}"] = float(h[f])
         elif name == "corpus":
@@ -554,6 +555,153 @@ def fleet_health(events: List[Dict]) -> Optional[Dict]:
     return out
 
 
+def serving_health(
+    events: List[Dict], metrics: Dict[str, float]
+) -> Optional[Dict]:
+    """Serving-health summary for a ``stc serve`` run (docs/SERVING.md):
+    request volume, p50/p99 service latency, batch fill, hot-swaps,
+    quarantined/refused documents, and the per-executable dispatch
+    attribution of the ``serve.``-labeled executables.  Reads the
+    registry-snapshot metrics (``hist.serve.*`` / ``counter.serve.*``)
+    plus the ``serve_*`` events; None when the run never served."""
+    served = any(
+        e.get("event") in
+        ("serve_warmup", "serve_swap", "serve_swap_failed",
+         "serve_drained")
+        for e in events
+    )
+    if not served and not any(k.startswith(
+        ("counter.serve.", "hist.serve.", "gauge.serve.")
+    ) for k in metrics):
+        return None
+    out: Dict = {
+        "requests": int(metrics.get("counter.serve.requests", 0)),
+        "batches": int(metrics.get("counter.serve.batches", 0)),
+        "hot_swaps": int(metrics.get("counter.serve.swaps", 0)),
+        "swap_failures": int(
+            metrics.get("counter.serve.swap_failures", 0)
+        ),
+        "quarantined": int(metrics.get("counter.serve.quarantined", 0)),
+        "rejected_while_draining": int(
+            metrics.get("counter.serve.rejected", 0)
+        ),
+    }
+    lat: Dict[str, float] = {}
+    for q in ("p50", "p95", "p99", "mean", "max", "count"):
+        v = metrics.get(f"hist.serve.request_seconds.{q}")
+        if v is not None:
+            lat[q] = v
+    if lat:
+        out["request_seconds"] = lat
+    qs = metrics.get("hist.serve.queue_seconds.p50")
+    if qs is not None:
+        out["queue_seconds_p50"] = qs
+    fill = metrics.get("hist.serve.batch_fill.mean")
+    if fill is not None:
+        out["batch_fill_mean"] = round(fill, 4)
+    warm = next(
+        (e for e in events if e.get("event") == "serve_warmup"), None
+    )
+    if warm is not None:
+        out["warmup"] = {
+            k: warm[k]
+            for k in ("buckets", "warmup_seconds", "retraces_at_warmup")
+            if k in warm
+        }
+    drained = next(
+        (e for e in reversed(events)
+         if e.get("event") == "serve_drained"), None
+    )
+    if drained is not None and _is_num(
+        drained.get("retraces_after_warmup")
+    ):
+        out["retraces_after_warmup"] = int(
+            drained["retraces_after_warmup"]
+        )
+    swaps = [
+        {
+            "from": e.get("from_model"), "to": e.get("to_model"),
+            "epoch": e.get("epoch"),
+        }
+        for e in events if e.get("event") == "serve_swap"
+    ]
+    if swaps:
+        out["swap_history"] = swaps
+    # per-executable attribution: join the serve-labeled
+    # dispatch_executable announcements to their live call counters
+    executables = []
+    for e in events:
+        if e.get("event") != "dispatch_executable":
+            continue
+        label = str(e.get("label", ""))
+        if not label.startswith("serve."):
+            continue
+        d = e.get("digest")
+        executables.append({
+            "label": label,
+            "digest": d,
+            "calls": int(metrics.get(f"counter.dispatch.{d}.calls", 0)),
+            "compile_seconds": e.get("compile_seconds"),
+            "signature": str(e.get("signature", ""))[:80],
+        })
+    if executables:
+        executables.sort(key=lambda r: -r["calls"])
+        out["executables"] = executables
+    return out
+
+
+def _print_serving_health(sh: Dict, file=None) -> None:
+    file = file if file is not None else sys.stdout
+    print("serving health:", file=file)
+    lat = sh.get("request_seconds", {})
+    lat_s = (
+        f"  p50 {lat['p50'] * 1000:.1f}ms  p99 {lat['p99'] * 1000:.1f}ms"
+        if "p50" in lat and "p99" in lat else ""
+    )
+    print(
+        f"  requests: {sh['requests']}  batches: {sh['batches']}"
+        f"{lat_s}", file=file,
+    )
+    if "batch_fill_mean" in sh:
+        print(
+            f"  batch fill: {sh['batch_fill_mean']:.1%} mean"
+            + (
+                f"  coalescer wait p50: "
+                f"{sh['queue_seconds_p50'] * 1000:.1f}ms"
+                if "queue_seconds_p50" in sh else ""
+            ),
+            file=file,
+        )
+    print(
+        f"  hot-swaps: {sh['hot_swaps']}  swap failures: "
+        f"{sh['swap_failures']}  quarantined: {sh['quarantined']}  "
+        f"refused while draining: {sh['rejected_while_draining']}",
+        file=file,
+    )
+    for s in sh.get("swap_history", ()):
+        print(
+            f"  swap: {s['from']} -> {s['to']} (epoch {s['epoch']})",
+            file=file,
+        )
+    w = sh.get("warmup")
+    if w:
+        print(
+            f"  warmup: buckets {w.get('buckets')} in "
+            f"{w.get('warmup_seconds')}s", file=file,
+        )
+    if "retraces_after_warmup" in sh:
+        print(
+            f"  recompiles after warmup: {sh['retraces_after_warmup']}",
+            file=file,
+        )
+    for r in sh.get("executables", ()):
+        print(
+            f"  executable {r['label']} [{r['digest']}]: "
+            f"{r['calls']} dispatch(es), compile "
+            f"{r['compile_seconds']}s", file=file,
+        )
+
+
 def _print_fleet_health(fh: Dict, file=None) -> None:
     file = file if file is not None else sys.stdout
     print("fleet health:", file=file)
@@ -637,12 +785,15 @@ def _cmd_summarize(args) -> int:
     metrics = run_metrics(events)
     lh = ledger_health(events)
     fh = fleet_health(events)
+    sh = serving_health(events, metrics)
     if getattr(args, "json", False):
         doc = {"manifest": manifest, "metrics": metrics}
         if lh is not None:
             doc["ledger_health"] = lh
         if fh is not None:
             doc["fleet_health"] = fh
+        if sh is not None:
+            doc["serving_health"] = sh
         print(json.dumps(doc, sort_keys=True))
         return 0
     print(f"run: {args.run}")
@@ -653,6 +804,8 @@ def _cmd_summarize(args) -> int:
         _print_ledger_health(lh)
     if fh is not None:
         _print_fleet_health(fh)
+    if sh is not None:
+        _print_serving_health(sh)
     print("metrics:")
     for k in sorted(metrics):
         v = metrics[k]

@@ -88,6 +88,26 @@ METRICS: Dict[str, str] = {
         "current AIMD max_files_per_trigger cap (backpressure controller)",
     "stream.score.micro_batch_seconds": "stream-score trigger wall time",
     "stream.train.micro_batch_seconds": "stream-train trigger wall time",
+    # -- scoring service (docs/SERVING.md) ------------------------------
+    "serve.requests": "documents accepted by the scoring service",
+    "serve.rejected":
+        "documents refused by a draining service (SIGTERM received: "
+        "queued work finishes, new work is turned away)",
+    "serve.batches": "coalesced dispatches served (continuous batching)",
+    "serve.swaps": "atomic model hot-swaps installed (new ledger epoch)",
+    "serve.swap_failures":
+        "hot-swap attempts aborted (verify/load/install failure) — the "
+        "service keeps serving the previous verified model",
+    "serve.quarantined":
+        "serve documents that failed vectorize/score and got an error "
+        "response instead of killing their batch",
+    "serve.queue_depth": "documents waiting in the coalescer queue",
+    "serve.request_seconds":
+        "per-document service latency: accept -> response ready",
+    "serve.queue_seconds":
+        "per-document coalescer wait: enqueue -> batch dispatch",
+    "serve.batch_fill":
+        "live-document fill ratio of each dispatched serve batch",
     # -- training loops -------------------------------------------------
     "train_iteration_seconds": "per-iteration wall time (IterationTimer)",
     # -- device-resident model handoff (PERF.md item 2) -----------------

@@ -1,0 +1,537 @@
+"""The scoring service (spark_text_clustering_tpu.serving): coalescer
+mechanics, served-vs-batch byte identity, concurrent hot-swap atomicity,
+drain semantics, and chaos behavior at the serve.* fault sites.
+
+The determinism contract under test: the daemon scores with PER-DOCUMENT
+frozen convergence (``topic_inference_segments(freeze=True)``), so a
+response is a pure function of the document — independent of what
+traffic it coalesced with — and byte-identical to
+``score --per-doc-convergence`` over the same texts (docs/SERVING.md).
+"""
+
+import json
+import os
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from spark_text_clustering_tpu import telemetry
+from spark_text_clustering_tpu.models.base import LDAModel
+from spark_text_clustering_tpu.models.persistence import (
+    resolve_latest_model,
+    save_model,
+)
+from spark_text_clustering_tpu.pipeline import (
+    TextPreprocessor,
+    make_vectorizer,
+)
+from spark_text_clustering_tpu.resilience import (
+    CorruptArtifactError,
+    faultinject,
+)
+from spark_text_clustering_tpu.serving import (
+    PendingDoc,
+    RequestCoalescer,
+    ScoringService,
+    ServiceDraining,
+    make_http_server,
+)
+from spark_text_clustering_tpu.telemetry import dispatch as dispatch_attr
+
+K = 3
+V = 64
+
+
+def _make_vocab():
+    """64 terms that survive the preprocessor verbatim (the tokenizer
+    splits digit boundaries and the stemmer rewrites real words, so
+    ``term12``-style synthetic vocabularies silently vectorize to
+    NOTHING and every distribution degenerates to uniform)."""
+    cands = [
+        f"x{a}{b}" for a in "bcdfgklmnprtvz" for b in "bcdfgklmnprtvz"
+    ]
+    pre = TextPreprocessor(stop_words=frozenset(), lemmatize=False)
+    toks = pre.transform({"texts": [" ".join(cands)]})["tokens"][0]
+    keep = [c for c in cands if c in set(toks)]
+    assert len(keep) >= V, "preprocessor rewrote the fixture vocabulary"
+    return keep[:V]
+
+
+VOCAB = _make_vocab()
+
+
+@pytest.fixture(autouse=True)
+def _telemetry_reset():
+    telemetry.shutdown()
+    telemetry.get_registry().reset()
+    dispatch_attr.reset()
+    faultinject.reset()
+    yield
+    telemetry.shutdown()
+    telemetry.get_registry().reset()
+    dispatch_attr.reset()
+    faultinject.reset()
+
+
+def _model(seed: int) -> LDAModel:
+    rng = np.random.default_rng(seed)
+    return LDAModel(
+        lam=rng.random((K, V)).astype(np.float32) + 0.1,
+        vocab=list(VOCAB),
+        alpha=np.full(K, 0.5, np.float32),
+        eta=0.1,
+    )
+
+
+def _texts(n: int, seed: int = 7):
+    rng = np.random.default_rng(seed)
+    return [
+        " ".join(rng.choice(VOCAB, size=int(rng.integers(5, 30))))
+        for _ in range(n)
+    ]
+
+
+def _service(models_dir, **kw):
+    kw.setdefault("lemmatize", False)
+    kw.setdefault("max_batch", 8)
+    kw.setdefault("linger_s", 0.002)
+    kw.setdefault("token_buckets", (64, 256))
+    kw.setdefault("model_poll_interval", 0.05)
+    return ScoringService(models_dir, "EN", **kw)
+
+
+@pytest.fixture()
+def models_dir(tmp_path):
+    d = str(tmp_path / "models")
+    save_model(_model(0), os.path.join(d, "LdaModel_EN_1000"))
+    return d
+
+
+# ---------------------------------------------------------------------------
+# coalescer mechanics
+# ---------------------------------------------------------------------------
+class TestCoalescer:
+    def _doc(self, i):
+        return PendingDoc(
+            name=f"d{i}",
+            row=(np.zeros(1, np.int32), np.ones(1, np.float32)),
+        )
+
+    def test_full_batch_dispatches_without_waiting_for_linger(self):
+        telemetry.configure(None)
+        seen = []
+
+        def dispatch(batch):
+            seen.append(len(batch))
+            for d in batch:
+                d.distribution = np.zeros(K, np.float32)
+                d.done.set()
+
+        co = RequestCoalescer(dispatch, max_batch=4, linger_s=5.0)
+        docs = [co.submit(self._doc(i)) for i in range(4)]
+        t0 = time.perf_counter()
+        for d in docs:
+            assert d.done.wait(2.0)
+        assert time.perf_counter() - t0 < 2.0  # never paid the 5s linger
+        co.drain()
+        assert seen and seen[0] == 4
+        reg = telemetry.get_registry()
+        assert reg.counter("serve.batches").value >= 1
+        fill = reg.histogram("serve.batch_fill")
+        assert fill.max == 1.0
+
+    def test_linger_deadline_ships_a_partial_batch(self):
+        telemetry.configure(None)
+        sizes = []
+
+        def dispatch(batch):
+            sizes.append(len(batch))
+            for d in batch:
+                d.done.set()
+
+        co = RequestCoalescer(dispatch, max_batch=64, linger_s=0.05)
+        doc = co.submit(self._doc(0))
+        assert doc.done.wait(5.0)       # shipped alone after the linger
+        co.drain()
+        assert sizes == [1]
+        fill = telemetry.get_registry().histogram("serve.batch_fill")
+        assert fill.count == 1 and fill.max == pytest.approx(1 / 64)
+        q = telemetry.get_registry().histogram("serve.queue_seconds")
+        assert q.count == 1 and q.max >= 0.04   # waited ~the linger
+
+    def test_dispatch_failure_quarantines_batch_not_worker(self):
+        telemetry.configure(None)
+        boom = [True]
+
+        def dispatch(batch):
+            if boom[0]:
+                boom[0] = False
+                raise RuntimeError("injected batch failure")
+            for d in batch:
+                d.done.set()
+
+        co = RequestCoalescer(dispatch, max_batch=2, linger_s=0.001)
+        bad = [co.submit(self._doc(i)) for i in range(2)]
+        for d in bad:
+            assert d.done.wait(2.0)
+            assert d.error is not None and "injected" in d.error
+        ok = co.submit(self._doc(9))     # the worker survived
+        assert ok.done.wait(2.0) and ok.error is None
+        co.drain()
+        assert telemetry.get_registry().counter(
+            "serve.quarantined"
+        ).value == 2
+
+    def test_drain_refuses_new_and_finishes_queued(self):
+        telemetry.configure(None)
+
+        def dispatch(batch):
+            for d in batch:
+                d.done.set()
+
+        co = RequestCoalescer(dispatch, max_batch=4, linger_s=0.001)
+        d0 = co.submit(self._doc(0))
+        co.drain()
+        assert d0.done.is_set()
+        with pytest.raises(ServiceDraining):
+            co.submit(self._doc(1))
+
+
+# ---------------------------------------------------------------------------
+# served-vs-batch byte identity
+# ---------------------------------------------------------------------------
+class TestByteIdentity:
+    def test_concurrent_serving_matches_batch_cli_bytes(self, models_dir):
+        telemetry.configure(None)
+        svc = _service(models_dir)
+        texts = _texts(17)
+        results = [None] * len(texts)
+
+        def client(i):
+            results[i] = svc.submit_texts([texts[i]], [f"d{i}"])[0]
+
+        threads = [
+            threading.Thread(target=client, args=(i,))
+            for i in range(len(texts))
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        svc.begin_drain()
+        served = np.asarray(
+            [r["distribution"] for r in results], np.float64
+        ).astype(np.float32)
+
+        # the batch side: one whole-corpus score --per-doc-convergence
+        model = _model(0)
+        pre = TextPreprocessor(stop_words=frozenset(), lemmatize=False)
+        rows = make_vectorizer(VOCAB)(
+            pre.transform({"texts": texts})["tokens"]
+        )
+        batch = np.asarray(
+            model.topic_distribution(rows, convergence="per_doc"),
+            np.float32,
+        )
+        # the comparison must be about real inference, not the uniform
+        # fallback empty rows degenerate to
+        assert not np.allclose(batch, 1.0 / K)
+        assert served.tobytes() == batch.tobytes()
+        # and the responses carried usable attribution + argmax topics
+        for r, dist in zip(results, batch):
+            assert r["topic"] == int(np.argmax(dist))
+            assert r["model"]["model"].endswith("LdaModel_EN_1000")
+
+    def test_per_doc_convergence_is_grouping_invariant(self):
+        model = _model(3)
+        pre = TextPreprocessor(stop_words=frozenset(), lemmatize=False)
+        rows = make_vectorizer(VOCAB)(
+            pre.transform({"texts": _texts(9, seed=11)})["tokens"]
+        )
+        whole = model.topic_distribution(rows, convergence="per_doc")
+        solo = np.concatenate([
+            model.topic_distribution([r], convergence="per_doc")
+            for r in rows
+        ])
+        assert whole.tobytes() == solo.tobytes()
+        # the default batch-coupled loop is NOT grouping-invariant —
+        # the property per_doc exists to provide (if this ever starts
+        # passing, the default semantics changed under us)
+        whole_b = model.topic_distribution(rows)
+        solo_b = np.concatenate(
+            [model.topic_distribution([r]) for r in rows]
+        )
+        assert whole_b.tobytes() != solo_b.tobytes()
+
+
+# ---------------------------------------------------------------------------
+# warmup / steady-state recompiles
+# ---------------------------------------------------------------------------
+class TestWarmup:
+    def test_in_bucket_traffic_never_recompiles_after_warmup(
+        self, models_dir
+    ):
+        telemetry.configure(None)
+        svc = _service(models_dir)
+        at_warmup = svc.warmup_report["retraces_at_warmup"]
+        for chunk in range(4):
+            svc.submit_texts(_texts(5, seed=chunk), None)
+        report = svc.begin_drain()
+        assert report["retraces_after_warmup"] == 0
+        assert telemetry.get_registry().counter(
+            "compile.retraces"
+        ).value == at_warmup
+
+
+# ---------------------------------------------------------------------------
+# hot swap
+# ---------------------------------------------------------------------------
+class TestHotSwap:
+    def _await_swap(self, svc, path, timeout=10.0):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if svc.scorer.path == path:
+                return True
+            time.sleep(0.02)
+        return False
+
+    def test_concurrent_swap_attributes_every_response_to_one_model(
+        self, models_dir
+    ):
+        telemetry.configure(None)
+        svc = _service(models_dir)
+        path_a = svc.scorer.path
+        stop = threading.Event()
+        seen = []
+        errors = []
+
+        def client(i):
+            j = 0
+            while not stop.is_set():
+                try:
+                    out = svc.submit_texts(
+                        _texts(2, seed=i * 100 + j), None
+                    )
+                except ServiceDraining:
+                    return
+                for r in out:
+                    if "error" in r:
+                        errors.append(r["error"])
+                    else:
+                        seen.append(r["model"])
+                j += 1
+
+        threads = [
+            threading.Thread(target=client, args=(i,)) for i in range(4)
+        ]
+        for t in threads:
+            t.start()
+        time.sleep(0.2)
+        path_b = os.path.join(models_dir, "LdaModel_EN_2000")
+        save_model(_model(1), path_b)      # the published new epoch
+        assert self._await_swap(svc, path_b)
+        time.sleep(0.3)                    # post-swap traffic
+        stop.set()
+        for t in threads:
+            t.join()
+        svc.begin_drain()
+        assert not errors
+        models = {m["model"] for m in seen}
+        # every response named exactly one published artifact — the old
+        # or the new, never a torn mix — and both sides carried traffic
+        assert models == {path_a, path_b}
+        gens = {m["model"]: m["generation"] for m in seen}
+        assert gens[path_a] == 0 and gens[path_b] == 1
+        assert telemetry.get_registry().counter(
+            "serve.swaps"
+        ).value == 1
+
+    def test_swap_fault_keeps_serving_old_verified_model(
+        self, models_dir
+    ):
+        telemetry.configure(None)
+        svc = _service(models_dir)
+        path_a = svc.scorer.path
+        path_b = os.path.join(models_dir, "LdaModel_EN_2000")
+        save_model(_model(1), path_b)
+        faultinject.configure("serve.swap:fail@1")
+        assert svc.poll_model_once() is False     # the armed kill fired
+        assert svc.scorer.path == path_a
+        out = svc.submit_texts(_texts(1), None)
+        assert out[0]["model"]["model"] == path_a
+        reg = telemetry.get_registry()
+        assert reg.counter("serve.swap_failures").value == 1
+        assert reg.counter("serve.swaps").value == 0
+        faultinject.reset()
+        assert svc.poll_model_once() is True      # next poll recovers
+        assert svc.scorer.path == path_b
+        svc.begin_drain()
+
+    def test_corrupt_candidate_never_installs(self, models_dir):
+        telemetry.configure(None)
+        svc = _service(models_dir)
+        path_a = svc.scorer.path
+        # a newer dir whose payload rotted after sealing: verify-deep
+        # selection must fall back to the committed older model
+        path_b = os.path.join(models_dir, "LdaModel_EN_2000")
+        save_model(_model(1), path_b)
+        with open(os.path.join(path_b, "arrays.npz"), "r+b") as f:
+            f.truncate(16)
+        assert svc.poll_model_once() is False
+        assert svc.scorer.path == path_a
+        svc.begin_drain()
+
+
+# ---------------------------------------------------------------------------
+# drain + accept faults
+# ---------------------------------------------------------------------------
+class TestDrain:
+    def test_drain_finishes_queued_then_refuses(self, models_dir):
+        telemetry.configure(None)
+        svc = _service(models_dir, linger_s=0.2, max_batch=64)
+        got = []
+        t = threading.Thread(
+            target=lambda: got.extend(svc.submit_texts(_texts(3), None))
+        )
+        t.start()
+        time.sleep(0.05)          # let them enqueue inside the linger
+        report = svc.begin_drain()
+        t.join(5.0)
+        assert len(got) == 3 and all("topic" in r for r in got)
+        assert report["requests"] == 3
+        with pytest.raises(ServiceDraining):
+            svc.submit_texts(["refused"], None)
+        assert telemetry.get_registry().counter(
+            "serve.rejected"
+        ).value == 1
+
+    def test_accept_fault_site_is_armed(self, models_dir):
+        telemetry.configure(None)
+        svc = _service(models_dir)
+        faultinject.configure("serve.accept:fail@1")
+        with pytest.raises(faultinject.InjectedIOError):
+            svc.submit_texts(_texts(1), None)
+        faultinject.reset()
+        assert svc.submit_texts(_texts(1), None)[0]["topic"] >= 0
+        svc.begin_drain()
+
+    def test_batch_fault_gives_error_responses_daemon_survives(
+        self, models_dir
+    ):
+        telemetry.configure(None)
+        svc = _service(models_dir)
+        faultinject.configure("serve.batch:fail@1")
+        out = svc.submit_texts(_texts(2), None)
+        assert all("error" in r for r in out)
+        ok = svc.submit_texts(_texts(2, seed=9), None)
+        assert all("topic" in r for r in ok)
+        assert telemetry.get_registry().counter(
+            "serve.quarantined"
+        ).value == 2
+        svc.begin_drain()
+
+
+# ---------------------------------------------------------------------------
+# HTTP front + serving-health summary
+# ---------------------------------------------------------------------------
+class TestHttpAndHealth:
+    def test_http_score_healthz_metrics_roundtrip(
+        self, models_dir, tmp_path
+    ):
+        stream = str(tmp_path / "serve.jsonl")
+        telemetry.configure(stream)
+        telemetry.manifest(kind="serve")
+        svc = _service(models_dir)
+        httpd = make_http_server(svc, port=0)
+        port = httpd.server_address[1]
+        t = threading.Thread(target=httpd.serve_forever, daemon=True)
+        t.start()
+        try:
+            body = json.dumps(
+                {"texts": _texts(3), "names": ["a", "b", "c"]}
+            ).encode()
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/score", data=body,
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                doc = json.loads(resp.read())
+            assert [r["name"] for r in doc["results"]] == ["a", "b", "c"]
+            assert all(
+                abs(sum(r["distribution"]) - 1.0) < 1e-5
+                for r in doc["results"]
+            )
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=10
+            ) as resp:
+                health = json.loads(resp.read())
+            assert health["status"] == "ok"
+            assert health["requests"] == 3
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=10
+            ) as resp:
+                snap = json.loads(resp.read())
+            assert snap["counters"]["serve.requests"] == 3
+        finally:
+            report = svc.begin_drain()
+            httpd.shutdown()
+        telemetry.event("serve_drained", **report)
+        telemetry.shutdown()
+
+        # the run stream renders a serving-health section
+        from spark_text_clustering_tpu.telemetry.metrics_cli import (
+            load_run,
+            run_metrics,
+            serving_health,
+        )
+
+        _, events = load_run(stream)
+        sh = serving_health(events, run_metrics(events))
+        assert sh is not None
+        assert sh["requests"] == 3
+        assert sh["request_seconds"]["count"] == 3
+        assert sh["request_seconds"]["p99"] > 0
+        assert sh["retraces_after_warmup"] == 0
+        assert sh["executables"], "serve.* dispatch attribution missing"
+        assert all(
+            e["label"] == "serve.topic_inference"
+            for e in sh["executables"]
+        )
+
+    def test_serving_health_absent_for_non_serve_runs(self):
+        from spark_text_clustering_tpu.telemetry.metrics_cli import (
+            serving_health,
+        )
+
+        assert serving_health(
+            [{"event": "train_fit"}], {"counter.ledger.commits": 1.0}
+        ) is None
+
+
+# ---------------------------------------------------------------------------
+# shared model resolution (the de-duplicated seam)
+# ---------------------------------------------------------------------------
+class TestResolveLatestModel:
+    def test_resolves_newest_and_loads(self, models_dir):
+        save_model(_model(1), os.path.join(models_dir, "LdaModel_EN_2000"))
+        path, model = resolve_latest_model(models_dir, "EN")
+        assert path.endswith("LdaModel_EN_2000")
+        assert model.k == K
+        # explicit pin wins over recency
+        pin = os.path.join(models_dir, "LdaModel_EN_1000")
+        path2, _ = resolve_latest_model(models_dir, "EN", explicit=pin)
+        assert path2 == pin
+
+    def test_missing_and_corrupt_raise_typed(self, tmp_path, models_dir):
+        with pytest.raises(CorruptArtifactError):
+            resolve_latest_model(str(tmp_path / "void"), "EN")
+        bad = os.path.join(models_dir, "LdaModel_EN_1000")
+        with open(os.path.join(bad, "arrays.npz"), "r+b") as f:
+            f.truncate(8)
+        # deep verification skips the rotted dir; with nothing left the
+        # error is typed, never a stack of zipfile noise
+        with pytest.raises(CorruptArtifactError):
+            resolve_latest_model(models_dir, "EN", verify_deep=True)
