@@ -53,9 +53,25 @@
 #      warmup (the sentinel's serving claim), and a SIGTERM drain
 #      exits 0; the deterministic serve counters (requests, swaps)
 #      gate against the committed baseline
+#  12. monitor drill (`stc monitor`, telemetry.alerts) in three parts:
+#      (a) deterministic --once gating — the planted retrace storm
+#      must fire exactly the retrace_storm alert (exit 1 under
+#      --fail-on-alert) and the clean gate-5 train stream must fire
+#      ZERO across every built-in rule; the storm run's counter.alert.*
+#      fold into the committed baseline; (b) live wedge drill — a
+#      2-worker supervised fleet with worker 0 wedged via the existing
+#      worker.heartbeat:hang chaos spec while a monitor tail-follows
+#      the lease files: exactly worker_stale[0] must fire AND resolve
+#      (the respawned worker's heartbeats clear it), worker 1 never
+#      alerts; (c) telemetry-driven resize — a 1-worker fleet over a
+#      backlog, the monitor's queue_depth alert writes a scale_out
+#      request to the actions file, `supervise --actions-file` applies
+#      it as a ledger-gated resize to 2 workers, and the drill asserts
+#      exactly-once ingest across the resize (no source committed
+#      twice, every report belongs to a committed epoch)
 #
 # Usage:
-#   scripts/ci_check.sh                 # run all eleven gates
+#   scripts/ci_check.sh                 # run all twelve gates
 #   scripts/ci_check.sh --rebaseline    # recapture ALL baselines
 #                                       # (metrics + lint waivers +
 #                                       # lint counters + compile
@@ -461,6 +477,252 @@ stream(f"{workdir}/skew-p1.jsonl", 1, 0.900, 7)   # the straggler
 EOF
 }
 
+run_monitor_once_drill() {
+    # gate 12a: deterministic batch-mode gating.  The planted retrace
+    # storm must fire exactly the retrace_storm alert; the clean
+    # gate-5 train stream must fire zero across EVERY built-in rule.
+    # The storm run's counter.alert.* are machine-independent and fold
+    # into the shared baseline.
+    local workdir="$1"
+    if [[ ! -s "$workdir/storm.jsonl" ]]; then
+        make_retrace_storm "$workdir" || return 1
+    fi
+    python -m spark_text_clustering_tpu.cli monitor --once \
+        --stream "$workdir/storm.jsonl" --builtin retrace_storm \
+        --fail-on-alert --quiet \
+        --alerts-file "$workdir/monitor_once_alerts.jsonl" \
+        --telemetry-file "$workdir/monitor_once.jsonl" >/dev/null
+    if [[ $? -ne 1 ]]; then
+        echo "monitor drill: planted retrace storm did not fire"
+        return 1
+    fi
+    python -m spark_text_clustering_tpu.cli monitor --once \
+        --stream "$workdir/run.jsonl" --fail-on-alert --quiet \
+        >/dev/null
+    if [[ $? -ne 0 ]]; then
+        echo "monitor drill: clean train stream raised an alert"
+        return 1
+    fi
+    # the persisted firing state degrades serve-style health readers
+    python - "$workdir" <<'EOF'
+import sys
+
+from spark_text_clustering_tpu.telemetry.alerts import firing_alerts
+
+workdir = sys.argv[1]
+firing = firing_alerts(f"{workdir}/monitor_once_alerts.jsonl")
+assert [f["rule"] for f in firing] == ["retrace_storm"], firing
+EOF
+}
+
+run_monitor_fleet_drill() {
+    # gate 12b: live wedge drill.  Worker 0 of a supervised
+    # stream-score fleet wedges via the existing worker.heartbeat:hang
+    # chaos spec; a monitor tail-following the lease files must fire
+    # worker_stale for EXACTLY worker 0 (threshold above the jax
+    # import gap, below the wedge age) and resolve it once the
+    # respawned worker heartbeats again.
+    local workdir="$1"
+    python - "$workdir" <<'EOF'
+import json, os, sys
+import numpy as np
+
+from spark_text_clustering_tpu.models.base import LDAModel
+
+workdir = sys.argv[1]
+watch = os.path.join(workdir, "mon_watch")
+os.makedirs(watch, exist_ok=True)
+pools = ["piano violin orchestra symphony concerto melody",
+         "electron proton neutron quantum particle physics"]
+for i in range(4):
+    with open(os.path.join(watch, f"doc{i:02d}.txt"), "w") as f:
+        f.write(f"{pools[i % 2]} tok{i}")
+rng = np.random.default_rng(0)
+m = LDAModel(
+    lam=rng.random((2, 64)).astype(np.float32) + 0.1,
+    vocab=[f"h{i}" for i in range(64)],
+    alpha=np.full(2, 0.5, np.float32), eta=0.1,
+)
+m.save(os.path.join(workdir, "mon_models", "LdaModel_EN_1000"))
+# worker_stale retuned for the drill's timing: fire above the jax
+# import gap (~2-3s), resolve fast once heartbeats return
+with open(os.path.join(workdir, "mon_rules.json"), "w") as f:
+    json.dump([{"name": "worker_stale", "value": 4.5,
+                "for_seconds": 0.0, "resolve_seconds": 0.3,
+                "signal": {"event": "lease", "field": "age",
+                           "agg": "last", "by": "worker",
+                           "window_seconds": 8.0}}], f)
+EOF
+    python -m spark_text_clustering_tpu.cli monitor \
+        --fleet-dir "$workdir/mon_fleet" \
+        --builtin worker_stale --rules "$workdir/mon_rules.json" \
+        --alerts-file "$workdir/mon_fleet_alerts.jsonl" \
+        --interval 0.2 --max-seconds 180 --quiet \
+        --telemetry-file "$workdir/monitor_fleet.jsonl" \
+        >/dev/null 2>&1 &
+    local mon_pid=$!
+    python -m spark_text_clustering_tpu.cli supervise \
+        --role stream-score --watch-dir "$workdir/mon_watch" \
+        --fleet-dir "$workdir/mon_fleet" --workers 2 \
+        --chaos-worker 0:worker.heartbeat:hang@3 \
+        --heartbeat-interval 0.2 --lease-timeout 6 \
+        --grace-seconds 1.0 --sweep-interval 0.15 \
+        --poll-interval 0.05 --idle-timeout 0.8 \
+        --max-files-per-trigger 2 --no-lemmatize \
+        --model "$workdir/mon_models/LdaModel_EN_1000" \
+        --output-dir "$workdir/mon_out" >/dev/null
+    local sup_rc=$?
+    sleep 1.5              # let the monitor observe the recovered fleet
+    kill -TERM "$mon_pid" 2>/dev/null
+    wait "$mon_pid"
+    if [[ $sup_rc -ne 0 ]]; then
+        echo "monitor drill: wedged-fleet supervision failed"
+        return 1
+    fi
+    python - "$workdir" <<'EOF'
+import sys
+
+from spark_text_clustering_tpu.telemetry.alerts import AlertLog
+
+workdir = sys.argv[1]
+recs, torn = AlertLog(f"{workdir}/mon_fleet_alerts.jsonl").replay()
+fired = [(r["rule"], r["key"]) for r in recs if r["state"] == "firing"]
+resolved = [
+    (r["rule"], r["key"]) for r in recs if r["state"] == "resolved"
+]
+assert ("worker_stale", "0") in fired, (
+    f"wedged worker never alerted: {recs}"
+)
+assert ("worker_stale", "0") in resolved, (
+    f"worker_stale[0] never resolved after the respawn: {recs}"
+)
+assert all(r[1] == "0" for r in fired), (
+    f"a healthy worker alerted: {fired}"
+)
+assert {r[0] for r in fired} == {"worker_stale"}, fired
+print(f"monitor wedge drill: worker_stale[0] fired and resolved "
+      f"({len(recs)} transition(s))")
+EOF
+}
+
+run_monitor_resize_drill() {
+    # gate 12c: the telemetry -> topology loop.  A 1-worker fleet over
+    # a 10-file backlog reports sustained queue depth through its
+    # lease; the monitor's queue_depth alert writes a scale_out request
+    # to the actions file; `supervise --actions-file` applies it as a
+    # LEDGER-GATED resize to 2 workers; ingest stays exactly-once
+    # across the resize.
+    local workdir="$1"
+    python - "$workdir" <<'EOF'
+import json, os, sys
+
+workdir = sys.argv[1]
+watch = os.path.join(workdir, "rsz_watch")
+os.makedirs(watch, exist_ok=True)
+pools = ["piano violin orchestra symphony concerto melody",
+         "electron proton neutron quantum particle physics"]
+# a backlog deep enough that the 1-file-per-trigger worker stays
+# visibly behind for seconds (single-doc triggers drain ~50 ms each;
+# the lease carries the live depth on every rate-limited heartbeat)
+for i in range(48):
+    with open(os.path.join(watch, f"doc{i:02d}.txt"), "w") as f:
+        f.write(f"{pools[i % 2]} tok{i}")
+with open(os.path.join(workdir, "rsz_rules.json"), "w") as f:
+    json.dump([{"name": "queue_depth", "value": 3.0,
+                "for_seconds": 0.2, "resolve_seconds": 0.5}], f)
+model_dir = os.path.join(workdir, "rsz_models", "LdaModel_EN_1000")
+if not os.path.isdir(model_dir):
+    import numpy as np
+
+    from spark_text_clustering_tpu.models.base import LDAModel
+
+    rng = np.random.default_rng(0)
+    LDAModel(
+        lam=rng.random((2, 64)).astype(np.float32) + 0.1,
+        vocab=[f"h{i}" for i in range(64)],
+        alpha=np.full(2, 0.5, np.float32), eta=0.1,
+    ).save(model_dir)
+EOF
+    python -m spark_text_clustering_tpu.cli monitor \
+        --fleet-dir "$workdir/rsz_fleet" \
+        --builtin queue_depth --rules "$workdir/rsz_rules.json" \
+        --alerts-file "$workdir/rsz_alerts.jsonl" \
+        --actions-file "$workdir/rsz_actions.json" \
+        --interval 0.1 --max-seconds 180 --quiet \
+        --telemetry-file "$workdir/monitor_resize.jsonl" \
+        >/dev/null 2>&1 &
+    local mon_pid=$!
+    python -m spark_text_clustering_tpu.cli supervise \
+        --role stream-score --watch-dir "$workdir/rsz_watch" \
+        --fleet-dir "$workdir/rsz_fleet" --workers 1 --max-workers 2 \
+        --actions-file "$workdir/rsz_actions.json" \
+        --heartbeat-interval 0.15 --lease-timeout 8 \
+        --grace-seconds 5.0 --sweep-interval 0.1 \
+        --poll-interval 0.2 --idle-timeout 1.5 \
+        --max-files-per-trigger 1 --no-lemmatize \
+        --model "$workdir/rsz_models/LdaModel_EN_1000" \
+        --output-dir "$workdir/rsz_out" >/dev/null
+    local sup_rc=$?
+    kill -TERM "$mon_pid" 2>/dev/null
+    wait "$mon_pid"
+    if [[ $sup_rc -ne 0 ]]; then
+        echo "monitor drill: resize-on-alert supervision failed"
+        return 1
+    fi
+    python - "$workdir" <<'EOF'
+import json, os, sys
+
+from spark_text_clustering_tpu.resilience import EpochLedger
+from spark_text_clustering_tpu.resilience.supervisor import FleetLedger
+from spark_text_clustering_tpu.telemetry.alerts import AlertLog
+
+workdir = sys.argv[1]
+fleet = os.path.join(workdir, "rsz_fleet")
+# the alert fired and the actions file carried the scale request
+recs, _ = AlertLog(f"{workdir}/rsz_alerts.jsonl").replay()
+assert any(
+    r["rule"] == "queue_depth" and r["state"] == "firing"
+    for r in recs
+), f"queue_depth never fired: {recs}"
+with open(f"{workdir}/rsz_actions.json") as f:
+    acts = json.load(f)["actions"]
+assert any(a["kind"] == "scale_out" for a in acts), acts
+with open(f"{workdir}/rsz_actions.json.ack") as f:
+    assert json.load(f)["last_id"] >= 0
+# the supervisor applied it as a LEDGER-GATED resize to 2 workers
+led = FleetLedger(fleet)
+resizes = [r for r in led.records() if r["kind"] == "resize"]
+assert resizes, "no resize record in fleet.jsonl"
+assert resizes[0]["why"].startswith("alert_"), resizes[0]
+assert led.current()["worker_count"] == 2, led.current()
+# exactly-once across the alert-driven resize: no source committed
+# twice, nothing lost, every report belongs to a committed epoch
+wdirs = [
+    os.path.join(fleet, n) for n in sorted(os.listdir(fleet))
+    if n.startswith("w") and os.path.isdir(os.path.join(fleet, n))
+]
+per = []
+for wd in wdirs:
+    for r in EpochLedger(wd).records():
+        per.extend(r.get("sources", ()))
+assert len(per) == len(set(per)), "a source committed twice"
+watch = os.path.join(workdir, "rsz_watch")
+want = {os.path.join(watch, n) for n in os.listdir(watch)}
+assert set(per) == want, "sources lost or foreign"
+reports = []
+for d, _, files in os.walk(os.path.join(workdir, "rsz_out")):
+    reports.extend(files)
+committed = sum(EpochLedger(wd).last_committed() + 1 for wd in wdirs)
+assert len(reports) == committed, (
+    f"{len(reports)} reports vs {committed} committed epochs"
+)
+print(
+    f"monitor resize drill: queue_depth alert -> ledger-gated resize "
+    f"1 -> 2, {committed} epochs exactly-once"
+)
+EOF
+}
+
 if [[ "${1:-}" == "--rebaseline" ]]; then
     python -m spark_text_clustering_tpu.cli lint --rebaseline || exit 1
     work=$(mktemp -d)
@@ -494,6 +756,13 @@ if [[ "${1:-}" == "--rebaseline" ]]; then
         --write-baseline --tolerance 0.0 \
         --include counter.serve.requests \
         --include counter.serve.swaps || exit 1
+    # fold the monitor drill's deterministic alert counters the same
+    # way (the --once storm run; live-drill counters are timing-bound)
+    run_monitor_once_drill "$work" || exit 1
+    python -m spark_text_clustering_tpu.cli metrics check \
+        "$work/monitor_once.jsonl" --baseline "$BASELINE" \
+        --write-baseline --tolerance 0.0 --include counter.alert. \
+        || exit 1
     # recapture the recompile sentinel's expected-signature table from
     # the same train run plus a score run and an NMF fit+transform run
     # (gate 9's fixture triple)
@@ -509,12 +778,12 @@ fail=0
 work=$(mktemp -d)
 trap 'rm -rf "$work"' EXIT
 
-echo "== [1/11] stc lint (AST rules + jaxpr audit) =="
+echo "== [1/12] stc lint (AST rules + jaxpr audit) =="
 python -m spark_text_clustering_tpu.cli lint \
     --telemetry-file "$work/lint.jsonl"
 if [[ $? -ne 0 ]]; then echo "FAIL: stc lint"; fail=1; fi
 
-echo "== [2/11] ruff (generic-Python tier) =="
+echo "== [2/12] ruff (generic-Python tier) =="
 if command -v ruff >/dev/null 2>&1; then
     ruff check spark_text_clustering_tpu
     if [[ $? -ne 0 ]]; then echo "FAIL: ruff"; fail=1; fi
@@ -522,31 +791,32 @@ else
     echo "ruff not installed — skipped (stc lint STC101/102/006 cover it)"
 fi
 
-echo "== [3/11] tier-1 tests =="
+echo "== [3/12] tier-1 tests =="
 timeout -k 10 870 python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors -p no:cacheprovider \
     -p no:xdist -p no:randomly
 if [[ $? -ne 0 ]]; then echo "FAIL: tier-1"; fail=1; fi
 
-echo "== [4/11] telemetry overhead budget =="
+echo "== [4/12] telemetry overhead budget =="
 python scripts/check_telemetry_overhead.py
 if [[ $? -ne 0 ]]; then echo "FAIL: telemetry overhead"; fail=1; fi
 
-echo "== [5/11] metrics regression gate =="
+echo "== [5/12] metrics regression gate =="
 if run_ci_train "$work"; then
-    # lint., ledger., fleet., and serve. families are captured by their
-    # own gates (1/6, 8, 10, and 11) — a batch train run never touches
-    # them
+    # lint., ledger., fleet., serve., and alert. families are captured
+    # by their own gates (1/6, 8, 10, 11, and 12) — a batch train run
+    # never touches them
     python -m spark_text_clustering_tpu.cli metrics check "$work/run.jsonl" \
         --baseline "$BASELINE" "${EXCLUDES[@]}" --exclude lint. \
-        --exclude ledger. --exclude fleet. --exclude serve.
+        --exclude ledger. --exclude fleet. --exclude serve. \
+        --exclude alert. --exclude monitor. --exclude drift.
     if [[ $? -ne 0 ]]; then echo "FAIL: metrics check"; fail=1; fi
 else
     echo "FAIL: CI training run"
     fail=1
 fi
 
-echo "== [6/11] lint metrics gate (waiver count version-gated) =="
+echo "== [6/12] lint metrics gate (waiver count version-gated) =="
 if [[ -s "$work/lint.jsonl" ]]; then
     python -m spark_text_clustering_tpu.cli metrics check "$work/lint.jsonl" \
         --baseline "$BASELINE" --include lint.
@@ -556,7 +826,7 @@ else
     fail=1
 fi
 
-echo "== [7/11] cross-host skew gate (metrics merge) =="
+echo "== [7/12] cross-host skew gate (metrics merge) =="
 if make_skew_streams "$work"; then
     python -m spark_text_clustering_tpu.cli metrics merge \
         "$work/skew-p0.jsonl" "$work/skew-p1.jsonl" --fail-on-skew \
@@ -577,7 +847,7 @@ else
     fail=1
 fi
 
-echo "== [8/11] exactly-once ledger chaos drill (STC_FAULTS) =="
+echo "== [8/12] exactly-once ledger chaos drill (STC_FAULTS) =="
 if run_ledger_drill "$work"; then
     python -m spark_text_clustering_tpu.cli metrics check \
         "$work/ledger_drill.jsonl" --baseline "$BASELINE" \
@@ -588,7 +858,7 @@ else
     fail=1
 fi
 
-echo "== [9/11] recompile sentinel (metrics compile-check) =="
+echo "== [9/12] recompile sentinel (metrics compile-check) =="
 if [[ -s "$work/run.jsonl" ]] && run_ci_score "$work" \
     && run_ci_nmf "$work"; then
     python -m spark_text_clustering_tpu.cli metrics compile-check \
@@ -615,7 +885,7 @@ else
     fail=1
 fi
 
-echo "== [10/11] supervisor drill (lease expiry -> SIGKILL -> respawn) =="
+echo "== [10/12] supervisor drill (lease expiry -> SIGKILL -> respawn) =="
 if run_supervisor_drill "$work"; then
     # the ladder's counters are deterministic: 3 spawns (2 + 1
     # respawn), 1 lease expiry, 1 preemption (the drain SIGTERM the
@@ -629,7 +899,7 @@ else
     fail=1
 fi
 
-echo "== [11/11] serve drill (hot-swap + drain + zero-recompile) =="
+echo "== [11/12] serve drill (hot-swap + drain + zero-recompile) =="
 if [[ -d "$work/models" ]] && run_serve_drill "$work"; then
     # requests (32 = two exact 16-doc volleys) and swaps (1) are
     # machine-independent; batch counts depend on coalescing timing
@@ -640,6 +910,27 @@ if [[ -d "$work/models" ]] && run_serve_drill "$work"; then
     if [[ $? -ne 0 ]]; then echo "FAIL: serve drill metrics"; fail=1; fi
 else
     echo "FAIL: serve drill run"
+    fail=1
+fi
+
+echo "== [12/12] monitor drill (alerts fire/resolve + resize-on-alert) =="
+if run_monitor_once_drill "$work"; then
+    # the --once storm run's alert counters are deterministic: exactly
+    # one firing (retrace_storm), nothing pending/resolved
+    python -m spark_text_clustering_tpu.cli metrics check \
+        "$work/monitor_once.jsonl" --baseline "$BASELINE" \
+        --include counter.alert.
+    if [[ $? -ne 0 ]]; then echo "FAIL: monitor alert counters"; fail=1; fi
+else
+    echo "FAIL: monitor --once drill"
+    fail=1
+fi
+if ! run_monitor_fleet_drill "$work"; then
+    echo "FAIL: monitor wedge drill (worker_stale fire/resolve)"
+    fail=1
+fi
+if ! run_monitor_resize_drill "$work"; then
+    echo "FAIL: monitor resize drill (telemetry-driven fleet control)"
     fail=1
 fi
 

@@ -473,6 +473,7 @@ def cmd_serve(args: argparse.Namespace) -> int:
             **({"token_buckets": buckets} if buckets else {}),
             model_poll_interval=args.model_poll_interval,
             quarantine_dir=args.quarantine_dir,
+            alerts_file=args.alerts_file,
         )
     except CorruptArtifactError as exc:
         print(f"error: {exc}", file=sys.stderr)
@@ -1012,6 +1013,7 @@ def cmd_supervise(args: argparse.Namespace) -> int:
         max_respawns=args.max_respawns,
         resize_plan=resize_plan,
         worker_faults=worker_faults,
+        actions_file=args.actions_file,
     )
     try:
         rep = sup.run()
@@ -1354,6 +1356,10 @@ def build_parser() -> argparse.ArgumentParser:
     se.add_argument("--max-seconds", type=float, default=None,
                     help="drain + exit after this many seconds (drills); "
                          "default: run until SIGTERM")
+    se.add_argument("--alerts-file", default=None,
+                    help="an `stc monitor` alerts.jsonl: while it holds "
+                         "firing alerts, GET /healthz reports status "
+                         "'degraded' and lists them")
     se.add_argument("--telemetry-file", default=None,
                     help="telemetry run stream (serve.* histograms, "
                          "hot-swap events, dispatch/compile attribution) "
@@ -1476,6 +1482,13 @@ def build_parser() -> argparse.ArgumentParser:
     sv.add_argument("--max-respawns", type=int, default=5,
                     help="fleet-wide respawn budget before supervision "
                          "aborts (a crash loop must fail loudly)")
+    sv.add_argument("--actions-file", default=None,
+                    help="poll this `stc monitor` actions file every "
+                         "sweep: a firing queue_depth/fleet_skew alert's "
+                         "scale request triggers the ledger-gated "
+                         "resize, a worker_stale drain request runs the "
+                         "escalation ladder (applied ids acked in "
+                         "<file>.ack, exactly once)")
     sv.add_argument("--resize-at", action="append", default=[],
                     metavar="EPOCHS:WORKERS",
                     help="scripted resize: once the fleet's total "
@@ -1526,6 +1539,10 @@ def build_parser() -> argparse.ArgumentParser:
 
     add_metrics_subparser(sub)
 
+    from .telemetry.monitor_cli import add_monitor_subparser
+
+    add_monitor_subparser(sub)
+
     from .analysis.cli import add_lint_subparser
 
     add_lint_subparser(sub)
@@ -1549,9 +1566,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     # `stream` (requeue/compact) is pure filesystem maintenance: no jax
     # `supervise` is pure subprocess-and-files machinery: its WORKERS
     # bring jax up; the supervisor must survive anything they do to it
+    # `monitor` is a pure host-side reader like `metrics`: no jax ever
     if (
         args.cmd not in ("doctor", "metrics", "lint", "stream",
-                         "supervise")
+                         "supervise", "monitor")
         and getattr(args, "coordinator", None) is None
     ):
         from .utils.env import enable_persistent_compile_cache

@@ -83,6 +83,8 @@ SITES = frozenset({
     "serve.accept",       # before the scoring service accepts a request
     "serve.batch",        # before a coalesced serve batch dispatches
     "serve.swap",         # before a verified model hot-swap installs
+    "monitor.poll",       # top of each alert-engine evaluation cycle
+    "monitor.action",     # before the monitor's actions-file write
 })
 
 

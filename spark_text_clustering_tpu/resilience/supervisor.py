@@ -63,7 +63,7 @@ import signal
 import subprocess
 import time
 from dataclasses import dataclass, field
-from typing import Callable, Dict, List, Optional, Sequence, Set
+from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple
 
 from . import faultinject
 from .errors import FencedEpochError, ResilienceError
@@ -102,6 +102,7 @@ PREEMPTIONS_COUNTER = "fleet.preemptions"
 LEASE_EXPIRIES_COUNTER = "fleet.lease_expiries"
 CRASHES_COUNTER = "fleet.crashes"
 HEARTBEATS_COUNTER = "fleet.heartbeats"
+ACTIONS_APPLIED_COUNTER = "fleet.actions_applied"
 FENCE_REFUSALS_COUNTER = "ledger.fence_refusals"
 
 
@@ -468,6 +469,7 @@ class FleetSupervisor:
         resize_plan: Optional[List[Dict]] = None,
         worker_faults: Optional[Dict[int, str]] = None,
         env: Optional[Dict[str, str]] = None,
+        actions_file: Optional[str] = None,
     ) -> None:
         if workers < 1:
             raise ValueError("workers must be >= 1")
@@ -494,6 +496,15 @@ class FleetSupervisor:
         )
         self.worker_faults = dict(worker_faults or {})
         self.env = dict(env) if env is not None else dict(os.environ)
+        # telemetry-driven fleet control: an `stc monitor` writes
+        # scale/drain requests here; we poll it every sweep and ack the
+        # last applied id in <actions_file>.ack so a request is applied
+        # exactly once across supervisor restarts
+        self.actions_file = actions_file
+        self._actions_stamp: Optional[Tuple[float, int]] = None
+        self._last_action_id = -1
+        if actions_file:
+            self._last_action_id = self._read_action_ack()
 
         self.ledger = FleetLedger(fleet_dir)
         self.report = FleetReport()
@@ -742,6 +753,87 @@ class FleetSupervisor:
             ):
                 self._resize(count - 1, why="idle")
 
+    # -- telemetry-driven actions (the monitor's half of the loop) -------
+    def _ack_path(self) -> str:
+        return self.actions_file + ".ack"
+
+    def _read_action_ack(self) -> int:
+        try:
+            with open(self._ack_path(), "r", encoding="utf-8") as f:
+                return int(json.load(f).get("last_id", -1))
+        except (OSError, json.JSONDecodeError, ValueError):
+            return -1
+
+    def _check_actions(self) -> None:
+        """Apply NEW requests from the monitor's actions file: a
+        ``scale_out``/``scale_in``/``resize`` request goes through the
+        same ledger-gated ``_resize`` the queue-depth controller uses
+        (drain whole fleet between committed epochs, fence the new
+        generation); a ``drain`` request runs the escalation ladder on
+        one worker.  Every processed id is acked — clamped/no-op
+        requests too, or a firing alert would re-apply forever."""
+        from .. import telemetry
+
+        if not self.actions_file:
+            return
+        try:
+            st = os.stat(self.actions_file)
+            stamp = (st.st_mtime, st.st_size)
+        except OSError:
+            return
+        if stamp == self._actions_stamp:
+            return
+        self._actions_stamp = stamp
+        try:
+            with open(self.actions_file, "r", encoding="utf-8") as f:
+                doc = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            return                      # mid-write; next sweep re-reads
+        actions = doc.get("actions") if isinstance(doc, dict) else None
+        if not isinstance(actions, list):
+            return
+        fresh = sorted(
+            (
+                a for a in actions
+                if isinstance(a, dict)
+                and isinstance(a.get("id"), int)
+                and a["id"] > self._last_action_id
+            ),
+            key=lambda a: a["id"],
+        )
+        for act in fresh:
+            kind = str(act.get("kind", ""))
+            why = f"alert_{act.get('alert', '?')}"
+            telemetry.count(ACTIONS_APPLIED_COUNTER)
+            telemetry.event(
+                "fleet_action", id=act["id"], kind=kind, why=why,
+            )
+            if kind in ("scale_out", "scale_in", "resize"):
+                count = self._current_count()
+                if kind == "resize":
+                    target = int(act.get("workers", count))
+                else:
+                    delta = int(act.get("workers_delta", 1))
+                    target = count + (
+                        delta if kind == "scale_out" else -delta
+                    )
+                self._resize(target, why=why)
+            elif kind == "drain":
+                w = self._procs.get(int(act.get("worker", -1)))
+                if w is not None and not w.finished \
+                        and w.proc.poll() is None:
+                    self._escalate(w, why=why)
+                    self._handle_death(w, cause=why)
+            self._last_action_id = act["id"]
+        if fresh:
+            atomic_write_text(
+                self._ack_path(),
+                json.dumps(
+                    {"last_id": self._last_action_id},
+                    sort_keys=True,
+                ) + "\n",
+            )
+
     # -- the loop --------------------------------------------------------
     def run(self) -> FleetReport:
         from .. import telemetry
@@ -877,5 +969,6 @@ class FleetSupervisor:
         )
         if not active:
             return True
+        self._check_actions()
         self._check_resize(depths)
         return False

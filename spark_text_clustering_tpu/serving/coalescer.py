@@ -146,11 +146,11 @@ class RequestCoalescer:
                     "serve.queue_seconds", now - d.enqueued_at
                 )
             telemetry.count("serve.batches")
+            fill = len(batch) / self.max_batch
             telemetry.observe(
-                "serve.batch_fill",
-                len(batch) / self.max_batch,
-                buckets=_FILL_BUCKETS,
+                "serve.batch_fill", fill, buckets=_FILL_BUCKETS,
             )
+            t0 = time.perf_counter()
             try:
                 faultinject.check("serve.batch")
                 self.dispatch(batch)
@@ -158,9 +158,23 @@ class RequestCoalescer:
                 # the batch dies, its documents get error responses,
                 # the SERVICE keeps serving (PR 2 quarantine discipline)
                 telemetry.count("serve.quarantined", len(batch))
+                telemetry.event(
+                    "serve_quarantined", docs=len(batch),
+                    error=repr(exc),
+                )
                 for d in batch:
                     if not d.done.is_set():
                         d.fail(exc)
+            else:
+                # the live per-batch record the `stc monitor` serve
+                # rules (p99/fill regressions) tail — the registry
+                # histograms only reach the stream at shutdown
+                telemetry.event(
+                    "serve_batch",
+                    docs=len(batch),
+                    seconds=round(time.perf_counter() - t0, 6),
+                    fill=round(fill, 4),
+                )
 
     # -- drain -----------------------------------------------------------
     def drain(self, timeout: float = 60.0) -> None:

@@ -209,11 +209,15 @@ class ScoringService:
         model_poll_interval: float = 2.0,
         quarantine_dir: Optional[str] = None,
         request_timeout: float = 60.0,
+        alerts_file: Optional[str] = None,
     ) -> None:
         self.models_dir = models_dir
         self.lang = lang
         self.explicit_model = model
         self.verify_deep = verify_deep
+        # a monitor's alerts.jsonl: firing alerts degrade /healthz
+        # (docs/OBSERVABILITY.md "Live monitoring & alerting")
+        self.alerts_file = alerts_file
         self._scorer_kw = dict(
             stop_words=stop_words,
             lemmatize=lemmatize,
@@ -260,8 +264,19 @@ class ScoringService:
 
     def health(self) -> dict:
         reg = telemetry.get_registry()
-        return {
-            "status": "draining" if self.draining else "ok",
+        firing = []
+        if self.alerts_file:
+            # torn/missing logs read as no alerts (firing_alerts is
+            # mtime-cached) — a health check must never crash on its
+            # own telemetry
+            from ..telemetry.alerts import firing_alerts
+
+            firing = firing_alerts(self.alerts_file)
+        status = "draining" if self.draining else (
+            "degraded" if firing else "ok"
+        )
+        out = {
+            "status": status,
             "model": self._scorer.attribution,
             "uptime_s": round(time.time() - self.started_at, 3),
             "queue_depth": self.coalescer.queue_depth(),
@@ -273,6 +288,12 @@ class ScoringService:
                 if k != "signatures"
             },
         }
+        if self.alerts_file:
+            out["alerts"] = {
+                "source": self.alerts_file,
+                "firing": firing,
+            }
+        return out
 
     # -- request path ----------------------------------------------------
     def submit_texts(
@@ -305,6 +326,10 @@ class ScoringService:
                 # one malformed document gets an error response; its
                 # batchmates (and the daemon) are untouched
                 telemetry.count("serve.quarantined")
+                telemetry.event(
+                    "serve_quarantined", docs=1, stage="vectorize",
+                    error=repr(exc),
+                )
                 self.quarantine.put(name, text, exc, stage="vectorize")
                 results[i] = {"name": name, "error": repr(exc)}
                 pending.append(None)
@@ -453,12 +478,40 @@ class _ServeHandler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
+    def _send_text(self, code: int, text: str, ctype: str) -> None:
+        body = text.encode("utf-8")
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
     def do_GET(self):  # noqa: N802 (http.server API)
+        from ..telemetry import prometheus
+
         service: ScoringService = self.server.service
-        if self.path == "/healthz":
+        path, _, query = self.path.partition("?")
+        if path == "/healthz":
             self._send(200, service.health())
-        elif self.path == "/metrics":
-            self._send(200, telemetry.get_registry().snapshot())
+        elif path == "/metrics":
+            # content negotiation: standard scrapers (Prometheus sends
+            # text/plain;version=... / openmetrics Accept values) get
+            # the text exposition format; the existing JSON consumers
+            # (no Accept preference, or application/json) keep the
+            # registry dump byte-compatible
+            accept = self.headers.get("Accept", "")
+            if query == "format=prometheus" or (
+                not query and prometheus.wants_prometheus(accept)
+            ):
+                self._send_text(
+                    200,
+                    prometheus.render(
+                        telemetry.get_registry().snapshot()
+                    ),
+                    prometheus.CONTENT_TYPE,
+                )
+            else:
+                self._send(200, telemetry.get_registry().snapshot())
         else:
             self._send(404, {"error": f"no route {self.path}"})
 

@@ -55,7 +55,9 @@ __all__ = [
     "ledger_health",
     "fleet_health",
     "serving_health",
+    "alert_health",
     "cmd_summarize",
+    "cmd_tail",
     "cmd_diff",
     "cmd_check",
     "cmd_merge",
@@ -650,6 +652,123 @@ def serving_health(
     return out
 
 
+def alert_health(
+    events: List[Dict], metrics: Dict[str, float]
+) -> Optional[Dict]:
+    """Alert-health summary for an ``stc monitor`` run
+    (docs/OBSERVABILITY.md "Live monitoring & alerting"): per-rule
+    transition totals, the still-firing set (replayed from the
+    ``alert_transition`` events), actions emitted, and the newest
+    topic-drift probe reading.  None when the run never monitored."""
+    trans = [
+        e for e in events if e.get("event") == "alert_transition"
+    ]
+    actions = [
+        e for e in events if e.get("event") == "action_emitted"
+    ]
+    drifts = [e for e in events if e.get("event") == "drift_probe"]
+    monitored = bool(trans or actions or drifts) or any(
+        k.startswith(("counter.alert.", "counter.monitor.",
+                      "gauge.alert.", "gauge.drift."))
+        for k in metrics
+    )
+    if not monitored:
+        return None
+    out: Dict = {
+        "fired": int(metrics.get("counter.alert.firing", 0)),
+        "resolved": int(metrics.get("counter.alert.resolved", 0)),
+        "pending": int(metrics.get("counter.alert.pending", 0)),
+        "actions_emitted": int(
+            metrics.get("counter.monitor.actions", 0)
+        ),
+        "polls": int(metrics.get("counter.monitor.polls", 0)),
+    }
+    by_rule: Dict[str, Dict[str, int]] = {}
+    firing: Dict[Tuple[str, str], Dict] = {}
+    for e in trans:
+        rule = str(e.get("rule", "?"))
+        state = str(e.get("state", "?"))
+        by_rule.setdefault(rule, {})
+        by_rule[rule][state] = by_rule[rule].get(state, 0) + 1
+        k = (rule, str(e.get("key", "")))
+        if state == "firing":
+            firing[k] = e
+        elif state == "resolved":
+            firing.pop(k, None)
+    if by_rule:
+        out["by_rule"] = by_rule
+    out["still_firing"] = sorted(
+        (
+            {
+                "rule": rule, "key": key,
+                "value": rec.get("value"),
+                "threshold": rec.get("threshold"),
+            }
+            for (rule, key), rec in firing.items()
+        ),
+        key=lambda r: (r["rule"], r["key"]),
+    )
+    if actions:
+        out["actions"] = [
+            {
+                "kind": a.get("kind"), "alert": a.get("alert"),
+                "key": a.get("key"), "id": a.get("id"),
+            }
+            for a in actions
+        ]
+    if drifts:
+        last = drifts[-1]
+        out["drift"] = {
+            "ledger": last.get("ledger"),
+            "epoch": last.get("epoch"),
+            "kl": last.get("kl"),
+            "hellinger": last.get("hellinger"),
+            "probes": len(drifts),
+        }
+    elif _is_num(metrics.get("gauge.drift.kl")):
+        out["drift"] = {
+            "kl": metrics.get("gauge.drift.kl"),
+            "hellinger": metrics.get("gauge.drift.hellinger"),
+        }
+    return out
+
+
+def _print_alert_health(ah: Dict, file=None) -> None:
+    file = file if file is not None else sys.stdout
+    print("alert health:", file=file)
+    print(
+        f"  fired: {ah['fired']}  resolved: {ah['resolved']}  "
+        f"pending: {ah['pending']}  actions: {ah['actions_emitted']}  "
+        f"(over {ah['polls']} poll(s))", file=file,
+    )
+    for rule, states in sorted(ah.get("by_rule", {}).items()):
+        parts = "  ".join(
+            f"{s}: {n}" for s, n in sorted(states.items())
+        )
+        print(f"  rule {rule}: {parts}", file=file)
+    for f_ in ah.get("still_firing", ()):
+        key = f" [{f_['key']}]" if f_.get("key") else ""
+        print(
+            f"  STILL FIRING: {f_['rule']}{key} value="
+            f"{f_.get('value')} threshold={f_.get('threshold')}",
+            file=file,
+        )
+    for a in ah.get("actions", ()):
+        print(
+            f"  action: {a['kind']} (alert {a['alert']}"
+            + (f" [{a['key']}]" if a.get("key") else "") + ")",
+            file=file,
+        )
+    d = ah.get("drift")
+    if d:
+        print(
+            f"  drift: kl={d.get('kl')} hellinger="
+            f"{d.get('hellinger')}"
+            + (f" @ epoch {d['epoch']}" if "epoch" in d else ""),
+            file=file,
+        )
+
+
 def _print_serving_health(sh: Dict, file=None) -> None:
     file = file if file is not None else sys.stdout
     print("serving health:", file=file)
@@ -786,6 +905,7 @@ def _cmd_summarize(args) -> int:
     lh = ledger_health(events)
     fh = fleet_health(events)
     sh = serving_health(events, metrics)
+    ah = alert_health(events, metrics)
     if getattr(args, "json", False):
         doc = {"manifest": manifest, "metrics": metrics}
         if lh is not None:
@@ -794,6 +914,8 @@ def _cmd_summarize(args) -> int:
             doc["fleet_health"] = fh
         if sh is not None:
             doc["serving_health"] = sh
+        if ah is not None:
+            doc["alert_health"] = ah
         print(json.dumps(doc, sort_keys=True))
         return 0
     print(f"run: {args.run}")
@@ -806,11 +928,79 @@ def _cmd_summarize(args) -> int:
         _print_fleet_health(fh)
     if sh is not None:
         _print_serving_health(sh)
+    if ah is not None:
+        _print_alert_health(ah)
     print("metrics:")
     for k in sorted(metrics):
         v = metrics[k]
         vs = f"{v:.6g}" if abs(v) < 1e6 else f"{v:.4e}"
         print(f"  {k} = {vs}")
+    return 0
+
+
+def _render_event(e: Dict) -> str:
+    """One compact line per tailed event (the `metrics tail` view)."""
+    import datetime
+
+    ts = e.get("ts")
+    if _is_num(ts):
+        stamp = datetime.datetime.fromtimestamp(float(ts)).strftime(
+            "%H:%M:%S.%f"
+        )[:-3]
+    else:
+        stamp = "--:--:--.---"
+    name = str(e.get("event", "?"))
+    stream = str(e.get("_stream", ""))
+    parts = []
+    for k in sorted(e):
+        if k in ("event", "ts", "_stream"):
+            continue
+        v = e[k]
+        if isinstance(v, float):
+            vs = f"{v:.6g}"
+        elif isinstance(v, (dict, list)):
+            vs = json.dumps(v)
+        else:
+            vs = str(v)
+        if len(vs) > 48:
+            vs = vs[:45] + "..."
+        parts.append(f"{k}={vs}")
+    head = f"{stamp} [{stream}] {name}" if stream else f"{stamp} {name}"
+    return f"{head}  " + " ".join(parts) if parts else head
+
+
+def cmd_tail(args) -> int:
+    """Live follow-mode rendering of run stream(s): the `stc top`-style
+    operator view, sharing the monitor's torn-line/truncation tolerant
+    tailing machinery.  Ctrl-C exits cleanly."""
+    import time as _time
+
+    from ..resilience.retry import sleep as _sleep
+    from .alerts import StreamSet
+
+    streams = StreamSet(list(args.runs), from_start=not args.end)
+    deadline = (
+        _time.monotonic() + args.max_seconds
+        if args.max_seconds is not None else None
+    )
+    shown = 0
+    try:
+        while True:
+            for e in streams.poll():
+                print(_render_event(e), flush=False)
+                shown += 1
+            sys.stdout.flush()
+            if args.once:
+                break
+            if deadline is not None and _time.monotonic() >= deadline:
+                break
+            _sleep(args.interval)
+    except (KeyboardInterrupt, BrokenPipeError):
+        pass
+    try:
+        print(f"# tailed {shown} event(s)", file=sys.stderr)
+    except BrokenPipeError:
+        pass
     return 0
 
 
@@ -1148,6 +1338,37 @@ def add_metrics_subparser(sub) -> None:
     sm.add_argument("run", help="telemetry .jsonl (or a BENCH_*.json)")
     sm.add_argument("--json", action="store_true")
     sm.set_defaults(fn=cmd_summarize)
+
+    tl = msub.add_parser(
+        "tail",
+        help="live follow-mode rendering of run stream(s) — operator "
+             "visibility without the alert engine (shares the "
+             "monitor's torn-line tolerant tailing machinery)",
+    )
+    tl.add_argument(
+        "runs", nargs="+",
+        help="telemetry .jsonl stream(s) or glob patterns "
+             "(re-expanded every poll)",
+    )
+    tl.add_argument(
+        "--interval", type=float, default=0.5,
+        help="seconds between polls",
+    )
+    tl.add_argument(
+        "--end", action="store_true",
+        help="start at the current end of each stream (default: "
+             "render history first, then follow)",
+    )
+    tl.add_argument(
+        "--once", action="store_true",
+        help="render the current content and exit (no follow)",
+    )
+    tl.add_argument(
+        "--max-seconds", type=float, default=None,
+        help="stop following after this long (drills/tests); "
+             "default: until Ctrl-C",
+    )
+    tl.set_defaults(fn=cmd_tail)
 
     df = msub.add_parser("diff", help="align two runs metric-by-metric")
     df.add_argument("a")

@@ -75,6 +75,9 @@ METRICS: Dict[str, str] = {
         "dead worker detected)",
     "fleet.crashes": "workers that died without a terminal done-lease",
     "fleet.heartbeats": "lease renewals written by workers",
+    "fleet.actions_applied":
+        "monitor actions-file requests applied by the supervisor "
+        "(alert-driven resize/drain — the telemetry -> topology loop)",
     # -- quarantine requeue (stc stream requeue) ------------------------
     "requeue.replayed":
         "quarantined documents replayed back into a watch directory",
@@ -146,6 +149,17 @@ PREFIXES: Dict[str, str] = {
     "merge.": "metrics merge: per-metric min/median/max across processes",
     "skew.": "metrics merge: cross-host skew findings (straggler/retries/"
              "queue-depth divergence)",
+    # live alerting engine (`stc monitor`, telemetry.alerts /
+    # docs/OBSERVABILITY.md "Live monitoring & alerting")
+    "alert.":
+        "telemetry.alerts: alert state-machine transitions "
+        "(alert.pending/firing/resolved counters, alert.active gauge)",
+    "drift.":
+        "telemetry.alerts: topic-drift probe over committed-epoch "
+        "lambdas (drift.kl / drift.hellinger gauges, drift.probes)",
+    "monitor.":
+        "telemetry.alerts: monitor engine self-observation (polls, "
+        "events consumed, actions emitted, poll errors, live streams)",
 }
 
 

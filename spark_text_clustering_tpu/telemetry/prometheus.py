@@ -1,0 +1,95 @@
+"""Prometheus text exposition of a registry snapshot.
+
+``stc serve``'s ``GET /metrics`` used to return only the ad-hoc JSON
+registry dump; this module renders the SAME snapshot in the Prometheus
+text exposition format (version 0.0.4) so standard scrapers work
+against the service unmodified — content negotiation in the HTTP
+handler picks the format from the ``Accept`` header.
+
+Mapping:
+
+  * counters -> ``# TYPE ... counter`` (name suffixed ``_total`` per
+    convention);
+  * gauges   -> ``# TYPE ... gauge``;
+  * histograms -> ``# TYPE ... summary`` with ``quantile`` labels: the
+    registry's fixed-bucket histograms snapshot p50/p95/p99 (+ sum and
+    count), which maps exactly onto the summary type — bucket counts
+    are not in the snapshot, and re-deriving ``le`` buckets would
+    invent data the registry never kept.
+
+Metric names sanitize dots to underscores under an ``stc_`` namespace
+(``serve.request_seconds`` -> ``stc_serve_request_seconds``); the
+original dotted name travels in a ``# HELP`` line so dashboards can be
+traced back to telemetry/names.py.
+
+jax-free, stdlib-only, like every telemetry module.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from typing import Dict, List
+
+__all__ = ["CONTENT_TYPE", "sanitize", "render", "wants_prometheus"]
+
+# the 0.0.4 text format's canonical content type
+CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+_SANITIZE_RE = re.compile(r"[^a-zA-Z0-9_]")
+
+
+def sanitize(name: str) -> str:
+    """Dotted telemetry name -> Prometheus metric name."""
+    return "stc_" + _SANITIZE_RE.sub("_", name)
+
+
+def _num(v) -> str:
+    if v is None:
+        return "NaN"
+    f = float(v)
+    if math.isnan(f):
+        return "NaN"
+    if math.isinf(f):
+        return "+Inf" if f > 0 else "-Inf"
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+def render(snapshot: Dict) -> str:
+    """The exposition text for one ``MetricRegistry.snapshot()``."""
+    lines: List[str] = []
+    for name, v in sorted(snapshot.get("counters", {}).items()):
+        pn = sanitize(name) + "_total"
+        lines.append(f"# HELP {pn} counter {name}")
+        lines.append(f"# TYPE {pn} counter")
+        lines.append(f"{pn} {_num(v)}")
+    for name, v in sorted(snapshot.get("gauges", {}).items()):
+        pn = sanitize(name)
+        lines.append(f"# HELP {pn} gauge {name}")
+        lines.append(f"# TYPE {pn} gauge")
+        lines.append(f"{pn} {_num(v)}")
+    for name, h in sorted(snapshot.get("histograms", {}).items()):
+        pn = sanitize(name)
+        lines.append(f"# HELP {pn} histogram {name} (as summary)")
+        lines.append(f"# TYPE {pn} summary")
+        for q, fld in (("0.5", "p50"), ("0.95", "p95"), ("0.99", "p99")):
+            lines.append(
+                f'{pn}{{quantile="{q}"}} {_num(h.get(fld))}'
+            )
+        lines.append(f"{pn}_sum {_num(h.get('sum', 0.0))}")
+        lines.append(f"{pn}_count {_num(h.get('count', 0))}")
+    return "\n".join(lines) + "\n"
+
+
+def wants_prometheus(accept: str) -> bool:
+    """Content negotiation: a scraper asking for text exposition
+    (Prometheus sends ``text/plain;version=...`` and/or
+    ``application/openmetrics-text``) gets it; everything else —
+    including the existing JSON consumers, which send no Accept or
+    ``application/json`` — keeps the ad-hoc JSON dump."""
+    accept = (accept or "").lower()
+    if "application/json" in accept:
+        return False
+    return "text/plain" in accept or "openmetrics" in accept

@@ -1,0 +1,1011 @@
+"""The live alerting engine (`stc monitor`, telemetry.alerts): torn-tail
+tolerant tailing, signal aggregation, the pending -> firing -> resolved
+state machine (with flap suppression), the checksummed alerts log and
+its resume semantics, the topic-drift probe over committed-epoch
+lambdas, the actions file, the supervisor's alert-driven resize/drain
+(stub fleet — no jax), and the Prometheus exposition renderer.
+
+Everything here is jax-free and fast: the monitor is a pure host-side
+reader and must stay one.
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+from spark_text_clustering_tpu import telemetry
+from spark_text_clustering_tpu.resilience import faultinject
+from spark_text_clustering_tpu.resilience.errors import (
+    CorruptArtifactError,
+)
+from spark_text_clustering_tpu.resilience.ledger import EpochLedger
+from spark_text_clustering_tpu.resilience.supervisor import (
+    FleetLedger,
+    FleetSupervisor,
+    lease_path,
+)
+from spark_text_clustering_tpu.telemetry import prometheus
+from spark_text_clustering_tpu.telemetry.alerts import (
+    BUILTIN_RULES,
+    ActionEmitter,
+    AlertEngine,
+    AlertLog,
+    AlertRule,
+    DriftProbe,
+    JsonlTailer,
+    StreamSet,
+    builtin_rules,
+    eval_signal,
+    firing_alerts,
+    read_actions,
+    rule_from_dict,
+    topic_distance,
+)
+from spark_text_clustering_tpu.telemetry.metrics_cli import (
+    alert_health,
+    load_run,
+    run_metrics,
+)
+
+
+@pytest.fixture(autouse=True)
+def _telemetry_reset():
+    telemetry.shutdown()
+    telemetry.get_registry().reset()
+    faultinject.reset()
+    yield
+    telemetry.shutdown()
+    telemetry.get_registry().reset()
+    faultinject.reset()
+
+
+def _write_lines(path, recs, partial=None):
+    with open(path, "w", encoding="utf-8") as f:
+        for r in recs:
+            f.write(json.dumps(r) + "\n")
+        if partial is not None:
+            f.write(partial)
+
+
+# ---------------------------------------------------------------------------
+# tailing machinery
+# ---------------------------------------------------------------------------
+class TestTailing:
+    def test_only_complete_lines_consumed(self, tmp_path):
+        p = str(tmp_path / "s.jsonl")
+        _write_lines(
+            p, [{"event": "a", "ts": 1.0}], partial='{"event": "to'
+        )
+        t = JsonlTailer(p)
+        assert [e["event"] for e in t.poll()] == ["a"]
+        # the torn tail completes across TWO more appends
+        with open(p, "a") as f:
+            f.write('rn", ')
+        assert t.poll() == []
+        with open(p, "a") as f:
+            f.write('"ts": 2.0}\n')
+        assert [e["event"] for e in t.poll()] == ["torn"]
+
+    def test_truncation_restarts_from_top(self, tmp_path):
+        p = str(tmp_path / "s.jsonl")
+        _write_lines(p, [{"event": "old", "n": i} for i in range(50)])
+        t = JsonlTailer(p)
+        assert len(t.poll()) == 50
+        # the writer truncated (a new run re-configured the sink)
+        _write_lines(p, [{"event": "fresh"}])
+        assert [e["event"] for e in t.poll()] == ["fresh"]
+
+    def test_missing_file_is_quiet_until_created(self, tmp_path):
+        p = str(tmp_path / "later.jsonl")
+        t = JsonlTailer(p)
+        assert t.poll() == []
+        _write_lines(p, [{"event": "born"}])
+        assert [e["event"] for e in t.poll()] == ["born"]
+
+    def test_unparseable_complete_lines_skipped(self, tmp_path):
+        p = str(tmp_path / "s.jsonl")
+        with open(p, "w") as f:
+            f.write('{"event": "ok"}\n')
+            f.write("not json at all\n")
+            f.write('{"event": "ok2"}\n')
+        assert [e["event"] for e in JsonlTailer(p).poll()] == [
+            "ok", "ok2",
+        ]
+
+    def test_streamset_glob_picks_up_new_streams(self, tmp_path):
+        pat = str(tmp_path / "events-p*.jsonl")
+        s = StreamSet([pat])
+        _write_lines(
+            str(tmp_path / "events-p0.jsonl"), [{"event": "a"}]
+        )
+        evs = s.poll()
+        assert [e["_stream"] for e in evs] == ["events-p0.jsonl"]
+        # a respawned worker's stream appears mid-follow
+        _write_lines(
+            str(tmp_path / "events-p1.jsonl"), [{"event": "b"}]
+        )
+        evs = s.poll()
+        assert [e["_stream"] for e in evs] == ["events-p1.jsonl"]
+
+
+# ---------------------------------------------------------------------------
+# signal aggregation
+# ---------------------------------------------------------------------------
+def _evts(*specs):
+    return [(ts, dict(e, ts=ts)) for ts, e in specs]
+
+
+class TestSignals:
+    def test_last_rate_sum_percentile(self):
+        events = _evts(
+            (1.0, {"event": "m", "v": 1.0}),
+            (2.0, {"event": "m", "v": 5.0}),
+            (3.0, {"event": "m", "v": 3.0}),
+            (3.5, {"event": "other", "v": 99.0}),
+        )
+        sig = {"event": "m", "field": "v", "window_seconds": 10.0}
+        assert eval_signal(dict(sig, agg="last"), events, 4.0) == {
+            None: 3.0
+        }
+        assert eval_signal(dict(sig, agg="sum"), events, 4.0) == {
+            None: 9.0
+        }
+        assert eval_signal(dict(sig, agg="max"), events, 4.0) == {
+            None: 5.0
+        }
+        rate = eval_signal(dict(sig, agg="rate"), events, 4.0)[None]
+        assert rate == pytest.approx(3 / 10.0)
+        assert eval_signal(dict(sig, agg="p99"), events, 4.0) == {
+            None: 5.0
+        }
+
+    def test_window_excludes_old_events(self):
+        events = _evts(
+            (1.0, {"event": "m", "v": 100.0}),
+            (9.0, {"event": "m", "v": 2.0}),
+        )
+        sig = {"event": "m", "field": "v", "agg": "max",
+               "window_seconds": 5.0}
+        assert eval_signal(sig, events, 10.0) == {None: 2.0}
+
+    def test_by_groups_and_reduce_folds(self):
+        events = _evts(
+            (1.0, {"event": "lease", "worker": 0, "queue_depth": 4}),
+            (1.0, {"event": "lease", "worker": 1, "queue_depth": 1}),
+            (2.0, {"event": "lease", "worker": 0, "queue_depth": 6}),
+        )
+        sig = {"event": "lease", "field": "queue_depth", "agg": "last",
+               "by": "worker", "window_seconds": 10.0}
+        assert eval_signal(sig, events, 3.0) == {"0": 6.0, "1": 1.0}
+        assert eval_signal(
+            dict(sig, reduce="sum"), events, 3.0
+        ) == {None: 7.0}
+
+    def test_distinct_and_where(self):
+        events = _evts(
+            (1.0, {"event": "dispatch_executable", "label": "a",
+                   "digest": "d1"}),
+            (2.0, {"event": "dispatch_executable", "label": "a",
+                   "digest": "d2"}),
+            (3.0, {"event": "dispatch_executable", "label": "a",
+                   "digest": "d1"}),
+            (3.0, {"event": "dispatch_executable", "label": "b",
+                   "digest": "d9"}),
+        )
+        sig = {"event": "dispatch_executable", "field": "digest",
+               "agg": "distinct", "by": "label",
+               "window_seconds": 10.0}
+        assert eval_signal(sig, events, 4.0) == {"a": 2.0, "b": 1.0}
+        sig2 = {"event": "dispatch_executable", "agg": "count",
+                "where": {"label": "b"}, "window_seconds": 10.0}
+        assert eval_signal(sig2, events, 4.0) == {None: 1.0}
+
+
+# ---------------------------------------------------------------------------
+# rule validation
+# ---------------------------------------------------------------------------
+class TestRuleValidation:
+    def test_bad_specs_raise_typed(self):
+        with pytest.raises(ValueError, match="unknown kind"):
+            AlertRule(name="r", kind="nope")
+        with pytest.raises(ValueError, match="unknown op"):
+            AlertRule(
+                name="r", op="!=",
+                signal={"event": "m"},
+            )
+        with pytest.raises(ValueError, match="unknown agg"):
+            AlertRule(
+                name="r", signal={"event": "m", "agg": "median"}
+            )
+        with pytest.raises(ValueError, match="signal"):
+            AlertRule(name="r", kind="threshold")
+        with pytest.raises(ValueError, match="by"):
+            AlertRule(
+                name="r", kind="divergence", signal={"event": "m"}
+            )
+        with pytest.raises(ValueError, match="unknown field"):
+            rule_from_dict({"name": "r", "threshold": 3})
+        with pytest.raises(ValueError, match="unknown action"):
+            AlertRule(
+                name="r", signal={"event": "m"},
+                action={"kind": "explode"},
+            )
+
+    def test_builtins_all_instantiate(self):
+        rules = builtin_rules()
+        assert len(rules) == len(BUILTIN_RULES)
+        kinds = {r.kind for r in rules}
+        assert kinds == {"threshold", "absence", "divergence", "drift"}
+
+    def test_duplicate_rule_names_refused(self):
+        r = AlertRule(name="r", signal={"event": "m"})
+        with pytest.raises(ValueError, match="duplicate"):
+            AlertEngine([r, r])
+
+
+# ---------------------------------------------------------------------------
+# state machine
+# ---------------------------------------------------------------------------
+def _age_rule(**kw):
+    base = dict(
+        name="stale", kind="threshold",
+        signal={"event": "lease", "field": "age", "agg": "last",
+                "by": "worker", "window_seconds": 30.0},
+        op=">", value=5.0, for_seconds=1.0, resolve_seconds=2.0,
+    )
+    base.update(kw)
+    return AlertRule(**base)
+
+
+class _Clock:
+    def __init__(self, t=100.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def _feed(eng, clock, age, worker=0):
+    eng._ingest(
+        [{"event": "lease", "ts": clock.t, "worker": worker,
+          "age": age}],
+        clock.t,
+    )
+    return eng.poll(clock.t)
+
+
+class TestStateMachine:
+    def test_pending_firing_resolved_lifecycle(self, tmp_path):
+        clock = _Clock()
+        log = str(tmp_path / "alerts.jsonl")
+        eng = AlertEngine(
+            [_age_rule()], alerts_path=log, now_fn=clock
+        )
+        assert [t["state"] for t in _feed(eng, clock, 9.0)] == [
+            "pending"
+        ]
+        clock.t += 1.5
+        assert [t["state"] for t in _feed(eng, clock, 9.5)] == [
+            "firing"
+        ]
+        assert eng.firing() == [("stale", "0")]
+        # sustained clear resolves (after resolve_seconds)
+        clock.t += 1.0
+        assert _feed(eng, clock, 0.5) == []
+        clock.t += 2.5
+        assert [t["state"] for t in _feed(eng, clock, 0.5)] == [
+            "resolved"
+        ]
+        assert eng.firing() == []
+
+    def test_flap_suppression_holds_firing(self, tmp_path):
+        clock = _Clock()
+        eng = AlertEngine([_age_rule()], now_fn=clock)
+        _feed(eng, clock, 9.0)
+        clock.t += 1.5
+        _feed(eng, clock, 9.0)           # firing
+        # condition flaps below/above faster than resolve_seconds: the
+        # alert must NOT resolve-and-refire on every oscillation
+        for _ in range(4):
+            clock.t += 0.5
+            assert _feed(eng, clock, 0.1) == []
+            clock.t += 0.5
+            assert _feed(eng, clock, 9.0) == []
+        states = [t["state"] for t in eng.transitions]
+        assert states == ["pending", "firing"]
+        assert eng.firing() == [("stale", "0")]
+
+    def test_pending_cancels_silently_below_for_seconds(self):
+        clock = _Clock()
+        eng = AlertEngine([_age_rule(for_seconds=5.0)], now_fn=clock)
+        _feed(eng, clock, 9.0)           # pending
+        clock.t += 1.0
+        _feed(eng, clock, 0.1)           # condition gone before the gate
+        clock.t += 10.0
+        _feed(eng, clock, 0.1)
+        states = [t["state"] for t in eng.transitions]
+        assert states == ["pending"]
+        assert eng.firing() == []
+
+    def test_for_seconds_zero_fires_immediately(self):
+        clock = _Clock()
+        eng = AlertEngine([_age_rule(for_seconds=0.0)], now_fn=clock)
+        trs = _feed(eng, clock, 9.0)
+        assert [t["state"] for t in trs] == ["firing"]
+
+    def test_per_key_instances_are_independent(self):
+        clock = _Clock()
+        eng = AlertEngine([_age_rule(for_seconds=0.0)], now_fn=clock)
+        eng._ingest(
+            [
+                {"event": "lease", "ts": clock.t, "worker": 0,
+                 "age": 9.0},
+                {"event": "lease", "ts": clock.t, "worker": 1,
+                 "age": 0.1},
+            ],
+            clock.t,
+        )
+        eng.poll(clock.t)
+        assert eng.firing() == [("stale", "0")]
+
+
+class TestAbsence:
+    def test_silence_fires_and_activity_resolves(self):
+        clock = _Clock()
+        rule = AlertRule(
+            name="stalled", kind="absence",
+            signal={"event": "micro_batch"},
+            op=">", value=10.0, resolve_seconds=0.0,
+        )
+        eng = AlertEngine([rule], now_fn=clock)
+        eng._ingest(
+            [{"event": "micro_batch", "ts": clock.t}], clock.t
+        )
+        assert eng.poll(clock.t) == []
+        clock.t += 11.0
+        trs = eng.poll(clock.t)
+        assert [t["state"] for t in trs] == ["firing"]
+        # the stream comes back
+        eng._ingest(
+            [{"event": "micro_batch", "ts": clock.t}], clock.t
+        )
+        trs = eng.poll(clock.t)
+        assert [t["state"] for t in trs] == ["resolved"]
+
+    def test_never_seen_event_measures_from_engine_start(self):
+        clock = _Clock()
+        rule = AlertRule(
+            name="stalled", kind="absence",
+            signal={"event": "micro_batch"},
+            op=">", value=10.0,
+        )
+        eng = AlertEngine([rule], now_fn=clock)
+        assert eng.poll(clock.t) == []   # start reference, no data yet
+        clock.t += 5.0
+        assert eng.poll(clock.t) == []
+        clock.t += 6.0
+        # absence rules with no key universe stay quiet until the event
+        # family has been seen at least once (by=None yields one key)
+        trs = eng.poll(clock.t)
+        assert [t["state"] for t in trs] == ["firing"]
+
+
+class TestDivergence:
+    def test_skewed_worker_fires_with_worst_key(self):
+        clock = _Clock()
+        rule = AlertRule(
+            name="fleet_skew", kind="divergence",
+            signal={"event": "lease", "field": "queue_depth",
+                    "agg": "last", "by": "worker",
+                    "window_seconds": 30.0},
+            op=">", value=1.0, for_seconds=0.0,
+        )
+        eng = AlertEngine([rule], now_fn=clock)
+        eng._ingest(
+            [
+                {"event": "lease", "ts": clock.t, "worker": 0,
+                 "queue_depth": 12},
+                {"event": "lease", "ts": clock.t, "worker": 1,
+                 "queue_depth": 1},
+            ],
+            clock.t,
+        )
+        trs = eng.poll(clock.t)
+        assert [t["state"] for t in trs] == ["firing"]
+        assert trs[0]["worst"] == "0"
+        assert trs[0]["worst_value"] == 12.0
+
+    def test_balanced_fleet_and_single_worker_stay_quiet(self):
+        clock = _Clock()
+        rule = AlertRule(
+            name="fleet_skew", kind="divergence",
+            signal={"event": "lease", "field": "queue_depth",
+                    "agg": "last", "by": "worker",
+                    "window_seconds": 30.0},
+            op=">", value=1.0,
+        )
+        eng = AlertEngine([rule], now_fn=clock)
+        eng._ingest(
+            [
+                {"event": "lease", "ts": clock.t, "worker": 0,
+                 "queue_depth": 5},
+                {"event": "lease", "ts": clock.t, "worker": 1,
+                 "queue_depth": 6},
+            ],
+            clock.t,
+        )
+        assert eng.poll(clock.t) == []
+        # one worker = no divergence possible
+        eng2 = AlertEngine([rule], now_fn=clock)
+        eng2._ingest(
+            [{"event": "lease", "ts": clock.t, "worker": 0,
+              "queue_depth": 50}],
+            clock.t,
+        )
+        assert eng2.poll(clock.t) == []
+
+
+# ---------------------------------------------------------------------------
+# alerts log: persistence + resume
+# ---------------------------------------------------------------------------
+class TestAlertLog:
+    def test_records_checksummed_and_torn_tail_tolerated(
+        self, tmp_path
+    ):
+        p = str(tmp_path / "alerts.jsonl")
+        log = AlertLog(p)
+        log.append(rule="r", key="0", state="firing", value=9.0)
+        log.append(rule="r", key="0", state="resolved", value=0.0)
+        with open(p, "a") as f:
+            f.write('{"rule": "r", "torn')
+        recs, torn = AlertLog(p).replay()
+        assert len(recs) == 2 and torn == 1
+        assert all("checksum" in r for r in recs)
+
+    def test_corrupt_interior_line_raises_typed(self, tmp_path):
+        p = str(tmp_path / "alerts.jsonl")
+        log = AlertLog(p)
+        log.append(rule="r", key="0", state="firing")
+        log.append(rule="r", key="0", state="resolved")
+        lines = open(p).read().splitlines()
+        lines[0] = lines[0].replace("firing", "FIRinG")
+        with open(p, "w") as f:
+            f.write("\n".join(lines) + "\n")
+        with pytest.raises(CorruptArtifactError):
+            AlertLog(p).replay()
+
+    def test_firing_alerts_reader(self, tmp_path):
+        p = str(tmp_path / "alerts.jsonl")
+        log = AlertLog(p)
+        log.append(rule="a", key="", state="firing", value=2.0,
+                   threshold=1.0)
+        log.append(rule="b", key="3", state="firing", value=9.0)
+        assert [f["rule"] for f in firing_alerts(p)] == ["a", "b"]
+        log.append(rule="a", key="", state="resolved")
+        assert [f["rule"] for f in firing_alerts(p)] == ["b"]
+        assert firing_alerts(str(tmp_path / "missing.jsonl")) == []
+
+    def test_engine_restart_resumes_firing_set(self, tmp_path):
+        clock = _Clock()
+        p = str(tmp_path / "alerts.jsonl")
+        eng = AlertEngine(
+            [_age_rule(for_seconds=0.0)], alerts_path=p, now_fn=clock
+        )
+        _feed(eng, clock, 9.0)
+        assert eng.firing() == [("stale", "0")]
+        # a NEW engine over the same log: still firing, and a poll with
+        # the condition still true emits NO duplicate firing record
+        clock.t += 1.0
+        eng2 = AlertEngine(
+            [_age_rule(for_seconds=0.0)], alerts_path=p, now_fn=clock
+        )
+        assert eng2.firing() == [("stale", "0")]
+        _feed(eng2, clock, 9.5)
+        states = [r["state"] for r in AlertLog(p).replay()[0]]
+        assert states == ["firing"]
+        # and the resumed engine can resolve it (resolve_seconds=2
+        # hold: one clear poll starts the window, the next past it
+        # resolves)
+        clock.t += 3.0
+        assert _feed(eng2, clock, 0.1) == []
+        clock.t += 2.5
+        trs = _feed(eng2, clock, 0.1)
+        assert [t["state"] for t in trs] == ["resolved"]
+        assert firing_alerts(p) == []
+
+
+# ---------------------------------------------------------------------------
+# topic-drift probe
+# ---------------------------------------------------------------------------
+K, V = 3, 32
+
+
+def _commit_lambda(ckpt, epoch, lam):
+    led = EpochLedger(ckpt)
+    led.begin(
+        epoch, kind="stream-train",
+        sources=[f"doc-{epoch:03d}"], payloads=[],
+    )
+    spec = led.stage_shard(
+        epoch, 0, 1, cols=(0, lam.shape[1]), step=epoch,
+        lam=np.asarray(lam, np.float32),
+    )
+    led.commit(
+        epoch, kind="stream-train", sources=[f"doc-{epoch:03d}"],
+        shards=[spec], process_count=1,
+    )
+
+
+class TestDriftProbe:
+    def test_distance_is_permutation_invariant(self):
+        rng = np.random.default_rng(0)
+        a = rng.random((K, V)) + 0.05
+        kl, hel = topic_distance(a, a[[2, 0, 1]])
+        assert kl < 1e-9 and hel < 1e-6
+        b = a.copy()
+        b[1] = rng.random(V) + 0.05
+        kl2, hel2 = topic_distance(a, b)
+        assert kl2 > 0.01 and hel2 > 0.01
+
+    def test_probe_quiet_on_permutation_fires_on_perturbation(
+        self, tmp_path
+    ):
+        telemetry.configure(None)
+        ckpt = str(tmp_path / "ckpt")
+        rng = np.random.default_rng(1)
+        lam = (rng.random((K, V)) + 0.05).astype(np.float32)
+        rule = AlertRule(
+            name="topic_drift", kind="drift", metric="kl",
+            op=">", value=0.05, ledger_dir=ckpt,
+        )
+        clock = _Clock()
+        eng = AlertEngine([rule], now_fn=clock)
+
+        _commit_lambda(ckpt, 0, lam)
+        assert eng.poll(clock.t) == []          # baseline capture
+        clock.t += 1.0
+        # a permuted-but-identical lambda must stay quiet
+        _commit_lambda(ckpt, 1, lam[[1, 2, 0]])
+        assert eng.poll(clock.t) == []
+        reg = telemetry.get_registry()
+        assert reg.gauge("drift.kl").value < 1e-9
+        # a genuinely moved topic fires
+        clock.t += 1.0
+        moved = lam.copy()
+        moved[0] = (rng.random(V) + 0.05).astype(np.float32)
+        _commit_lambda(ckpt, 2, moved)
+        trs = eng.poll(clock.t)
+        assert [t["state"] for t in trs] == ["firing"]
+        assert trs[0]["value"] > 0.05
+        assert reg.gauge("drift.kl").value == trs[0]["value"]
+        # drift settles -> resolves on the next committed epoch
+        clock.t += 1.0
+        _commit_lambda(ckpt, 3, moved[[2, 1, 0]])
+        trs = eng.poll(clock.t)
+        assert [t["state"] for t in trs] == ["resolved"]
+
+    def test_corrupt_shard_skipped_not_fatal(self, tmp_path):
+        telemetry.configure(None)
+        ckpt = str(tmp_path / "ckpt")
+        rng = np.random.default_rng(2)
+        lam = (rng.random((K, V)) + 0.05).astype(np.float32)
+        _commit_lambda(ckpt, 0, lam)
+        probe = DriftProbe(ckpt)
+        probe.poll(0.0)
+        assert probe.last_epoch == 0
+        _commit_lambda(ckpt, 1, lam)
+        # bit-rot the newest shard: the probe must skip, not crash
+        rec = [
+            r for r in EpochLedger(ckpt).records() if r.get("shards")
+        ][-1]
+        shard = os.path.join(ckpt, rec["shards"][0]["file"])
+        with open(shard, "r+b") as f:
+            f.seek(10)
+            f.write(b"\xff\xff\xff")
+        assert probe.poll(1.0) is None
+        assert probe.last_epoch == 0     # next committed epoch re-probes
+
+
+# ---------------------------------------------------------------------------
+# actions: emission + the supervisor applying them
+# ---------------------------------------------------------------------------
+class TestActions:
+    def test_emitter_ids_monotonic_across_restart(self, tmp_path):
+        p = str(tmp_path / "actions.json")
+        em = ActionEmitter(p)
+        em.emit("scale_out", alert="queue_depth", key="", value=9.0)
+        em.flush()
+        doc = read_actions(p)
+        assert [a["id"] for a in doc["actions"]] == [0]
+        em2 = ActionEmitter(p)
+        em2.emit("drain", alert="worker_stale", key="1", value=20.0,
+                 worker=1)
+        em2.flush()
+        ids = [a["id"] for a in read_actions(p)["actions"]]
+        assert ids == [0, 1]
+
+    def test_torn_actions_file_reads_empty(self, tmp_path):
+        p = str(tmp_path / "actions.json")
+        with open(p, "w") as f:
+            f.write('{"actions": [{"id"')
+        assert read_actions(p) == {"actions": []}
+
+    def test_engine_emits_one_action_per_firing_episode(
+        self, tmp_path
+    ):
+        clock = _Clock()
+        p = str(tmp_path / "actions.json")
+        rule = _age_rule(
+            for_seconds=0.0, action={"kind": "drain"}
+        )
+        eng = AlertEngine([rule], actions_path=p, now_fn=clock)
+        _feed(eng, clock, 9.0)
+        for _ in range(3):               # stays firing: no re-emission
+            clock.t += 1.0
+            _feed(eng, clock, 9.0)
+        acts = read_actions(p)["actions"]
+        assert len(acts) == 1
+        assert acts[0]["kind"] == "drain"
+        assert acts[0]["worker"] == 0    # numeric key -> worker index
+        assert acts[0]["alert"] == "stale"
+
+
+STUB = r"""
+import json, os, signal, sys, time
+
+lease, gen, sid = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+beats = int(os.environ.get("STUB_BEATS", "6"))
+depth = int(os.environ.get("STUB_DEPTH", "0"))
+signal.signal(signal.SIGTERM, lambda s, f: None)   # ignore drains
+
+def write(**kw):
+    payload = {"pid": os.getpid(), "generation": gen, "spawn_id": sid,
+               "ts": time.time(), "queue_depth": depth,
+               "worker": int(os.path.basename(lease)[1:4]), **kw}
+    tmp = lease + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(payload, f)
+    os.replace(tmp, lease)
+
+write()
+for _ in range(beats):
+    time.sleep(0.08)
+    write()
+write(done=True, reason="idle")
+"""
+
+
+def _stub_supervisor(tmp_path, fleet, actions_file, **kw):
+    stub = tmp_path / "stub.py"
+    stub.write_text(STUB)
+
+    def build(index, count, generation, spawn_id):
+        return [sys.executable, str(stub), lease_path(fleet, index),
+                str(generation), str(spawn_id)]
+
+    env = {
+        k: v for k, v in os.environ.items()
+        if k not in (faultinject.ENV_SPEC, faultinject.ENV_SEED)
+    }
+    env.update(kw.pop("stub_env", {}))
+    base = dict(
+        workers=1, max_workers=2, lease_timeout=2.0,
+        grace_seconds=0.4, sweep_interval=0.1,
+        startup_grace_seconds=10.0, env=env,
+        actions_file=actions_file,
+    )
+    base.update(kw)
+    return FleetSupervisor(fleet, build, **base)
+
+
+def _write_actions(path, *actions):
+    with open(path, "w") as f:
+        json.dump({"schema": 1, "actions": list(actions)}, f)
+
+
+class TestSupervisorActions:
+    def test_scale_out_action_drives_ledger_gated_resize(
+        self, tmp_path
+    ):
+        telemetry.configure(None)
+        fleet = str(tmp_path / "fleet")
+        actions = str(tmp_path / "actions.json")
+        _write_actions(
+            actions,
+            {"id": 0, "kind": "scale_out", "alert": "queue_depth",
+             "key": "", "value": 9.0},
+        )
+        sup = _stub_supervisor(
+            tmp_path, fleet, actions,
+            stub_env={"STUB_BEATS": "10"},
+        )
+        rep = sup.run()
+        assert rep.converged
+        assert rep.resizes == 1 and rep.resize_history == [2]
+        cur = FleetLedger(fleet).current()
+        assert cur["worker_count"] == 2
+        resize = [
+            r for r in FleetLedger(fleet).records()
+            if r["kind"] == "resize"
+        ]
+        assert resize and resize[0]["why"] == "alert_queue_depth"
+        with open(actions + ".ack") as f:
+            assert json.load(f) == {"last_id": 0}
+        reg = telemetry.get_registry()
+        assert reg.counter("fleet.actions_applied").value == 1
+
+    def test_acked_actions_never_reapply(self, tmp_path):
+        telemetry.configure(None)
+        fleet = str(tmp_path / "fleet")
+        actions = str(tmp_path / "actions.json")
+        _write_actions(
+            actions,
+            {"id": 0, "kind": "scale_out", "alert": "queue_depth",
+             "key": "", "value": 9.0},
+        )
+        rep = _stub_supervisor(
+            tmp_path, fleet, actions,
+            stub_env={"STUB_BEATS": "10"},
+        ).run()
+        assert rep.resizes == 1
+        # a RESUMED supervision over the same fleet + actions file must
+        # not re-apply the already-acked request
+        rep2 = _stub_supervisor(tmp_path, fleet, actions).run()
+        assert rep2.resizes == 0
+
+    def test_drain_action_runs_the_ladder_and_respawns(self, tmp_path):
+        telemetry.configure(None)
+        fleet = str(tmp_path / "fleet")
+        actions = str(tmp_path / "actions.json")
+        _write_actions(
+            actions,
+            {"id": 0, "kind": "drain", "alert": "worker_stale",
+             "key": "0", "value": 30.0, "worker": 0},
+        )
+        rep = _stub_supervisor(
+            tmp_path, fleet, actions, workers=2,
+            stub_env={"STUB_BEATS": "12"},
+        ).run()
+        assert rep.converged
+        assert rep.respawns == 1         # the drained worker came back
+        assert rep.spawns == 3           # 2 initial + the respawn
+        assert rep.resizes == 0
+        reg = telemetry.get_registry()
+        assert reg.counter("fleet.actions_applied").value == 1
+
+    def test_clamped_resize_is_still_acked(self, tmp_path):
+        telemetry.configure(None)
+        fleet = str(tmp_path / "fleet")
+        actions = str(tmp_path / "actions.json")
+        # max_workers=2, already at 2: the scale_out clamps to a no-op
+        # but MUST ack, or a firing alert would retry forever
+        _write_actions(
+            actions,
+            {"id": 0, "kind": "scale_out", "alert": "queue_depth",
+             "key": "", "value": 9.0},
+        )
+        rep = _stub_supervisor(
+            tmp_path, fleet, actions, workers=2,
+        ).run()
+        assert rep.resizes == 0
+        with open(actions + ".ack") as f:
+            assert json.load(f) == {"last_id": 0}
+
+
+# ---------------------------------------------------------------------------
+# fleet-dir lease pseudo-events (the engine side of worker_stale)
+# ---------------------------------------------------------------------------
+class TestLeaseEvents:
+    def test_lease_files_become_events_and_done_goes_quiet(
+        self, tmp_path
+    ):
+        import time as _time
+
+        fleet = str(tmp_path / "fleet")
+        os.makedirs(os.path.join(fleet, "leases"))
+        lp = lease_path(fleet, 0)
+        now = _time.time()
+        with open(lp, "w") as f:
+            json.dump(
+                {"worker": 0, "ts": now - 7.5, "queue_depth": 3}, f
+            )
+        rule = _age_rule(for_seconds=0.0, value=5.0)
+        eng = AlertEngine([rule], fleet_dir=fleet)
+        trs = eng.poll(now)
+        assert [t["state"] for t in trs] == ["firing"]
+        assert trs[0]["value"] == pytest.approx(7.5, abs=0.2)
+        # the worker finishes: done leases emit nothing, the stale age
+        # ages out of the window, the alert resolves
+        with open(lp, "w") as f:
+            json.dump(
+                {"worker": 0, "ts": now, "done": True,
+                 "reason": "idle"}, f
+            )
+        assert eng.poll(now + 40.0) == []    # past the 30s window:
+        # clear starts; the resolve_seconds=2 hold lands next poll
+        trs = eng.poll(now + 43.0)
+        assert [t["state"] for t in trs] == ["resolved"]
+
+
+# ---------------------------------------------------------------------------
+# CLI: monitor --once, metrics tail, alert-health section
+# ---------------------------------------------------------------------------
+def _storm_stream(path):
+    from spark_text_clustering_tpu.telemetry import TelemetryWriter
+
+    w = TelemetryWriter(path, run_id="storm")
+    w.write_manifest(kind="storm")
+    for i in range(32):
+        w.emit(
+            "dispatch_executable", digest=f"s{i:04d}",
+            label="online.chunk_runner", signature=f"f32[{i},64]",
+        )
+    w.close()
+
+
+class TestMonitorCli:
+    def test_once_fires_on_storm_and_exits_nonzero(
+        self, tmp_path, capsys
+    ):
+        from spark_text_clustering_tpu.cli import main
+
+        storm = str(tmp_path / "storm.jsonl")
+        _storm_stream(storm)
+        mon = str(tmp_path / "mon.jsonl")
+        rc = main([
+            "monitor", "--once", "--stream", storm,
+            "--builtin", "retrace_storm", "--fail-on-alert",
+            "--alerts-file", str(tmp_path / "alerts.jsonl"),
+            "--telemetry-file", mon,
+        ])
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert "retrace_storm" in out
+        # the monitor's own run stream renders an alert-health section
+        _, events = load_run(mon)
+        ah = alert_health(events, run_metrics(events))
+        assert ah is not None
+        assert ah["fired"] == 1
+        assert ah["still_firing"][0]["rule"] == "retrace_storm"
+        # and serve's /healthz reader sees the persisted firing alert
+        firing = firing_alerts(str(tmp_path / "alerts.jsonl"))
+        assert [f["rule"] for f in firing] == ["retrace_storm"]
+
+    def test_once_clean_stream_fires_nothing(self, tmp_path, capsys):
+        from spark_text_clustering_tpu.cli import main
+        from spark_text_clustering_tpu.telemetry import (
+            TelemetryWriter,
+        )
+
+        clean = str(tmp_path / "clean.jsonl")
+        w = TelemetryWriter(clean, run_id="clean")
+        w.write_manifest(kind="clean")
+        for i in range(3):
+            w.emit(
+                "dispatch_executable", digest=f"d{i}",
+                label=f"label{i}", signature="f32[8,64]",
+            )
+        w.emit("micro_batch", seconds=0.1, docs=4)
+        w.close()
+        rc = main([
+            "monitor", "--once", "--stream", clean, "--fail-on-alert",
+        ])
+        capsys.readouterr()
+        assert rc == 0
+
+    def test_rules_file_overrides_builtin_threshold(
+        self, tmp_path, capsys
+    ):
+        from spark_text_clustering_tpu.cli import main
+
+        storm = str(tmp_path / "storm.jsonl")
+        _storm_stream(storm)
+        rules = str(tmp_path / "rules.json")
+        with open(rules, "w") as f:
+            json.dump(
+                [{"name": "retrace_storm", "value": 100.0}], f
+            )
+        rc = main([
+            "monitor", "--once", "--stream", storm, "--rules", rules,
+            "--fail-on-alert",
+        ])
+        capsys.readouterr()
+        assert rc == 0                   # retuned threshold stays quiet
+
+    def test_alert_health_absent_for_non_monitor_runs(self):
+        assert alert_health(
+            [{"event": "train_fit"}], {"counter.serve.requests": 3.0}
+        ) is None
+
+    def test_metrics_tail_renders_events(self, tmp_path, capsys):
+        from spark_text_clustering_tpu.cli import main
+
+        p = str(tmp_path / "run.jsonl")
+        _write_lines(
+            p,
+            [
+                {"event": "micro_batch", "ts": 1700000000.0,
+                 "docs": 4, "seconds": 0.25},
+            ],
+            partial='{"event": "torn',
+        )
+        rc = main(["metrics", "tail", p, "--once"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "micro_batch" in out
+        assert "docs=4" in out
+        assert "torn" not in out         # incomplete line not rendered
+
+
+# ---------------------------------------------------------------------------
+# chaos: the monitor's own fault sites
+# ---------------------------------------------------------------------------
+class TestMonitorChaos:
+    def test_poll_fault_raises_injected(self):
+        faultinject.configure("monitor.poll:fail@1")
+        eng = AlertEngine([_age_rule()])
+        with pytest.raises(faultinject.InjectedIOError):
+            eng.poll(100.0)
+        # run() survives it: the error is counted, the loop continues
+        telemetry.configure(None)
+        faultinject.configure("monitor.poll:fail@1")
+        eng2 = AlertEngine([_age_rule()])
+        eng2.run(interval=0.01, max_seconds=0.05)
+        reg = telemetry.get_registry()
+        assert reg.counter("monitor.poll_errors").value == 1
+
+    def test_action_fault_fails_flush(self, tmp_path):
+        clock = _Clock()
+        p = str(tmp_path / "actions.json")
+        faultinject.configure("monitor.action:fail@1")
+        rule = _age_rule(for_seconds=0.0, action={"kind": "drain"})
+        eng = AlertEngine([rule], actions_path=p, now_fn=clock)
+        with pytest.raises(faultinject.InjectedIOError):
+            _feed(eng, clock, 9.0)
+
+
+# ---------------------------------------------------------------------------
+# Prometheus exposition
+# ---------------------------------------------------------------------------
+class TestPrometheus:
+    def test_render_counters_gauges_summaries(self):
+        telemetry.configure(None)
+        reg = telemetry.get_registry()
+        reg.counter("serve.requests").inc(7)
+        reg.gauge("alert.active").set(2)
+        h = reg.histogram("serve.request_seconds")
+        for v in (0.01, 0.02, 0.04):
+            h.observe(v)
+        text = prometheus.render(reg.snapshot())
+        assert "# TYPE stc_serve_requests_total counter" in text
+        assert "stc_serve_requests_total 7" in text
+        assert "# TYPE stc_alert_active gauge" in text
+        assert "stc_alert_active 2" in text
+        assert "# TYPE stc_serve_request_seconds summary" in text
+        assert 'stc_serve_request_seconds{quantile="0.5"}' in text
+        assert "stc_serve_request_seconds_count 3" in text
+        assert text.endswith("\n")
+
+    def test_sanitize_and_empty_histogram_nan(self):
+        assert prometheus.sanitize("a.b-c.d") == "stc_a_b_c_d"
+        telemetry.configure(None)
+        reg = telemetry.get_registry()
+        reg.histogram("empty.hist")
+        text = prometheus.render(reg.snapshot())
+        assert 'stc_empty_hist{quantile="0.5"} NaN' in text
+
+    def test_content_negotiation_matrix(self):
+        assert prometheus.wants_prometheus(
+            "text/plain;version=0.0.4;q=0.5"
+        )
+        assert prometheus.wants_prometheus(
+            "application/openmetrics-text; version=1.0.0"
+        )
+        assert not prometheus.wants_prometheus("")
+        assert not prometheus.wants_prometheus("application/json")
+        assert not prometheus.wants_prometheus(
+            "application/json, text/plain"
+        )

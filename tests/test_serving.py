@@ -422,7 +422,10 @@ class TestDrain:
         self, models_dir
     ):
         telemetry.configure(None)
-        svc = _service(models_dir)
+        # a long linger pins BOTH docs into the one batch fail@1 kills
+        # (the 2ms default can split them under full-suite load, and
+        # the second batch would then succeed)
+        svc = _service(models_dir, linger_s=0.5)
         faultinject.configure("serve.batch:fail@1")
         out = svc.submit_texts(_texts(2), None)
         assert all("error" in r for r in out)
@@ -509,6 +512,65 @@ class TestHttpAndHealth:
         assert serving_health(
             [{"event": "train_fit"}], {"counter.ledger.commits": 1.0}
         ) is None
+
+    def test_firing_alerts_degrade_healthz_and_prometheus_metrics(
+        self, models_dir, tmp_path
+    ):
+        """The monitor loop's serving surfaces: a firing alert in the
+        wired alerts.jsonl turns /healthz 'degraded' (and resolving it
+        restores 'ok'), and /metrics speaks Prometheus text exposition
+        under scraper content negotiation while JSON consumers keep the
+        registry dump."""
+        from spark_text_clustering_tpu.telemetry.alerts import AlertLog
+
+        telemetry.configure(None)
+        alerts = str(tmp_path / "alerts.jsonl")
+        log = AlertLog(alerts)
+        log.append(
+            rule="serve_p99", key="", state="firing", value=0.9,
+            threshold=0.5,
+        )
+        svc = _service(models_dir, alerts_file=alerts)
+        httpd = make_http_server(svc, port=0)
+        port = httpd.server_address[1]
+        t = threading.Thread(target=httpd.serve_forever, daemon=True)
+        t.start()
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=10
+            ) as resp:
+                health = json.loads(resp.read())
+            assert health["status"] == "degraded"
+            assert [
+                f["rule"] for f in health["alerts"]["firing"]
+            ] == ["serve_p99"]
+            # resolution restores health (the mtime cache re-reads)
+            log.append(rule="serve_p99", key="", state="resolved")
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=10
+            ) as resp:
+                health = json.loads(resp.read())
+            assert health["status"] == "ok"
+            assert health["alerts"]["firing"] == []
+            # a Prometheus scraper's Accept gets text exposition
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/metrics",
+                headers={"Accept": "text/plain;version=0.0.4"},
+            )
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                ctype = resp.headers["Content-Type"]
+                text = resp.read().decode()
+            assert ctype.startswith("text/plain")
+            assert "# TYPE stc_serve_batches_total counter" in text
+            # JSON consumers (no Accept preference) are untouched
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=10
+            ) as resp:
+                snap = json.loads(resp.read())
+            assert "counters" in snap
+        finally:
+            svc.begin_drain()
+            httpd.shutdown()
 
 
 # ---------------------------------------------------------------------------
