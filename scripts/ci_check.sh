@@ -69,9 +69,20 @@
 #      it as a ledger-gated resize to 2 workers, and the drill asserts
 #      exactly-once ingest across the resize (no source committed
 #      twice, every report belongs to a committed epoch)
+#  13. executable-cache cold-start drill (compilecache,
+#      docs/OBSERVABILITY.md "Executable cache"): process A scores the
+#      gate-5 model with STC_COMPILE_CACHE armed (populating the
+#      store), process B cold-starts against it and must reach its
+#      first dispatch on cache hits alone — compile.cache_hits >= 1,
+#      compile.cache_misses == 0, compile.retraces == 0 — with a
+#      byte-identical scoring report; a deliberately corrupted entry
+#      must then degrade to a live compile (rc=0,
+#      compile.cache_invalidations >= 1, entry quarantined, report
+#      still byte-identical); process B's deterministic cache counters
+#      gate against the committed baseline
 #
 # Usage:
-#   scripts/ci_check.sh                 # run all twelve gates
+#   scripts/ci_check.sh                 # run all thirteen gates
 #   scripts/ci_check.sh --rebaseline    # recapture ALL baselines
 #                                       # (metrics + lint waivers +
 #                                       # lint counters + compile
@@ -723,6 +734,133 @@ print(
 EOF
 }
 
+run_cold_start_drill() {
+    # gate 13: the persistent executable cache's cross-process
+    # contract, on the gate-5 corpus + model.  Three identical score
+    # processes: A populates the store, B must cold-start on hits
+    # alone, C must survive a deliberately corrupted entry.
+    local workdir="$1"
+    local ccdir="$workdir/compile_cache"
+    local common=(score --books "$workdir/books"
+                  --models-dir "$workdir/models" --lang EN
+                  --no-lemmatize)
+    STC_COMPILE_CACHE="$ccdir" \
+        python -m spark_text_clustering_tpu.cli "${common[@]}" \
+        --output-dir "$workdir/cold_out_a" \
+        --telemetry-file "$workdir/cold_a.jsonl" >/dev/null || {
+        echo "cold-start drill: populate run (A) failed"; return 1; }
+    STC_COMPILE_CACHE="$ccdir" \
+        python -m spark_text_clustering_tpu.cli "${common[@]}" \
+        --output-dir "$workdir/cold_out_b" \
+        --telemetry-file "$workdir/cold_b.jsonl" >/dev/null || {
+        echo "cold-start drill: warm run (B) failed"; return 1; }
+    python - "$workdir" <<'EOF'
+import glob, json, os, sys
+
+from spark_text_clustering_tpu.telemetry.metrics_cli import (
+    load_run, run_metrics,
+)
+
+workdir = sys.argv[1]
+
+
+def counters(stem):
+    _, events = load_run(os.path.join(workdir, f"{stem}.jsonl"))
+    m = run_metrics(events)
+    return {
+        k: int(m.get(f"counter.compile.{k}", 0))
+        for k in ("cache_hits", "cache_misses", "cache_stores",
+                  "cache_invalidations", "retraces")
+    }
+
+
+a, b = counters("cold_a"), counters("cold_b")
+assert a["cache_stores"] >= 1 and a["cache_hits"] == 0, (
+    f"populate run did not fill the store: {a}"
+)
+assert b["cache_hits"] >= 1, f"warm run never hit: {b}"
+assert b["cache_misses"] == 0, f"warm run missed: {b}"
+assert b["cache_stores"] == 0, f"warm run re-stored: {b}"
+assert b["retraces"] == 0, f"warm run re-traced: {b}"
+
+
+def report_bytes(out_dir):
+    (path,) = glob.glob(os.path.join(workdir, out_dir, "*", "*")) or \
+        glob.glob(os.path.join(workdir, out_dir, "*"))
+    with open(path, "rb") as f:
+        return f.read()
+
+
+assert report_bytes("cold_out_a") == report_bytes("cold_out_b"), (
+    "a cache hit changed the scoring report bytes"
+)
+print(
+    f"cold-start drill: B reached first dispatch on "
+    f"{b['cache_hits']} hit(s), 0 misses, 0 retraces, "
+    f"byte-identical report"
+)
+EOF
+    [[ $? -ne 0 ]] && return 1
+    # corrupt one committed entry: the next process must degrade to a
+    # live compile (rc=0), quarantine the entry, and still produce the
+    # byte-identical report
+    python - "$workdir" <<'EOF'
+import glob, os, sys
+
+workdir = sys.argv[1]
+bins = glob.glob(os.path.join(
+    workdir, "compile_cache", "*", "*", "executable.bin"
+))
+assert bins, "no committed cache entries to corrupt"
+with open(bins[0], "r+b") as f:
+    blob = bytearray(f.read())
+    blob[len(blob) // 2] ^= 0xFF
+    f.seek(0)
+    f.write(blob)
+EOF
+    STC_COMPILE_CACHE="$ccdir" \
+        python -m spark_text_clustering_tpu.cli "${common[@]}" \
+        --output-dir "$workdir/cold_out_c" \
+        --telemetry-file "$workdir/cold_c.jsonl" >/dev/null || {
+        echo "cold-start drill: corrupted-entry run (C) crashed"
+        return 1
+    }
+    python - "$workdir" <<'EOF'
+import glob, os, sys
+
+from spark_text_clustering_tpu.telemetry.metrics_cli import (
+    load_run, run_metrics,
+)
+
+workdir = sys.argv[1]
+_, events = load_run(os.path.join(workdir, "cold_c.jsonl"))
+m = run_metrics(events)
+assert int(m.get("counter.compile.cache_invalidations", 0)) >= 1, (
+    "corrupted entry was not invalidated"
+)
+qdirs = glob.glob(os.path.join(
+    workdir, "compile_cache", "*", ".quarantine", "*"
+))
+assert qdirs, "corrupted entry was not quarantined"
+
+
+def report_bytes(out_dir):
+    (path,) = glob.glob(os.path.join(workdir, out_dir, "*", "*")) or \
+        glob.glob(os.path.join(workdir, out_dir, "*"))
+    with open(path, "rb") as f:
+        return f.read()
+
+
+assert report_bytes("cold_out_a") == report_bytes("cold_out_c"), (
+    "the corrupt-entry fallback changed the scoring report bytes"
+)
+print(
+    "cold-start drill: corrupted entry degraded to live compile "
+    "(quarantined, report byte-identical)"
+)
+EOF
+}
+
 if [[ "${1:-}" == "--rebaseline" ]]; then
     python -m spark_text_clustering_tpu.cli lint --rebaseline || exit 1
     work=$(mktemp -d)
@@ -763,6 +901,13 @@ if [[ "${1:-}" == "--rebaseline" ]]; then
         "$work/monitor_once.jsonl" --baseline "$BASELINE" \
         --write-baseline --tolerance 0.0 --include counter.alert. \
         || exit 1
+    # fold the cold-start drill's deterministic cache counters (the
+    # warm B run: hits exact, misses/stores/invalidations zero-absent)
+    run_cold_start_drill "$work" || exit 1
+    python -m spark_text_clustering_tpu.cli metrics check \
+        "$work/cold_b.jsonl" --baseline "$BASELINE" \
+        --write-baseline --tolerance 0.0 \
+        --include counter.compile.cache || exit 1
     # recapture the recompile sentinel's expected-signature table from
     # the same train run plus a score run and an NMF fit+transform run
     # (gate 9's fixture triple)
@@ -778,12 +923,12 @@ fail=0
 work=$(mktemp -d)
 trap 'rm -rf "$work"' EXIT
 
-echo "== [1/12] stc lint (AST rules + jaxpr audit) =="
+echo "== [1/13] stc lint (AST rules + jaxpr audit) =="
 python -m spark_text_clustering_tpu.cli lint \
     --telemetry-file "$work/lint.jsonl"
 if [[ $? -ne 0 ]]; then echo "FAIL: stc lint"; fail=1; fi
 
-echo "== [2/12] ruff (generic-Python tier) =="
+echo "== [2/13] ruff (generic-Python tier) =="
 if command -v ruff >/dev/null 2>&1; then
     ruff check spark_text_clustering_tpu
     if [[ $? -ne 0 ]]; then echo "FAIL: ruff"; fail=1; fi
@@ -791,17 +936,17 @@ else
     echo "ruff not installed — skipped (stc lint STC101/102/006 cover it)"
 fi
 
-echo "== [3/12] tier-1 tests =="
+echo "== [3/13] tier-1 tests =="
 timeout -k 10 870 python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors -p no:cacheprovider \
     -p no:xdist -p no:randomly
 if [[ $? -ne 0 ]]; then echo "FAIL: tier-1"; fail=1; fi
 
-echo "== [4/12] telemetry overhead budget =="
+echo "== [4/13] telemetry overhead budget =="
 python scripts/check_telemetry_overhead.py
 if [[ $? -ne 0 ]]; then echo "FAIL: telemetry overhead"; fail=1; fi
 
-echo "== [5/12] metrics regression gate =="
+echo "== [5/13] metrics regression gate =="
 if run_ci_train "$work"; then
     # lint., ledger., fleet., serve., and alert. families are captured
     # by their own gates (1/6, 8, 10, 11, and 12) — a batch train run
@@ -809,14 +954,15 @@ if run_ci_train "$work"; then
     python -m spark_text_clustering_tpu.cli metrics check "$work/run.jsonl" \
         --baseline "$BASELINE" "${EXCLUDES[@]}" --exclude lint. \
         --exclude ledger. --exclude fleet. --exclude serve. \
-        --exclude alert. --exclude monitor. --exclude drift.
+        --exclude alert. --exclude monitor. --exclude drift. \
+        --exclude compile.cache
     if [[ $? -ne 0 ]]; then echo "FAIL: metrics check"; fail=1; fi
 else
     echo "FAIL: CI training run"
     fail=1
 fi
 
-echo "== [6/12] lint metrics gate (waiver count version-gated) =="
+echo "== [6/13] lint metrics gate (waiver count version-gated) =="
 if [[ -s "$work/lint.jsonl" ]]; then
     python -m spark_text_clustering_tpu.cli metrics check "$work/lint.jsonl" \
         --baseline "$BASELINE" --include lint.
@@ -826,7 +972,7 @@ else
     fail=1
 fi
 
-echo "== [7/12] cross-host skew gate (metrics merge) =="
+echo "== [7/13] cross-host skew gate (metrics merge) =="
 if make_skew_streams "$work"; then
     python -m spark_text_clustering_tpu.cli metrics merge \
         "$work/skew-p0.jsonl" "$work/skew-p1.jsonl" --fail-on-skew \
@@ -847,7 +993,7 @@ else
     fail=1
 fi
 
-echo "== [8/12] exactly-once ledger chaos drill (STC_FAULTS) =="
+echo "== [8/13] exactly-once ledger chaos drill (STC_FAULTS) =="
 if run_ledger_drill "$work"; then
     python -m spark_text_clustering_tpu.cli metrics check \
         "$work/ledger_drill.jsonl" --baseline "$BASELINE" \
@@ -858,7 +1004,7 @@ else
     fail=1
 fi
 
-echo "== [9/12] recompile sentinel (metrics compile-check) =="
+echo "== [9/13] recompile sentinel (metrics compile-check) =="
 if [[ -s "$work/run.jsonl" ]] && run_ci_score "$work" \
     && run_ci_nmf "$work"; then
     python -m spark_text_clustering_tpu.cli metrics compile-check \
@@ -885,7 +1031,7 @@ else
     fail=1
 fi
 
-echo "== [10/12] supervisor drill (lease expiry -> SIGKILL -> respawn) =="
+echo "== [10/13] supervisor drill (lease expiry -> SIGKILL -> respawn) =="
 if run_supervisor_drill "$work"; then
     # the ladder's counters are deterministic: 3 spawns (2 + 1
     # respawn), 1 lease expiry, 1 preemption (the drain SIGTERM the
@@ -899,7 +1045,7 @@ else
     fail=1
 fi
 
-echo "== [11/12] serve drill (hot-swap + drain + zero-recompile) =="
+echo "== [11/13] serve drill (hot-swap + drain + zero-recompile) =="
 if [[ -d "$work/models" ]] && run_serve_drill "$work"; then
     # requests (32 = two exact 16-doc volleys) and swaps (1) are
     # machine-independent; batch counts depend on coalescing timing
@@ -913,7 +1059,7 @@ else
     fail=1
 fi
 
-echo "== [12/12] monitor drill (alerts fire/resolve + resize-on-alert) =="
+echo "== [12/13] monitor drill (alerts fire/resolve + resize-on-alert) =="
 if run_monitor_once_drill "$work"; then
     # the --once storm run's alert counters are deterministic: exactly
     # one firing (retrace_storm), nothing pending/resolved
@@ -931,6 +1077,19 @@ if ! run_monitor_fleet_drill "$work"; then
 fi
 if ! run_monitor_resize_drill "$work"; then
     echo "FAIL: monitor resize drill (telemetry-driven fleet control)"
+    fail=1
+fi
+
+echo "== [13/13] executable-cache cold-start drill (compilecache) =="
+if [[ -d "$work/models" ]] && run_cold_start_drill "$work"; then
+    # the warm B run's cache counters are deterministic: one hit per
+    # score-path digest, zero misses/stores/invalidations
+    python -m spark_text_clustering_tpu.cli metrics check \
+        "$work/cold_b.jsonl" --baseline "$BASELINE" \
+        --include counter.compile.cache
+    if [[ $? -ne 0 ]]; then echo "FAIL: cold-start cache counters"; fail=1; fi
+else
+    echo "FAIL: executable-cache cold-start drill"
     fail=1
 fi
 

@@ -348,6 +348,19 @@ def _build_serve_topic_inference():
     return fn, (_f32((t, K)), _f32((t,)), seg, alpha, _f32((B, K)))
 
 
+def _build_score_gather():
+    # the packed scoring paths' [V, k] -> [T, k] token-row gather
+    # (models.base.gather_token_rows, instrumented as score.gather /
+    # serve.gather): trivial program, but it is a first-class cached
+    # executable now — the audit keeps its dtype story pinned
+    import numpy as np
+
+    from ..models.base import gather_token_rows
+
+    idx = (np.arange(32, dtype=np.int32) % V).astype(np.int32)
+    return gather_token_rows, (_f32((V, K)), idx)
+
+
 ENTRYPOINTS: Tuple[EntryPoint, ...] = (
     EntryPoint("em_lda.bucket_step", True, _build_em_bucket_step),
     EntryPoint("em_lda.train_step", True, _build_em_train_step),
@@ -392,6 +405,7 @@ ENTRYPOINTS: Tuple[EntryPoint, ...] = (
         "serving.topic_inference_frozen", False,
         _build_serve_topic_inference,
     ),
+    EntryPoint("models.score_gather", False, _build_score_gather),
 )
 
 

@@ -1035,6 +1035,144 @@ def cmd_supervise(args: argparse.Namespace) -> int:
     return 0
 
 
+def _fmt_entry_size(n) -> str:
+    if n is None:
+        return "-"
+    for unit in ("B", "KiB", "MiB"):
+        if n < 1024 or unit == "MiB":
+            return f"{n:.0f}{unit}" if unit == "B" else f"{n:.1f}{unit}"
+        n /= 1024.0
+    return f"{n:.1f}MiB"
+
+
+def cmd_compile_cache(args: argparse.Namespace) -> int:
+    """Maintenance verbs for the persistent AOT executable cache
+    (docs/OBSERVABILITY.md "Executable cache"): ``warm`` pre-populates
+    the serve bucket grid, ``ls`` lists entries, ``gc`` prunes,
+    ``verify`` re-hashes every committed entry."""
+    import json as _json
+
+    from . import compilecache
+
+    root = args.cache_dir or os.environ.get(compilecache.ENV_DIR)
+    if not root:
+        print(
+            "compile-cache requires --cache-dir or the "
+            f"{compilecache.ENV_DIR} environment variable",
+            file=sys.stderr,
+        )
+        return 2
+    store = compilecache.configure(root)
+
+    if args.cc_cmd == "ls":
+        entries = store.entries()
+        if getattr(args, "json", False):
+            print(_json.dumps(
+                {"root": root, "entries": entries}, sort_keys=True
+            ))
+            return 0
+        print(f"executable cache {root}: {len(entries)} entry(ies)")
+        for e in entries:
+            mark = " STALE-FP" if e.get("stale") else ""
+            print(
+                f"  [{e['fingerprint']}] {e['digest']} "
+                f"{e.get('label', '?')}: {e['status']}{mark}, "
+                f"{_fmt_entry_size(e.get('payload_bytes'))}, "
+                f"compiled in {e.get('compile_seconds')}s"
+            )
+        return 0
+
+    if args.cc_cmd == "verify":
+        entries = store.entries()
+        findings = store.verify()
+        if getattr(args, "json", False):
+            print(_json.dumps(
+                {
+                    "root": root,
+                    "entries": len(entries),
+                    "findings": findings,
+                },
+                sort_keys=True,
+            ))
+        else:
+            for f_ in findings:
+                print(
+                    f"  BAD [{f_['fingerprint']}] {f_['digest']}: "
+                    f"{f_['finding']}"
+                )
+            print(
+                f"verify: {len(entries) - len(findings)}/{len(entries)} "
+                f"entry(ies) loadable"
+            )
+        return 1 if findings else 0
+
+    if args.cc_cmd == "gc":
+        removed = store.gc(args.keep_newest)
+        print(
+            f"gc: kept the {args.keep_newest} newest committed "
+            f"entry(ies) per fingerprint — removed "
+            f"{removed['entries']} entry(ies), {removed['stages']} "
+            f"stale stage(s), {removed['quarantined']} quarantined"
+        )
+        return 0
+
+    # warm: pre-populate the deterministic serve bucket grid — exactly
+    # the signature set compile_baseline.json pins for the serving
+    # labels — so replicas/workers spawned later hit instead of compile
+    own_telemetry = bool(getattr(args, "telemetry_file", None))
+    if own_telemetry:
+        telemetry.configure(args.telemetry_file)
+        telemetry.manifest(kind="compile-cache-warm", cache=root)
+    from .serving.server import DEFAULT_TOKEN_BUCKETS, ServeScorer
+
+    try:
+        model_path, model = resolve_latest_model(
+            args.models_dir, args.lang, explicit=args.model,
+            verify_deep=True,
+        )
+    except CorruptArtifactError as exc:
+        print(f"error: {exc}", file=sys.stderr)
+        return 2
+    buckets = tuple(args.token_bucket) or DEFAULT_TOKEN_BUCKETS
+    scorer = ServeScorer(
+        model, model_path, generation=0,
+        stop_words=_load_stop_words(args.stop_words),
+        lemmatize=not args.no_lemmatize,
+        max_batch=args.max_batch,
+        token_buckets=buckets,
+    )
+    report = scorer.warmup()
+    print(
+        f"warmed {model_path} buckets {report['buckets']} in "
+        f"{report['warmup_seconds']}s — "
+        f"{report.get('cache_stores', 0)} stored, "
+        f"{report.get('cache_hits', 0)} already cached, "
+        f"{report.get('cache_misses', 0)} miss(es)"
+    )
+    # coverage vs the committed signature expectation: which baseline
+    # labels did this warm populate, and which need a real corpus-shaped
+    # run (their signatures depend on document shapes we cannot invent)
+    if args.baseline and os.path.exists(args.baseline):
+        from .telemetry import compilation
+
+        with open(args.baseline, encoding="utf-8") as f:
+            expected = sorted(_json.load(f).get("labels", {}))
+        warmed = set(compilation.signatures())
+        for lbl in expected:
+            state = (
+                "populated" if lbl in warmed
+                else "needs a corpus-shaped run (stc score/train "
+                     "--compile-cache)"
+            )
+            print(f"  baseline label {lbl}: {state}")
+    if own_telemetry:
+        telemetry.event("compile_cache_warm", model=model_path, **{
+            k: v for k, v in report.items() if k != "signatures"
+        })
+        telemetry.shutdown()
+    return 0
+
+
 def cmd_doctor(args: argparse.Namespace) -> int:
     """Environment health report: accelerator reachability (probed in a
     throwaway subprocess so a wedged TPU tunnel can only time out, never
@@ -1142,6 +1280,18 @@ def _make_trigger_controller(args: argparse.Namespace):
     )
 
 
+def _add_compile_cache_arg(p: argparse.ArgumentParser) -> None:
+    p.add_argument(
+        "--compile-cache", default=None, metavar="DIR",
+        help="persistent AOT executable cache root: first dispatches "
+             "deserialize previously committed executables instead of "
+             "trace+compiling, and fresh compiles publish back "
+             "(equivalent to STC_COMPILE_CACHE=DIR; exported to the "
+             "environment so spawned workers inherit it; "
+             "docs/OBSERVABILITY.md \"Executable cache\")",
+    )
+
+
 def _add_distributed_args(p: argparse.ArgumentParser) -> None:
     """Multi-host DCN flags (every process runs the same command with its
     own --process-id; tests/test_multihost.py exercises the path)."""
@@ -1153,6 +1303,7 @@ def _add_distributed_args(p: argparse.ArgumentParser) -> None:
 
 
 def _add_stream_args(p: argparse.ArgumentParser) -> None:
+    _add_compile_cache_arg(p)
     p.add_argument("--watch-dir", required=True,
                    help="directory to watch for arriving .txt files")
     p.add_argument("--poll-interval", type=float, default=1.0)
@@ -1276,6 +1427,7 @@ def build_parser() -> argparse.ArgumentParser:
     tr.add_argument("--no-lemmatize", action="store_true")
     tr.add_argument("--include-all", action="store_true",
                     help="ingest non-.txt files too (reference behavior)")
+    _add_compile_cache_arg(tr)
     _add_distributed_args(tr)
     tr.set_defaults(fn=cmd_train)
 
@@ -1312,6 +1464,7 @@ def build_parser() -> argparse.ArgumentParser:
                     help="telemetry run stream (dispatch/compile/memory "
                          "attribution for the scoring path) as JSONL — "
                          "consumed by `metrics roofline`/`compile-check`")
+    _add_compile_cache_arg(sc)
     sc.set_defaults(fn=cmd_score)
 
     se = sub.add_parser(
@@ -1365,6 +1518,7 @@ def build_parser() -> argparse.ArgumentParser:
                          "hot-swap events, dispatch/compile attribution) "
                          "— `metrics summarize` renders its "
                          "serving-health section from this")
+    _add_compile_cache_arg(se)
     se.set_defaults(fn=cmd_serve)
 
     ss = sub.add_parser(
@@ -1527,7 +1681,55 @@ def build_parser() -> argparse.ArgumentParser:
     sv.add_argument("--worker-arg", action="append", default=[],
                     help="extra argv appended verbatim to every worker "
                          "command (repeatable)")
+    _add_compile_cache_arg(sv)
     sv.set_defaults(fn=cmd_supervise)
+
+    cc = sub.add_parser(
+        "compile-cache",
+        help="persistent AOT executable cache maintenance: warm "
+             "(pre-populate the serve bucket grid), ls, gc, verify",
+    )
+    cc_sub = cc.add_subparsers(dest="cc_cmd", required=True)
+    ccw = cc_sub.add_parser(
+        "warm",
+        help="pre-populate the cache with the serve warmup grid (the "
+             "deterministic signature set compile_baseline.json pins "
+             "for serving) so replicas and workers spawned later "
+             "deserialize instead of compiling",
+    )
+    ccw.add_argument("--cache-dir", default=None,
+                     help="store root (default: $STC_COMPILE_CACHE)")
+    ccw.add_argument("--models-dir", default="models")
+    ccw.add_argument("--model", default=None, help="explicit model dir")
+    ccw.add_argument("--lang", default="EN", choices=sorted(LANG_DIRS))
+    ccw.add_argument("--stop-words", default=None)
+    ccw.add_argument("--no-lemmatize", action="store_true")
+    ccw.add_argument("--max-batch", type=int, default=64)
+    ccw.add_argument("--token-bucket", action="append", type=int,
+                     default=[], metavar="T",
+                     help="pow2 buckets to warm (repeatable; default "
+                          "the serve grid 256 1024 4096)")
+    ccw.add_argument("--baseline",
+                     default="scripts/records/compile_baseline.json",
+                     help="compile sentinel baseline to report label "
+                          "coverage against ('' disables)")
+    ccw.add_argument("--telemetry-file", default=None)
+    ccw.set_defaults(fn=cmd_compile_cache)
+    for name, hlp in (
+        ("ls", "list every cache entry with status/size/age"),
+        ("verify", "re-hash every committed entry; exit 1 if any "
+                   "entry would not load"),
+        ("gc", "prune to the newest N committed entries per backend "
+               "fingerprint; drop stages + quarantined entries"),
+    ):
+        p = cc_sub.add_parser(name, help=hlp)
+        p.add_argument("--cache-dir", default=None,
+                       help="store root (default: $STC_COMPILE_CACHE)")
+        if name == "gc":
+            p.add_argument("--keep-newest", type=int, required=True)
+        else:
+            p.add_argument("--json", action="store_true")
+        p.set_defaults(fn=cmd_compile_cache)
 
     dr = sub.add_parser(
         "doctor", help="environment health report (hang-proof probes)"
@@ -1551,6 +1753,17 @@ def build_parser() -> argparse.ArgumentParser:
 
 def main(argv: Optional[List[str]] = None) -> int:
     args = build_parser().parse_args(argv)
+    # Persistent AOT executable cache (compilecache): --compile-cache is
+    # exported to the environment so every spawned worker (supervise
+    # fleets, serve replicas under a process manager) inherits the same
+    # store with zero plumbing; the env alone also works (lazy read).
+    # jax-free: arming the cache is a module global + a path string.
+    cc_dir = getattr(args, "compile_cache", None)
+    if cc_dir:
+        from . import compilecache
+
+        os.environ[compilecache.ENV_DIR] = cc_dir
+        compilecache.configure(cc_dir)
     # Persistent XLA compile cache: a fresh `score` process pays ~65s of
     # jit compiles for the 51-book bucket set without it, 0.3s warm.
     # `doctor` is the exception — it must probe the platform without

@@ -1,0 +1,100 @@
+"""Persistent AOT executable cache — mmap-and-go cold starts.
+
+Every respawned supervisor worker, every new ``stc serve`` replica, and
+every cold ``stc score``/``stc train`` batch run used to re-pay
+trace+compile for executables the compile sentinel had already proven
+stable (``compile_baseline.json`` pins the exact signature set).  This
+package closes that tax: a content-addressed on-disk store of
+serialized XLA executables keyed by (backend fingerprint, dispatch
+label, abstract-signature digest) — the SAME digests
+``telemetry.dispatch``/``telemetry.compilation`` already compute — so a
+second process reaches its first dispatch by deserializing instead of
+recompiling (~20x faster per executable on the sandbox CPU; the bench
+``cold_start`` sweep tracks the end-to-end time-to-first-doc claim).
+
+Activation mirrors the chaos harness (``resilience.faultinject``): the
+``STC_COMPILE_CACHE`` environment variable names the store root and is
+read lazily once, so supervised workers and serve replicas inherit the
+cache with zero plumbing; ``configure()`` arms/disarms it explicitly
+(CLI ``--compile-cache`` flags, tests).  With nothing armed, ``active``
+is one module-global check and the dispatch fast path is untouched.
+
+The consumers:
+
+* ``telemetry.dispatch`` consults the store on the FIRST call of every
+  instrumented digest (serve warmup, score/train hot loops, stream
+  workers — one integration point covers every cold path) and publishes
+  fresh compiles back;
+* ``ServeScorer.warmup()`` reports per-warmup hit/miss deltas
+  (hot-swap warmups included);
+* ``stc compile-cache`` gives ``warm`` / ``ls`` / ``gc`` / ``verify``.
+
+jax-free at import, like every module the telemetry registry loads.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from .store import CachedExecutable, ExecutableStore
+
+__all__ = [
+    "ENV_DIR",
+    "CachedExecutable",
+    "ExecutableStore",
+    "configure",
+    "reset",
+    "active",
+    "get_store",
+]
+
+ENV_DIR = "STC_COMPILE_CACHE"
+
+_store: Optional[ExecutableStore] = None
+_env_loaded = False
+
+
+def _push_armed_state(active: Optional[bool]) -> None:
+    # keep the dispatch wrapper's disabled-mode fast path at a global
+    # read: the armed state is pushed there, never queried per call
+    from ..telemetry.dispatch import note_cache_config
+
+    note_cache_config(active)
+
+
+def configure(root: Optional[str]) -> Optional[ExecutableStore]:
+    """Arm the cache at ``root`` (or with ``None`` disarm) for this
+    process; explicit configuration wins over the environment."""
+    global _store, _env_loaded
+    _env_loaded = True
+    _store = ExecutableStore(root) if root else None
+    _push_armed_state(_store is not None)
+    return _store
+
+
+def reset() -> None:
+    """Disarm; the next ``active()``/``get_store()`` re-reads the env."""
+    global _store, _env_loaded
+    _store = None
+    _env_loaded = False
+    _push_armed_state(None)
+
+
+def _current() -> Optional[ExecutableStore]:
+    global _store, _env_loaded
+    if not _env_loaded:
+        _env_loaded = True
+        root = os.environ.get(ENV_DIR)
+        if root:
+            _store = ExecutableStore(root)
+        _push_armed_state(_store is not None)
+    return _store
+
+
+def active() -> bool:
+    return _current() is not None
+
+
+def get_store() -> Optional[ExecutableStore]:
+    return _current()

@@ -1,0 +1,139 @@
+"""JAX AOT executable (de)serialization behind a capability shim.
+
+The pinned jax (0.4.x) ships ``jax.experimental.serialize_executable``:
+``serialize(compiled)`` returns ``(payload, in_tree, out_tree)`` where
+``payload`` is the XLA executable blob and the treedefs describe the
+DYNAMIC calling convention of the compiled program.  Newer releases move
+the same capability under ``jax.export``; older/exotic builds may have
+neither, and some backends refuse to serialize.  Everything here
+therefore degrades to an explicit ``(False, reason)`` instead of
+raising — the store turns "unsupported" into a counted cache miss and
+the caller compiles live, exactly how ``telemetry.memory`` handles a
+backend without ``memory_analysis``.
+
+Two structural facts the store relies on (probed against jax 0.4.37):
+
+* a ``Compiled`` — original or deserialized — is called with the
+  DYNAMIC operands only: static kwargs (``freeze=True``,
+  ``max_inner=...``) that the call site passed to the jit wrapper must
+  be dropped, and the dict of dynamic kwargs must match the compiled
+  ``in_tree`` exactly.  ``call_convention`` extracts the expected
+  positional arity and dynamic-kwarg names from the treedef so a cache
+  hit can adapt the instrumented call; any residual mismatch raises
+  ``TypeError`` BEFORE execution, which the dispatch layer treats as a
+  safe fall-back to live compile — a cached entry can be useless,
+  never wrong.
+* treedefs pickle cleanly on the pinned jax, so an entry stores the
+  payload bytes and the pickled ``(in_tree, out_tree)`` pair as two
+  files under one manifest.
+
+jax-free at import (the telemetry/registry constraint): jax is only
+touched from inside the functions, after the caller has already
+dispatched through it.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import pickle
+from typing import Any, Dict, Optional, Tuple
+
+__all__ = [
+    "supported",
+    "backend_fingerprint",
+    "call_convention",
+    "serialize_compiled",
+    "deserialize_compiled",
+]
+
+_supported: Optional[Tuple[bool, str]] = None
+
+
+def supported() -> Tuple[bool, str]:
+    """Can this jax build serialize compiled executables?  Cached after
+    the first probe; ``(False, reason)`` marks the degradation tier."""
+    global _supported
+    if _supported is None:
+        try:
+            from jax.experimental import serialize_executable  # noqa: F401
+
+            _supported = (True, "jax.experimental.serialize_executable")
+        except Exception as exc:  # ImportError or a broken lazy module
+            _supported = (False, f"unsupported:{type(exc).__name__}")
+    return _supported
+
+
+def _reset_probe() -> None:
+    """Forget the capability probe (tests monkeypatch around it)."""
+    global _supported
+    _supported = None
+
+
+def backend_fingerprint() -> str:
+    """Key prefix binding an entry to everything that can invalidate a
+    serialized executable: jax/jaxlib versions, the backend platform and
+    device kind, the LOCAL device count (a shard_map program compiled
+    over 8 virtual devices cannot load into a 1-device process), and the
+    host microarchitecture digest — sandbox hosts share node names
+    across CPU generations, and an executable compiled for the wrong
+    machine dies with SIGILL (the ``enable_persistent_compile_cache``
+    post-mortem; same scheme, shared).  Readable prefix + short hash:
+    ``cpu8-0.4.37-<hex12>``."""
+    import jax
+    import jaxlib
+
+    from ..utils.env import host_microarch_digest
+
+    devices = jax.devices()
+    kind = devices[0].device_kind if devices else "none"
+    raw = "|".join((
+        jax.__version__,
+        jaxlib.__version__,
+        jax.default_backend(),
+        kind,
+        str(len(devices)),
+        host_microarch_digest(),
+    ))
+    digest = hashlib.sha256(raw.encode()).hexdigest()[:12]
+    return f"{jax.default_backend()}{len(devices)}-{jax.__version__}-{digest}"
+
+
+def call_convention(in_tree) -> Dict[str, Any]:
+    """The dynamic calling convention a compiled ``in_tree`` expects:
+    top-level positional arity and the dynamic kwarg names (statics were
+    erased by the lowering).  Best-effort: an unrecognized treedef shape
+    yields an empty dict and the hit path falls back to trying the call
+    verbatim."""
+    try:
+        from jax.tree_util import treedef_children
+
+        args_td, kw_td = treedef_children(in_tree)
+        _, kw_keys = kw_td.node_data()
+        return {
+            "n_args": len(treedef_children(args_td)),
+            "kw_names": sorted(str(k) for k in (kw_keys or ())),
+        }
+    except Exception as exc:
+        return {"error": f"{type(exc).__name__}: {exc}"}
+
+
+def serialize_compiled(compiled) -> Tuple[bytes, bytes, Dict[str, Any]]:
+    """``(payload, trees_pkl, call_meta)`` for one compiled executable.
+    Raises on backends/programs that refuse serialization — the store
+    catches and books the reason as a skipped write."""
+    from jax.experimental.serialize_executable import serialize
+
+    payload, in_tree, out_tree = serialize(compiled)
+    trees = pickle.dumps((in_tree, out_tree), protocol=4)
+    return bytes(payload), trees, call_convention(in_tree)
+
+
+def deserialize_compiled(payload: bytes, trees: bytes):
+    """Rehydrate a ``Compiled`` onto the CURRENT backend.  The caller
+    guarantees the entry's fingerprint matched first; anything this
+    still raises is treated as a corrupt/stale entry (invalidated, never
+    fatal)."""
+    from jax.experimental.serialize_executable import deserialize_and_load
+
+    in_tree, out_tree = pickle.loads(trees)
+    return deserialize_and_load(payload, in_tree, out_tree)

@@ -40,6 +40,16 @@ topic_inference = telemetry.instrument_dispatch(
 )
 
 
+# the packed scoring paths' [V, k] -> [T, k] token-row gather, jitted
+# once and INSTRUMENTED (score.gather here, serve.gather in the serving
+# snapshot): as a bare `table[idx]` it compiled anonymously per token
+# bucket outside the dispatch layer, which made it invisible to the
+# compile sentinel AND un-cacheable by the persistent executable store —
+# the last live compile standing between a warm-cache cold start and
+# its first scored document (bench.py `cold_start`)
+gather_token_rows = jax.jit(lambda table, idx: table[idx])
+
+
 @dataclass
 class LDAModel:
     """Topic model: ``lam`` [k, V] topic-word pseudo-counts, vocabulary, and
@@ -356,6 +366,9 @@ class LDAModel:
         topic_inference_segments = telemetry.instrument_dispatch(
             "score.topic_inference_segments", topic_inference_segments
         )
+        gather = telemetry.instrument_dispatch(
+            "score.gather", gather_token_rows
+        )
 
         n = len(rows)
         if n == 0:
@@ -380,7 +393,7 @@ class LDAModel:
                 self.k,
                 self.gamma_shape,
             )
-        eb_tok = jnp.moveaxis(eb, 0, -1)[jnp.asarray(flat_i)]
+        eb_tok = gather(jnp.moveaxis(eb, 0, -1), jnp.asarray(flat_i))
         return np.asarray(
             topic_inference_segments(
                 eb_tok, jnp.asarray(flat_c), jnp.asarray(seg),

@@ -19,6 +19,20 @@ caused.  The policy:
   ``Params.dispatch_budget_bytes`` for loops that stage per-iteration
   input tensors (the packed online path ships each chunk's minibatches
   to the device; corpus-resident loops stage nothing and pass 0).
+
+Interplay with the persistent executable cache (``compilecache``): the
+chunk length resolved here is PART of every chunk runner's abstract
+signature, so it is part of the cache digest — two processes only share
+a cached executable when this policy resolves the same interval for
+both.  The policy is deliberately a pure function of (Params, ckpt,
+verbose, n_iters, bytes_per_iter) with no wall-clock or load feedback:
+keeping it deterministic is what lets a respawned supervisor worker or
+a repeat ``stc train`` run hit the executables its predecessor stored
+instead of recompiling a one-off chunk shape.  ``donate_carry`` is
+equally cache-neutral — donation is baked into the lowering before
+serialization, so a deserialized executable donates exactly like the
+live-compiled one and the no-use-after-donate contract below applies
+unchanged to cache hits.
 """
 
 from __future__ import annotations

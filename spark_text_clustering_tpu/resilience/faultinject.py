@@ -85,6 +85,9 @@ SITES = frozenset({
     "serve.swap",         # before a verified model hot-swap installs
     "monitor.poll",       # top of each alert-engine evaluation cycle
     "monitor.action",     # before the monitor's actions-file write
+    "compilecache.read",  # before an executable-cache entry is read
+    "compilecache.write", # before an executable-cache entry is staged
+                          # (partial: truncates the staged payload)
 })
 
 

@@ -99,6 +99,7 @@ class ServeScorer:
         if self._lda:
             import jax.numpy as jnp
 
+            from ..models.base import gather_token_rows
             from ..ops.lda_math import topic_inference_segments
 
             self._eb_tok_table = jnp.moveaxis(
@@ -110,6 +111,12 @@ class ServeScorer:
             )
             self._infer = telemetry.instrument_dispatch(
                 "serve.topic_inference", topic_inference_segments
+            )
+            # instrumented (and therefore cacheable) per-bucket token
+            # gather — as a bare table[idx] it was the one live compile
+            # a warm-cache warmup still paid per bucket
+            self._gather = telemetry.instrument_dispatch(
+                "serve.gather", gather_token_rows
             )
 
     @property
@@ -157,7 +164,7 @@ class ServeScorer:
             seg[o:o + len(ids)] = d
             o += len(ids)
         out = self._infer(
-            self._eb_tok_table[jnp.asarray(flat_i)],
+            self._gather(self._eb_tok_table, jnp.asarray(flat_i)),
             jnp.asarray(flat_c),
             jnp.asarray(seg),
             self._alpha,
@@ -170,24 +177,45 @@ class ServeScorer:
         """AOT-compile one executable per configured token bucket BEFORE
         traffic arrives, committing the signatures to the compile
         sentinel — past this point an in-bucket dispatch can never pay a
-        trace/compile (``compile.retraces`` must not move)."""
+        trace/compile (``compile.retraces`` must not move).
+
+        With the persistent executable cache armed (``compilecache``,
+        ``STC_COMPILE_CACHE`` or ``serve --compile-cache``), each bucket
+        consults the store first — a replica warming against a
+        populated cache deserializes instead of compiling (docs/PERF.md
+        cold-start table), and hot-swap warmups ride the same path for
+        free (``poll_model_once`` calls this for every candidate).  The
+        report carries the per-warmup hit/miss/store deltas so
+        ``serve_warmup`` events say where the warmup time went."""
+        from .. import compilecache
         from ..telemetry import compilation
 
+        reg = telemetry.get_registry()
+        cache0 = {
+            k: reg.counter(f"compile.cache_{k}").value
+            for k in ("hits", "misses", "stores")
+        }
         t0 = time.perf_counter()
         v = max(1, self.model.vocab_size)
         for t in self.token_buckets:
             live = max(1, t // 2 + 1)    # lands exactly in bucket t
             ids = (np.arange(live, dtype=np.int32) % v).astype(np.int32)
             self.score_rows([(ids, np.ones(live, np.float32))])
-        retraces = telemetry.get_registry().counter(
-            "compile.retraces"
-        ).value
+        retraces = reg.counter("compile.retraces").value
         report = {
             "buckets": list(self.token_buckets),
             "warmup_seconds": round(time.perf_counter() - t0, 6),
             "signatures": compilation.signatures(),
             "retraces_at_warmup": int(retraces),
+            "compile_cache": (
+                "on" if compilecache.active() else "off"
+            ),
         }
+        if compilecache.active():
+            for k, v0 in cache0.items():
+                report[f"cache_{k}"] = int(
+                    reg.counter(f"compile.cache_{k}").value - v0
+                )
         return report
 
 

@@ -100,6 +100,12 @@ __all__ = [
     "emit_fit",
 ]
 
+# process anchor for compile.time_to_first_dispatch_seconds
+# (telemetry.compilation): THIS package is imported at process start by
+# every driver, while compilation.py itself only loads lazily at the
+# first instrumented dispatch — anchoring there would measure ~0
+PROCESS_T0 = time.perf_counter()
+
 _registry = MetricRegistry()
 _writer: Optional[TelemetryWriter] = None
 _enabled = False

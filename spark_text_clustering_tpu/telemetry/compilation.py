@@ -37,6 +37,7 @@ from __future__ import annotations
 
 import json
 import threading
+import time
 from typing import Dict, List, Optional, Set
 
 __all__ = [
@@ -54,6 +55,16 @@ DEFAULT_BASELINE_PATH = "scripts/records/compile_baseline.json"
 _lock = threading.Lock()
 # label -> [digest, ...] in first-seen order (the ordinal is the index+1)
 _label_digests: Dict[str, List[str]] = {}
+_first_dispatch_seen = False
+
+
+def _process_t0() -> float:
+    """The time-to-first-dispatch anchor: the telemetry PACKAGE import
+    (process start for every driver).  This module loads lazily at the
+    first dispatch, so its own import time would measure ~0."""
+    from . import PROCESS_T0
+
+    return PROCESS_T0
 
 
 def signatures() -> Dict[str, int]:
@@ -63,13 +74,17 @@ def signatures() -> Dict[str, int]:
 
 
 def reset() -> None:
+    global _first_dispatch_seen
     with _lock:
         _label_digests.clear()
+    _first_dispatch_seen = False
 
 
 def note_first_call(rec) -> None:
     """Record a digest's first instrumented call (dispatch calls this
-    once per ExecutableRecord, after the call that traced/compiled)."""
+    once per ExecutableRecord, after the call that traced/compiled —
+    or, under the executable cache, deserialized)."""
+    global _first_dispatch_seen
     from . import get_registry
 
     with _lock:
@@ -80,12 +95,26 @@ def note_first_call(rec) -> None:
         ordinal = len(seen)
     rec.compile_ordinal = ordinal
     reg = get_registry()
+    if not _first_dispatch_seen:
+        # the cold-start metric the executable cache exists to shrink:
+        # how long did THIS process take to complete its first
+        # instrumented dispatch (compile- or deserialize-dominated)
+        _first_dispatch_seen = True
+        reg.gauge("compile.time_to_first_dispatch_seconds").set(
+            round(time.perf_counter() - _process_t0(), 6)
+        )
     reg.gauge(f"compile.{rec.label}.signatures").set(ordinal)
     if rec.compile_seconds is not None:
         reg.gauge(f"compile.{rec.digest}.compile_seconds").set(
             rec.compile_seconds
         )
-    if ordinal > 1:
+    if ordinal > 1 and rec.cache_status != "hit":
+        # a hit DESERIALIZED a committed executable — nothing traced,
+        # nothing compiled, so the retrace counter (the sentinel's
+        # live-compile alarm, and serve's zero-recompile steady-state
+        # contract) must not move; the signature gauge above still
+        # records the ordinal so compile-check sees the same
+        # per-label signature multiplicity either way
         reg.counter("compile.retraces").inc()
 
 

@@ -26,11 +26,26 @@ records per digest:
 plus one ``dispatch_executable`` event per digest per run stream mapping
 the digest back to its human label and argument signature (now also
 carrying the first-call compile seconds, the label's signature ordinal
-from the recompile sentinel, and the ``memory_analysis`` peak bytes).
-The first call per digest also feeds ``telemetry.compilation`` (the
-``compile.*`` recompile sentinel) and ``telemetry.memory`` (the
-``mem.<digest>.*`` attribution, captured on the same AOT retrace the
-cost analysis already pays).
+from the recompile sentinel, the ``memory_analysis`` peak bytes, and
+the executable-cache status).  The first call per digest also feeds
+``telemetry.compilation`` (the ``compile.*`` recompile sentinel) and
+``telemetry.memory`` (the ``mem.<digest>.*`` attribution, captured on
+the same AOT retrace the cost analysis already pays).
+
+When the persistent executable cache is armed (``compilecache``,
+``STC_COMPILE_CACHE``), the first call per digest CONSULTS the store
+before letting jit trace+compile: a hit deserializes the committed
+executable (~20x cheaper than compiling on the sandbox CPU) and every
+subsequent call for that digest dispatches through it; a miss compiles
+live and publishes the executable back through the store's atomic
+manifest+COMMIT protocol.  This one integration point is what makes
+serve warmup, supervisor-respawned stream workers, and cold
+``stc score``/``stc train`` batch runs all cache-aware at once — they
+already route every hot-loop callable through ``instrument``.  Cache
+mode implies the recorded path even when no telemetry run stream is
+configured (the always-live registry carries the ``compile.cache_*``
+counters); with the cache off, the disabled-telemetry fast path is
+byte-for-byte what it was.
 
 jax 0.4.x caveats (docs/OBSERVABILITY.md "dispatch attribution"):
 ``cost_analysis`` needs a second trace via ``fn.lower(...).compile()``
@@ -102,6 +117,12 @@ class ExecutableRecord:
     # mem_source
     mem_bytes: Optional[Dict[str, int]] = None
     mem_source: str = "pending"
+    # persistent executable cache (compilecache): "off" | "hit" |
+    # "stored" | "miss" | "miss:<reason>"; a hit pins the deserialized
+    # executable here and every later call for this digest uses it
+    cache_status: str = "off"
+    cache_load_seconds: Optional[float] = None
+    cached_exec: Optional[Any] = field(default=None, repr=False)
     announced_to: Optional[int] = None
     _capturing: bool = field(default=False, repr=False)
 
@@ -229,29 +250,43 @@ def _normalize_cost(raw) -> Dict[str, float]:
     return out
 
 
-def _analyze_cost(rec: ExecutableRecord, fn, args, kwargs) -> None:
+def _attribute_compiled(rec: ExecutableRecord, compiled) -> None:
+    """Cost + memory attribution from an already-compiled executable
+    (the AOT retrace's, or a cache hit's deserialized one — which pays
+    NO retrace at all)."""
     from .memory import attribute_compiled
 
-    if os.environ.get("STC_DISPATCH_COST", "1") == "0":
-        rec.cost_source = "disabled"
-        rec.mem_source = "disabled"
-        return
-    lower = getattr(fn, "lower", None)
-    if lower is None:
-        rec.cost_source = "no_lower"
-        rec.mem_source = "unavailable:no_lower"
-        return
-    _tls.cost_tracing = True
     try:
-        compiled = lower(*args, **kwargs).compile()
         cost = _normalize_cost(compiled.cost_analysis())
         rec.est_flops = cost.get("est_flops")
         rec.est_bytes = cost.get("est_bytes")
         rec.est_seconds = cost.get("est_seconds")
         rec.cost_source = "cost_analysis" if cost else "empty"
-        # the same AOT executable answers the memory question too —
-        # one retrace buys both attributions (telemetry.memory)
+        # the same executable answers the memory question too — one
+        # compiled object buys both attributions (telemetry.memory)
         attribute_compiled(rec, compiled)
+    except Exception as exc:
+        rec.cost_source = f"error:{type(exc).__name__}"
+        if rec.mem_source == "pending":
+            rec.mem_source = f"unavailable:{type(exc).__name__}"
+
+
+def _analyze_cost(rec: ExecutableRecord, fn, args, kwargs):
+    """AOT-retrace ``fn`` once for cost/memory attribution; returns the
+    compiled executable (so the cache store can serialize the SAME
+    object — one retrace buys all three) or None."""
+    if os.environ.get("STC_DISPATCH_COST", "1") == "0":
+        rec.cost_source = "disabled"
+        rec.mem_source = "disabled"
+        return None
+    lower = getattr(fn, "lower", None)
+    if lower is None:
+        rec.cost_source = "no_lower"
+        rec.mem_source = "unavailable:no_lower"
+        return None
+    _tls.cost_tracing = True
+    try:
+        compiled = lower(*args, **kwargs).compile()
     except Exception as exc:
         # attribution is best-effort by contract: a backend that cannot
         # lower/compile AOT (or rejects the static-arg calling
@@ -260,8 +295,96 @@ def _analyze_cost(rec: ExecutableRecord, fn, args, kwargs) -> None:
         rec.cost_source = f"error:{type(exc).__name__}"
         if rec.mem_source == "pending":
             rec.mem_source = f"unavailable:{type(exc).__name__}"
+        return None
     finally:
         _tls.cost_tracing = False
+    _attribute_compiled(rec, compiled)
+    return compiled
+
+
+# -- persistent executable cache (compilecache) ------------------------------
+# The disabled-telemetry fast path must stay at "a couple of global
+# reads" (the <2% overhead budget scripts/check_telemetry_overhead.py
+# enforces), so the cache-armed state is PUSHED here by
+# compilecache.configure()/reset() instead of queried per call; the
+# pending flag covers the lazy first read of STC_COMPILE_CACHE.
+_cache_pending = True
+_cache_on = False
+
+
+def note_cache_config(active: Optional[bool]) -> None:
+    """compilecache pushes its armed state (None = re-read the env
+    lazily on the next instrumented call)."""
+    global _cache_pending, _cache_on
+    if active is None:
+        _cache_pending = True
+        _cache_on = False
+    else:
+        _cache_pending = False
+        _cache_on = bool(active)
+
+
+def _resolve_cache_armed() -> bool:
+    global _cache_pending, _cache_on
+    from .. import compilecache
+
+    _cache_on = compilecache.active()
+    _cache_pending = False
+    return _cache_on
+
+
+def _cache_store_for(rec: ExecutableRecord):
+    """The armed ExecutableStore, or None.  Never raises — a broken
+    cache must degrade to live compile, not take the hot loop down."""
+    from .. import compilecache
+
+    try:
+        if not compilecache.active():
+            return None
+        return compilecache.get_store()
+    except Exception as exc:
+        rec.cache_status = f"miss:config_error:{type(exc).__name__}"
+        return None
+
+
+def _cache_lookup(rec: ExecutableRecord):
+    store = _cache_store_for(rec)
+    if store is None:
+        return None
+    entry = store.lookup(rec.label, rec.signature, rec.digest)
+    if entry is None and rec.cache_status == "off":
+        rec.cache_status = "miss"
+    return entry
+
+
+def _cache_publish(
+    rec: ExecutableRecord, compiled, fn, args, kwargs
+) -> None:
+    """Publish a freshly compiled executable back to the store.  Reuses
+    the cost-analysis retrace's compiled object when available;
+    otherwise (STC_DISPATCH_COST=0) pays its own AOT compile, because a
+    cache-armed process explicitly asked for the store to fill."""
+    store = _cache_store_for(rec)
+    if store is None:
+        return
+    if compiled is None:
+        lower = getattr(fn, "lower", None)
+        if lower is None:
+            rec.cache_status = "miss:no_lower"
+            return
+        _tls.cost_tracing = True
+        try:
+            compiled = lower(*args, **kwargs).compile()
+        except Exception as exc:
+            rec.cache_status = f"miss:aot_error:{type(exc).__name__}"
+            return
+        finally:
+            _tls.cost_tracing = False
+    if store.store(
+        rec.label, rec.signature, rec.digest, compiled,
+        compile_seconds=rec.compile_seconds,
+    ):
+        rec.cache_status = "stored"
 
 
 # -- accounting --------------------------------------------------------------
@@ -310,6 +433,8 @@ def _account(rec: ExecutableRecord) -> None:
             compile_ordinal=rec.compile_ordinal,
             mem_peak_bytes=(rec.mem_bytes or {}).get("peak_bytes"),
             mem_source=rec.mem_source,
+            cache=rec.cache_status,
+            cache_load_seconds=rec.cache_load_seconds,
         )
 
 
@@ -331,8 +456,24 @@ def _call_recorded(label: str, fn, args, kwargs):
         rec._capturing = True
         _stack().append(rec)
         t0 = time.perf_counter()
+        cached = None
         try:
-            out = fn(*args, **kwargs)
+            cached = _cache_lookup(rec)  # None unless the cache is armed
+            if cached is not None:
+                try:
+                    out = cached.call(args, kwargs)
+                except TypeError as exc:
+                    # calling-convention mismatch (the executable's own
+                    # pytree/aval validation fires BEFORE execution):
+                    # the entry is useless for this call shape — live
+                    # compile, exactly as if it had missed
+                    rec.cache_status = (
+                        f"miss:convention:{str(exc)[:120]}"
+                    )
+                    cached = None
+                    out = fn(*args, **kwargs)
+            else:
+                out = fn(*args, **kwargs)
         finally:
             dt = time.perf_counter() - t0
             _stack().pop()
@@ -341,15 +482,36 @@ def _call_recorded(label: str, fn, args, kwargs):
                 rec.collective_bytes_per_call = 0  # warm cache: nothing seen
         # timed BEFORE the AOT cost/memory retrace below so the compile
         # gauge and the roofline wall total carry only the real call
+        # (for a cache hit this is deserialize + dispatch — the honest
+        # first-call cost the cold-start bench compares)
         rec.compile_seconds = dt
         rec.wall_seconds += dt
-        _analyze_cost(rec, fn, args, kwargs)
+        if cached is not None:
+            rec.cached_exec = cached
+            rec.cache_status = "hit"
+            rec.cache_load_seconds = cached.load_seconds
+            # the deserialized executable answers cost/memory questions
+            # directly — a hit never pays the AOT retrace
+            _attribute_compiled(rec, cached.compiled)
+        else:
+            compiled = _analyze_cost(rec, fn, args, kwargs)
+            _cache_publish(rec, compiled, fn, args, kwargs)
         from .compilation import note_first_call
 
         note_first_call(rec)
     else:
         t0 = time.perf_counter()
-        out = fn(*args, **kwargs)
+        if rec.cached_exec is not None:
+            try:
+                out = rec.cached_exec.call(args, kwargs)
+            except TypeError:
+                # a same-digest call with a different calling pattern
+                # (positional vs keyword): stop trusting the cached
+                # executable for this digest and let jit own it again
+                rec.cached_exec = None
+                out = fn(*args, **kwargs)
+        else:
+            out = fn(*args, **kwargs)
         rec.wall_seconds += time.perf_counter() - t0
     _tls.last_record = rec
     _account(rec)
@@ -369,7 +531,15 @@ def instrument(label: str, fn: Callable) -> Callable:
         from . import enabled
 
         if not enabled():
-            return fn(*args, **kwargs)
+            # cache-armed processes need the recorded path (that is
+            # where the lookup lives) even without a run stream; the
+            # registry is always live so the compile.cache_* counters
+            # still move.  Cache off keeps the global-check fast path
+            # (the armed state is pushed by compilecache, not queried).
+            if not _cache_on and not (
+                _cache_pending and _resolve_cache_armed()
+            ):
+                return fn(*args, **kwargs)
         return _call_recorded(label, fn, args, kwargs)
 
     wrapped.__wrapped__ = fn

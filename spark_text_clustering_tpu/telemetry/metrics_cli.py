@@ -56,6 +56,7 @@ __all__ = [
     "fleet_health",
     "serving_health",
     "alert_health",
+    "compile_health",
     "cmd_summarize",
     "cmd_tail",
     "cmd_diff",
@@ -607,7 +608,9 @@ def serving_health(
     if warm is not None:
         out["warmup"] = {
             k: warm[k]
-            for k in ("buckets", "warmup_seconds", "retraces_at_warmup")
+            for k in ("buckets", "warmup_seconds", "retraces_at_warmup",
+                      "compile_cache", "cache_hits", "cache_misses",
+                      "cache_stores")
             if k in warm
         }
     drained = next(
@@ -649,6 +652,81 @@ def serving_health(
     if executables:
         executables.sort(key=lambda r: -r["calls"])
         out["executables"] = executables
+    return out
+
+
+def compile_health(
+    events: List[Dict], metrics: Dict[str, float]
+) -> Optional[Dict]:
+    """Compile-health summary (docs/OBSERVABILITY.md "Executable
+    cache"): executable-cache hit rate, this process's
+    time-to-first-dispatch, and cold-vs-warm first-call seconds per
+    dispatch label — the attribution that says where cold-start time
+    went.  Reads the ``counter.compile.cache_*`` registry metrics, the
+    ``compile_cache`` events, and the cache fields the
+    ``dispatch_executable`` announcements carry.  None for streams
+    that predate the cache (no cache counters, no time-to-first-
+    dispatch gauge) so old fixtures render unchanged."""
+    cache = {
+        k: int(metrics.get(f"counter.compile.cache_{k}", 0))
+        for k in ("hits", "misses", "stores", "invalidations")
+    }
+    have_cache = any(
+        f"counter.compile.cache_{k}" in metrics for k in cache
+    ) or any(e.get("event") == "compile_cache" for e in events)
+    ttfd = metrics.get("gauge.compile.time_to_first_dispatch_seconds")
+    if not have_cache and ttfd is None:
+        return None
+    out: Dict = {"cache": cache}
+    consulted = cache["hits"] + cache["misses"]
+    if consulted:
+        out["cache"]["hit_rate"] = round(cache["hits"] / consulted, 4)
+    if ttfd is not None:
+        out["time_to_first_dispatch_seconds"] = round(ttfd, 6)
+    retr = metrics.get("counter.compile.retraces")
+    if retr is not None:
+        out["retraces"] = int(retr)
+    # cold-vs-warm first-call seconds by label: a dispatch_executable
+    # with cache == "hit" paid deserialize+dispatch, anything else paid
+    # trace+compile(+dispatch) — the per-label delta is the saving
+    by_label: Dict[str, Dict] = {}
+    for e in events:
+        if e.get("event") != "dispatch_executable":
+            continue
+        lbl = str(e.get("label", "?"))
+        row = by_label.setdefault(
+            lbl, {"cold_seconds": [], "warm_seconds": []}
+        )
+        cs = e.get("compile_seconds")
+        if not _is_num(cs):
+            continue
+        if str(e.get("cache", "off")) == "hit":
+            row["warm_seconds"].append(float(cs))
+        else:
+            row["cold_seconds"].append(float(cs))
+    labels = {}
+    for lbl, row in sorted(by_label.items()):
+        rec = {}
+        for kind in ("cold_seconds", "warm_seconds"):
+            vals = row[kind]
+            if vals:
+                rec[kind] = round(sum(vals), 6)
+                rec[f"{kind.split('_')[0]}_first_calls"] = len(vals)
+        if rec:
+            labels[lbl] = rec
+    if labels:
+        out["by_label"] = labels
+    invalidated = [
+        {
+            "digest": e.get("digest"), "label": e.get("label"),
+            "reason": e.get("reason"),
+        }
+        for e in events
+        if e.get("event") == "compile_cache"
+        and e.get("op") == "invalidate"
+    ]
+    if invalidated:
+        out["invalidated"] = invalidated
     return out
 
 
@@ -731,6 +809,45 @@ def alert_health(
             "hellinger": metrics.get("gauge.drift.hellinger"),
         }
     return out
+
+
+def _print_compile_health(ch: Dict, file=None) -> None:
+    file = file if file is not None else sys.stdout
+    print("compile health:", file=file)
+    c = ch["cache"]
+    rate = (
+        f"  hit rate: {c['hit_rate']:.1%}" if "hit_rate" in c else ""
+    )
+    print(
+        f"  executable cache: {c['hits']} hit(s), {c['misses']} "
+        f"miss(es), {c['stores']} store(s), {c['invalidations']} "
+        f"invalidation(s){rate}", file=file,
+    )
+    if "time_to_first_dispatch_seconds" in ch:
+        print(
+            f"  time to first dispatch: "
+            f"{ch['time_to_first_dispatch_seconds']:.3f}s", file=file,
+        )
+    if "retraces" in ch:
+        print(f"  retraces: {ch['retraces']}", file=file)
+    for lbl, rec in sorted(ch.get("by_label", {}).items()):
+        parts = []
+        if "cold_seconds" in rec:
+            parts.append(
+                f"cold compile {rec['cold_seconds']:.3f}s over "
+                f"{rec['cold_first_calls']} first call(s)"
+            )
+        if "warm_seconds" in rec:
+            parts.append(
+                f"warm load {rec['warm_seconds']:.3f}s over "
+                f"{rec['warm_first_calls']} first call(s)"
+            )
+        print(f"  label {lbl}: {'  '.join(parts)}", file=file)
+    for inv in ch.get("invalidated", ()):
+        print(
+            f"  INVALIDATED {inv['digest']} ({inv['label']}): "
+            f"{inv['reason']}", file=file,
+        )
 
 
 def _print_alert_health(ah: Dict, file=None) -> None:
@@ -906,6 +1023,7 @@ def _cmd_summarize(args) -> int:
     fh = fleet_health(events)
     sh = serving_health(events, metrics)
     ah = alert_health(events, metrics)
+    ch = compile_health(events, metrics)
     if getattr(args, "json", False):
         doc = {"manifest": manifest, "metrics": metrics}
         if lh is not None:
@@ -916,6 +1034,8 @@ def _cmd_summarize(args) -> int:
             doc["serving_health"] = sh
         if ah is not None:
             doc["alert_health"] = ah
+        if ch is not None:
+            doc["compile_health"] = ch
         print(json.dumps(doc, sort_keys=True))
         return 0
     print(f"run: {args.run}")
@@ -930,6 +1050,8 @@ def _cmd_summarize(args) -> int:
         _print_serving_health(sh)
     if ah is not None:
         _print_alert_health(ah)
+    if ch is not None:
+        _print_compile_health(ch)
     print("metrics:")
     for k in sorted(metrics):
         v = metrics[k]

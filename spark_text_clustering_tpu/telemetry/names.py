@@ -120,6 +120,25 @@ METRICS: Dict[str, str] = {
     "handoff.downloads":
         "deferred device-resident models materialized to host on their "
         "first host-side consumer (ensure_host)",
+    # -- persistent executable cache (docs/OBSERVABILITY.md
+    #    "Executable cache"; spark_text_clustering_tpu/compilecache) ----
+    "compile.cache_hits":
+        "instrumented first calls served by deserializing a committed "
+        "executable-cache entry instead of trace+compile",
+    "compile.cache_misses":
+        "executable-cache consultations that fell through to live "
+        "compile (absent entry, stale fingerprint, unsupported backend, "
+        "I/O failure, or a just-invalidated entry)",
+    "compile.cache_stores":
+        "freshly compiled executables serialized and committed to the "
+        "cache (publish-race losers do not count)",
+    "compile.cache_invalidations":
+        "corrupt/torn/mismatched cache entries quarantined on contact "
+        "(each one also counts a miss — degradation, never a crash)",
+    "compile.time_to_first_dispatch_seconds":
+        "wall seconds from telemetry import to the end of this "
+        "process's first instrumented dispatch (the cold-start metric "
+        "the executable cache exists to shrink)",
     # -- static analysis (docs/STATIC_ANALYSIS.md) ----------------------
     "lint.findings": "unwaived stc lint findings in the last run",
     "lint.waived": "stc lint findings suppressed by pragma or baseline",
@@ -139,7 +158,9 @@ PREFIXES: Dict[str, str] = {
     "compile.":
         "telemetry.compilation: recompile sentinel — distinct compiled "
         "signatures per dispatch label, first-call compile seconds, "
-        "retrace counter (gated vs scripts/records/compile_baseline.json)",
+        "retrace counter (gated vs scripts/records/compile_baseline.json) "
+        "— plus the executable cache's per-entry "
+        "compile.<digest>.cache_load_seconds gauges (compilecache)",
     "mem.":
         "telemetry.memory: per-digest memory_analysis attribution "
         "(arg/out/temp/peak bytes) + live device memory_stats and "

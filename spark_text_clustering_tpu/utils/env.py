@@ -22,8 +22,33 @@ __all__ = [
     "scrub_axon_env",
     "scrubbed_cpu_env",
     "probe_accelerator",
+    "host_microarch_digest",
     "enable_persistent_compile_cache",
 ]
+
+
+def host_microarch_digest() -> str:
+    """Short digest of the host's ACTUAL CPU feature flags + machine.
+
+    Sandbox hosts share node names across different microarchitectures,
+    and a persisted executable compiled for the wrong machine dies with
+    SIGILL (bench.py round-3 post-mortem) — so every on-disk compile
+    artifact key (the XLA compilation cache below AND the AOT executable
+    store in ``compilecache``) includes this digest instead of trusting
+    ``platform.node()``."""
+    import hashlib
+    import platform
+
+    try:
+        with open("/proc/cpuinfo") as f:
+            flags = next(
+                (ln for ln in f if ln.startswith(("flags", "Features"))), ""
+            )
+    except OSError:
+        flags = ""
+    return hashlib.sha1(
+        f"{flags}|{platform.machine()}|{platform.node()}".encode()
+    ).hexdigest()[:12]
 
 
 def scrub_axon_env(env: MutableMapping[str, str]) -> None:
@@ -183,21 +208,9 @@ def enable_persistent_compile_cache(cache_root: Optional[str] = None) -> str:
     same scheme, shared).  Call AFTER the backend is chosen (imports
     jax).  Returns the cache dir.
     """
-    import hashlib
-    import platform
-
     import jax
 
-    try:
-        with open("/proc/cpuinfo") as f:
-            flags = next(
-                (ln for ln in f if ln.startswith(("flags", "Features"))), ""
-            )
-    except OSError:
-        flags = ""
-    fp = hashlib.sha1(
-        f"{flags}|{platform.machine()}|{platform.node()}".encode()
-    ).hexdigest()[:12]
+    fp = host_microarch_digest()
     root = cache_root or os.path.join(
         os.path.expanduser("~"), ".cache", "spark_text_clustering_tpu"
     )

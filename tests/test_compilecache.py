@@ -1,0 +1,583 @@
+"""Persistent AOT executable cache (spark_text_clustering_tpu.compilecache)
+and its dispatch-layer integration: hit/miss/store round trips with
+byte-identical outputs, the calling-convention adapter, the
+corrupt/torn/stale/ioerror degradation tiers (always a counted miss,
+never a crash, never a wrong executable), the maintenance verbs, the
+serve-warmup stats, and the `metrics summarize` compile-health section.
+"""
+
+import functools
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from spark_text_clustering_tpu import compilecache, telemetry
+from spark_text_clustering_tpu.compilecache import serialization
+from spark_text_clustering_tpu.compilecache.store import (
+    COMMIT_NAME,
+    ENTRY_JSON,
+    PAYLOAD_BIN,
+    QUARANTINE_DIR,
+)
+from spark_text_clustering_tpu.resilience import faultinject
+from spark_text_clustering_tpu.resilience.integrity import (
+    finalize_artifact_dir,
+)
+from spark_text_clustering_tpu.telemetry import dispatch as dispatch_attr
+
+SERIALIZATION_OK = serialization.supported()[0]
+needs_serialization = pytest.mark.skipif(
+    not SERIALIZATION_OK,
+    reason="this jax build cannot serialize executables — the "
+    "degradation tier has its own tests below",
+)
+
+
+@pytest.fixture(autouse=True)
+def _reset():
+    telemetry.shutdown()
+    telemetry.get_registry().reset()
+    dispatch_attr.reset()
+    compilecache.reset()
+    faultinject.reset()
+    yield
+    telemetry.shutdown()
+    telemetry.get_registry().reset()
+    dispatch_attr.reset()
+    compilecache.reset()
+    faultinject.reset()
+
+
+@pytest.fixture
+def cache_dir(tmp_path, monkeypatch):
+    monkeypatch.delenv(compilecache.ENV_DIR, raising=False)
+    root = str(tmp_path / "compile_cache")
+    compilecache.configure(root)
+    return root
+
+
+@functools.partial(jax.jit, static_argnames=("freeze",))
+def _infer_like(x, y, *, tol, freeze=False):
+    out = x * y + tol
+    return jnp.where(out > 0, out, 0.0) if freeze else out
+
+
+def _counters():
+    snap = telemetry.get_registry().snapshot()
+    return {
+        k.replace("compile.cache_", ""): int(v)
+        for k, v in snap["counters"].items()
+        if k.startswith("compile.cache_")
+    }
+
+
+def _fresh_process_sim():
+    """Simulate a respawned process: new dispatch records, new
+    signature table, new registry — only the on-disk store survives."""
+    root = compilecache.get_store().root
+    dispatch_attr.reset()
+    telemetry.get_registry().reset()
+    compilecache.reset()
+    compilecache.configure(root)
+
+
+def _args():
+    return (jnp.ones((8, 4)), jnp.full((8, 4), 2.0))
+
+
+def _run_once(label="t.infer", **kw):
+    fn = telemetry.instrument_dispatch(label, _infer_like)
+    x, y = _args()
+    return np.asarray(fn(x, y, tol=0.5, freeze=True, **kw))
+
+
+@needs_serialization
+class TestRoundTrip:
+    def test_miss_store_then_hit_identical(self, cache_dir):
+        telemetry.configure(None)
+        out_cold = _run_once()
+        assert _counters() == {"misses": 1, "stores": 1}
+        (rec,) = dispatch_attr.records().values()
+        assert rec.cache_status == "stored"
+
+        _fresh_process_sim()
+        telemetry.configure(None)
+        out_warm = _run_once()
+        assert np.array_equal(out_cold, out_warm)
+        c = _counters()
+        assert c["hits"] == 1 and "misses" not in c
+        (rec,) = dispatch_attr.records().values()
+        assert rec.cache_status == "hit"
+        assert rec.cache_load_seconds is not None
+        snap = telemetry.get_registry().snapshot()
+        assert any(
+            k.startswith("compile.") and k.endswith("cache_load_seconds")
+            for k in snap["gauges"]
+        )
+        # a hit deserializes — the retrace counter must not move
+        assert snap["counters"].get("compile.retraces", 0) == 0
+
+    def test_steady_state_uses_cached_executable(self, cache_dir):
+        telemetry.configure(None)
+        _run_once()
+        _fresh_process_sim()
+        telemetry.configure(None)
+        fn = telemetry.instrument_dispatch("t.infer", _infer_like)
+        x, y = _args()
+        a = np.asarray(fn(x, y, tol=0.5, freeze=True))
+        b = np.asarray(fn(x, y, tol=0.5, freeze=True))
+        assert np.array_equal(a, b)
+        (rec,) = dispatch_attr.records().values()
+        assert rec.calls == 2
+        assert rec.cached_exec is not None
+        assert _counters()["hits"] == 1     # one lookup, not per call
+
+    def test_cache_works_without_telemetry_enabled(self, cache_dir):
+        # a cache-armed process records (registry counters) even when
+        # no run stream / telemetry was configured — the supervised
+        # worker + stc score default
+        assert not telemetry.enabled()
+        out = _run_once()
+        assert out.shape == (8, 4)
+        assert _counters() == {"misses": 1, "stores": 1}
+        _fresh_process_sim()
+        _run_once()
+        assert _counters()["hits"] == 1
+
+    def test_distinct_shapes_distinct_entries(self, cache_dir):
+        telemetry.configure(None)
+        fn = telemetry.instrument_dispatch("t.infer", _infer_like)
+        fn(*_args(), tol=0.5, freeze=True)
+        fn(jnp.ones((4, 2)), jnp.ones((4, 2)), tol=0.5, freeze=True)
+        assert _counters() == {"misses": 2, "stores": 2}
+        assert len(compilecache.get_store().entries()) == 2
+
+    def test_cost_and_memory_attributed_on_hit_without_retrace(
+        self, cache_dir
+    ):
+        telemetry.configure(None)
+        _run_once()
+        _fresh_process_sim()
+        telemetry.configure(None)
+        _run_once()
+        (rec,) = dispatch_attr.records().values()
+        # attribution comes from the DESERIALIZED executable
+        assert rec.cost_source in ("cost_analysis", "empty")
+        assert rec.mem_source in (
+            "memory_analysis", "unavailable:no_memory_analysis",
+        ) or rec.mem_source.startswith("unavailable:")
+
+
+@needs_serialization
+class TestDegradation:
+    def _populate(self):
+        telemetry.configure(None)
+        out = _run_once()
+        store = compilecache.get_store()
+        (entry,) = [
+            e for e in store.entries() if e["status"] == "committed"
+        ]
+        return out, store, entry["path"]
+
+    def test_corrupt_payload_quarantined_falls_back_live(
+        self, cache_dir
+    ):
+        out, store, path = self._populate()
+        bin_path = os.path.join(path, PAYLOAD_BIN)
+        blob = bytearray(open(bin_path, "rb").read())
+        blob[len(blob) // 2] ^= 0xFF
+        with open(bin_path, "wb") as f:
+            f.write(blob)
+        _fresh_process_sim()
+        telemetry.configure(None)
+        out2 = _run_once()                 # live compile, correct bytes
+        assert np.array_equal(out, out2)
+        c = _counters()
+        assert c["invalidations"] == 1
+        assert c["misses"] >= 1
+        assert c["stores"] == 1            # repopulated after quarantine
+        qdir = os.path.join(os.path.dirname(path), QUARANTINE_DIR)
+        assert os.path.isdir(qdir) and os.listdir(qdir)
+        (rec,) = dispatch_attr.records().values()
+        assert rec.cache_status == "stored"
+
+    def test_torn_entry_missing_commit_is_invalidated(self, cache_dir):
+        out, store, path = self._populate()
+        os.remove(os.path.join(path, COMMIT_NAME))
+        _fresh_process_sim()
+        telemetry.configure(None)
+        out2 = _run_once()
+        assert np.array_equal(out, out2)
+        assert _counters()["invalidations"] == 1
+
+    def test_metadata_mismatch_is_invalidated(self, cache_dir):
+        out, store, path = self._populate()
+        meta_path = os.path.join(path, ENTRY_JSON)
+        with open(meta_path, encoding="utf-8") as f:
+            meta = json.load(f)
+        meta["label"] = "somebody.else"
+        with open(meta_path, "w", encoding="utf-8") as f:
+            json.dump(meta, f)
+        finalize_artifact_dir(path)        # checksums verify, meta lies
+        _fresh_process_sim()
+        telemetry.configure(None)
+        out2 = _run_once()
+        assert np.array_equal(out, out2)
+        assert _counters()["invalidations"] == 1
+
+    def test_stale_fingerprint_is_a_plain_miss(self, cache_dir):
+        out, store, path = self._populate()
+        # re-home the entry under a foreign fingerprint dir
+        foreign = os.path.join(store.root, "tpu8-9.9.9-deadbeef0000")
+        os.makedirs(foreign)
+        os.rename(path, os.path.join(foreign, os.path.basename(path)))
+        _fresh_process_sim()
+        telemetry.configure(None)
+        out2 = _run_once()
+        assert np.array_equal(out, out2)
+        c = _counters()
+        assert "invalidations" not in c    # nothing quarantined
+        assert c["misses"] == 1 and c["stores"] == 1
+
+    def test_read_ioerror_fault_is_a_miss_never_a_crash(
+        self, cache_dir
+    ):
+        out, store, path = self._populate()
+        _fresh_process_sim()
+        faultinject.configure("compilecache.read:ioerror@1.0")
+        telemetry.configure(None)
+        out2 = _run_once()
+        assert np.array_equal(out, out2)
+        c = _counters()
+        assert c["misses"] >= 1 and "hits" not in c
+        assert "invalidations" not in c    # entry intact on disk
+        faultinject.configure(None)
+        _fresh_process_sim()
+        telemetry.configure(None)
+        _run_once()
+        assert _counters()["hits"] == 1    # fine again once I/O heals
+
+    def test_write_fault_skips_store_run_continues(self, cache_dir):
+        faultinject.configure("compilecache.write:fail@1")
+        telemetry.configure(None)
+        out = _run_once()
+        assert out.shape == (8, 4)
+        c = _counters()
+        assert "stores" not in c and c["misses"] == 1
+        assert compilecache.get_store().entries() == []
+
+    def test_partial_write_fault_poisons_entry_then_quarantines(
+        self, cache_dir
+    ):
+        # `partial` truncates the staged payload AFTER it was written;
+        # the manifest then seals the truncated bytes, so the entry
+        # COMMITS but cannot deserialize — the reader must quarantine
+        # it and compile live (never a wrong executable)
+        faultinject.configure("compilecache.write:partial@1")
+        telemetry.configure(None)
+        out = _run_once()
+        faultinject.configure(None)
+        _fresh_process_sim()
+        telemetry.configure(None)
+        out2 = _run_once()
+        assert np.array_equal(out, out2)
+        c = _counters()
+        assert c["invalidations"] == 1 and c["stores"] == 1
+
+    def test_unsupported_serialization_tier(
+        self, cache_dir, monkeypatch
+    ):
+        monkeypatch.setattr(
+            serialization, "_supported", (False, "unsupported:Test")
+        )
+        telemetry.configure(None)
+        out = _run_once()
+        assert out.shape == (8, 4)
+        c = _counters()
+        assert c["misses"] == 1 and "stores" not in c
+        assert compilecache.get_store().entries() == []
+
+    def test_store_race_second_writer_discards(self, cache_dir):
+        telemetry.configure(None)
+        _run_once()
+        store = compilecache.get_store()
+        (rec,) = dispatch_attr.records().values()
+        # a second writer for the SAME digest must bow out cleanly
+        lowered = _infer_like.lower(*_args(), tol=0.5, freeze=True)
+        assert store.store(
+            rec.label, rec.signature, rec.digest, lowered.compile()
+        ) is False
+        assert _counters()["stores"] == 1
+
+
+@needs_serialization
+class TestCallConvention:
+    def test_positional_vs_keyword_falls_back_live(self, cache_dir):
+        @jax.jit
+        def f(x, y):
+            return x - y
+
+        telemetry.configure(None)
+        fn = telemetry.instrument_dispatch("t.conv", f)
+        x, y = _args()
+        out = np.asarray(fn(x, y))         # stored with 2 positionals
+        _fresh_process_sim()
+        telemetry.configure(None)
+        fn2 = telemetry.instrument_dispatch("t.conv", f)
+        # same leaves -> same digest, but a different calling pattern:
+        # the adapter must refuse (TypeError) and live compile
+        out2 = np.asarray(fn2(x, y=y))
+        assert np.array_equal(out, out2)
+        (rec,) = dispatch_attr.records().values()
+        assert rec.cache_status.startswith("miss:convention")
+
+    def test_static_kwargs_are_dropped_on_hit(self, cache_dir):
+        telemetry.configure(None)
+        out = _run_once()                  # freeze=True is static
+        _fresh_process_sim()
+        telemetry.configure(None)
+        out2 = _run_once()
+        assert np.array_equal(out, out2)
+        assert _counters()["hits"] == 1
+
+
+@needs_serialization
+class TestMaintenance:
+    def _populate_n(self, n=3):
+        telemetry.configure(None)
+        fn = telemetry.instrument_dispatch("t.sizes", _infer_like)
+        for i in range(n):
+            shape = (4, 2 ** (i + 1))
+            fn(jnp.ones(shape), jnp.ones(shape), tol=0.5)
+        return compilecache.get_store()
+
+    def test_entries_and_verify_clean(self, cache_dir):
+        store = self._populate_n(2)
+        entries = store.entries()
+        assert len(entries) == 2
+        assert all(e["status"] == "committed" for e in entries)
+        assert all(e["label"] == "t.sizes" for e in entries)
+        assert store.verify() == []
+
+    def test_verify_reports_corruption(self, cache_dir):
+        store = self._populate_n(2)
+        victim = store.entries()[0]["path"]
+        with open(os.path.join(victim, PAYLOAD_BIN), "ab") as f:
+            f.write(b"rot")
+        findings = store.verify()
+        assert len(findings) == 1
+        assert "checksum mismatch" in findings[0]["finding"]
+
+    def test_gc_keeps_newest(self, cache_dir):
+        store = self._populate_n(3)
+        # age the entries deterministically via their recorded times
+        for i, e in enumerate(sorted(
+            store.entries(), key=lambda r: r["digest"]
+        )):
+            meta_path = os.path.join(e["path"], ENTRY_JSON)
+            with open(meta_path, encoding="utf-8") as f:
+                meta = json.load(f)
+            meta["created_at"] = 1000.0 + i
+            with open(meta_path, "w", encoding="utf-8") as f:
+                json.dump(meta, f)
+            finalize_artifact_dir(e["path"])
+        removed = store.gc(keep_newest=1)
+        assert removed["entries"] == 2
+        survivors = store.entries()
+        assert len(survivors) == 1
+        assert survivors[0]["status"] == "committed"
+
+    def test_gc_sweeps_stages_and_quarantine(self, cache_dir):
+        store = self._populate_n(1)
+        fdir = os.path.dirname(store.entries()[0]["path"])
+        os.makedirs(os.path.join(fdir, ".stage-dead-123"))
+        os.makedirs(os.path.join(fdir, QUARANTINE_DIR, "old.1"))
+        removed = store.gc(keep_newest=8)
+        assert removed["stages"] == 1
+        assert removed["quarantined"] == 1
+        assert store.entries()[0]["status"] == "committed"
+
+    def test_cli_ls_verify_gc(self, cache_dir, capsys):
+        from spark_text_clustering_tpu.cli import main
+
+        self._populate_n(2)
+        assert main(["compile-cache", "ls", "--cache-dir", cache_dir,
+                     "--json"]) == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert len(doc["entries"]) == 2
+        assert main(["compile-cache", "verify", "--cache-dir",
+                     cache_dir]) == 0
+        capsys.readouterr()
+        assert main(["compile-cache", "gc", "--cache-dir", cache_dir,
+                     "--keep-newest", "1"]) == 0
+        capsys.readouterr()
+        assert main(["compile-cache", "ls", "--cache-dir", cache_dir,
+                     "--json"]) == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert len(doc["entries"]) == 1
+
+    def test_cli_verify_exit_1_on_corruption(self, cache_dir, capsys):
+        from spark_text_clustering_tpu.cli import main
+
+        store = self._populate_n(1)
+        with open(
+            os.path.join(store.entries()[0]["path"], PAYLOAD_BIN), "ab"
+        ) as f:
+            f.write(b"x")
+        assert main(["compile-cache", "verify", "--cache-dir",
+                     cache_dir]) == 1
+
+    def test_cli_requires_cache_dir(self, monkeypatch, capsys):
+        from spark_text_clustering_tpu.cli import main
+
+        monkeypatch.delenv(compilecache.ENV_DIR, raising=False)
+        compilecache.reset()
+        assert main(["compile-cache", "ls"]) == 2
+
+
+@needs_serialization
+class TestServeWarmup:
+    def _scorer(self, buckets=(64, 256)):
+        from spark_text_clustering_tpu.models.base import LDAModel
+        from spark_text_clustering_tpu.serving.server import ServeScorer
+
+        rng = np.random.default_rng(0)
+        model = LDAModel(
+            lam=rng.random((3, 128)).astype(np.float32) + 0.1,
+            vocab=[f"h{i}" for i in range(128)],
+            alpha=np.full(3, 0.5, np.float32),
+            eta=0.1,
+        )
+        return ServeScorer(
+            model, "/nowhere", generation=0, max_batch=8,
+            token_buckets=buckets,
+        )
+
+    def test_warmup_reports_stores_then_hits(self, cache_dir):
+        telemetry.configure(None)
+        report = self._scorer().warmup()
+        assert report["compile_cache"] == "on"
+        # per bucket: the inference dispatch + the token gather
+        assert report["cache_stores"] == 4
+        assert report["cache_hits"] == 0
+        _fresh_process_sim()
+        telemetry.configure(None)
+        report2 = self._scorer().warmup()
+        assert report2["cache_hits"] == 4
+        assert report2["cache_misses"] == 0
+        snap = telemetry.get_registry().snapshot()
+        assert snap["counters"].get("compile.retraces", 0) == 0
+
+    def test_warmup_without_cache_says_off(self):
+        compilecache.configure(None)
+        telemetry.configure(None)
+        report = self._scorer().warmup()
+        assert report["compile_cache"] == "off"
+        assert "cache_hits" not in report
+
+
+class TestCompileHealth:
+    def test_section_absent_for_old_streams(self):
+        from spark_text_clustering_tpu.telemetry.metrics_cli import (
+            compile_health,
+        )
+
+        assert compile_health(
+            [{"event": "train_fit"}], {"counter.ledger.commits": 1.0}
+        ) is None
+
+    def test_section_renders_cache_and_labels(self):
+        from spark_text_clustering_tpu.telemetry.metrics_cli import (
+            compile_health,
+        )
+
+        events = [
+            {"event": "dispatch_executable", "label": "serve.x",
+             "digest": "d1", "compile_seconds": 1.25, "cache": "miss"},
+            {"event": "dispatch_executable", "label": "serve.x",
+             "digest": "d2", "compile_seconds": 0.03, "cache": "hit"},
+            {"event": "compile_cache", "op": "invalidate",
+             "digest": "d9", "label": "serve.y", "reason": "rot"},
+        ]
+        metrics = {
+            "counter.compile.cache_hits": 3.0,
+            "counter.compile.cache_misses": 1.0,
+            "counter.compile.cache_stores": 1.0,
+            "counter.compile.cache_invalidations": 1.0,
+            "counter.compile.retraces": 0.0,
+            "gauge.compile.time_to_first_dispatch_seconds": 0.42,
+        }
+        ch = compile_health(events, metrics)
+        assert ch["cache"]["hits"] == 3
+        assert ch["cache"]["hit_rate"] == 0.75
+        assert ch["time_to_first_dispatch_seconds"] == 0.42
+        assert ch["retraces"] == 0
+        lbl = ch["by_label"]["serve.x"]
+        assert lbl["cold_seconds"] == 1.25
+        assert lbl["warm_seconds"] == 0.03
+        assert ch["invalidated"][0]["digest"] == "d9"
+
+    @needs_serialization
+    def test_summarize_renders_section_from_real_run(
+        self, cache_dir, tmp_path, capsys
+    ):
+        from spark_text_clustering_tpu.cli import main
+
+        stream = str(tmp_path / "run.jsonl")
+        telemetry.configure(stream)
+        telemetry.manifest(kind="test-cache")
+        _run_once()
+        telemetry.shutdown()
+        assert main(["metrics", "summarize", stream, "--json"]) == 0
+        doc = json.loads(capsys.readouterr().out)
+        ch = doc["compile_health"]
+        assert ch["cache"]["misses"] == 1
+        assert ch["cache"]["stores"] == 1
+        assert "time_to_first_dispatch_seconds" in ch
+
+
+@needs_serialization
+@pytest.mark.slow
+class TestColdStartSubprocess:
+    def test_second_process_zero_compile(self, tmp_path):
+        """The gate-13 contract in miniature: process A populates the
+        store, process B reaches its first dispatch on hits alone with
+        zero retraces."""
+        child = (
+            "import json, sys\n"
+            "import jax, jax.numpy as jnp\n"
+            "import numpy as np\n"
+            "from spark_text_clustering_tpu import telemetry\n"
+            "fn = telemetry.instrument_dispatch(\n"
+            "    't.sub', jax.jit(lambda x: (x * 2 + 1).sum()))\n"
+            "out = float(fn(jnp.ones((16, 8))))\n"
+            "reg = telemetry.get_registry()\n"
+            "print(json.dumps({'out': out, 'hits': reg.counter(\n"
+            "    'compile.cache_hits').value, 'misses': reg.counter(\n"
+            "    'compile.cache_misses').value, 'retraces': reg.counter(\n"
+            "    'compile.retraces').value}))\n"
+        )
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["STC_COMPILE_CACHE"] = str(tmp_path / "cc")
+
+        def run():
+            r = subprocess.run(
+                [sys.executable, "-c", child], capture_output=True,
+                text=True, timeout=300, env=env,
+            )
+            assert r.returncode == 0, r.stderr[-2000:]
+            return json.loads(r.stdout.strip().splitlines()[-1])
+
+        a = run()
+        b = run()
+        assert a["out"] == b["out"]
+        assert a["misses"] >= 1 and a["hits"] == 0
+        assert b["hits"] >= 1 and b["misses"] == 0
+        assert b["retraces"] == 0
