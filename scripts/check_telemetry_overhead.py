@@ -87,9 +87,15 @@ def main() -> int:
     )
 
     # disabled per-call primitive cost (span + count + observe + a
-    # dispatch-instrumented call per loop — the wrapper must collapse to
-    # one bool check plus the underlying call when telemetry is off)
+    # dispatch-instrumented call + the tracing layer's two disabled-mode
+    # touchpoints per loop — each must collapse to one global check:
+    # tracing.fields() is the per-micro-batch stamp with no context
+    # installed, emit_span the per-request span that must cost nothing
+    # with telemetry off)
     assert not telemetry.enabled()
+    from spark_text_clustering_tpu.telemetry import tracing
+
+    assert tracing.current() is None
     wrapped_noop = telemetry.instrument_dispatch(
         "overhead.probe", lambda: None
     )
@@ -100,7 +106,12 @@ def main() -> int:
         telemetry.count("overhead.probe")
         telemetry.observe("overhead.probe", 0.0)
         wrapped_noop()
-    per_call = (time.perf_counter() - t0) / (4 * PRIMITIVE_LOOP)
+        tracing.fields()
+        tracing.emit_span(
+            "overhead.probe", trace_id="0", span_id="0",
+            start=0.0, seconds=0.0,
+        )
+    per_call = (time.perf_counter() - t0) / (6 * PRIMITIVE_LOOP)
 
     overhead_s = calls * per_call
     ratio = overhead_s / max(fit_s, 1e-9)

@@ -80,9 +80,21 @@
 #      compile.cache_invalidations >= 1, entry quarantined, report
 #      still byte-identical); process B's deterministic cache counters
 #      gate against the committed baseline
+#  14. end-to-end lineage drill (telemetry.tracing / stc lineage,
+#      docs/OBSERVABILITY.md "Causal tracing & lineage"): a supervised
+#      2-worker stream-train fleet publishes models under ONE trace id
+#      (supervisor spawn -> STC_TRACE -> lease -> epoch ledger ->
+#      model-publish), `stc serve` answers one traced request over a
+#      published model, and `stc lineage` from the saved response must
+#      resolve the exact publish epoch, BOTH workers' committed source
+#      sets, and zero unattributed request spans; `metrics trace
+#      --causal` over the supervisor + worker + serve streams must
+#      render the request's chain across >= 3 process tracks connected
+#      by flow events with lease-anchored clock corrections; the serve
+#      run's counter.trace.* gate against the committed baseline
 #
 # Usage:
-#   scripts/ci_check.sh                 # run all thirteen gates
+#   scripts/ci_check.sh                 # run all fourteen gates
 #   scripts/ci_check.sh --rebaseline    # recapture ALL baselines
 #                                       # (metrics + lint waivers +
 #                                       # lint counters + compile
@@ -861,6 +873,178 @@ print(
 EOF
 }
 
+run_lineage_drill() {
+    # gate 14: one trace id from ingested file to served byte.  A
+    # supervised 2-worker stream-train fleet publishes per-worker
+    # models under the supervisor's root trace; serve answers ONE
+    # traced request; `stc lineage` walks the saved response back to
+    # the publish epoch and both workers' committed source sets; the
+    # --causal export joins the chain across >= 3 process tracks.
+    local workdir="$1"
+    python - "$workdir" <<'EOF'
+import os, sys
+
+workdir = sys.argv[1]
+watch = os.path.join(workdir, "lin_watch")
+os.makedirs(watch, exist_ok=True)
+pools = ["piano violin orchestra symphony concerto melody",
+         "electron proton neutron quantum particle physics"]
+for i in range(4):
+    with open(os.path.join(watch, f"doc{i:02d}.txt"), "w") as f:
+        f.write(f"{pools[i % 2]} tok{i}")
+EOF
+    python -m spark_text_clustering_tpu.cli supervise \
+        --role stream-train --watch-dir "$workdir/lin_watch" \
+        --fleet-dir "$workdir/lin_fleet" --workers 2 \
+        --heartbeat-interval 0.2 --lease-timeout 8 \
+        --grace-seconds 2.0 --sweep-interval 0.15 \
+        --poll-interval 0.05 --idle-timeout 1.0 \
+        --no-lemmatize --k 2 --hash-features 64 --seed 3 \
+        --checkpoint-interval 1 \
+        --models-dir "$workdir/lin_models" \
+        --worker-telemetry-dir "$workdir/lin_wtel" \
+        --telemetry-file "$workdir/lin_sup.jsonl" >/dev/null || {
+        echo "lineage drill: supervised publish fleet failed"
+        return 1
+    }
+    # serve the w000-published model; ONE traced request, saved verbatim
+    python - "$workdir" <<'EOF'
+import json, os, re, signal, subprocess, sys, time, urllib.request
+
+workdir = sys.argv[1]
+log_path = os.path.join(workdir, "lin_serve.log")
+proc = subprocess.Popen(
+    [sys.executable, "-m", "spark_text_clustering_tpu.cli", "serve",
+     "--models-dir", os.path.join(workdir, "lin_models", "w000"),
+     "--port", "0", "--no-lemmatize", "--max-batch", "8",
+     "--linger-ms", "2", "--token-bucket", "256",
+     "--telemetry-file", os.path.join(workdir, "lin_serve.jsonl")],
+    stdout=open(log_path, "w"), stderr=subprocess.STDOUT,
+)
+port = None
+pat = re.compile(r"on http://127\.0\.0\.1:(\d+)")
+deadline = time.time() + 180
+while time.time() < deadline:
+    with open(log_path) as f:
+        m = pat.search(f.read())
+    if m:
+        port = int(m.group(1))
+        break
+    if proc.poll() is not None:
+        sys.exit(f"serve died during startup (rc={proc.returncode})")
+    time.sleep(0.2)
+assert port, "serve never announced its port"
+req = urllib.request.Request(
+    f"http://127.0.0.1:{port}/score",
+    data=json.dumps(
+        {"texts": ["piano violin orchestra symphony"]}
+    ).encode(),
+    headers={"Content-Type": "application/json"},
+)
+with urllib.request.urlopen(req, timeout=60) as r:
+    header = r.headers.get("X-STC-Trace")
+    body = json.loads(r.read())
+assert header, "response carried no X-STC-Trace header"
+assert body["trace"]["trace_id"] in header, (header, body["trace"])
+assert body["model"].get("publish_trace"), (
+    "served model lost its publish trace"
+)
+with open(os.path.join(workdir, "lin_response.json"), "w") as f:
+    json.dump(body, f, sort_keys=True)
+proc.send_signal(signal.SIGTERM)
+assert proc.wait(timeout=180) == 0, "serve drain did not exit 0"
+print(f"lineage drill: traced request served ({header})")
+EOF
+    [[ $? -ne 0 ]] && return 1
+    # lineage from the response: exact publish epoch, both workers'
+    # committed source sets, zero unattributed spans
+    python -m spark_text_clustering_tpu.cli lineage \
+        "$workdir/lin_response.json" --fleet-dir "$workdir/lin_fleet" \
+        --telemetry "$workdir/lin_serve.jsonl" --json \
+        > "$workdir/lin_report.json" || {
+        echo "lineage drill: stc lineage failed"; return 1; }
+    python - "$workdir" <<'EOF'
+import json, os, sys
+
+from spark_text_clustering_tpu.resilience.ledger import EpochLedger
+from spark_text_clustering_tpu.resilience.supervisor import FleetLedger
+
+workdir = sys.argv[1]
+with open(os.path.join(workdir, "lin_report.json")) as f:
+    rep = json.load(f)
+assert rep["lineage"] == "resolved", rep
+# exact publish epoch, cross-checked against the worker ledger itself
+(pub_rec,) = [
+    r for r in EpochLedger(
+        os.path.join(workdir, "lin_fleet", "w000")
+    ).records() if r["kind"] == "model-publish"
+]
+assert rep["model"]["publish"]["epoch"] == pub_rec["epoch"], rep["model"]
+# ONE trace id supervisor -> workers -> publish
+(root_id,) = {
+    r["trace_id"]
+    for r in FleetLedger(os.path.join(workdir, "lin_fleet")).records()
+}
+assert rep["model"]["publish"]["trace_id"] == root_id
+# both workers' committed source sets, exactly the watch corpus
+assert {w["worker"] for w in rep["workers"]} == {0, 1}, rep["workers"]
+watch = os.path.join(workdir, "lin_watch")
+want = sorted(os.path.join(watch, n) for n in os.listdir(watch))
+assert rep["sources"] == want, (rep["sources"], want)
+# zero unattributed spans on the request trace
+assert rep["spans"]["unattributed"] == 0, rep["spans"]
+assert rep["spans"]["total"] >= 4, rep["spans"]
+print(
+    f"lineage drill: publish epoch {pub_rec['epoch']}, "
+    f"{len(want)} sources across 2 workers, "
+    f"{rep['spans']['total']} spans all attributed"
+)
+EOF
+    [[ $? -ne 0 ]] && return 1
+    # causal export: the request chain crosses >= 3 process tracks
+    # over flow events, with lease-anchored clock corrections applied
+    python -m spark_text_clustering_tpu.cli metrics trace \
+        "$workdir/lin_sup.jsonl" "$workdir"/lin_wtel/*.jsonl \
+        "$workdir/lin_serve.jsonl" --causal \
+        --out "$workdir/lin_trace.json" >/dev/null || {
+        echo "lineage drill: metrics trace --causal failed"; return 1; }
+    python - "$workdir" <<'EOF'
+import json, os, sys
+
+workdir = sys.argv[1]
+with open(os.path.join(workdir, "lin_trace.json")) as f:
+    ev = json.load(f)["traceEvents"]
+with open(os.path.join(workdir, "lin_response.json")) as f:
+    resp = json.load(f)
+spans = {
+    e["args"]["span_id"]: e for e in ev
+    if e.get("ph") == "X" and isinstance(e.get("args"), dict)
+    and e["args"].get("span_id")
+}
+flows = [e for e in ev if e.get("ph") in ("s", "f")]
+assert flows, "no flow events in the causal export"
+assert [e for e in flows if e["cat"] == "lineage"], (
+    "no lineage link joining publish -> request"
+)
+# walk: request span -> publish span -> parent chain -> fleet_spawn
+pids = {spans[resp["trace"]["span_id"]]["pid"]}
+cur = resp["model"]["publish_trace"]["span_id"]
+while cur in spans:
+    e = spans[cur]
+    pids.add(e["pid"])
+    if e["name"] == "fleet_spawn":
+        break
+    cur = e["args"].get("parent_span_id")
+else:
+    sys.exit("request chain never reached the supervisor's spawn span")
+assert len(pids) >= 3, f"chain only crossed {len(pids)} process track(s)"
+print(
+    f"lineage drill: causal chain spans {len(pids)} process tracks, "
+    f"{len(flows) // 2} flow edge(s)"
+)
+EOF
+}
+
 if [[ "${1:-}" == "--rebaseline" ]]; then
     python -m spark_text_clustering_tpu.cli lint --rebaseline || exit 1
     work=$(mktemp -d)
@@ -908,6 +1092,13 @@ if [[ "${1:-}" == "--rebaseline" ]]; then
         "$work/cold_b.jsonl" --baseline "$BASELINE" \
         --write-baseline --tolerance 0.0 \
         --include counter.compile.cache || exit 1
+    # fold the lineage drill's deterministic trace counters (one
+    # sampled request, four spans; dropped stays zero-absent)
+    run_lineage_drill "$work" || exit 1
+    python -m spark_text_clustering_tpu.cli metrics check \
+        "$work/lin_serve.jsonl" --baseline "$BASELINE" \
+        --write-baseline --tolerance 0.0 \
+        --include counter.trace. || exit 1
     # recapture the recompile sentinel's expected-signature table from
     # the same train run plus a score run and an NMF fit+transform run
     # (gate 9's fixture triple)
@@ -923,12 +1114,12 @@ fail=0
 work=$(mktemp -d)
 trap 'rm -rf "$work"' EXIT
 
-echo "== [1/13] stc lint (AST rules + jaxpr audit) =="
+echo "== [1/14] stc lint (AST rules + jaxpr audit) =="
 python -m spark_text_clustering_tpu.cli lint \
     --telemetry-file "$work/lint.jsonl"
 if [[ $? -ne 0 ]]; then echo "FAIL: stc lint"; fail=1; fi
 
-echo "== [2/13] ruff (generic-Python tier) =="
+echo "== [2/14] ruff (generic-Python tier) =="
 if command -v ruff >/dev/null 2>&1; then
     ruff check spark_text_clustering_tpu
     if [[ $? -ne 0 ]]; then echo "FAIL: ruff"; fail=1; fi
@@ -936,17 +1127,17 @@ else
     echo "ruff not installed — skipped (stc lint STC101/102/006 cover it)"
 fi
 
-echo "== [3/13] tier-1 tests =="
+echo "== [3/14] tier-1 tests =="
 timeout -k 10 870 python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors -p no:cacheprovider \
     -p no:xdist -p no:randomly
 if [[ $? -ne 0 ]]; then echo "FAIL: tier-1"; fail=1; fi
 
-echo "== [4/13] telemetry overhead budget =="
+echo "== [4/14] telemetry overhead budget =="
 python scripts/check_telemetry_overhead.py
 if [[ $? -ne 0 ]]; then echo "FAIL: telemetry overhead"; fail=1; fi
 
-echo "== [5/13] metrics regression gate =="
+echo "== [5/14] metrics regression gate =="
 if run_ci_train "$work"; then
     # lint., ledger., fleet., serve., and alert. families are captured
     # by their own gates (1/6, 8, 10, 11, and 12) — a batch train run
@@ -955,14 +1146,14 @@ if run_ci_train "$work"; then
         --baseline "$BASELINE" "${EXCLUDES[@]}" --exclude lint. \
         --exclude ledger. --exclude fleet. --exclude serve. \
         --exclude alert. --exclude monitor. --exclude drift. \
-        --exclude compile.cache
+        --exclude compile.cache --exclude trace. --exclude lineage.
     if [[ $? -ne 0 ]]; then echo "FAIL: metrics check"; fail=1; fi
 else
     echo "FAIL: CI training run"
     fail=1
 fi
 
-echo "== [6/13] lint metrics gate (waiver count version-gated) =="
+echo "== [6/14] lint metrics gate (waiver count version-gated) =="
 if [[ -s "$work/lint.jsonl" ]]; then
     python -m spark_text_clustering_tpu.cli metrics check "$work/lint.jsonl" \
         --baseline "$BASELINE" --include lint.
@@ -972,7 +1163,7 @@ else
     fail=1
 fi
 
-echo "== [7/13] cross-host skew gate (metrics merge) =="
+echo "== [7/14] cross-host skew gate (metrics merge) =="
 if make_skew_streams "$work"; then
     python -m spark_text_clustering_tpu.cli metrics merge \
         "$work/skew-p0.jsonl" "$work/skew-p1.jsonl" --fail-on-skew \
@@ -993,7 +1184,7 @@ else
     fail=1
 fi
 
-echo "== [8/13] exactly-once ledger chaos drill (STC_FAULTS) =="
+echo "== [8/14] exactly-once ledger chaos drill (STC_FAULTS) =="
 if run_ledger_drill "$work"; then
     python -m spark_text_clustering_tpu.cli metrics check \
         "$work/ledger_drill.jsonl" --baseline "$BASELINE" \
@@ -1004,7 +1195,7 @@ else
     fail=1
 fi
 
-echo "== [9/13] recompile sentinel (metrics compile-check) =="
+echo "== [9/14] recompile sentinel (metrics compile-check) =="
 if [[ -s "$work/run.jsonl" ]] && run_ci_score "$work" \
     && run_ci_nmf "$work"; then
     python -m spark_text_clustering_tpu.cli metrics compile-check \
@@ -1031,7 +1222,7 @@ else
     fail=1
 fi
 
-echo "== [10/13] supervisor drill (lease expiry -> SIGKILL -> respawn) =="
+echo "== [10/14] supervisor drill (lease expiry -> SIGKILL -> respawn) =="
 if run_supervisor_drill "$work"; then
     # the ladder's counters are deterministic: 3 spawns (2 + 1
     # respawn), 1 lease expiry, 1 preemption (the drain SIGTERM the
@@ -1045,7 +1236,7 @@ else
     fail=1
 fi
 
-echo "== [11/13] serve drill (hot-swap + drain + zero-recompile) =="
+echo "== [11/14] serve drill (hot-swap + drain + zero-recompile) =="
 if [[ -d "$work/models" ]] && run_serve_drill "$work"; then
     # requests (32 = two exact 16-doc volleys) and swaps (1) are
     # machine-independent; batch counts depend on coalescing timing
@@ -1059,7 +1250,7 @@ else
     fail=1
 fi
 
-echo "== [12/13] monitor drill (alerts fire/resolve + resize-on-alert) =="
+echo "== [12/14] monitor drill (alerts fire/resolve + resize-on-alert) =="
 if run_monitor_once_drill "$work"; then
     # the --once storm run's alert counters are deterministic: exactly
     # one firing (retrace_storm), nothing pending/resolved
@@ -1080,7 +1271,7 @@ if ! run_monitor_resize_drill "$work"; then
     fail=1
 fi
 
-echo "== [13/13] executable-cache cold-start drill (compilecache) =="
+echo "== [13/14] executable-cache cold-start drill (compilecache) =="
 if [[ -d "$work/models" ]] && run_cold_start_drill "$work"; then
     # the warm B run's cache counters are deterministic: one hit per
     # score-path digest, zero misses/stores/invalidations
@@ -1090,6 +1281,19 @@ if [[ -d "$work/models" ]] && run_cold_start_drill "$work"; then
     if [[ $? -ne 0 ]]; then echo "FAIL: cold-start cache counters"; fail=1; fi
 else
     echo "FAIL: executable-cache cold-start drill"
+    fail=1
+fi
+
+echo "== [14/14] end-to-end lineage drill (causal tracing) =="
+if run_lineage_drill "$work"; then
+    # the serve run's trace counters are deterministic: ONE sampled
+    # request, four emitted spans, nothing dropped
+    python -m spark_text_clustering_tpu.cli metrics check \
+        "$work/lin_serve.jsonl" --baseline "$BASELINE" \
+        --include counter.trace.
+    if [[ $? -ne 0 ]]; then echo "FAIL: lineage trace counters"; fail=1; fi
+else
+    echo "FAIL: end-to-end lineage drill"
     fail=1
 fi
 

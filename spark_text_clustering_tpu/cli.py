@@ -550,7 +550,11 @@ def cmd_stream_score(args: argparse.Namespace) -> int:
         telemetry.manifest(
             kind="stream-score", model=model_path,
             vocab_width=model.vocab_size, watch_dir=args.watch_dir,
+            **_worker_manifest_fields(args),
         )
+        from .telemetry import tracing as _tracing
+
+        _tracing.emit_adopt()
 
     # Transactional scoring (--checkpoint-dir): every trigger becomes one
     # committed epoch in resilience.ledger — the per-epoch report file
@@ -725,7 +729,11 @@ def cmd_stream_train(args: argparse.Namespace) -> int:
                 len(vocab) if vocab is not None else num_features
             ),
             watch_dir=args.watch_dir,
+            **_worker_manifest_fields(args),
         )
+        from .telemetry import tracing as _tracing
+
+        _tracing.emit_adopt()
 
     trainer = StreamingOnlineLDA(
         params,
@@ -931,6 +939,18 @@ def cmd_supervise(args: argparse.Namespace) -> int:
         argv = [
             sys.executable, "-m", "spark_text_clustering_tpu.cli",
             args.role,
+        ]
+        if args.worker_telemetry_dir:
+            # one run stream per INCARNATION (spawn id in the name):
+            # a respawn must not truncate the dead incarnation's stream
+            argv += [
+                "--telemetry-file",
+                os.path.join(
+                    args.worker_telemetry_dir,
+                    f"worker-w{index:03d}-s{spawn_id}.jsonl",
+                ),
+            ]
+        argv += [
             "--watch-dir", args.watch_dir,
             "--checkpoint-dir", worker_dir(args.fleet_dir, index),
             "--fleet-dir", args.fleet_dir,
@@ -1173,6 +1193,31 @@ def cmd_compile_cache(args: argparse.Namespace) -> int:
     return 0
 
 
+def cmd_lineage(args: argparse.Namespace) -> int:
+    """Walk the causal chain behind a served byte (docs/OBSERVABILITY.md
+    "Causal tracing & lineage"): from a model dir, a serve response
+    JSON, or a trace id, resolve the publish epoch, every contributing
+    worker's committed source set, the request's span chain, and the
+    compile digests that served it.  Degrades typed on torn/corrupt/
+    legacy records — exit 0 with DEGRADED notes, never a crash; exit 3
+    only when the target itself is unresolvable."""
+    import json as _json
+
+    from . import lineage
+
+    report = lineage.walk(
+        args.target,
+        fleet_dir=args.fleet_dir,
+        ledger_dir=args.ledger_dir,
+        telemetry_paths=args.telemetry or (),
+    )
+    if args.json:
+        print(_json.dumps(report, sort_keys=True))
+    else:
+        print(lineage.render_tree(report))
+    return 3 if report["kind"] == "unknown" else 0
+
+
 def cmd_doctor(args: argparse.Namespace) -> int:
     """Environment health report: accelerator reachability (probed in a
     throwaway subprocess so a wedged TPU tunnel can only time out, never
@@ -1235,7 +1280,12 @@ def _fleet_worker_context(args: argparse.Namespace):
         WorkerLease,
         lease_path,
     )
+    from .telemetry import tracing
 
+    # adopt a spawner-propagated causal context (STC_TRACE) FIRST: the
+    # initial lease beat below must already carry it, and every ledger
+    # record this worker commits hangs off the adopted span
+    tracing.adopt_env()
     preempt = PreemptionNotice().install()
     fleet_dir = getattr(args, "fleet_dir", None)
     if not fleet_dir:
@@ -1265,6 +1315,15 @@ def _fleet_worker_context(args: argparse.Namespace):
         configure_lease_deadline(float(lease_timeout))
     lease.beat(force=True)          # visible before the slow jax import
     return preempt, lease, fence, partition
+
+
+def _worker_manifest_fields(args: argparse.Namespace) -> dict:
+    """Fleet identity for a supervised worker's run-stream manifest:
+    `metrics trace --causal` pairs each worker stream with the
+    supervisor's ``lease_sync`` clock anchors by this index."""
+    if not getattr(args, "fleet_dir", None):
+        return {}
+    return {"worker_index": int(getattr(args, "worker_index", 0) or 0)}
 
 
 def _make_trigger_controller(args: argparse.Namespace):
@@ -1678,6 +1737,12 @@ def build_parser() -> argparse.ArgumentParser:
                     help="supervisor telemetry run stream (fleet_* "
                          "events + fleet.* counters) — consumed by "
                          "`metrics summarize` fleet health")
+    sv.add_argument("--worker-telemetry-dir", default=None,
+                    help="give every worker incarnation its own "
+                         "telemetry run stream under this dir "
+                         "(worker-wNNN-sSS.jsonl) — the per-worker "
+                         "tracks `metrics trace --causal` and `metrics "
+                         "merge` join with the supervisor stream")
     sv.add_argument("--worker-arg", action="append", default=[],
                     help="extra argv appended verbatim to every worker "
                          "command (repeatable)")
@@ -1731,6 +1796,31 @@ def build_parser() -> argparse.ArgumentParser:
             p.add_argument("--json", action="store_true")
         p.set_defaults(fn=cmd_compile_cache)
 
+    li = sub.add_parser(
+        "lineage",
+        help="walk the causal chain behind a served byte: model dir / "
+             "serve response JSON / trace id -> publish epoch, "
+             "committed source sets, request span chain, compile "
+             "digests",
+    )
+    li.add_argument("target",
+                    help="a model artifact dir, a saved serve response "
+                         "JSON, or a trace id (32-hex or traceparent)")
+    li.add_argument("--fleet-dir", default=None,
+                    help="walk EVERY worker ledger of this fleet dir "
+                         "(w000/, w001/, ...) into the committed "
+                         "source union")
+    li.add_argument("--ledger-dir", default=None,
+                    help="explicit epoch-ledger checkpoint dir "
+                         "(default: the model meta.json's ledger_ref)")
+    li.add_argument("--telemetry", action="append", default=[],
+                    metavar="RUN.JSONL",
+                    help="run stream(s) to resolve the request's trace "
+                         "spans and the serve-side compile digests "
+                         "(repeatable)")
+    li.add_argument("--json", action="store_true")
+    li.set_defaults(fn=cmd_lineage)
+
     dr = sub.add_parser(
         "doctor", help="environment health report (hang-proof probes)"
     )
@@ -1780,9 +1870,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     # `supervise` is pure subprocess-and-files machinery: its WORKERS
     # bring jax up; the supervisor must survive anything they do to it
     # `monitor` is a pure host-side reader like `metrics`: no jax ever
+    # `lineage` walks ledgers and run streams on the host: no jax ever
     if (
         args.cmd not in ("doctor", "metrics", "lint", "stream",
-                         "supervise", "monitor")
+                         "supervise", "monitor", "lineage")
         and getattr(args, "coordinator", None) is None
     ):
         from .utils.env import enable_persistent_compile_cache

@@ -88,6 +88,8 @@ SITES = frozenset({
     "compilecache.read",  # before an executable-cache entry is read
     "compilecache.write", # before an executable-cache entry is staged
                           # (partial: truncates the staged payload)
+    "lineage.read",       # before each ledger/meta read of a lineage
+                          # walk (the walker must degrade typed)
 })
 
 

@@ -267,6 +267,8 @@ class EpochLedger:
             raise ValueError(
                 f"epoch {epoch} out of order (next is {self.next_epoch()})"
             )
+        from ..telemetry import tracing
+
         intent = {
             "schema": LEDGER_SCHEMA,
             "epoch": epoch,
@@ -275,6 +277,12 @@ class EpochLedger:
             "payloads": sorted(payloads),
             "process_count": int(process_count),
         }
+        # causal context: the staged intent carries the PROCESS span (the
+        # committed record below carries its own child span), so a crash
+        # between stage and commit still leaves an attributable orphan
+        ctx = tracing.current()
+        if ctx is not None:
+            intent["trace"] = ctx.to_fields()
         path = self._intent_path(epoch)
 
         def _write() -> None:
@@ -305,6 +313,7 @@ class EpochLedger:
         point — everything before it rolls back on crash, everything
         after it is exactly-once durable."""
         from .. import telemetry
+        from ..telemetry import tracing
 
         payloads = payloads or {}
         digests = {}
@@ -325,10 +334,33 @@ class EpochLedger:
             "sources": sorted(sources),
             "payloads": digests,
             "process_count": int(process_count),
+            "ts": time.time(),
             **({"shards": shards} if shards else {}),
             **({"model_ref": model_ref} if model_ref else {}),
             **extra,
         }
+        # causal context: every committed record owns ONE span (child of
+        # the process context), so `stc lineage` and the --causal trace
+        # exporter can hang the epoch off the worker that produced it —
+        # and a `model-publish` record's span is the model's birth
+        # certificate the serve side links back to
+        ctx = tracing.current()
+        span_fields = None
+        if ctx is not None:
+            span_fields = ctx.child().to_fields()
+            record["trace"] = span_fields
+        if self.fence is not None:
+            # worker identity rides the record too: lineage resolves
+            # "which worker/generation/spawn committed this epoch"
+            # without re-deriving it from the fleet ledger
+            for key, attr in (
+                ("worker", "worker_index"),
+                ("generation", "generation"),
+                ("spawn_id", "spawn_id"),
+            ):
+                val = getattr(self.fence, attr, None)
+                if val is not None and key not in record:
+                    record[key] = int(val)
         record["checksum"] = record_checksum(record)
         line = json.dumps(record, sort_keys=True) + "\n"
 
@@ -350,6 +382,7 @@ class EpochLedger:
         telemetry.event(
             "ledger_commit", epoch=epoch, kind=kind,
             sources=len(record["sources"]), payloads=len(digests),
+            **(span_fields or {}),
         )
         # post-commit cleanup: best-effort — a crash in THIS window
         # leaves a stale intent for a committed epoch, which recover()
@@ -629,6 +662,7 @@ class EpochLedger:
         layer), then publish a ready marker carrying its digest.
         Returns the shard spec the commit record will embed."""
         from ..models.persistence import save_train_state
+        from ..telemetry import tracing
 
         self._check_fence()
         fname = shard_filename(epoch, process_index)
@@ -642,6 +676,12 @@ class EpochLedger:
             "cols": [int(cols[0]), int(cols[1])],
             "sha256": file_sha256(path),
         }
+        # the ready marker names the staging process's causal context so
+        # a torn multi-host checkpoint attributes to the worker that
+        # staged it
+        ctx = tracing.current()
+        if ctx is not None:
+            spec["trace"] = ctx.to_fields()
         atomic_write_text(
             self._marker_path(epoch, process_index),
             json.dumps(spec, indent=2, sort_keys=True) + "\n",

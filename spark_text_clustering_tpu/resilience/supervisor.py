@@ -290,6 +290,7 @@ class WorkerLease:
 
     def _write(self, **fields) -> None:
         from .. import telemetry
+        from ..telemetry import tracing
 
         payload = {
             "pid": os.getpid(),
@@ -297,6 +298,10 @@ class WorkerLease:
             "generation": self.generation,
             "spawn_id": self.spawn_id,
             "ts": time.time(),
+            # the lease file is a propagation hop: the adopted causal
+            # context rides every renewal, so anything reading leases
+            # (monitor, lineage, a human) sees which trace owns the pid
+            **tracing.fields(),
             **fields,
         }
 
@@ -513,13 +518,29 @@ class FleetSupervisor:
         self._procs: Dict[int, _Worker] = {}
         self._depth_streak = 0
         self._idle_streak = 0
+        # causal root: every worker spawn gets a CHILD span of this
+        # context in its environment (STC_TRACE), so one trace id covers
+        # supervisor -> worker -> ledger -> publish (telemetry.tracing)
+        from ..telemetry import tracing
+
+        self.trace = tracing.current() or tracing.mint()
+        # newest observed lease ts per worker — lease_sync events (the
+        # cross-process clock anchors `metrics trace --causal` corrects
+        # with) are emitted once per RENEWAL, not once per sweep
+        self._lease_sync: Dict[int, float] = {}
 
     # -- spawning --------------------------------------------------------
-    def _worker_env(self, index: int, chaos: bool):
+    def _worker_env(self, index: int, chaos: bool, trace=None):
+        from ..telemetry import tracing
+
         env = {
             k: v for k, v in self.env.items()
             if k not in (faultinject.ENV_SPEC, faultinject.ENV_SEED)
         }
+        # context propagation: the worker adopts this span at startup
+        # (tracing.adopt_env) and stamps it into every lease renewal and
+        # ledger record it writes
+        env.update(tracing.env_for_child(trace))
         # chaos policy: STC_FAULTS reaches each worker's FIRST
         # generation-0 spawn only — the injected crash is the drill;
         # recovery must run clean (a respawn that re-inherited kill@1
@@ -544,12 +565,15 @@ class FleetSupervisor:
         argv = list(
             self.worker_argv(index, count, self.generation, spawn_id)
         )
+        # one child span per spawn: the env hands it to the worker, the
+        # fleet_spawn event anchors the supervisor end of the causal edge
+        span = self.trace.child()
 
         def _launch() -> subprocess.Popen:
             faultinject.check("supervisor.spawn")
             return subprocess.Popen(
                 argv,
-                env=self._worker_env(index, chaos),
+                env=self._worker_env(index, chaos, trace=span),
                 stdout=subprocess.DEVNULL,
                 stderr=subprocess.DEVNULL,
             )
@@ -569,6 +593,7 @@ class FleetSupervisor:
             "fleet_spawn",
             worker=index, pid=proc.pid,
             generation=self.generation, spawn_id=spawn_id,
+            **span.to_fields(),
         )
         return w
 
@@ -586,6 +611,7 @@ class FleetSupervisor:
             generation=self.generation,
             worker_count=count,
             spawn_ids=spawn_ids,
+            trace_id=self.trace.trace_id,
             **extra,
         )
         for i in range(count):
@@ -940,6 +966,18 @@ class FleetSupervisor:
                 age = now - float(lease.get("ts", 0.0))
                 budget = self.lease_timeout
                 depths[i] = int(lease.get("queue_depth", 0))
+                # clock anchor: (worker-clock lease ts, supervisor-clock
+                # observation) pairs — `metrics trace --causal` takes
+                # the min delta per worker as its skew CORRECTION (the
+                # lease write->read latency bounds the error by one
+                # sweep interval).  Emitted once per renewal.
+                lts = float(lease.get("ts", 0.0))
+                if self._lease_sync.get(i) != lts:
+                    self._lease_sync[i] = lts
+                    telemetry.event(
+                        "lease_sync", worker=i, lease_ts=lts,
+                        observed_ts=now,
+                    )
                 # slack is only meaningful against the steady-state
                 # lease budget — the startup grace would drown it
                 slack = budget - age

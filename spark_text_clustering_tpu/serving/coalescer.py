@@ -58,6 +58,12 @@ class PendingDoc:
     distribution: Optional[np.ndarray] = None     # [k] on success
     error: Optional[str] = None                   # repr on failure
     served_by: Optional[dict] = None              # model attribution
+    # causal timeline stamps (perf_counter space): when the batch
+    # worker popped this doc and how long its shared dispatch took —
+    # the service turns these into serve.batch_wait / serve.dispatch
+    # spans under the request's trace context
+    popped_at: Optional[float] = None
+    dispatch_seconds: Optional[float] = None
 
     def fail(self, error: BaseException) -> None:
         self.error = repr(error)
@@ -142,6 +148,7 @@ class RequestCoalescer:
                 return
             now = time.perf_counter()
             for d in batch:
+                d.popped_at = now
                 telemetry.observe(
                     "serve.queue_seconds", now - d.enqueued_at
                 )
@@ -157,6 +164,9 @@ class RequestCoalescer:
             except Exception as exc:
                 # the batch dies, its documents get error responses,
                 # the SERVICE keeps serving (PR 2 quarantine discipline)
+                dt = time.perf_counter() - t0
+                for d in batch:
+                    d.dispatch_seconds = dt
                 telemetry.count("serve.quarantined", len(batch))
                 telemetry.event(
                     "serve_quarantined", docs=len(batch),
@@ -166,13 +176,16 @@ class RequestCoalescer:
                     if not d.done.is_set():
                         d.fail(exc)
             else:
+                dt = time.perf_counter() - t0
+                for d in batch:
+                    d.dispatch_seconds = dt
                 # the live per-batch record the `stc monitor` serve
                 # rules (p99/fill regressions) tail — the registry
                 # histograms only reach the stream at shutdown
                 telemetry.event(
                     "serve_batch",
                     docs=len(batch),
-                    seconds=round(time.perf_counter() - t0, 6),
+                    seconds=round(dt, 6),
                     fill=round(fill, 4),
                 )
 

@@ -42,6 +42,7 @@ from .. import telemetry
 from ..models.persistence import resolve_latest_model
 from ..resilience import CorruptArtifactError, Quarantine, faultinject
 from ..resilience.retry import sleep as _sleep
+from ..telemetry import tracing
 from .coalescer import PendingDoc, RequestCoalescer, ServiceDraining
 
 __all__ = ["ServeScorer", "ScoringService", "make_http_server"]
@@ -95,6 +96,13 @@ class ServeScorer:
             "step": meta.get("step"),
             "generation": int(generation),
         }
+        publish_trace = self._publish_trace(ledger_ref)
+        if publish_trace:
+            # the training side of the causal chain: the model-publish
+            # ledger record's span — responses (and trace_request
+            # events) link the serving trace back to the trace that
+            # ingested and trained the bytes being served
+            self.attribution["publish_trace"] = publish_trace
         self._lda = isinstance(model, LDAModel)
         if self._lda:
             import jax.numpy as jnp
@@ -118,6 +126,25 @@ class ServeScorer:
             self._gather = telemetry.instrument_dispatch(
                 "serve.gather", gather_token_rows
             )
+
+    @staticmethod
+    def _publish_trace(ledger_ref) -> Optional[dict]:
+        """Trace fields of the model-publish ledger record, when the
+        checkpoint dir is still reachable.  Best-effort: a relocated or
+        legacy (pre-trace) ledger reads as no training trace."""
+        if not ledger_ref or ledger_ref.get("epoch") is None \
+                or not ledger_ref.get("dir"):
+            return None
+        from ..resilience.ledger import EpochLedger
+
+        try:
+            rec = EpochLedger(str(ledger_ref["dir"])).record_for(
+                int(ledger_ref["epoch"])
+            )
+        except (OSError, ValueError, CorruptArtifactError):
+            return None
+        trace = (rec or {}).get("trace")
+        return dict(trace) if isinstance(trace, dict) else None
 
     @property
     def k(self) -> int:
@@ -328,11 +355,20 @@ class ScoringService:
         self,
         texts: Sequence[str],
         names: Optional[Sequence[str]] = None,
+        trace: Optional[tracing.TraceContext] = None,
     ) -> List[dict]:
         """Score ``texts``; returns one result dict per document, in
         order.  Raises ``ServiceDraining`` after the preemption notice.
         Called from HTTP handler threads (and directly by tests/bench);
-        blocks until every document's batch completed."""
+        blocks until every document's batch completed.
+
+        ``trace``: the request's causal context (the HTTP front parses
+        ``X-STC-Trace`` into one; None mints a head-sampled root).  A
+        sampled request emits the per-request span chain
+        ``serve.request`` -> ``serve.vectorize`` / ``serve.batch_wait``
+        -> ``serve.dispatch`` onto the run stream; an unsampled one
+        only propagates the id — no span cost on the hot path.
+        """
         faultinject.check("serve.accept")
         if self.draining:
             telemetry.count("serve.rejected", len(texts))
@@ -340,8 +376,15 @@ class ScoringService:
                 "scoring service is draining (preemption notice "
                 "received) — retry against another replica"
             )
+        ctx = trace if trace is not None else tracing.mint()
+        if ctx.sampled:
+            telemetry.count("trace.sampled")
+        else:
+            telemetry.count("trace.dropped")
+        traced = ctx.sampled and telemetry.enabled()
         names = list(names or [f"doc{i}" for i in range(len(texts))])
         t0 = time.perf_counter()
+        t0_wall = time.time()
         scorer = self._scorer       # vectorize against ONE vocabulary
         pending: List[Optional[PendingDoc]] = []
         results: List[Optional[dict]] = [None] * len(texts)
@@ -366,6 +409,7 @@ class ScoringService:
             pending.append(
                 self.coalescer.submit(PendingDoc(name=name, row=row))
             )
+        vec_end = time.perf_counter()
         for i, doc in enumerate(pending):
             if doc is None:
                 continue
@@ -388,7 +432,94 @@ class ScoringService:
             telemetry.observe(
                 "serve.request_seconds", time.perf_counter() - t0
             )
+        if traced:
+            self._emit_request_spans(
+                ctx, scorer, pending,
+                t0=t0, t0_wall=t0_wall, vec_end=vec_end,
+                end=time.perf_counter(), docs=len(texts),
+            )
         return [r for r in results if r is not None]
+
+    def _emit_request_spans(
+        self, ctx, scorer, pending, *, t0, t0_wall, vec_end, end, docs,
+    ) -> None:
+        """One request's causal spans + the ``trace_request`` anchor
+        event, all on the run stream.  Span starts are wall-clock
+        (``t0_wall`` plus the perf-counter delta) so the --causal
+        exporter can place them on the corrected cross-process
+        timeline.  The request's own span id is the context's — the
+        root the lineage walker checks for unattributed children."""
+
+        def wall(p: float) -> float:
+            return t0_wall + (p - t0)
+
+        attr = scorer.attribution
+        publish = attr.get("publish_trace") or {}
+        telemetry.event(
+            "trace_request",
+            trace_id=ctx.trace_id,
+            span_id=ctx.span_id,
+            sampled=True,
+            docs=docs,
+            model=attr["model"],
+            epoch=attr.get("epoch"),
+            **(
+                {
+                    "publish_trace_id": publish.get("trace_id"),
+                    "publish_span_id": publish.get("span_id"),
+                }
+                if publish.get("span_id") else {}
+            ),
+        )
+        tracing.emit_span(
+            "serve.request",
+            trace_id=ctx.trace_id,
+            span_id=ctx.span_id,
+            parent_span_id=ctx.parent_span_id,
+            start=t0_wall,
+            seconds=end - t0,
+            docs=docs,
+        )
+        tracing.emit_span(
+            "serve.vectorize",
+            trace_id=ctx.trace_id,
+            span_id=tracing.new_span_id(),
+            parent_span_id=ctx.span_id,
+            start=t0_wall,
+            seconds=vec_end - t0,
+        )
+        live = [
+            d for d in pending
+            if d is not None and d.popped_at is not None
+        ]
+        if not live:
+            return
+        enq = min(d.enqueued_at for d in live)
+        popped = max(d.popped_at for d in live)
+        wait_id = tracing.new_span_id()
+        tracing.emit_span(
+            "serve.batch_wait",
+            trace_id=ctx.trace_id,
+            span_id=wait_id,
+            parent_span_id=ctx.span_id,
+            start=wall(enq),
+            seconds=max(0.0, popped - enq),
+        )
+        dispatch_s = max(
+            (d.dispatch_seconds for d in live
+             if d.dispatch_seconds is not None),
+            default=None,
+        )
+        if dispatch_s is not None:
+            tracing.emit_span(
+                "serve.dispatch",
+                trace_id=ctx.trace_id,
+                span_id=tracing.new_span_id(),
+                parent_span_id=wait_id,
+                start=wall(popped),
+                seconds=dispatch_s,
+                model=attr["model"],
+            )
 
     def _dispatch(self, batch: List[PendingDoc]) -> None:
         # ONE snapshot per batch: the whole dispatch — and therefore
@@ -498,11 +629,15 @@ class _ServeHandler(BaseHTTPRequestHandler):
     def log_message(self, fmt, *args):  # noqa: A003
         pass
 
-    def _send(self, code: int, doc: dict) -> None:
+    def _send(self, code: int, doc: dict, trace=None) -> None:
         body = json.dumps(doc).encode("utf-8")
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
+        if trace is not None:
+            # the served byte's end of the causal chain: clients (and
+            # `stc lineage`) resume the walk from this header
+            self.send_header(tracing.HEADER, trace.format())
         self.end_headers()
         self.wfile.write(body)
 
@@ -548,6 +683,11 @@ class _ServeHandler(BaseHTTPRequestHandler):
         if self.path != "/score":
             self._send(404, {"error": f"no route {self.path}"})
             return
+        # inbound causal context: a W3C-traceparent-style X-STC-Trace
+        # header continues the caller's trace (the server works under a
+        # CHILD span of it); no header mints a head-sampled root
+        inbound = tracing.parse(self.headers.get(tracing.HEADER))
+        ctx = inbound.child() if inbound is not None else tracing.mint()
         try:
             length = int(self.headers.get("Content-Length", "0"))
             payload = json.loads(self.rfile.read(length) or b"{}")
@@ -560,19 +700,24 @@ class _ServeHandler(BaseHTTPRequestHandler):
                 )
             names = payload.get("names")
         except (ValueError, json.JSONDecodeError) as exc:
-            self._send(400, {"error": f"bad request: {exc}"})
+            self._send(400, {"error": f"bad request: {exc}"}, trace=ctx)
             return
         try:
-            results = service.submit_texts(texts, names)
+            results = service.submit_texts(texts, names, trace=ctx)
         except ServiceDraining as exc:
-            self._send(503, {"error": str(exc), "status": "draining"})
+            self._send(
+                503, {"error": str(exc), "status": "draining"},
+                trace=ctx,
+            )
             return
         self._send(
             200,
             {
                 "results": results,
                 "model": service.scorer.attribution,
+                "trace": ctx.to_fields(),
             },
+            trace=ctx,
         )
 
 

@@ -34,6 +34,7 @@ from typing import Iterator, List, Optional, Sequence, Tuple
 import numpy as np
 
 from . import telemetry
+from .telemetry import tracing
 from .config import Params
 from .ops.sparse import batch_from_rows, next_pow2, pad_rows
 from .pipeline import TextPreprocessor, is_hashed_vocab, make_vectorizer
@@ -522,6 +523,9 @@ class StreamingScorer:
         telemetry.event(
             "micro_batch", role="score", batch_id=mb.batch_id,
             docs=len(mb), seconds=round(dt, 6),
+            # supervised workers stamp their adopted causal context so
+            # the --causal exporter hangs triggers off the spawn chain
+            **tracing.fields(),
         )
         # trigger boundary = memory-pressure sample point (mem.device.*
         # / mem.host.rss_bytes gauges; no-op when telemetry is off)
@@ -733,6 +737,7 @@ class StreamingOnlineLDA:
                 "micro_batch", role="train", batch_id=mb.batch_id,
                 docs=len(rows), seconds=round(dt, 6),
                 docs_seen=self.docs_seen, step=int(self.state.step),
+                **tracing.fields(),
             )
             # trigger boundary = memory-pressure sample point
             # (mem.device.* / mem.host.rss_bytes gauges)

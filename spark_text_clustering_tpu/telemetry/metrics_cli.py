@@ -51,6 +51,7 @@ __all__ = [
     "flatten_numeric",
     "load_process_streams",
     "merge_metrics",
+    "clock_corrections",
     "skew_findings",
     "ledger_health",
     "fleet_health",
@@ -346,7 +347,8 @@ def skew_findings(streams, merged: Dict[str, Dict],
 
 def _clock_offsets(streams) -> Dict[str, float]:
     """Per-process manifest-timestamp offset from the earliest stream —
-    surfaced (never corrected) so cross-host clock skew is visible."""
+    the RAW reading (manifest ts includes process start order, not just
+    clock skew), kept verbatim in the skew report."""
     ts = {
         s["label"]: s["manifest"].get("ts")
         for s in streams
@@ -356,6 +358,46 @@ def _clock_offsets(streams) -> Dict[str, float]:
         return {}
     t0 = min(ts.values())
     return {lbl: round(t - t0, 6) for lbl, t in ts.items()}
+
+
+def clock_corrections(streams) -> Dict[str, float]:
+    """Per-stream clock CORRECTION in seconds: add it to a stream's
+    timestamps to express them on the anchor (supervisor) clock.
+
+    Sync anchors are the supervisor's ``lease_sync`` events — one
+    (worker-clock ``lease_ts``, supervisor-clock ``observed_ts``) pair
+    per heartbeat renewal.  ``observed - lease`` equals the true clock
+    offset plus the lease write->read latency (bounded by one sweep
+    interval), so the MINIMUM over all renewals is the tightest offset
+    estimate the filesystem protocol admits.  Worker streams pair with
+    their anchors by the ``worker_index`` manifest field; streams with
+    no anchor (the supervisor itself, standalone serve) correct by 0 —
+    correction is a refinement, never a requirement.
+    """
+    out: Dict[str, float] = {s["label"]: 0.0 for s in streams}
+    anchors: Dict[int, List[float]] = {}
+    for s in streams:
+        for e in s["events"]:
+            if e.get("event") != "lease_sync":
+                continue
+            if not (_is_num(e.get("lease_ts"))
+                    and _is_num(e.get("observed_ts"))):
+                continue
+            try:
+                worker = int(e.get("worker", -1))
+            except (TypeError, ValueError):
+                continue
+            anchors.setdefault(worker, []).append(
+                float(e["observed_ts"]) - float(e["lease_ts"])
+            )
+    if not anchors:
+        return out
+    for s in streams:
+        widx = s["manifest"].get("worker_index")
+        if not (_is_num(widx) and int(widx) in anchors):
+            continue
+        out[s["label"]] = round(min(anchors[int(widx)]), 6)
+    return out
 
 
 def cmd_merge(args) -> int:
@@ -375,6 +417,7 @@ def _cmd_merge(args) -> int:
     merged = merge_metrics(streams)
     findings = skew_findings(streams, merged, args.skew_threshold)
     offsets = _clock_offsets(streams)
+    corrections = clock_corrections(streams)
 
     if getattr(args, "json", False):
         doc = {
@@ -385,6 +428,7 @@ def _cmd_merge(args) -> int:
                     "host": s["manifest"].get("host"),
                     "events": len(s["events"]),
                     "clock_offset_s": offsets.get(s["label"]),
+                    "clock_correction_s": corrections.get(s["label"]),
                 }
                 for s in streams
             ],
@@ -401,11 +445,15 @@ def _cmd_merge(args) -> int:
         for s in streams:
             off = offsets.get(s["label"])
             off_s = f", clock_offset={off:+.3f}s" if off is not None else ""
+            corr = corrections.get(s["label"], 0.0)
+            # lease-anchored correction (0 = no anchor); the raw offset
+            # above stays in the report untouched
+            corr_s = f", clock_correction={corr:+.3f}s" if corr else ""
             print(
                 f"  {s['label']}: {s['path']} "
                 f"(run_id={s['manifest'].get('run_id', '?')}, "
                 f"host={s['manifest'].get('host', '?')}, "
-                f"events={len(s['events'])}{off_s})"
+                f"events={len(s['events'])}{off_s}{corr_s})"
             )
         w = max((len(k) for k in merged), default=10)
         print(f"{'metric'.ljust(w)}  {'min':>12}  {'median':>12}  "
@@ -434,7 +482,7 @@ def _cmd_merge(args) -> int:
 
 
 def cmd_trace(args) -> int:
-    from .trace_export import trace_document
+    from .trace_export import causal_trace_document, trace_document
 
     streams, problems = load_process_streams(args.runs)
     for p in problems:
@@ -442,15 +490,31 @@ def cmd_trace(args) -> int:
     if not streams:
         print("no readable run streams to export", file=sys.stderr)
         return 2
-    doc = trace_document(streams)
+    if getattr(args, "causal", False):
+        corrections = clock_corrections(streams)
+        doc = causal_trace_document(streams, corrections)
+        flows = sum(
+            1 for e in doc["traceEvents"] if e.get("ph") == "s"
+        )
+        note = (
+            f", {flows} flow edge(s), clock corrections "
+            + " ".join(
+                f"{lbl}{corr:+.3f}s"
+                for lbl, corr in sorted(corrections.items()) if corr
+            )
+            if flows or any(corrections.values()) else ""
+        )
+    else:
+        doc = trace_document(streams)
+        note = ""
     payload = json.dumps(doc)
     if args.out:
         with open(args.out, "w", encoding="utf-8") as f:
             f.write(payload)
         print(
             f"trace written: {args.out} "
-            f"({len(doc['traceEvents'])} events, {len(streams)} track(s))"
-            f" — load in Perfetto / chrome://tracing"
+            f"({len(doc['traceEvents'])} events, {len(streams)} track(s)"
+            f"{note}) — load in Perfetto / chrome://tracing"
         )
     else:
         print(payload)
@@ -1559,6 +1623,13 @@ def add_metrics_subparser(sub) -> None:
     tc.add_argument(
         "--out", default=None,
         help="write the trace here (default: stdout)",
+    )
+    tc.add_argument(
+        "--causal", action="store_true",
+        help="one shared timeline with lease-anchored clock "
+             "CORRECTIONS and Perfetto flow events joining the causal "
+             "span chain (supervisor -> worker -> serve) across "
+             "process tracks",
     )
     tc.set_defaults(fn=cmd_trace)
 

@@ -139,6 +139,22 @@ METRICS: Dict[str, str] = {
         "wall seconds from telemetry import to the end of this "
         "process's first instrumented dispatch (the cold-start metric "
         "the executable cache exists to shrink)",
+    # -- causal tracing (telemetry.tracing; docs/OBSERVABILITY.md
+    #    "Causal tracing & lineage") ------------------------------------
+    "trace.sampled":
+        "serve requests admitted by head sampling (their trace context "
+        "emits spans and rides the response header)",
+    "trace.dropped":
+        "serve requests minted UNSAMPLED by head sampling (the context "
+        "still propagates; no spans are emitted)",
+    "trace.spans":
+        "completed causal spans emitted to run streams (trace_span "
+        "events the --causal exporter joins into flow chains)",
+    # -- model lineage (stc lineage; spark_text_clustering_tpu/lineage) -
+    "lineage.walks": "lineage walks completed by the stc lineage verb",
+    "lineage.degraded":
+        "lineage reads that degraded typed (torn/corrupt ledger tail, "
+        "unreadable meta, legacy pre-trace records) instead of crashing",
     # -- static analysis (docs/STATIC_ANALYSIS.md) ----------------------
     "lint.findings": "unwaived stc lint findings in the last run",
     "lint.waived": "stc lint findings suppressed by pragma or baseline",

@@ -1,0 +1,389 @@
+"""``stc lineage``: walker semantics, typed degradation, serve request
+spans, and the real supervisor->worker->ledger->publish->serve
+propagation round-trip (subprocess).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from spark_text_clustering_tpu import lineage, telemetry
+from spark_text_clustering_tpu.models.base import LDAModel
+from spark_text_clustering_tpu.models.persistence import save_model
+from spark_text_clustering_tpu.resilience import faultinject
+from spark_text_clustering_tpu.resilience.ledger import EpochLedger
+from spark_text_clustering_tpu.telemetry import tracing
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _reset():
+    telemetry.shutdown()
+    telemetry.get_registry().reset()
+    tracing.install(None)
+    faultinject.reset()
+    yield
+    telemetry.shutdown()
+    telemetry.get_registry().reset()
+    tracing.install(None)
+    faultinject.reset()
+
+
+def _ledgered_model(tmp_path, *, traced=True, publish=True):
+    """A checkpoint-dir ledger with one stream-train epoch and (by
+    default) a model-publish record pinning a saved artifact dir."""
+    if traced:
+        tracing.install(tracing.mint())
+    ckpt = tmp_path / "ckpt"
+    led = EpochLedger(str(ckpt))
+    led.begin(
+        0, kind="stream-train",
+        sources=["/w/a.txt", "/w/b.txt"], payloads=[],
+    )
+    led.commit(
+        0, kind="stream-train", sources=["/w/a.txt", "/w/b.txt"],
+    )
+    model_dir = str(tmp_path / "models" / "LdaModel_EN_1000")
+    rng = np.random.default_rng(0)
+    model = LDAModel(
+        lam=rng.random((2, 16)).astype(np.float32) + 0.1,
+        vocab=[f"h{i}" for i in range(16)],
+        alpha=np.full(2, 0.5, np.float32), eta=0.1,
+    )
+    if publish:
+        save_model(
+            model, model_dir,
+            ledger_ref={"dir": str(ckpt), "epoch": 1},
+        )
+        led.begin(1, kind="model-publish", sources=[], payloads=[])
+        led.commit(
+            1, kind="model-publish", sources=[], model_ref=model_dir,
+        )
+    else:
+        save_model(model, model_dir)
+    tracing.install(None)
+    return str(ckpt), model_dir
+
+
+class TestWalk:
+    def test_model_dir_resolves_publish_and_sources(self, tmp_path):
+        ckpt, model_dir = _ledgered_model(tmp_path)
+        rep = lineage.walk(model_dir)
+        assert rep["kind"] == "model"
+        assert rep["lineage"] == "resolved"
+        assert rep["model"]["publish_epoch"] == 1
+        assert rep["model"]["ledger_dir"] == ckpt
+        assert rep["model"]["publish"]["epoch"] == 1
+        assert rep["model"]["publish"]["trace_id"] != "unknown"
+        assert rep["sources"] == ["/w/a.txt", "/w/b.txt"]
+        (worker,) = rep["workers"]
+        (epoch_row,) = worker["epochs"]
+        assert epoch_row["kind"] == "stream-train"
+        assert epoch_row["trace_id"] == rep["model"]["publish"]["trace_id"]
+
+    def test_legacy_pre_trace_records_degrade_not_crash(self, tmp_path):
+        ckpt, model_dir = _ledgered_model(tmp_path, traced=False)
+        rep = lineage.walk(model_dir)
+        assert rep["lineage"] == "resolved"     # sources still resolve
+        (worker,) = rep["workers"]
+        assert worker["epochs"][0]["trace_id"] == "unknown"
+        assert any("predates causal tracing" in d for d in rep["degraded"])
+
+    def test_compacted_ledger_still_resolves_sources(self, tmp_path):
+        ckpt, model_dir = _ledgered_model(tmp_path)
+        led = EpochLedger(ckpt)
+        assert led.compact() is not None
+        rep = lineage.walk(model_dir)
+        assert rep["sources"] == ["/w/a.txt", "/w/b.txt"]
+        assert rep["lineage"] == "resolved"
+        assert any("compacted" in d for d in rep["degraded"])
+        # the snapshot pins the publish model_ref, so the publish still
+        # attributes (epoch number = the newest committed epoch)
+        assert rep["model"]["publish"]["model_ref"] == model_dir
+
+    def test_torn_ledger_tail_degrades_typed(self, tmp_path):
+        ckpt, model_dir = _ledgered_model(tmp_path)
+        path = os.path.join(ckpt, "epochs.jsonl")
+        with open(path, "r+", encoding="utf-8") as f:
+            lines = f.readlines()
+            f.seek(0)
+            f.truncate()
+            # corrupt a NON-final line: the suffix is untrusted and the
+            # ledger read raises CorruptArtifactError
+            lines[0] = lines[0][: len(lines[0]) // 2] + "\n"
+            f.writelines(lines)
+        rep = lineage.walk(model_dir)
+        assert rep["lineage"] == "unknown"
+        assert any("unreadable ledger" in d for d in rep["degraded"])
+
+    def test_lineage_read_fault_degrades_typed(self, tmp_path):
+        ckpt, model_dir = _ledgered_model(tmp_path)
+        telemetry.configure(None)
+        faultinject.configure("lineage.read:ioerror@1.0")
+        rep = lineage.walk(model_dir)
+        assert rep["lineage"] == "unknown"
+        assert rep["degraded"]
+        assert telemetry.get_registry().counter(
+            "lineage.degraded"
+        ).value >= 1
+
+    def test_unresolvable_target(self, tmp_path):
+        rep = lineage.walk(str(tmp_path / "nope"))
+        assert rep["kind"] == "unknown"
+        assert rep["lineage"] == "unknown"
+
+    def test_response_json_and_trace_id_targets(self, tmp_path):
+        ckpt, model_dir = _ledgered_model(tmp_path)
+        trace_id = "ab" * 16
+        resp = {
+            "results": [{"name": "d0", "topic": 1}],
+            "model": {
+                "model": model_dir,
+                "epoch": 1,
+                "ledger_ref": {"dir": ckpt, "epoch": 1},
+            },
+            "trace": {"trace_id": trace_id, "span_id": "cd" * 8},
+        }
+        resp_path = tmp_path / "response.json"
+        resp_path.write_text(json.dumps(resp))
+        rep = lineage.walk(str(resp_path))
+        assert rep["kind"] == "response"
+        assert rep["trace_id"] == trace_id
+        assert rep["model"]["publish_epoch"] == 1
+        assert rep["sources"] == ["/w/a.txt", "/w/b.txt"]
+        # a bare trace id resolves through a telemetry stream's
+        # trace_request event
+        tel = tmp_path / "serve.jsonl"
+        tel.write_text(
+            json.dumps({"event": "manifest", "schema": 1, "ts": 1.0,
+                        "run_id": "t", "kind": "serve"}) + "\n"
+            + json.dumps({"ts": 2.0, "event": "trace_request",
+                          "trace_id": trace_id, "span_id": "cd" * 8,
+                          "model": model_dir, "epoch": 1}) + "\n"
+        )
+        rep2 = lineage.walk(
+            trace_id, ledger_dir=ckpt, telemetry_paths=[str(tel)],
+        )
+        assert rep2["kind"] == "trace"
+        assert rep2["model"]["dir"] == model_dir
+        assert rep2["sources"] == ["/w/a.txt", "/w/b.txt"]
+
+    def test_span_attribution_counts_unattributed(self):
+        trace_id = "12" * 16
+        events = [
+            {"event": "trace_request", "trace_id": trace_id,
+             "span_id": "aa" * 8},
+            {"event": "trace_span", "trace_id": trace_id,
+             "name": "serve.request", "span_id": "aa" * 8},
+            {"event": "trace_span", "trace_id": trace_id,
+             "name": "serve.vectorize", "span_id": "bb" * 8,
+             "parent_span_id": "aa" * 8},
+            # orphan: parent never emitted
+            {"event": "trace_span", "trace_id": trace_id,
+             "name": "serve.mystery", "span_id": "cc" * 8,
+             "parent_span_id": "ee" * 8},
+            # other trace: ignored
+            {"event": "trace_span", "trace_id": "34" * 16,
+             "name": "other", "span_id": "dd" * 8},
+        ]
+        spans = lineage.span_attribution(events, trace_id)
+        assert spans["total"] == 3
+        assert spans["unattributed"] == 1
+        assert spans["unattributed_names"] == ["serve.mystery"]
+
+    def test_cli_verb_renders_tree_and_json(self, tmp_path, capsys):
+        from spark_text_clustering_tpu.cli import main
+
+        ckpt, model_dir = _ledgered_model(tmp_path)
+        assert main(["lineage", model_dir]) == 0
+        out = capsys.readouterr().out
+        assert "committed source set (2)" in out
+        assert "published by epoch 1" in out
+        assert main(["lineage", model_dir, "--json"]) == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["model"]["publish_epoch"] == 1
+        assert main(["lineage", str(tmp_path / "missing")]) == 3
+
+
+# ---------------------------------------------------------------------------
+# serve request spans (in-process service)
+# ---------------------------------------------------------------------------
+class TestServeSpans:
+    def _served(self, tmp_path, trace=None, sample_env=None,
+                monkeypatch=None):
+        from tests.test_serving import VOCAB, _model, _service
+
+        if sample_env is not None:
+            monkeypatch.setenv(tracing.ENV_SAMPLE, sample_env)
+        models = str(tmp_path / "models")
+        save_model(_model(0), os.path.join(models, "LdaModel_EN_1000"))
+        telemetry.configure(str(tmp_path / "serve.jsonl"))
+        telemetry.manifest(kind="serve")
+        svc = _service(models)
+        try:
+            out = svc.submit_texts(
+                [" ".join(VOCAB[:5])], trace=trace,
+            )
+        finally:
+            svc.begin_drain(timeout=10)
+        telemetry.shutdown()
+        events = [
+            json.loads(ln)
+            for ln in open(tmp_path / "serve.jsonl", encoding="utf-8")
+        ]
+        return out, events
+
+    def test_sampled_request_emits_linked_span_chain(self, tmp_path):
+        ctx = tracing.mint()
+        out, events = self._served(tmp_path, trace=ctx)
+        assert "topic" in out[0]
+        spans = lineage.span_attribution(events, ctx.trace_id)
+        assert spans["total"] == 4
+        assert spans["unattributed"] == 0
+        assert spans["names"] == [
+            "serve.batch_wait", "serve.dispatch", "serve.request",
+            "serve.vectorize",
+        ]
+        (req,) = [
+            e for e in events if e.get("event") == "trace_request"
+        ]
+        assert req["trace_id"] == ctx.trace_id
+        assert req["span_id"] == ctx.span_id
+
+    def test_unsampled_request_propagates_without_spans(
+        self, tmp_path,
+    ):
+        ctx = tracing.mint(sampled=False)
+        out, events = self._served(tmp_path, trace=ctx)
+        assert "topic" in out[0]    # scoring unaffected
+        assert not [
+            e for e in events if e.get("event") == "trace_span"
+        ]
+
+    def test_sampled_dropped_counter_pair(self, tmp_path):
+        from tests.test_serving import VOCAB, _model, _service
+
+        models = str(tmp_path / "models")
+        save_model(_model(0), os.path.join(models, "LdaModel_EN_1000"))
+        telemetry.configure(None)
+        svc = _service(models)
+        try:
+            svc.submit_texts([" ".join(VOCAB[:4])],
+                             trace=tracing.mint(sampled=True))
+            svc.submit_texts([" ".join(VOCAB[:4])],
+                             trace=tracing.mint(sampled=False))
+        finally:
+            svc.begin_drain(timeout=10)
+        reg = telemetry.get_registry()
+        assert reg.counter("trace.sampled").value == 1
+        assert reg.counter("trace.dropped").value == 1
+
+
+# ---------------------------------------------------------------------------
+# the real chain: supervisor -> worker -> ledger -> publish -> serve
+# ---------------------------------------------------------------------------
+def test_subprocess_chain_one_trace_id_end_to_end(tmp_path):
+    """A real 2-worker supervised stream-train fleet (subprocess CLI),
+    then an in-process scoring service over the published model: ONE
+    trace id must connect the supervisor's fleet records, both workers'
+    committed epochs, the model-publish record, and the served
+    response's publish attribution — and `stc lineage` must walk it."""
+    watch = tmp_path / "watch"
+    watch.mkdir()
+    pools = ["piano violin orchestra symphony concerto melody",
+             "electron proton neutron quantum particle physics"]
+    for i in range(4):
+        (watch / f"doc{i:02d}.txt").write_text(f"{pools[i % 2]} tok{i}")
+    fleet = str(tmp_path / "fleet")
+    models = str(tmp_path / "models")
+    wtel = str(tmp_path / "wtel")
+    env = dict(os.environ)
+    env.pop(faultinject.ENV_SPEC, None)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    r = subprocess.run(
+        [sys.executable, "-m", "spark_text_clustering_tpu.cli",
+         "supervise", "--role", "stream-train",
+         "--watch-dir", str(watch), "--fleet-dir", fleet,
+         "--workers", "2", "--heartbeat-interval", "0.2",
+         "--lease-timeout", "8", "--grace-seconds", "2",
+         "--sweep-interval", "0.15", "--poll-interval", "0.05",
+         "--idle-timeout", "1.0", "--no-lemmatize",
+         "--k", "2", "--hash-features", "64", "--seed", "3",
+         "--checkpoint-interval", "1", "--models-dir", models,
+         "--worker-telemetry-dir", wtel,
+         "--telemetry-file", str(tmp_path / "sup.jsonl")],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=300,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+
+    # ONE trace id across the supervisor's fleet ledger and every
+    # worker's committed records
+    from spark_text_clustering_tpu.resilience.supervisor import (
+        FleetLedger,
+    )
+
+    (root_id,) = {
+        rec["trace_id"] for rec in FleetLedger(fleet).records()
+    }
+    publishes = {}
+    for w in ("w000", "w001"):
+        recs = EpochLedger(os.path.join(fleet, w)).records()
+        assert recs, f"{w}: no committed epochs"
+        for rec in recs:
+            assert rec["trace"]["trace_id"] == root_id, (w, rec)
+            assert rec["worker"] == int(w[1:])
+        pub = [r_ for r_ in recs if r_["kind"] == "model-publish"]
+        assert len(pub) == 1
+        publishes[w] = pub[0]
+
+    # the per-worker run streams adopted the same trace
+    for name in sorted(os.listdir(wtel)):
+        events = [
+            json.loads(ln)
+            for ln in open(os.path.join(wtel, name), encoding="utf-8")
+        ]
+        (adopt,) = [
+            e for e in events if e.get("event") == "trace_adopt"
+        ]
+        assert adopt["trace_id"] == root_id
+
+    # serve the w000-published model in process: the response's publish
+    # attribution must point back at the SAME trace id
+    from tests.test_serving import _service
+
+    telemetry.configure(str(tmp_path / "serve.jsonl"))
+    telemetry.manifest(kind="serve")
+    svc = _service(os.path.join(models, "w000"), token_buckets=(256,))
+    ctx = tracing.mint()
+    try:
+        (res,) = svc.submit_texts([pools[0]], trace=ctx)
+    finally:
+        svc.begin_drain(timeout=10)
+    telemetry.shutdown()
+    assert "topic" in res
+    attr = svc.scorer.attribution
+    assert attr["publish_trace"]["trace_id"] == root_id
+    assert attr["epoch"] == publishes["w000"]["epoch"]
+
+    # and `stc lineage` from a saved response resolves the chain
+    resp_path = tmp_path / "response.json"
+    resp_path.write_text(json.dumps({
+        "results": [res], "model": attr, "trace": ctx.to_fields(),
+    }))
+    rep = lineage.walk(
+        str(resp_path), fleet_dir=fleet,
+        telemetry_paths=[str(tmp_path / "serve.jsonl")],
+    )
+    assert rep["lineage"] == "resolved"
+    assert rep["model"]["publish"]["epoch"] == publishes["w000"]["epoch"]
+    assert rep["sources"] == sorted(
+        str(watch / n) for n in os.listdir(watch)
+    )
+    assert {w["worker"] for w in rep["workers"]} == {0, 1}
+    assert rep["spans"]["unattributed"] == 0
+    assert rep["spans"]["total"] == 4

@@ -1,0 +1,367 @@
+"""Causal trace context: wire format, propagation hops, clock
+correction, and the --causal flow-event export schema.
+
+Pure host-side tests (no jax, no subprocess): the real
+supervisor->worker->ledger->publish->serve chain is exercised in
+tests/test_lineage.py (subprocess) and CI gate 14.
+"""
+
+import json
+import math
+import os
+
+import pytest
+
+from spark_text_clustering_tpu import telemetry
+from spark_text_clustering_tpu.resilience import faultinject
+from spark_text_clustering_tpu.resilience.ledger import (
+    EpochLedger,
+    record_checksum,
+)
+from spark_text_clustering_tpu.telemetry import tracing
+from spark_text_clustering_tpu.telemetry.metrics_cli import (
+    clock_corrections,
+    load_process_streams,
+)
+from spark_text_clustering_tpu.telemetry.trace_export import (
+    causal_trace_document,
+    trace_document,
+)
+
+
+@pytest.fixture(autouse=True)
+def _reset():
+    telemetry.shutdown()
+    telemetry.get_registry().reset()
+    tracing.install(None)
+    faultinject.reset()
+    yield
+    telemetry.shutdown()
+    telemetry.get_registry().reset()
+    tracing.install(None)
+    faultinject.reset()
+
+
+# ---------------------------------------------------------------------------
+# wire format
+# ---------------------------------------------------------------------------
+class TestContext:
+    def test_format_parse_roundtrip(self):
+        ctx = tracing.mint()
+        back = tracing.parse(ctx.format())
+        assert back is not None
+        assert back.trace_id == ctx.trace_id
+        assert back.span_id == ctx.span_id
+        assert back.sampled is True
+
+    def test_unsampled_flag_roundtrip(self):
+        ctx = tracing.mint(sampled=False)
+        assert ctx.format().endswith("-00")
+        back = tracing.parse(ctx.format())
+        assert back.sampled is False
+
+    @pytest.mark.parametrize("bad", [
+        None, "", "junk", "00-zz-aa-01",
+        "00-" + "0" * 32 + "-" + "1" * 16 + "-01",   # all-zero trace id
+        "00-" + "a" * 32 + "-" + "0" * 16 + "-01",   # all-zero span id
+        "00-" + "a" * 31 + "-" + "b" * 16 + "-01",   # short trace id
+    ])
+    def test_malformed_reads_as_no_context(self, bad):
+        assert tracing.parse(bad) is None
+
+    def test_child_links_parent_and_keeps_trace(self):
+        root = tracing.mint()
+        kid = root.child()
+        assert kid.trace_id == root.trace_id
+        assert kid.parent_span_id == root.span_id
+        assert kid.span_id != root.span_id
+        assert kid.sampled == root.sampled
+
+    def test_head_sampling_rates(self, monkeypatch):
+        monkeypatch.setenv(tracing.ENV_SAMPLE, "0")
+        assert tracing.mint().sampled is False
+        monkeypatch.setenv(tracing.ENV_SAMPLE, "1")
+        assert tracing.mint().sampled is True
+        monkeypatch.setenv(tracing.ENV_SAMPLE, "not-a-rate")
+        assert tracing.mint().sampled is True   # malformed: sample all
+
+    def test_env_adopt_installs_child(self, monkeypatch):
+        root = tracing.mint()
+        monkeypatch.setenv(tracing.ENV_CONTEXT, root.format())
+        adopted = tracing.adopt_env()
+        assert adopted is tracing.current()
+        assert adopted.trace_id == root.trace_id
+        assert adopted.parent_span_id == root.span_id
+        monkeypatch.delenv(tracing.ENV_CONTEXT)
+        tracing.install(None)
+        assert tracing.adopt_env() is None
+        assert tracing.current() is None
+
+    def test_env_for_child_roundtrip(self):
+        ctx = tracing.mint()
+        env = tracing.env_for_child(ctx)
+        assert tracing.parse(env[tracing.ENV_CONTEXT]) == ctx
+        assert tracing.env_for_child(None) == {}
+
+    def test_fields_flat_record(self):
+        assert tracing.fields() == {}
+        ctx = tracing.install(tracing.mint().child())
+        f = tracing.fields()
+        assert f["trace_id"] == ctx.trace_id
+        assert f["span_id"] == ctx.span_id
+        assert f["parent_span_id"] == ctx.parent_span_id
+
+
+# ---------------------------------------------------------------------------
+# ledger propagation hop
+# ---------------------------------------------------------------------------
+class TestLedgerStamping:
+    def test_commit_records_carry_child_span(self, tmp_path):
+        ctx = tracing.install(tracing.mint())
+        led = EpochLedger(str(tmp_path))
+        led.begin(0, kind="stream-train", sources=["a.txt"], payloads=[])
+        rec = led.commit(0, kind="stream-train", sources=["a.txt"])
+        trace = rec["trace"]
+        assert trace["trace_id"] == ctx.trace_id
+        assert trace["parent_span_id"] == ctx.span_id
+        assert trace["span_id"] != ctx.span_id
+        # the record is still checksum-consistent on re-read
+        (back,) = led.records()
+        assert record_checksum(back) == back["checksum"]
+        assert back["trace"] == trace
+        # the staged intent carried the PROCESS span
+        intent = json.loads(
+            (tmp_path / "epoch-000001.intent.json").read_text()
+        ) if (tmp_path / "epoch-000001.intent.json").exists() else None
+        assert intent is None  # commit cleaned it up
+
+    def test_untraced_process_commits_legacy_records(self, tmp_path):
+        led = EpochLedger(str(tmp_path))
+        led.begin(0, kind="stream-score", sources=[], payloads=[])
+        rec = led.commit(0, kind="stream-score", sources=[])
+        assert "trace" not in rec
+
+
+# ---------------------------------------------------------------------------
+# synthetic stream builders
+# ---------------------------------------------------------------------------
+def _stream(path, *, kind, ts, events, **manifest):
+    with open(path, "w", encoding="utf-8") as f:
+        f.write(json.dumps({
+            "event": "manifest", "schema": 1, "run_id": f"t-{kind}",
+            "kind": kind, "ts": ts, **manifest,
+        }) + "\n")
+        for e in events:
+            f.write(json.dumps(e) + "\n")
+    return str(path)
+
+
+def _chain_fixture(tmp_path, *, worker_offset=0.0, latency=0.01):
+    """A supervisor + worker + serve stream triple whose causal chain is
+    fully linked: spawn -> adopt -> commit(publish) -> request, with the
+    worker's clock skewed by ``worker_offset`` seconds."""
+    t = 1_000_000.0
+    sup_root = "a" * 32
+    spawn_span, adopt_span = "b" * 16, "c" * 16
+    pub_span = "d" * 16
+    req_trace, req_span = "e" * 32, "f" * 16
+    sup = _stream(
+        tmp_path / "sup.jsonl", kind="supervise", ts=t,
+        events=[
+            {"ts": t + 0.1, "event": "fleet_spawn", "worker": 0,
+             "trace_id": sup_root, "span_id": spawn_span},
+            # three renewals; the tightest latency wins
+            *[
+                {"ts": t + 1 + i, "event": "lease_sync", "worker": 0,
+                 "lease_ts": t + 1 + i - worker_offset - latency,
+                 "observed_ts": t + 1 + i}
+                for i in range(3)
+            ],
+        ],
+    )
+    wrk = _stream(
+        tmp_path / "wrk.jsonl", kind="stream-train",
+        ts=t + 0.5 - worker_offset, worker_index=0, process_index=0,
+        events=[
+            {"ts": t + 0.6 - worker_offset, "event": "trace_adopt",
+             "trace_id": sup_root, "span_id": adopt_span,
+             "parent_span_id": spawn_span},
+            {"ts": t + 2.0 - worker_offset, "event": "ledger_commit",
+             "epoch": 1, "kind": "model-publish", "sources": 0,
+             "payloads": 0, "trace_id": sup_root, "span_id": pub_span,
+             "parent_span_id": adopt_span},
+        ],
+    )
+    srv = _stream(
+        tmp_path / "srv.jsonl", kind="serve", ts=t + 3,
+        events=[
+            {"ts": t + 4.0, "event": "trace_request",
+             "trace_id": req_trace, "span_id": req_span,
+             "publish_trace_id": sup_root,
+             "publish_span_id": pub_span},
+            {"ts": t + 4.1, "event": "trace_span",
+             "name": "serve.request", "trace_id": req_trace,
+             "span_id": req_span, "start": t + 4.0, "seconds": 0.1},
+            {"ts": t + 4.1, "event": "trace_span",
+             "name": "serve.dispatch", "trace_id": req_trace,
+             "span_id": "9" * 16, "parent_span_id": req_span,
+             "start": t + 4.05, "seconds": 0.04},
+        ],
+    )
+    return [sup, wrk, srv], {
+        "sup_root": sup_root, "spawn": spawn_span, "adopt": adopt_span,
+        "publish": pub_span, "req": req_span,
+    }
+
+
+# ---------------------------------------------------------------------------
+# clock correction
+# ---------------------------------------------------------------------------
+class TestClockCorrection:
+    def test_planted_offset_recovered_within_latency(self, tmp_path):
+        offset, latency = -5.0, 0.01
+        paths, _ = _chain_fixture(
+            tmp_path, worker_offset=offset, latency=latency,
+        )
+        streams, problems = load_process_streams(paths)
+        assert not problems
+        corr = clock_corrections(streams)
+        by_kind = {
+            s["manifest"]["kind"]: corr[s["label"]] for s in streams
+        }
+        # anchor + serve streams correct by 0; the worker's correction
+        # recovers the planted offset up to the write->read latency
+        assert by_kind["supervise"] == 0.0
+        assert by_kind["serve"] == 0.0
+        assert math.isclose(
+            by_kind["stream-train"], offset + latency,
+            abs_tol=1e-6,
+        )
+
+    def test_no_anchors_means_zero_everywhere(self, tmp_path):
+        p = _stream(
+            tmp_path / "solo.jsonl", kind="train", ts=10.0, events=[],
+        )
+        (streams, _) = load_process_streams([p])
+        assert clock_corrections(streams) == {"p0": 0.0}
+
+
+# ---------------------------------------------------------------------------
+# --causal export schema pins
+# ---------------------------------------------------------------------------
+class TestCausalExport:
+    def _export(self, tmp_path, **kw):
+        paths, ids = _chain_fixture(tmp_path, **kw)
+        streams, _ = load_process_streams(paths)
+        doc = causal_trace_document(
+            streams, clock_corrections(streams)
+        )
+        return doc, ids
+
+    def test_flow_event_schema(self, tmp_path):
+        doc, ids = self._export(tmp_path)
+        ev = doc["traceEvents"]
+        starts = [e for e in ev if e["ph"] == "s"]
+        finishes = [e for e in ev if e["ph"] == "f"]
+        assert starts and len(starts) == len(finishes)
+        by_id_s = {e["id"]: e for e in starts}
+        by_id_f = {e["id"]: e for e in finishes}
+        assert set(by_id_s) == set(by_id_f)
+        for fid, s in by_id_s.items():
+            f = by_id_f[fid]
+            # schema pins: binding-point "e", shared non-zero id,
+            # monotone timestamps, integer pids
+            assert f["bp"] == "e"
+            assert fid != 0
+            assert s["ts"] <= f["ts"]
+            for half in (s, f):
+                assert isinstance(half["pid"], int)
+                assert half["tid"] == 0
+                assert half["cat"] in ("trace", "lineage")
+
+    def test_chain_spans_three_pids_and_lineage_link(self, tmp_path):
+        doc, ids = self._export(tmp_path)
+        ev = doc["traceEvents"]
+        slices = {
+            e["args"]["span_id"]: e for e in ev
+            if e["ph"] == "X" and isinstance(e.get("args"), dict)
+            and e["args"].get("span_id")
+        }
+        # every hop rendered, each on its own pid track
+        chain = [ids["spawn"], ids["adopt"], ids["publish"], ids["req"]]
+        assert all(sid in slices for sid in chain)
+        assert len({slices[s]["pid"] for s in chain}) == 3
+        # the publish->request join is a LINEAGE flow pair
+        lineage = [e for e in ev if e.get("cat") == "lineage"]
+        assert len(lineage) == 2
+        assert {e["ph"] for e in lineage} == {"s", "f"}
+        assert lineage[0]["pid"] != lineage[1]["pid"]
+
+    def test_corrected_clocks_align_the_commit(self, tmp_path):
+        """With a -5s planted skew the publish commit must still land
+        BETWEEN the spawn and the serve request on the shared
+        timeline — the uncorrected ordering would be nonsense."""
+        doc, ids = self._export(tmp_path, worker_offset=-5.0)
+        ev = doc["traceEvents"]
+        ts = {
+            e["args"]["span_id"]: e["ts"] for e in ev
+            if e["ph"] == "X" and isinstance(e.get("args"), dict)
+            and e["args"].get("span_id")
+        }
+        assert ts[ids["spawn"]] < ts[ids["publish"]] < ts[ids["req"]]
+
+    def test_default_export_unchanged_shape(self, tmp_path):
+        """The non-causal exporter keeps its per-stream-rebased shape:
+        no flow phases, pids from process_index."""
+        paths, _ = _chain_fixture(tmp_path)
+        streams, _ = load_process_streams(paths)
+        doc = trace_document(streams)
+        assert all(
+            e["ph"] in ("M", "X", "i") for e in doc["traceEvents"]
+        )
+
+    def test_span_counter_and_emission(self, tmp_path):
+        telemetry.configure(str(tmp_path / "out.jsonl"))
+        telemetry.manifest(kind="t")
+        ctx = tracing.mint()
+        tracing.emit_span(
+            "serve.request", trace_id=ctx.trace_id,
+            span_id=ctx.span_id, start=1.0, seconds=0.5,
+        )
+        assert telemetry.get_registry().counter(
+            "trace.spans"
+        ).value == 1
+        telemetry.shutdown()
+        recs = [
+            json.loads(ln)
+            for ln in open(tmp_path / "out.jsonl", encoding="utf-8")
+        ]
+        (span,) = [r for r in recs if r["event"] == "trace_span"]
+        assert span["name"] == "serve.request"
+        assert span["start"] == 1.0 and span["seconds"] == 0.5
+        assert span["trace_id"] == ctx.trace_id
+
+    def test_emit_span_disabled_is_noop(self, tmp_path):
+        ctx = tracing.mint()
+        tracing.emit_span(
+            "serve.request", trace_id=ctx.trace_id,
+            span_id=ctx.span_id, start=1.0, seconds=0.5,
+        )
+        assert telemetry.get_registry().counter(
+            "trace.spans"
+        ).value == 0
+
+
+# ---------------------------------------------------------------------------
+# names/sites registration pins
+# ---------------------------------------------------------------------------
+class TestRegistrations:
+    def test_trace_and_lineage_families_declared(self):
+        from spark_text_clustering_tpu.telemetry import names
+
+        for n in ("trace.sampled", "trace.dropped", "trace.spans",
+                  "lineage.walks", "lineage.degraded"):
+            assert names.declared(n), n
+
+    def test_lineage_read_fault_site_registered(self):
+        assert "lineage.read" in faultinject.SITES
