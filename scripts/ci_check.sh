@@ -92,14 +92,26 @@
 #      render the request's chain across >= 3 process tracks connected
 #      by flow events with lease-anchored clock corrections; the serve
 #      run's counter.trace.* gate against the committed baseline
+#  15. scale audit (`stc lint --scale`, analysis/scale_audit,
+#      docs/STATIC_ANALYSIS.md "Scale audit"): every registered jitted
+#      entry point traced ABSTRACTLY at its declared V=10M/k=500 scale
+#      shapes on the CPU sandbox (ShapeDtypeStruct avals — no giant
+#      buffers materialized) and gated on rules STC210-215
+#      (trace-at-scale, recompile/bucketing hazards, static per-chip
+#      HBM budget vs the roofline peaks table, sharding-propagation
+#      gaps, collective bytes per step, scale-only dtype promotion)
+#      plus drift vs the committed scripts/records/scale_baseline.json
+#      evidence record; the run's lint.scale_* counters gate against
+#      the committed baseline, and a planted STC211 recompile hazard +
+#      a planted STC212 HBM breach must both gate red (self-test)
 #
 # Usage:
-#   scripts/ci_check.sh                 # run all fourteen gates
+#   scripts/ci_check.sh                 # run all fifteen gates
 #   scripts/ci_check.sh --rebaseline    # recapture ALL baselines
 #                                       # (metrics + lint waivers +
-#                                       # lint counters + compile
-#                                       # signatures; commit the
-#                                       # result deliberately)
+#                                       # lint counters + scale record
+#                                       # + compile signatures; commit
+#                                       # the result deliberately)
 set -uo pipefail
 cd "$(dirname "$0")/.."
 
@@ -1046,7 +1058,11 @@ EOF
 }
 
 if [[ "${1:-}" == "--rebaseline" ]]; then
-    python -m spark_text_clustering_tpu.cli lint --rebaseline || exit 1
+    # --scale: regenerate the waiver allowlist AND the committed scale
+    # evidence record (scripts/records/scale_baseline.json) together —
+    # a waiver-only rewrite would drop the scale:* entries
+    python -m spark_text_clustering_tpu.cli lint --scale --rebaseline \
+        || exit 1
     work=$(mktemp -d)
     trap 'rm -rf "$work"' EXIT
     run_ci_train "$work" || exit 1
@@ -1054,12 +1070,19 @@ if [[ "${1:-}" == "--rebaseline" ]]; then
         --baseline "$BASELINE" --write-baseline --tolerance 0.0 \
         "${EXCLUDES[@]}" || exit 1
     # fold the lint counters into the same baseline (partial capture:
-    # only the lint. family is refreshed, training entries stay put)
+    # only the lint. family is refreshed, training entries stay put);
+    # the plain stream owns lint.findings/waived, the gate-15 scale
+    # stream owns lint.scale_*
     python -m spark_text_clustering_tpu.cli lint \
         --telemetry-file "$work/lint.jsonl" >/dev/null || exit 1
     python -m spark_text_clustering_tpu.cli metrics check "$work/lint.jsonl" \
         --baseline "$BASELINE" --write-baseline --tolerance 0.0 \
-        --include lint. || exit 1
+        --include lint. --exclude lint.scale || exit 1
+    python -m spark_text_clustering_tpu.cli lint --scale \
+        --telemetry-file "$work/lint_scale.jsonl" >/dev/null || exit 1
+    python -m spark_text_clustering_tpu.cli metrics check \
+        "$work/lint_scale.jsonl" --baseline "$BASELINE" \
+        --write-baseline --tolerance 0.0 --include lint.scale || exit 1
     # fold the exactly-once drill's ledger counters the same way
     run_ledger_drill "$work" || exit 1
     python -m spark_text_clustering_tpu.cli metrics check \
@@ -1114,12 +1137,12 @@ fail=0
 work=$(mktemp -d)
 trap 'rm -rf "$work"' EXIT
 
-echo "== [1/14] stc lint (AST rules + jaxpr audit) =="
+echo "== [1/15] stc lint (AST rules + jaxpr audit) =="
 python -m spark_text_clustering_tpu.cli lint \
     --telemetry-file "$work/lint.jsonl"
 if [[ $? -ne 0 ]]; then echo "FAIL: stc lint"; fail=1; fi
 
-echo "== [2/14] ruff (generic-Python tier) =="
+echo "== [2/15] ruff (generic-Python tier) =="
 if command -v ruff >/dev/null 2>&1; then
     ruff check spark_text_clustering_tpu
     if [[ $? -ne 0 ]]; then echo "FAIL: ruff"; fail=1; fi
@@ -1127,17 +1150,17 @@ else
     echo "ruff not installed — skipped (stc lint STC101/102/006 cover it)"
 fi
 
-echo "== [3/14] tier-1 tests =="
+echo "== [3/15] tier-1 tests =="
 timeout -k 10 870 python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors -p no:cacheprovider \
     -p no:xdist -p no:randomly
 if [[ $? -ne 0 ]]; then echo "FAIL: tier-1"; fail=1; fi
 
-echo "== [4/14] telemetry overhead budget =="
+echo "== [4/15] telemetry overhead budget =="
 python scripts/check_telemetry_overhead.py
 if [[ $? -ne 0 ]]; then echo "FAIL: telemetry overhead"; fail=1; fi
 
-echo "== [5/14] metrics regression gate =="
+echo "== [5/15] metrics regression gate =="
 if run_ci_train "$work"; then
     # lint., ledger., fleet., serve., and alert. families are captured
     # by their own gates (1/6, 8, 10, 11, and 12) — a batch train run
@@ -1153,17 +1176,18 @@ else
     fail=1
 fi
 
-echo "== [6/14] lint metrics gate (waiver count version-gated) =="
+echo "== [6/15] lint metrics gate (waiver count version-gated) =="
 if [[ -s "$work/lint.jsonl" ]]; then
+    # lint.scale_* belong to the gate-15 --scale stream, not stage 1's
     python -m spark_text_clustering_tpu.cli metrics check "$work/lint.jsonl" \
-        --baseline "$BASELINE" --include lint.
+        --baseline "$BASELINE" --include lint. --exclude lint.scale
     if [[ $? -ne 0 ]]; then echo "FAIL: lint metrics check"; fail=1; fi
 else
     echo "FAIL: no lint telemetry stream from stage 1"
     fail=1
 fi
 
-echo "== [7/14] cross-host skew gate (metrics merge) =="
+echo "== [7/15] cross-host skew gate (metrics merge) =="
 if make_skew_streams "$work"; then
     python -m spark_text_clustering_tpu.cli metrics merge \
         "$work/skew-p0.jsonl" "$work/skew-p1.jsonl" --fail-on-skew \
@@ -1184,7 +1208,7 @@ else
     fail=1
 fi
 
-echo "== [8/14] exactly-once ledger chaos drill (STC_FAULTS) =="
+echo "== [8/15] exactly-once ledger chaos drill (STC_FAULTS) =="
 if run_ledger_drill "$work"; then
     python -m spark_text_clustering_tpu.cli metrics check \
         "$work/ledger_drill.jsonl" --baseline "$BASELINE" \
@@ -1195,7 +1219,7 @@ else
     fail=1
 fi
 
-echo "== [9/14] recompile sentinel (metrics compile-check) =="
+echo "== [9/15] recompile sentinel (metrics compile-check) =="
 if [[ -s "$work/run.jsonl" ]] && run_ci_score "$work" \
     && run_ci_nmf "$work"; then
     python -m spark_text_clustering_tpu.cli metrics compile-check \
@@ -1222,7 +1246,7 @@ else
     fail=1
 fi
 
-echo "== [10/14] supervisor drill (lease expiry -> SIGKILL -> respawn) =="
+echo "== [10/15] supervisor drill (lease expiry -> SIGKILL -> respawn) =="
 if run_supervisor_drill "$work"; then
     # the ladder's counters are deterministic: 3 spawns (2 + 1
     # respawn), 1 lease expiry, 1 preemption (the drain SIGTERM the
@@ -1236,7 +1260,7 @@ else
     fail=1
 fi
 
-echo "== [11/14] serve drill (hot-swap + drain + zero-recompile) =="
+echo "== [11/15] serve drill (hot-swap + drain + zero-recompile) =="
 if [[ -d "$work/models" ]] && run_serve_drill "$work"; then
     # requests (32 = two exact 16-doc volleys) and swaps (1) are
     # machine-independent; batch counts depend on coalescing timing
@@ -1250,7 +1274,7 @@ else
     fail=1
 fi
 
-echo "== [12/14] monitor drill (alerts fire/resolve + resize-on-alert) =="
+echo "== [12/15] monitor drill (alerts fire/resolve + resize-on-alert) =="
 if run_monitor_once_drill "$work"; then
     # the --once storm run's alert counters are deterministic: exactly
     # one firing (retrace_storm), nothing pending/resolved
@@ -1271,7 +1295,7 @@ if ! run_monitor_resize_drill "$work"; then
     fail=1
 fi
 
-echo "== [13/14] executable-cache cold-start drill (compilecache) =="
+echo "== [13/15] executable-cache cold-start drill (compilecache) =="
 if [[ -d "$work/models" ]] && run_cold_start_drill "$work"; then
     # the warm B run's cache counters are deterministic: one hit per
     # score-path digest, zero misses/stores/invalidations
@@ -1284,7 +1308,7 @@ else
     fail=1
 fi
 
-echo "== [14/14] end-to-end lineage drill (causal tracing) =="
+echo "== [14/15] end-to-end lineage drill (causal tracing) =="
 if run_lineage_drill "$work"; then
     # the serve run's trace counters are deterministic: ONE sampled
     # request, four emitted spans, nothing dropped
@@ -1294,6 +1318,78 @@ if run_lineage_drill "$work"; then
     if [[ $? -ne 0 ]]; then echo "FAIL: lineage trace counters"; fail=1; fi
 else
     echo "FAIL: end-to-end lineage drill"
+    fail=1
+fi
+
+echo "== [15/15] scale audit (stc lint --scale, STC210-215) =="
+python -m spark_text_clustering_tpu.cli lint --scale \
+    --telemetry-file "$work/lint_scale.jsonl" >/dev/null
+if [[ $? -ne 0 ]]; then
+    echo "FAIL: stc lint --scale (rerun without >/dev/null for the report)"
+    fail=1
+fi
+if [[ -s "$work/lint_scale.jsonl" ]]; then
+    # the scale tier's coverage is version-gated: entries traced at
+    # scale, unwaived findings (0), and the reasoned waiver count
+    python -m spark_text_clustering_tpu.cli metrics check \
+        "$work/lint_scale.jsonl" --baseline "$BASELINE" \
+        --include lint.scale
+    if [[ $? -ne 0 ]]; then echo "FAIL: scale lint counters"; fail=1; fi
+else
+    echo "FAIL: no scale lint telemetry stream"
+    fail=1
+fi
+# self-test: a planted unbucketed-dynamic-dim entry (STC211) and a
+# planted over-HBM entry (STC212) must BOTH gate red — the scale tier
+# is only a gate if the hazards it exists for actually trip it
+python - <<'EOF'
+import numpy as np
+
+import jax
+
+from spark_text_clustering_tpu.analysis.entrypoints import (
+    ScaleDim, ScaleSpec,
+)
+from spark_text_clustering_tpu.analysis.scale_audit import (
+    audit_entry_scale,
+)
+
+
+def storm(dims):
+    def fn(x):
+        return x * np.float32(2.0)
+    return fn, (jax.ShapeDtypeStruct((dims["b"], 16), np.float32),)
+
+
+f, _ = audit_entry_scale(
+    "ci.storm",
+    ScaleSpec(dims={"b": ScaleDim((100, 101))}, build=storm),
+)
+assert [x.rule for x in f] == ["STC211"], [
+    (x.rule, x.message) for x in f
+]
+
+
+def hbm(dims):
+    def fn(x):
+        return x + np.float32(1.0)
+    return fn, (jax.ShapeDtypeStruct((dims["v"], 100), np.float32),)
+
+
+f, _ = audit_entry_scale(
+    "ci.hbm",
+    ScaleSpec(dims={"v": ScaleDim((100_000_000,))}, build=hbm),
+)
+assert [x.rule for x in f] == ["STC212"], [
+    (x.rule, x.message) for x in f
+]
+print(
+    "scale self-test: planted STC211 recompile hazard and planted "
+    "STC212 HBM breach both gate red"
+)
+EOF
+if [[ $? -ne 0 ]]; then
+    echo "FAIL: planted scale violations not flagged"
     fail=1
 fi
 

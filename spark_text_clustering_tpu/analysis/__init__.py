@@ -15,6 +15,14 @@ TPU hot paths depend on:
     checked for float64/weak-type leaks, host-callback primitives,
     oversized closure constants, and (multichip entries) sharding
     annotations.
+  * **scale audit** (``scale_audit``, via ``stc lint --scale``) — the
+    same registry traced ABSTRACTLY at each entry's declared scale
+    shapes (the CC-News k=500 / V=10M config and the pow2 bucket
+    grids) and checked for recompile/bucketing hazards, static
+    per-chip HBM-budget breaches, sharding-propagation gaps,
+    collective-bytes budgets, and scale-only dtype promotion
+    (STC210-215), gated against the committed
+    ``scripts/records/scale_baseline.json`` evidence record.
 
 Waivers: inline ``# stc-lint: disable=RULE -- reason`` pragmas or the
 committed ``scripts/records/lint_baseline.json`` allowlist; both require

@@ -20,6 +20,12 @@ mechanically so later PRs cannot erode them silently):
   STC006  no mutable default arguments; persistence-layer
           ``json.dump(s)`` must pass ``sort_keys=True`` (manifest bytes
           must not depend on dict build order).
+  STC007  lock discipline in the threaded modules (serving coalescer/
+          server, alert engine, supervisor): an attribute the class
+          writes under ``with self._lock`` anywhere is lock-guarded
+          state — touching it outside a lock block in another method is
+          a data race.  Deliberate lock-free reads (atomic reference
+          swaps, monotonic flags) carry reasoned waivers.
 
 Generic-Python tier (the ruff-equivalent checks, native so the gate
 works in hermetic containers without ruff installed):
@@ -49,7 +55,7 @@ PACKAGE = "spark_text_clustering_tpu"
 
 AST_RULES = (
     "STC001", "STC002", "STC003", "STC004", "STC005", "STC006",
-    "STC101", "STC102",
+    "STC007", "STC101", "STC102",
 )
 
 # rule-specific scoping -----------------------------------------------------
@@ -65,6 +71,23 @@ PERSISTENCE_FILES = {
 }
 # Spark-compat export writes key order the REFERENCE format dictates
 SORTKEYS_EXEMPT = {f"{PACKAGE}/models/reference_export.py"}
+# STC007 scope: the modules whose classes share mutable state across
+# threads (the serve front + batch worker + model watcher, and the
+# monitor/supervisor control loops)
+LOCK_FILES = {
+    f"{PACKAGE}/serving/coalescer.py",
+    f"{PACKAGE}/serving/server.py",
+    f"{PACKAGE}/telemetry/alerts.py",
+    f"{PACKAGE}/resilience/supervisor.py",
+}
+_LOCK_FACTORIES = {
+    "Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore",
+}
+# receiver methods that mutate the receiver in place
+_MUTATORS = {
+    "append", "extend", "insert", "pop", "popitem", "remove", "clear",
+    "update", "add", "discard", "setdefault", "appendleft", "sort",
+}
 
 _HOST_SYNC_ATTRS = {"block_until_ready", "item"}
 _NP_SYNC_FUNCS = {"asarray", "array", "asanyarray", "frombuffer"}
@@ -677,6 +700,137 @@ def _check_defaults_and_manifests(idx: LintIndex) -> List[Finding]:
 
 
 # ---------------------------------------------------------------------------
+# STC007 — lock discipline in the threaded modules
+# ---------------------------------------------------------------------------
+def _class_lock_attrs(cls: ast.ClassDef) -> Set[str]:
+    """Attributes initialized to a ``threading`` synchronizer
+    (``self._lock = threading.Lock()`` and friends)."""
+    locks: Set[str] = set()
+    for node in ast.walk(cls):
+        if not (
+            isinstance(node, ast.Assign)
+            and len(node.targets) == 1
+            and isinstance(node.targets[0], ast.Attribute)
+            and isinstance(node.targets[0].value, ast.Name)
+            and node.targets[0].value.id == "self"
+            and isinstance(node.value, ast.Call)
+        ):
+            continue
+        base, attr = _call_name(node.value.func)
+        if base == "threading" and attr in _LOCK_FACTORIES:
+            locks.add(node.targets[0].attr)
+    return locks
+
+
+def _self_attr_accesses(
+    method, locks: Set[str]
+) -> List[Tuple[str, str, bool, int]]:
+    """Every ``self.<attr>`` touch in one method as (attr, kind,
+    under_lock, lineno), kind ∈ {"read", "write"}.  ``with self.<lock>``
+    bodies (any nesting, any lock attr of the class) mark their
+    accesses as locked; an in-place mutator call
+    (``self.queue.append(x)``) counts as a write to the receiver."""
+    acc: List[Tuple[str, str, bool, int]] = []
+
+    def visit(node: ast.AST, locked: bool) -> None:
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            body_locked = locked
+            for item in node.items:
+                visit(item.context_expr, locked)
+                ce = item.context_expr
+                if (
+                    isinstance(ce, ast.Attribute)
+                    and isinstance(ce.value, ast.Name)
+                    and ce.value.id == "self"
+                    and ce.attr in locks
+                ):
+                    body_locked = True
+            for stmt in node.body:
+                visit(stmt, body_locked)
+            return
+        if (
+            isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"
+        ):
+            kind = (
+                "write"
+                if isinstance(node.ctx, (ast.Store, ast.Del))
+                else "read"
+            )
+            acc.append((node.attr, kind, locked, node.lineno))
+        if isinstance(node, ast.Call) and isinstance(
+            node.func, ast.Attribute
+        ):
+            f = node.func
+            if (
+                f.attr in _MUTATORS
+                and isinstance(f.value, ast.Attribute)
+                and isinstance(f.value.value, ast.Name)
+                and f.value.value.id == "self"
+            ):
+                acc.append((f.value.attr, "write", locked, node.lineno))
+        for child in ast.iter_child_nodes(node):
+            visit(child, locked)
+
+    for stmt in method.body:
+        visit(stmt, False)
+    return acc
+
+
+def _check_lock_discipline(idx: LintIndex) -> List[Finding]:
+    out: List[Finding] = []
+    for rel, mod in idx.modules.items():
+        if rel not in LOCK_FILES:
+            continue
+        for cls in (
+            n for n in ast.walk(mod.tree) if isinstance(n, ast.ClassDef)
+        ):
+            locks = _class_lock_attrs(cls)
+            if not locks:
+                continue
+            methods = [
+                n for n in cls.body
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+            ]
+            per_method = {
+                m.name: _self_attr_accesses(m, locks) for m in methods
+            }
+            # pass 1: anything the class ever WRITES under a lock is
+            # lock-guarded state
+            guarded: Set[str] = set()
+            for accesses in per_method.values():
+                for attr, kind, locked, _ in accesses:
+                    if kind == "write" and locked and attr not in locks:
+                        guarded.add(attr)
+            if not guarded:
+                continue
+            # pass 2: touching guarded state WITHOUT the lock in any
+            # method that can run on a different thread than the
+            # writer.  __init__ runs before the instance is shared.
+            seen: Set[Tuple[int, str]] = set()
+            for m in methods:
+                if m.name == "__init__":
+                    continue
+                for attr, kind, locked, lineno in per_method[m.name]:
+                    if locked or attr not in guarded:
+                        continue
+                    if (lineno, attr) in seen:
+                        continue
+                    seen.add((lineno, attr))
+                    out.append(idx.finding(
+                        "STC007", rel, lineno,
+                        f"attribute {attr!r} is written under "
+                        f"`with self.<lock>` elsewhere in "
+                        f"{cls.name} but {kind} here without the "
+                        f"lock — a data race once threads share the "
+                        f"instance; take the lock or waive a "
+                        f"deliberate lock-free access with a reason",
+                    ))
+    return out
+
+
+# ---------------------------------------------------------------------------
 # STC101 — unused imports
 # ---------------------------------------------------------------------------
 def _check_unused_imports(idx: LintIndex) -> List[Finding]:
@@ -745,6 +899,7 @@ _CHECKS = (
     _check_metric_names,
     _check_host_syncs,
     _check_defaults_and_manifests,
+    _check_lock_discipline,
     _check_unused_imports,
     _check_fstring_logging,
 )

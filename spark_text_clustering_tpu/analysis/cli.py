@@ -2,23 +2,34 @@
 
 Usage::
 
-    python -m spark_text_clustering_tpu.cli lint                # both layers
+    python -m spark_text_clustering_tpu.cli lint                # layers 1+2
+    python -m spark_text_clustering_tpu.cli lint --scale        # + layer 3
+    python -m spark_text_clustering_tpu.cli lint --changed      # pre-commit
     python -m spark_text_clustering_tpu.cli lint --format json  # machine-readable
     python -m spark_text_clustering_tpu.cli lint --no-jaxpr     # AST layer only
     python -m spark_text_clustering_tpu.cli lint --rebaseline   # regenerate waivers
 
+``--scale`` adds the layer-3 scale audit (``analysis.scale_audit``):
+every registered entry point traced abstractly at its declared
+V=10M/k=500 scale shapes, rules STC210-215, plus a drift gate against
+the committed ``scripts/records/scale_baseline.json`` evidence record.
+``--changed`` scopes the AST layer to git-changed files (and skips the
+trace layers unless a traced-surface file changed) — the fast
+pre-commit path; the full pass stays the CI gate.
+
 Exit codes mirror ``metrics check``: 0 = clean (no unwaived findings),
 1 = findings, 2 = usage/config error.  Every run mirrors its outcome
-into the telemetry registry (``lint.findings`` / ``lint.waived``) and —
-with ``--telemetry-file`` — into a run stream the ``metrics`` verbs can
-diff, so analysis drift is observable the same way perf drift is.
+into the telemetry registry (``lint.findings`` / ``lint.waived``, plus
+``lint.scale_*`` under ``--scale``) and — with ``--telemetry-file`` —
+into a run stream the ``metrics`` verbs can diff, so analysis drift is
+observable the same way perf drift is.
 """
 
 from __future__ import annotations
 
 import argparse
 import os
-from typing import List, Optional
+from typing import List, Optional, Sequence
 
 from .findings import (
     DEFAULT_BASELINE_PATH,
@@ -28,7 +39,18 @@ from .findings import (
     render_text,
 )
 
-__all__ = ["add_lint_subparser", "cmd_lint", "run_lint"]
+__all__ = ["add_lint_subparser", "cmd_lint", "run_lint", "changed_files"]
+
+# a --changed run skips the jaxpr/scale trace layers unless one of the
+# traced surfaces changed: the registry itself, or the modules whose
+# step functions it traces
+_TRACED_PREFIXES = (
+    "spark_text_clustering_tpu/analysis/",
+    "spark_text_clustering_tpu/models/",
+    "spark_text_clustering_tpu/ops/",
+    "spark_text_clustering_tpu/parallel/",
+    "spark_text_clustering_tpu/utils/jax_compat.py",
+)
 
 
 def _repo_root() -> str:
@@ -39,22 +61,58 @@ def _repo_root() -> str:
     )
 
 
+def changed_files(root: str) -> List[str]:
+    """Repo-relative paths with uncommitted changes (tracked diffs vs
+    HEAD + untracked non-ignored files) — the ``--changed`` scope."""
+    import subprocess
+
+    paths: List[str] = []
+    for cmd in (
+        ["git", "-C", root, "diff", "--name-only", "HEAD"],
+        ["git", "-C", root, "ls-files", "--others", "--exclude-standard"],
+    ):
+        proc = subprocess.run(
+            cmd, capture_output=True, text=True, check=False
+        )
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"--changed needs a git work tree at {root}: "
+                f"{(proc.stderr or '').strip()}"
+            )
+        paths.extend(p for p in proc.stdout.splitlines() if p)
+    return sorted(set(paths))
+
+
 def run_lint(
     root: Optional[str] = None,
     *,
     jaxpr: bool = True,
+    scale: bool = False,
     rules: Optional[List[str]] = None,
     baseline_path: Optional[str] = None,
+    scale_baseline_path: Optional[str] = None,
+    changed: Optional[Sequence[str]] = None,
 ):
-    """Run both layers; returns (findings, audited names, baseline).
+    """Run the requested layers; returns
+    (findings, audited names, baseline, scale report | None).
 
     Findings come back with pragma AND baseline waivers applied, plus
-    any STC000 meta-findings (reasonless/stale waivers).
+    any STC000 meta-findings (reasonless/stale waivers — stale checks
+    are skipped under a ``changed`` scope, where most waivers
+    legitimately match nothing).
     """
     from .ast_rules import run_ast_rules
 
     root = root or _repo_root()
     findings = run_ast_rules(root, rules=rules)
+    if changed is not None:
+        keep_paths = set(changed)
+        findings = [f for f in findings if f.path in keep_paths]
+        trace_surface_changed = any(
+            p.startswith(_TRACED_PREFIXES) for p in keep_paths
+        )
+        jaxpr = jaxpr and trace_surface_changed
+        scale = scale and trace_surface_changed
     audited: List[str] = []
     if jaxpr:
         from .jaxpr_audit import run_jaxpr_audit
@@ -64,10 +122,41 @@ def run_lint(
             keep = set(rules)
             jf = [f for f in jf if f.rule in keep]
         findings.extend(jf)
+    scale_report = None
+    if scale:
+        from .scale_audit import (
+            DEFAULT_SCALE_BASELINE_PATH,
+            compare_with_record,
+            load_scale_record,
+            run_scale_audit,
+        )
+
+        sf, scale_report = run_scale_audit()
+        sb_path = scale_baseline_path or os.path.join(
+            root, DEFAULT_SCALE_BASELINE_PATH
+        )
+        sf.extend(compare_with_record(
+            scale_report, load_scale_record(sb_path),
+            DEFAULT_SCALE_BASELINE_PATH,
+        ))
+        if rules:
+            keep = set(rules)
+            sf = [f for f in sf if f.rule in keep]
+        findings.extend(sf)
     bl_path = baseline_path or os.path.join(root, DEFAULT_BASELINE_PATH)
     baseline = Baseline.load(bl_path)
-    findings = apply_waivers(findings, baseline)
-    return findings, audited, baseline
+    exempt = tuple(
+        p
+        for p, ran in (("jaxpr:", jaxpr), ("scale:", scale))
+        if not ran
+    )
+    findings = apply_waivers(
+        findings,
+        baseline,
+        check_stale=changed is None,
+        stale_exempt_prefixes=exempt,
+    )
+    return findings, audited, baseline, scale_report
 
 
 def cmd_lint(args: argparse.Namespace) -> int:
@@ -81,12 +170,25 @@ def cmd_lint(args: argparse.Namespace) -> int:
     root = _repo_root()
     bl_path = args.baseline or os.path.join(root, DEFAULT_BASELINE_PATH)
     rules = args.rules.split(",") if args.rules else None
+    changed = None
+    if args.changed:
+        try:
+            changed = changed_files(root)
+        except RuntimeError as exc:
+            print(f"stc lint: {exc}")
+            return 2
+        if not changed:
+            print("stc lint --changed: no changed files — clean")
+            return 0
 
-    findings, audited, baseline = run_lint(
+    findings, audited, baseline, scale_report = run_lint(
         root,
         jaxpr=not args.no_jaxpr,
+        scale=args.scale,
         rules=rules,
         baseline_path=bl_path,
+        scale_baseline_path=args.scale_baseline,
+        changed=changed,
     )
 
     if args.rebaseline:
@@ -120,25 +222,55 @@ def cmd_lint(args: argparse.Namespace) -> int:
             f"lint baseline rewritten: {bl_path} "
             f"({len(new_waivers)} waiver(s))"
         )
+        if args.scale and scale_report is not None:
+            from .scale_audit import (
+                DEFAULT_SCALE_BASELINE_PATH,
+                save_scale_record,
+            )
+
+            sb_path = args.scale_baseline or os.path.join(
+                root, DEFAULT_SCALE_BASELINE_PATH
+            )
+            save_scale_record(scale_report, sb_path)
+            print(
+                f"scale record rewritten: {sb_path} "
+                f"({len(scale_report['entries'])} entries at "
+                f"{scale_report['backend']})"
+            )
         return 0
 
     unwaived = [f for f in findings if not f.waived]
     waived = [f for f in findings if f.waived]
     telemetry.count("lint.findings", len(unwaived))
     telemetry.count("lint.waived", len(waived))
+    if args.scale and scale_report is not None:
+        scale_f = [f for f in findings if f.path.startswith("scale:")]
+        telemetry.count(
+            "lint.scale_entries", len(scale_report["entries"])
+        )
+        telemetry.count(
+            "lint.scale_findings",
+            len([f for f in scale_f if not f.waived]),
+        )
+        telemetry.count(
+            "lint.scale_waived", len([f for f in scale_f if f.waived])
+        )
     if own_telemetry:
         telemetry.event(
             "lint_run",
             findings=len(unwaived),
             waived=len(waived),
             entrypoints=len(audited),
+            scale_entries=(
+                len(scale_report["entries"]) if scale_report else 0
+            ),
         )
         telemetry.shutdown()
 
     out = (
-        render_json(findings, audited)
+        render_json(findings, audited, scale_report)
         if args.format == "json"
-        else render_text(findings, audited)
+        else render_text(findings, audited, scale_report)
     )
     print(out)
     return 1 if unwaived else 0
@@ -148,7 +280,8 @@ def add_lint_subparser(sub) -> None:
     p = sub.add_parser(
         "lint",
         help="project-native static analysis: AST invariant rules + "
-             "jaxpr purity/dtype audit (docs/STATIC_ANALYSIS.md)",
+             "jaxpr purity/dtype audit (+ --scale: the V=10M/k=500 "
+             "scale-shape audit) (docs/STATIC_ANALYSIS.md)",
     )
     p.add_argument(
         "--format", default="text", choices=["text", "json"],
@@ -163,18 +296,37 @@ def add_lint_subparser(sub) -> None:
         help="skip layer 2 (no jax import; pure-AST runs are ~instant)",
     )
     p.add_argument(
+        "--scale", action="store_true",
+        help="add layer 3: trace every registered entry point at its "
+             "declared scale shapes (V=10M, k=500, pow2 bucket grids) "
+             "and enforce STC210-215 + the committed scale record",
+    )
+    p.add_argument(
+        "--changed", action="store_true",
+        help="diff-scoped fast mode: AST rules on git-changed files "
+             "only; trace layers run only when a traced surface "
+             "(analysis/models/ops/parallel) changed — the pre-commit "
+             "path (docs/STATIC_ANALYSIS.md)",
+    )
+    p.add_argument(
         "--baseline", default=None,
         help=f"waiver allowlist (default {DEFAULT_BASELINE_PATH})",
     )
     p.add_argument(
+        "--scale-baseline", default=None,
+        help="committed scale evidence record (default "
+             "scripts/records/scale_baseline.json)",
+    )
+    p.add_argument(
         "--rebaseline", action="store_true",
         help="rewrite the baseline to waive every current finding "
-             "(commit the result deliberately — mirrors `metrics check "
+             "(with --scale: also rewrite the scale record; commit the "
+             "result deliberately — mirrors `metrics check "
              "--write-baseline`)",
     )
     p.add_argument(
         "--telemetry-file", default=None,
-        help="emit a lint run stream (lint.findings / lint.waived) "
-             "consumable by the `metrics` verbs",
+        help="emit a lint run stream (lint.findings / lint.waived / "
+             "lint.scale_*) consumable by the `metrics` verbs",
     )
     p.set_defaults(fn=cmd_lint)

@@ -155,12 +155,21 @@ class Baseline:
 
 
 def apply_waivers(
-    findings: Sequence[Finding], baseline: Baseline
+    findings: Sequence[Finding],
+    baseline: Baseline,
+    *,
+    check_stale: bool = True,
+    stale_exempt_prefixes: Sequence[str] = (),
 ) -> List[Finding]:
     """Mark baseline-waived findings in place; append STC000 findings
     for reasonless waivers and stale baseline entries.  (Pragma waivers
     are applied at finding-construction time by the rule engine, which
-    has the source line in hand.)  Returns the full augmented list."""
+    has the source line in hand.)  ``check_stale=False`` skips the
+    stale-entry sweep — for partial runs (``lint --changed``) where
+    most waivers legitimately match nothing; ``stale_exempt_prefixes``
+    exempts waivers for layers that did not run this invocation
+    (``"jaxpr:"`` under --no-jaxpr, ``"scale:"`` without --scale).
+    Returns the full augmented list."""
     out = list(findings)
     for f in out:
         if f.waived:
@@ -183,7 +192,12 @@ def apply_waivers(
                 ),
                 snippet=f.snippet,
             ))
-    for w in baseline.stale_entries():
+    for w in baseline.stale_entries() if check_stale else ():
+        if any(
+            str(w.get("path", "")).startswith(p)
+            for p in stale_exempt_prefixes
+        ):
+            continue
         extra.append(Finding(
             rule="STC000",
             path=str(w.get("path", "?")),
@@ -203,7 +217,11 @@ def _split(findings: Sequence[Finding]):
     return unwaived, waived
 
 
-def render_text(findings: Sequence[Finding], audited: Sequence[str]) -> str:
+def render_text(
+    findings: Sequence[Finding],
+    audited: Sequence[str],
+    scale_report: Optional[Dict] = None,
+) -> str:
     unwaived, waived = _split(findings)
     lines: List[str] = []
     for f in sorted(unwaived, key=lambda f: (f.path, f.line, f.rule)):
@@ -219,6 +237,22 @@ def render_text(findings: Sequence[Finding], audited: Sequence[str]) -> str:
             lines.append(
                 f"  {loc}: {f.rule} [{f.waived_by}] {f.reason}"
             )
+    if scale_report is not None:
+        entries = scale_report.get("entries", {})
+        worst = max(
+            (
+                (e.get("hbm_frac") or 0.0, name)
+                for name, e in entries.items()
+            ),
+            default=(0.0, "-"),
+        )
+        lines.append("")
+        lines.append(
+            f"scale audit: {len(entries)} entry point(s) traced at "
+            f"declared scale shapes against the "
+            f"{scale_report.get('backend', '?')} HBM budget "
+            f"(worst per-chip fraction {worst[0]:.2f} at {worst[1]})"
+        )
     lines.append("")
     lines.append(
         f"stc lint: {len(unwaived)} finding(s), {len(waived)} waived, "
@@ -228,20 +262,21 @@ def render_text(findings: Sequence[Finding], audited: Sequence[str]) -> str:
 
 
 def render_json(
-    findings: Sequence[Finding], audited: Sequence[str]
+    findings: Sequence[Finding],
+    audited: Sequence[str],
+    scale_report: Optional[Dict] = None,
 ) -> str:
     unwaived, waived = _split(findings)
-    return json.dumps(
-        {
-            "version": 1,
-            "findings": [f.to_dict() for f in unwaived],
-            "waived": [f.to_dict() for f in waived],
-            "counts": {
-                "findings": len(unwaived),
-                "waived": len(waived),
-            },
-            "entrypoints_audited": list(audited),
+    doc = {
+        "version": 1,
+        "findings": [f.to_dict() for f in unwaived],
+        "waived": [f.to_dict() for f in waived],
+        "counts": {
+            "findings": len(unwaived),
+            "waived": len(waived),
         },
-        indent=2,
-        sort_keys=True,
-    )
+        "entrypoints_audited": list(audited),
+    }
+    if scale_report is not None:
+        doc["scale"] = scale_report
+    return json.dumps(doc, indent=2, sort_keys=True)

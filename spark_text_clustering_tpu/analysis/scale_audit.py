@@ -1,0 +1,671 @@
+"""Layer 3: scale-shape audit of the registered jitted entry points.
+
+The layer-2 jaxpr audit traces at TOY shapes (rank/dtype-faithful,
+size-tiny) — right for dtype and callback discipline, blind to every
+defect that only exists at the CC-News config (k=500, V=10M): a
+recompile storm from an unbucketed dynamic dim, a lambda that stops
+fitting HBM, a sharding annotation that silently degrades to full
+replication, a collective that moves the whole model every step.  Those
+used to be discoverable only on a TPU we cannot currently reach.
+
+This layer closes that gap STATICALLY: every entry point's registration
+declares *scale shapes* (``entrypoints.ScaleSpec`` — the declared
+production geometry, including the pow2 token-bucket grid), and the
+audit traces each entry at those shapes with ``jax.ShapeDtypeStruct``
+arguments — abstract avals only, so tracing V=10M costs milliseconds
+and a few hundred MB of host RAM, never a 20 GB buffer.  Rules
+(STC21x; waiver ``path`` is ``scale:<entry name>``):
+
+  STC210  the entry fails to build/trace at its declared scale shapes
+          (or declares none, or is missing from the committed scale
+          record — scale coverage must not rot silently)
+  STC211  recompile/bucketing hazard: the input signature varies along
+          a dim the spec did NOT declare bucketed (every distinct value
+          = one more compile: a storm at production traffic), a
+          "bucketed" grid that is not pow2-aligned, or the signature
+          set drifting from the committed ``scale_baseline.json``
+  STC212  static HBM-budget breach: the per-chip peak-live-bytes
+          estimate at scale (liveness scan over the jaxpr, vocab-
+          sharded operands divided by ``model_shards``) exceeds the
+          per-backend budget from ``telemetry.roofline.BACKEND_PEAKS``
+          (``hbm_bytes`` x utilization); also committed-record drift
+          beyond tolerance
+  STC213  sharding-propagation gap: a vocab-sharded entry whose scale
+          jaxpr carries NO model-axis mapping on any sharded-width
+          operand (it would silently run fully replicated), or that
+          all-gathers a sharded-width operand over the model axis
+  STC214  estimated collective bytes per step (psum/all_gather/
+          reduce_scatter/all_to_all/ppermute operands at scale, shard-
+          adjusted) over the per-step budget
+  STC215  dtype promotion that only manifests at scale params: input/
+          output dtypes differ between the grid-min and grid-max traces
+
+Pure tracing, CPU platform, x64 enabled (same hard mode as layer 2):
+no compile, no execution, no device state, bounded memory.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+from typing import Dict, Iterable, List, Mapping, Optional, Tuple
+
+from .findings import Finding
+
+__all__ = [
+    "DEFAULT_BACKEND",
+    "DEFAULT_SCALE_BASELINE_PATH",
+    "HBM_UTILIZATION",
+    "COLLECTIVE_BUDGET_BYTES",
+    "PEAK_DRIFT_TOLERANCE",
+    "audit_entry_scale",
+    "run_scale_audit",
+    "compare_with_record",
+    "load_scale_record",
+    "save_scale_record",
+]
+
+DEFAULT_BACKEND = "tpu-v5e"
+# fraction of the datasheet HBM a step may claim: the rest is runtime,
+# infeed, fragmentation, and the donation slack XLA needs to alias
+HBM_UTILIZATION = 0.9
+# per-chip per-step collective budget: ~5 ms of v5e ICI at ~400 GB/s,
+# rounded to a power of two so the number reads as a policy, not a
+# measurement (override per entry via ScaleSpec.collective_budget_bytes)
+COLLECTIVE_BUDGET_BYTES = 2 << 30
+# committed-record tolerance for byte estimates (signatures are exact)
+PEAK_DRIFT_TOLERANCE = 0.10
+
+DEFAULT_SCALE_BASELINE_PATH = os.path.join(
+    "scripts", "records", "scale_baseline.json"
+)
+
+_COLLECTIVE_PRIMS = (
+    "psum",
+    "all_gather",
+    "all_to_all",
+    "reduce_scatter",
+    "ppermute",
+)
+_GATHERING_PRIMS = ("all_gather", "all_to_all")
+
+
+# ---------------------------------------------------------------------------
+# jaxpr walking / byte accounting
+# ---------------------------------------------------------------------------
+def _sub_jaxprs(eqn) -> Iterable:
+    import jax.core as core
+
+    for v in eqn.params.values():
+        for item in v if isinstance(v, (tuple, list)) else (v,):
+            if isinstance(item, core.ClosedJaxpr):
+                yield item.jaxpr
+            elif isinstance(item, core.Jaxpr):
+                yield item
+
+
+def _iter_jaxprs(jaxpr) -> Iterable:
+    """Every jaxpr nesting level, root first (pjit/scan/shard_map
+    bodies included)."""
+    stack = [jaxpr]
+    while stack:
+        j = stack.pop()
+        yield j
+        for eqn in j.eqns:
+            stack.extend(_sub_jaxprs(eqn))
+
+
+def _iter_eqns(jaxpr) -> Iterable:
+    for j in _iter_jaxprs(jaxpr):
+        yield from j.eqns
+
+
+def _is_sharded_width(d: int, shard_sizes: frozenset) -> bool:
+    # the packed scatter paths pad the sharded vocab axis by ONE drop
+    # row (width V+1); on hardware that pad is per-shard too, so a
+    # declared-width-plus-one dim counts as sharded
+    return d in shard_sizes or (d - 1) in shard_sizes
+
+
+def _aval_nbytes(aval, shard_sizes: frozenset, model_shards: int) -> int:
+    """Per-chip bytes of one abstract value: sharded-width dims (the
+    declared scale value of every dim in ``ScaleSpec.sharded_dims``,
+    or that value + 1 — a padded scatter target) divide the buffer
+    across ``model_shards`` chips."""
+    shape = getattr(aval, "shape", None)
+    dtype = getattr(aval, "dtype", None)
+    if shape is None or dtype is None:
+        return 0
+    n = 1
+    for d in shape:
+        n *= int(d)
+    nbytes = n * dtype.itemsize
+    if model_shards > 1 and any(
+        _is_sharded_width(int(d), shard_sizes) for d in shape
+    ):
+        nbytes //= model_shards
+    return nbytes
+
+
+def _sig(aval) -> str:
+    dt = getattr(aval, "dtype", None)
+    shape = getattr(aval, "shape", ())
+    name = getattr(dt, "name", str(dt))
+    return f"{name}[{','.join(str(int(d)) for d in shape)}]"
+
+
+def _peak_live_bytes(
+    closed, shard_sizes: frozenset, model_shards: int
+) -> int:
+    """Static per-chip peak-live-bytes estimate: a liveness scan (def
+    -> last use) over every jaxpr nesting level, taking the worst
+    level.  Inputs, constants, and program outputs are held live for
+    the whole level (no donation/aliasing credit), so within a level
+    this reads conservatively HIGH; levels are not summed (an outer
+    pjit wrapper and its body would double-count their shared
+    operands), so a breach reported here is a real breach."""
+    import jax.core as core
+
+    def nbytes(v) -> int:
+        return _aval_nbytes(
+            getattr(v, "aval", None), shard_sizes, model_shards
+        )
+
+    peak = 0
+    for j in _iter_jaxprs(closed.jaxpr):
+        always = list(j.invars) + list(j.constvars) + [
+            v for v in j.outvars if isinstance(v, core.Var)
+        ]
+        base = sum(nbytes(v) for v in {id(v): v for v in always}.values())
+        outs = {id(v) for v in j.outvars if isinstance(v, core.Var)}
+        last_use: Dict[int, int] = {}
+        for i, eqn in enumerate(j.eqns):
+            for v in eqn.invars:
+                if isinstance(v, core.Var):
+                    last_use[id(v)] = i
+        cur = base
+        peak = max(peak, cur)
+        dying: Dict[int, int] = {}
+        for i, eqn in enumerate(j.eqns):
+            for v in eqn.outvars:
+                if isinstance(v, core.Var) and id(v) not in outs:
+                    cur += nbytes(v)
+                    end = last_use.get(id(v), i)
+                    dying[end] = dying.get(end, 0) + nbytes(v)
+            peak = max(peak, cur)
+            cur -= dying.pop(i, 0)
+    return peak
+
+
+def _collective_bytes(
+    closed, shard_sizes: frozenset, model_shards: int
+) -> int:
+    """Per-chip bytes moved by collectives in ONE step: for each
+    collective equation, the larger of its operand and result bytes
+    (all_gather results exceed their inputs), shard-adjusted."""
+    total = 0
+    for eqn in _iter_eqns(closed.jaxpr):
+        prim = eqn.primitive.name
+        if not any(prim.startswith(p) for p in _COLLECTIVE_PRIMS):
+            continue
+        in_b = sum(
+            _aval_nbytes(
+                getattr(v, "aval", None), shard_sizes, model_shards
+            )
+            for v in eqn.invars
+        )
+        out_b = sum(
+            _aval_nbytes(
+                getattr(v, "aval", None), shard_sizes, model_shards
+            )
+            for v in eqn.outvars
+        )
+        total += max(in_b, out_b)
+    return total
+
+
+def _axis_names(params: Mapping) -> Tuple[str, ...]:
+    v = params.get("axis_name", params.get("axes", ()))
+    if isinstance(v, (tuple, list)):
+        return tuple(str(a) for a in v)
+    return (str(v),) if v is not None else ()
+
+
+def _sharding_reaches_model(
+    closed, shard_sizes: frozenset, model_axis: str
+) -> Tuple[bool, List[str]]:
+    """(a sharded-width operand is mapped onto the model axis anywhere,
+    [descriptions of model-axis gathers of sharded-width operands]).
+
+    ``shard_map`` equations carry ``in_names``/``out_names`` (one dict
+    per operand: dim index -> mesh axis tuple); sharding-constraint
+    equations carry a sharding object whose repr names the axes."""
+    reached = False
+    gathers: List[str] = []
+    for eqn in _iter_eqns(closed.jaxpr):
+        prim = eqn.primitive.name
+        if prim == "shard_map":
+            for vars_, names in (
+                (eqn.invars, eqn.params.get("in_names", ())),
+                (eqn.outvars, eqn.params.get("out_names", ())),
+            ):
+                for var, nm in zip(vars_, names):
+                    aval = getattr(var, "aval", None)
+                    shape = getattr(aval, "shape", ())
+                    if not isinstance(nm, Mapping):
+                        continue
+                    for idx, d in enumerate(shape):
+                        if _is_sharded_width(
+                            int(d), shard_sizes
+                        ) and model_axis in tuple(nm.get(idx, ())):
+                            reached = True
+        elif "sharding_constraint" in prim:
+            wide = any(
+                _is_sharded_width(int(d), shard_sizes)
+                for v in list(eqn.invars) + list(eqn.outvars)
+                for d in getattr(getattr(v, "aval", None), "shape", ())
+            )
+            if wide and model_axis in str(eqn.params):
+                reached = True
+        elif any(prim.startswith(p) for p in _GATHERING_PRIMS):
+            if model_axis not in _axis_names(eqn.params):
+                continue
+            for v in eqn.invars:
+                shape = getattr(getattr(v, "aval", None), "shape", ())
+                if any(
+                    _is_sharded_width(int(d), shard_sizes)
+                    for d in shape
+                ):
+                    gathers.append(f"{prim} over {_sig(v.aval)}")
+    return reached, gathers
+
+
+# ---------------------------------------------------------------------------
+# tracing at declared scale points
+# ---------------------------------------------------------------------------
+def _trace(spec, dims: Mapping[str, int]):
+    """Trace the entry at one scale point; returns (closed jaxpr, flat
+    input avals).  x64-enabled, same hard mode as layer 2 — implicit
+    dtypes that only widen at scale params must widen HERE, not on the
+    chip."""
+    import jax
+    from jax.experimental import enable_x64 as _enable_x64
+
+    fn, args = spec.build(dict(dims))
+    with _enable_x64():
+        closed = jax.make_jaxpr(fn)(*args)
+    flat, _ = jax.tree_util.tree_flatten(args)
+    avals = [
+        jax.api_util.shaped_abstractify(a) if not hasattr(a, "dtype")
+        or not hasattr(a, "shape") else a
+        for a in flat
+    ]
+    return closed, avals
+
+
+def _shape_sig(avals) -> Tuple[str, ...]:
+    return tuple(
+        f"[{','.join(str(int(d)) for d in getattr(a, 'shape', ()))}]"
+        for a in avals
+    )
+
+
+def _dtype_sig(closed, avals) -> Tuple[str, ...]:
+    ins = tuple(
+        getattr(getattr(a, "dtype", None), "name", "?") for a in avals
+    )
+    outs = tuple(
+        getattr(getattr(v.aval, "dtype", None), "name", "?")
+        for v in closed.jaxpr.outvars
+    )
+    return ins + ("->",) + outs
+
+
+def _hbm_budget_bytes(backend: str) -> int:
+    from ..telemetry.roofline import BACKEND_PEAKS
+
+    peaks = BACKEND_PEAKS.get(backend) or BACKEND_PEAKS[DEFAULT_BACKEND]
+    return int(peaks["hbm_bytes"] * HBM_UTILIZATION)
+
+
+def audit_entry_scale(
+    name: str,
+    spec,
+    *,
+    multichip: bool = False,
+    backend: str = DEFAULT_BACKEND,
+    model_axis: str = "model",
+) -> Tuple[List[Finding], Optional[Dict]]:
+    """Run STC211-215 for one entry's ``ScaleSpec``; returns
+    (findings, record) — the record is the entry's row in the scale
+    report / committed baseline, None when tracing failed (the STC210
+    finding rides in the list)."""
+    findings: List[Finding] = []
+    path = f"scale:{name}"
+    pmax = {n: d.points[-1] for n, d in spec.dims.items()}
+    pmin = {n: d.points[0] for n, d in spec.dims.items()}
+    shard_sizes = frozenset(
+        int(pmax[n]) for n in spec.sharded_dims if n in pmax
+    )
+    shards = spec.model_shards if spec.sharded_dims else 1
+
+    try:
+        closed, avals = _trace(spec, pmax)
+    except Exception as exc:
+        findings.append(Finding(
+            rule="STC210", path=path, line=0,
+            message=(
+                f"entry failed to build/trace at scale point "
+                f"{pmax}: {type(exc).__name__}: {exc}"
+            ),
+            snippet=f"scale point {pmax}",
+        ))
+        return findings, None
+
+    # ---- STC211: unbucketed dynamic dims / non-pow2 buckets -----------
+    sig_max = _shape_sig(avals)
+    for dim_name, dim in spec.dims.items():
+        if dim.bucketed and any(
+            p < 1 or (p & (p - 1)) for p in dim.points
+        ):
+            findings.append(Finding(
+                rule="STC211", path=path, line=0,
+                message=(
+                    f"dim {dim_name!r} is declared bucketed but its "
+                    f"grid {dim.points} is not pow2-aligned — the AOT "
+                    f"warmup and the compile sentinel both key on pow2 "
+                    f"buckets"
+                ),
+                snippet=f"dim {dim_name} grid {dim.points}",
+            ))
+        if len(dim.points) < 2:
+            continue
+        adjacent = dict(pmax)
+        adjacent[dim_name] = dim.points[-2]
+        try:
+            _, adj_avals = _trace(spec, adjacent)
+        except Exception as exc:
+            findings.append(Finding(
+                rule="STC210", path=path, line=0,
+                message=(
+                    f"entry failed to trace at adjacent scale point "
+                    f"{adjacent}: {type(exc).__name__}: {exc}"
+                ),
+                snippet=f"scale point {adjacent}",
+            ))
+            continue
+        if _shape_sig(adj_avals) != sig_max and not dim.bucketed:
+            findings.append(Finding(
+                rule="STC211", path=path, line=0,
+                message=(
+                    f"input signature varies with UNBUCKETED dim "
+                    f"{dim_name!r} ({dim.points[-2]} -> "
+                    f"{dim.points[-1]} retraces) — every distinct "
+                    f"value at runtime is one more compile; bucket the "
+                    f"dim (pow2 grid) or pad it static"
+                ),
+                snippet=f"unbucketed dynamic dim {dim_name}",
+            ))
+
+    # ---- STC215: dtype drift across scale params ----------------------
+    if pmin != pmax:
+        try:
+            closed_min, avals_min = _trace(spec, pmin)
+        except Exception as exc:
+            findings.append(Finding(
+                rule="STC210", path=path, line=0,
+                message=(
+                    f"entry failed to trace at minimum scale point "
+                    f"{pmin}: {type(exc).__name__}: {exc}"
+                ),
+                snippet=f"scale point {pmin}",
+            ))
+        else:
+            dt_min = _dtype_sig(closed_min, avals_min)
+            dt_max = _dtype_sig(closed, avals)
+            if len(dt_min) != len(dt_max):
+                findings.append(Finding(
+                    rule="STC215", path=path, line=0,
+                    message=(
+                        f"traced arity changed between scale points "
+                        f"({len(dt_min)} vs {len(dt_max)} leaves) — "
+                        f"program structure depends on scale params"
+                    ),
+                    snippet="arity drift",
+                ))
+            else:
+                for i, (a, b) in enumerate(zip(dt_min, dt_max)):
+                    if a != b:
+                        findings.append(Finding(
+                            rule="STC215", path=path, line=0,
+                            message=(
+                                f"dtype promotion manifests only at "
+                                f"scale params: leaf {i} is {a} at "
+                                f"{pmin} but {b} at {pmax} — anchor "
+                                f"the dtype explicitly"
+                            ),
+                            snippet=f"leaf {i} {a}->{b}",
+                        ))
+
+    # ---- STC212: static HBM budget ------------------------------------
+    budget = _hbm_budget_bytes(backend)
+    peak = _peak_live_bytes(closed, shard_sizes, shards)
+    if peak > budget:
+        findings.append(Finding(
+            rule="STC212", path=path, line=0,
+            message=(
+                f"per-chip peak-live estimate {peak / 2**30:.2f} GiB "
+                f"at {pmax} exceeds the {backend} budget "
+                f"{budget / 2**30:.2f} GiB "
+                f"({shards} model shard(s)) — shard the wide operands "
+                f"or shrink the declared tier"
+            ),
+            snippet=f"hbm estimate over {backend} budget",
+        ))
+
+    # ---- STC213: sharding propagation at scale ------------------------
+    if spec.sharded_dims and multichip:
+        reached, gathers = _sharding_reaches_model(
+            closed, shard_sizes, model_axis
+        )
+        if not reached:
+            findings.append(Finding(
+                rule="STC213", path=path, line=0,
+                message=(
+                    f"entry declares {spec.sharded_dims} sharded over "
+                    f"the {model_axis!r} axis but its scale jaxpr maps "
+                    f"NO sharded-width operand onto that axis — it "
+                    f"would silently run fully replicated "
+                    f"({max(shard_sizes, default=0)}-wide buffers on "
+                    f"every chip)"
+                ),
+                snippet="no model-axis mapping on a sharded operand",
+            ))
+        for g in gathers:
+            findings.append(Finding(
+                rule="STC213", path=path, line=0,
+                message=(
+                    f"sharded-width operand gathered over the "
+                    f"{model_axis!r} axis ({g}) — the whole sharded "
+                    f"dimension materializes on every chip each step"
+                ),
+                snippet=g,
+            ))
+
+    # ---- STC214: collective bytes per step ----------------------------
+    coll = _collective_bytes(closed, shard_sizes, shards)
+    coll_budget = (
+        spec.collective_budget_bytes
+        if spec.collective_budget_bytes is not None
+        else COLLECTIVE_BUDGET_BYTES
+    )
+    if coll > coll_budget:
+        findings.append(Finding(
+            rule="STC214", path=path, line=0,
+            message=(
+                f"estimated collective traffic "
+                f"{coll / 2**30:.2f} GiB/chip/step at {pmax} exceeds "
+                f"the {coll_budget / 2**30:.2f} GiB budget — "
+                f"reduce-scatter instead of psum+keep, or raise the "
+                f"entry's declared budget with a reason"
+            ),
+            snippet="collective bytes over budget",
+        ))
+
+    record = {
+        "dims": {n: list(d.points) for n, d in spec.dims.items()},
+        "model_shards": shards,
+        "signature": list(sig_max),
+        "per_chip_peak_bytes": int(peak),
+        "hbm_budget_bytes": int(budget),
+        "hbm_frac": round(peak / budget, 4) if budget else None,
+        "collective_bytes_per_step": int(coll),
+        "backend": backend,
+    }
+    if spec.note:
+        record["note"] = spec.note
+    return findings, record
+
+
+# ---------------------------------------------------------------------------
+# committed scale record
+# ---------------------------------------------------------------------------
+def load_scale_record(path: str) -> Optional[Dict]:
+    import json
+
+    if not os.path.exists(path):
+        return None
+    with open(path, "r", encoding="utf-8") as f:
+        return json.load(f)
+
+
+def save_scale_record(report: Dict, path: str) -> None:
+    import json
+
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(report, f, indent=2, sort_keys=True)
+        f.write("\n")
+
+
+def compare_with_record(
+    report: Dict, record: Optional[Dict], baseline_path: str
+) -> List[Finding]:
+    """Drift gate against the committed scale record: entry-set changes
+    and signature changes are exact (the recompile surface is policy),
+    byte estimates get PEAK_DRIFT_TOLERANCE (liveness estimates may
+    shift slightly across pinned-jax upgrades)."""
+    regen = f"regenerate with `stc lint --scale --rebaseline` ({baseline_path})"
+    if record is None:
+        return [Finding(
+            rule="STC210", path="scale:baseline", line=0,
+            message=(
+                f"no committed scale record at {baseline_path} — the "
+                f"V=10M/k=500 claim has no evidence artifact; {regen}"
+            ),
+            snippet="missing scale_baseline.json",
+        )]
+    out: List[Finding] = []
+    old = record.get("entries", {})
+    new = report.get("entries", {})
+    for name in sorted(set(old) - set(new)):
+        out.append(Finding(
+            rule="STC210", path=f"scale:{name}", line=0,
+            message=(
+                f"entry is in the committed scale record but no longer "
+                f"audits at scale — {regen}"
+            ),
+            snippet="entry vanished from scale audit",
+        ))
+    for name in sorted(set(new) - set(old)):
+        out.append(Finding(
+            rule="STC210", path=f"scale:{name}", line=0,
+            message=(
+                f"entry audits at scale but is missing from the "
+                f"committed scale record — {regen}"
+            ),
+            snippet="entry missing from scale_baseline.json",
+        ))
+    for name in sorted(set(new) & set(old)):
+        o, n = old[name], new[name]
+        if list(o.get("signature", [])) != list(n.get("signature", [])):
+            out.append(Finding(
+                rule="STC211", path=f"scale:{name}", line=0,
+                message=(
+                    f"scale input signature drifted from the committed "
+                    f"record — the recompile surface changed; {regen}"
+                ),
+                snippet="signature drift vs scale_baseline.json",
+            ))
+        ob = float(o.get("per_chip_peak_bytes", 0))
+        nb = float(n.get("per_chip_peak_bytes", 0))
+        if ob and not math.isclose(
+            nb, ob, rel_tol=PEAK_DRIFT_TOLERANCE
+        ):
+            out.append(Finding(
+                rule="STC212", path=f"scale:{name}", line=0,
+                message=(
+                    f"per-chip peak estimate drifted "
+                    f"{ob / 2**20:.1f} -> {nb / 2**20:.1f} MiB "
+                    f"(> {PEAK_DRIFT_TOLERANCE:.0%} tolerance) vs the "
+                    f"committed record — {regen}"
+                ),
+                snippet="hbm drift vs scale_baseline.json",
+            ))
+    return out
+
+
+def run_scale_audit(
+    entries=None,
+    *,
+    backend: str = DEFAULT_BACKEND,
+) -> Tuple[List[Finding], Dict]:
+    """Audit every registered entry point at its declared scale shapes.
+
+    Same platform discipline as layer 2: pins jax to CPU before the
+    backend comes up (tracing is platform-independent; a wedged TPU
+    tunnel must never hang the linter).  Returns (findings, report);
+    a registration without a ``ScaleSpec`` is an STC210 finding — the
+    scale tier must cover the whole registry or say why not.
+    """
+    import sys
+
+    if "jax" not in sys.modules:
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    else:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+    from .entrypoints import ENTRYPOINTS
+
+    if entries is None:
+        entries = ENTRYPOINTS
+    findings: List[Finding] = []
+    report: Dict = {
+        "version": 1,
+        "backend": backend,
+        "hbm_utilization": HBM_UTILIZATION,
+        "entries": {},
+    }
+    for ep in entries:
+        spec = getattr(ep, "scale", None)
+        if spec is None:
+            findings.append(Finding(
+                rule="STC210", path=f"scale:{ep.name}", line=0,
+                message=(
+                    "entry point declares no scale shapes "
+                    "(EntryPoint.scale) — the V=10M/k=500 audit "
+                    "cannot see it; declare a ScaleSpec in the same "
+                    "PR as the registration"
+                ),
+                snippet="no ScaleSpec declared",
+            ))
+            continue
+        f, record = audit_entry_scale(
+            ep.name, spec, multichip=ep.multichip, backend=backend
+        )
+        findings.extend(f)
+        if record is not None:
+            report["entries"][ep.name] = record
+    return findings, report

@@ -158,6 +158,14 @@ METRICS: Dict[str, str] = {
     # -- static analysis (docs/STATIC_ANALYSIS.md) ----------------------
     "lint.findings": "unwaived stc lint findings in the last run",
     "lint.waived": "stc lint findings suppressed by pragma or baseline",
+    "lint.scale_entries":
+        "entry points traced at their declared V=10M/k=500 scale "
+        "shapes by the last `stc lint --scale` run (the layer-3 audit)",
+    "lint.scale_findings":
+        "unwaived STC210-215 scale-audit findings in the last run",
+    "lint.scale_waived":
+        "scale-audit findings suppressed by pragma or baseline (the "
+        "reasoned single-chip-tier HBM exceptions)",
 }
 
 # prefix -> owner/description of the dynamic family

@@ -47,18 +47,24 @@ __all__ = [
     "rows_live",
 ]
 
-# key -> {flops_per_s, bytes_per_s, note}; per chip (not per host)
+# key -> {flops_per_s, bytes_per_s, hbm_bytes, note}; per chip (not per
+# host).  hbm_bytes is the datasheet capacity the static scale audit
+# (analysis.scale_audit, rule STC212) budgets per-chip peak-live
+# estimates against.
 BACKEND_PEAKS: Dict[str, Dict] = {
     "tpu-v5e": {
         "flops_per_s": 197e12, "bytes_per_s": 819e9,
+        "hbm_bytes": 16 * 2**30,
         "note": "bf16 MXU peak / HBM2 per chip",
     },
     "tpu-v4": {
         "flops_per_s": 275e12, "bytes_per_s": 1228e9,
+        "hbm_bytes": 32 * 2**30,
         "note": "bf16 MXU peak / HBM2 per chip",
     },
     "cpu": {
         "flops_per_s": 5e10, "bytes_per_s": 2e10,
+        "hbm_bytes": 64 * 2**30,
         "note": "order-of-magnitude sandbox default — override "
                 "with --peaks for a calibrated host",
     },

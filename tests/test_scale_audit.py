@@ -1,0 +1,359 @@
+"""Layer-3 (scale audit) self-tests: plant each STC210-215 hazard in a
+throwaway ScaleSpec and assert the audit flags it, pin the registry's
+scale coverage (every entry declares scale shapes; the vocab-sharded
+families reach V=10M/k=500), and round-trip the committed scale record's
+drift gate.
+
+Everything traces ABSTRACTLY (ShapeDtypeStruct args) — planting a
+"40 GB" entry costs nothing."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from spark_text_clustering_tpu.utils import jax_compat  # noqa: F401  (jax.shard_map shim on 0.4.x)
+from spark_text_clustering_tpu.analysis.entrypoints import (
+    ENTRYPOINTS,
+    SCALE_K,
+    SCALE_V,
+    EntryPoint,
+    ScaleDim,
+    ScaleSpec,
+)
+from spark_text_clustering_tpu.analysis.scale_audit import (
+    audit_entry_scale,
+    compare_with_record,
+    run_scale_audit,
+)
+
+
+def _rules(findings):
+    return sorted({f.rule for f in findings})
+
+
+def _sds(shape, dtype=np.float32):
+    return jax.ShapeDtypeStruct(tuple(shape), dtype)
+
+
+def _mesh():
+    from spark_text_clustering_tpu.parallel.mesh import make_mesh
+
+    return make_mesh(
+        data_shards=1, model_shards=1, devices=jax.devices()[:1]
+    )
+
+
+# ---------------------------------------------------------------------------
+# planted hazards
+# ---------------------------------------------------------------------------
+def test_planted_trace_failure_is_stc210():
+    def build(dims):
+        raise ValueError("no such factory")
+
+    spec = ScaleSpec(dims={"v": ScaleDim((1024,))}, build=build)
+    findings, record = audit_entry_scale("selftest.broken", spec)
+    assert _rules(findings) == ["STC210"]
+    assert record is None
+
+
+def test_missing_scale_spec_is_stc210():
+    ep = EntryPoint("selftest.nospec", False, lambda: (None, ()))
+    findings, report = run_scale_audit([ep])
+    assert _rules(findings) == ["STC210"]
+    assert report["entries"] == {}
+
+
+def test_planted_unbucketed_dynamic_dim_is_stc211():
+    """The canonical recompile storm: the batch dim changes the input
+    signature between adjacent scale points and is NOT declared
+    bucketed — every distinct runtime value would compile again."""
+
+    def build(dims):
+        def fn(x):
+            return x * np.float32(2.0)
+
+        return fn, (_sds((dims["b"], 16)),)
+
+    spec = ScaleSpec(
+        dims={"b": ScaleDim((100, 101))},  # unbucketed, dynamic
+        build=build,
+    )
+    findings, record = audit_entry_scale("selftest.storm", spec)
+    assert _rules(findings) == ["STC211"]
+    assert "UNBUCKETED" in findings[0].message
+    assert record is not None
+
+
+def test_bucketed_pow2_grid_is_clean_but_non_pow2_is_stc211():
+    def build(dims):
+        def fn(x):
+            return x * np.float32(2.0)
+
+        return fn, (_sds((dims["b"], 16)),)
+
+    clean = ScaleSpec(
+        dims={"b": ScaleDim((512, 1024), bucketed=True)}, build=build
+    )
+    findings, _ = audit_entry_scale("selftest.buckets", clean)
+    assert findings == []
+
+    crooked = ScaleSpec(
+        dims={"b": ScaleDim((100, 200), bucketed=True)}, build=build
+    )
+    findings, _ = audit_entry_scale("selftest.crooked", crooked)
+    assert _rules(findings) == ["STC211"]
+    assert "pow2" in findings[0].message
+
+
+def test_planted_over_hbm_entry_is_stc212():
+    """A 40 GB unsharded operand against the 14.4 GiB v5e budget."""
+
+    def build(dims):
+        def fn(x):
+            return x + np.float32(1.0)
+
+        return fn, (_sds((dims["v"], 100)),)
+
+    spec = ScaleSpec(
+        dims={"v": ScaleDim((100_000_000,))}, build=build
+    )
+    findings, record = audit_entry_scale("selftest.hbm", spec)
+    assert _rules(findings) == ["STC212"]
+    assert record["per_chip_peak_bytes"] > 40 * 2**30
+
+
+def test_sharded_entry_under_budget_is_clean_and_divides():
+    """The same width, declared vocab-sharded over 16 chips, fits."""
+
+    def build(dims):
+        mesh = _mesh()
+        from jax.sharding import PartitionSpec as P
+
+        def body(x):
+            return x * np.float32(2.0)
+
+        fn = jax.jit(jax.shard_map(
+            body, mesh=mesh,
+            in_specs=P(None, "model"), out_specs=P(None, "model"),
+        ))
+        return fn, (_sds((100, dims["v"])),)
+
+    spec = ScaleSpec(
+        dims={"v": ScaleDim((100_000_000,))},
+        build=build,
+        sharded_dims=("v",),
+        model_shards=16,
+    )
+    findings, record = audit_entry_scale(
+        "selftest.sharded", spec, multichip=True
+    )
+    assert findings == [], [f.message for f in findings]
+    # 100 x 100M f32 = 40 GB global -> 2.5 GB per chip, in + out live
+    assert record["per_chip_peak_bytes"] < 6 * 2**30
+
+
+def test_planted_replication_gap_is_stc213():
+    """Declared vocab-sharded, but the jaxpr carries no model-axis
+    mapping — the silent full-replication hazard."""
+
+    def build(dims):
+        def fn(x):
+            return x * np.float32(2.0)
+
+        return fn, (_sds((100, dims["v"])),)
+
+    spec = ScaleSpec(
+        dims={"v": ScaleDim((1 << 20,))},
+        build=build,
+        sharded_dims=("v",),
+        model_shards=16,
+    )
+    findings, _ = audit_entry_scale(
+        "selftest.replicated", spec, multichip=True
+    )
+    assert "STC213" in _rules(findings)
+    assert any("replicated" in f.message for f in findings)
+
+
+def test_planted_model_axis_all_gather_is_stc213():
+    def build(dims):
+        mesh = _mesh()
+        from jax.sharding import PartitionSpec as P
+
+        def body(x):
+            return jax.lax.all_gather(x, "model", axis=1, tiled=True)
+
+        fn = jax.jit(jax.shard_map(
+            body, mesh=mesh,
+            in_specs=P(None, "model"), out_specs=P(),
+            check_rep=False,
+        ))
+        return fn, (_sds((8, dims["v"])),)
+
+    spec = ScaleSpec(
+        dims={"v": ScaleDim((1 << 20,))},
+        build=build,
+        sharded_dims=("v",),
+        model_shards=16,
+    )
+    findings, _ = audit_entry_scale(
+        "selftest.gather", spec, multichip=True
+    )
+    assert "STC213" in _rules(findings)
+    assert any("all_gather" in f.message for f in findings)
+
+
+def test_planted_collective_bytes_over_budget_is_stc214():
+    def build(dims):
+        mesh = _mesh()
+        from jax.sharding import PartitionSpec as P
+
+        def body(x):
+            return jax.lax.psum(x, "data")
+
+        fn = jax.jit(jax.shard_map(
+            body, mesh=mesh, in_specs=P(None, None), out_specs=P(),
+        ))
+        return fn, (_sds((1024, dims["v"])),)
+
+    spec = ScaleSpec(
+        dims={"v": ScaleDim((1 << 20,))},   # 4 GB psum, unsharded
+        build=build,
+    )
+    findings, record = audit_entry_scale("selftest.coll", spec)
+    assert "STC214" in _rules(findings)
+    assert record["collective_bytes_per_step"] > 2 << 30
+    # a raised per-entry budget silences exactly this rule
+    waived = ScaleSpec(
+        dims=spec.dims, build=build,
+        collective_budget_bytes=8 << 30,
+    )
+    findings, _ = audit_entry_scale("selftest.coll2", waived)
+    assert "STC214" not in _rules(findings)
+
+
+def test_planted_scale_param_promotion_is_stc215():
+    """The scale-only dtype leak: id/offset dtypes chosen FROM the
+    scale value (int32 vocab ids flip to int64 past 2^31) change the
+    traced program only at production params."""
+
+    def build(dims):
+        v = dims["v"]
+        idt = np.int32 if v < 2**31 else np.int64
+
+        def fn(ids, table):
+            return table[ids]
+
+        return fn, (_sds((16,), idt), _sds((64, 4)))
+
+    spec = ScaleSpec(
+        dims={"v": ScaleDim((1 << 20, 1 << 32))}, build=build
+    )
+    findings, _ = audit_entry_scale("selftest.promote", spec)
+    assert "STC215" in _rules(findings)
+    assert any(
+        "int32" in f.message and "int64" in f.message
+        for f in findings
+        if f.rule == "STC215"
+    )
+
+
+# ---------------------------------------------------------------------------
+# committed scale record drift gate
+# ---------------------------------------------------------------------------
+def _report(**entries):
+    return {"version": 1, "backend": "tpu-v5e", "entries": entries}
+
+
+def _entry(sig, peak):
+    return {"signature": sig, "per_chip_peak_bytes": peak}
+
+
+def test_missing_record_and_entry_set_drift_are_stc210():
+    rep = _report(a=_entry(["[4]"], 100))
+    findings = compare_with_record(rep, None, "scale_baseline.json")
+    assert _rules(findings) == ["STC210"]
+
+    rec = _report(a=_entry(["[4]"], 100), gone=_entry(["[4]"], 100))
+    rep2 = _report(
+        a=_entry(["[4]"], 100), fresh=_entry(["[4]"], 100)
+    )
+    findings = compare_with_record(rep2, rec, "scale_baseline.json")
+    assert sorted(f.path for f in findings) == [
+        "scale:fresh", "scale:gone",
+    ]
+    assert _rules(findings) == ["STC210"]
+
+
+def test_signature_and_peak_drift_gate():
+    rec = _report(a=_entry(["[4]"], 1000))
+    sig_drift = compare_with_record(
+        _report(a=_entry(["[8]"], 1000)), rec, "b.json"
+    )
+    assert _rules(sig_drift) == ["STC211"]
+    peak_drift = compare_with_record(
+        _report(a=_entry(["[4]"], 2000)), rec, "b.json"
+    )
+    assert _rules(peak_drift) == ["STC212"]
+    within_tolerance = compare_with_record(
+        _report(a=_entry(["[4]"], 1050)), rec, "b.json"
+    )
+    assert within_tolerance == []
+
+
+# ---------------------------------------------------------------------------
+# registry coverage at scale
+# ---------------------------------------------------------------------------
+def test_every_registered_entry_declares_scale_shapes():
+    missing = [ep.name for ep in ENTRYPOINTS if ep.scale is None]
+    assert missing == [], missing
+    assert len(ENTRYPOINTS) >= 20
+
+
+def test_vocab_sharded_families_reach_ccnews_scale():
+    """The ROADMAP-item-1 claim is only evidence if the audit actually
+    reaches V=10M/k=500 on the sharded training/eval families."""
+    for family in ("em_lda.", "online_lda.", "sharded_eval.", "nmf."):
+        eps = [
+            ep for ep in ENTRYPOINTS
+            if ep.name.startswith(family) and ep.scale is not None
+            and "v" in ep.scale.dims
+        ]
+        assert eps, family
+        assert any(
+            ep.scale.dims["v"].points[-1] >= SCALE_V
+            and ep.scale.dims["k"].points[-1] >= SCALE_K
+            for ep in eps
+        ), family
+
+
+def test_registry_scale_smoke_two_entries():
+    """One vocab-sharded step and the packed loglik audit clean at full
+    scale — the whole registry runs in CI gate 15 and the slow test."""
+    subset = [
+        ep for ep in ENTRYPOINTS
+        if ep.name in ("em_lda.bucket_step", "em_lda.packed_loglik")
+    ]
+    findings, report = run_scale_audit(subset)
+    assert findings == [], [
+        f"{f.path}: {f.rule}: {f.message}" for f in findings
+    ]
+    rec = report["entries"]["em_lda.bucket_step"]
+    # the fits-in-HBM claim: a 20 GB lambda audits under budget only
+    # because the model-axis sharding divides it across 16 chips
+    assert rec["per_chip_peak_bytes"] < rec["hbm_budget_bytes"]
+    assert rec["model_shards"] == 16
+
+
+@pytest.mark.slow
+def test_full_registry_scale_audit_matches_waived_exceptions():
+    """The full registry at scale: the ONLY breaches are the three
+    reasoned single-chip-tier STC212 waivers in lint_baseline.json."""
+    findings, report = run_scale_audit()
+    assert len(report["entries"]) == len(ENTRYPOINTS)
+    assert sorted({(f.path, f.rule) for f in findings}) == [
+        ("scale:models.score_gather", "STC212"),
+        ("scale:nmf.solve_w", "STC212"),
+        ("scale:ops.lda_math.e_step", "STC212"),
+    ]
