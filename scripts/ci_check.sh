@@ -104,12 +104,31 @@
 #      evidence record; the run's lint.scale_* counters gate against
 #      the committed baseline, and a planted STC211 recompile hazard +
 #      a planted STC212 HBM breach must both gate red (self-test)
+#  16. measured-scale observatory (`stc metrics scale-check --run`,
+#      telemetry/scale_probe, docs/OBSERVABILITY.md "Measured-scale
+#      observatory"): the vocab-sharded entry families (EM bucket
+#      step, online sufficient stats, sharded eval, sharded
+#      top-words) are EXECUTED on the forced 2x4 (data, model)
+#      8-virtual-device host mesh and the measured evidence — per-
+#      shard memory_analysis peaks, the executables' actual input/
+#      output shardings, collective bytes per step, per-device
+#      memory_stats (explicitly unavailable on CPU) — reconciles
+#      against the gate-15 static record within the committed
+#      tolerance: measured sharding must match the record's
+#      model-sharded declaration, zero retraces after the first step,
+#      the measured-anchored V=10M extrapolation must stay under the
+#      v5e HBM budget, and the measured twin section committed in
+#      scale_baseline.json drift-gates the ratios; the run's
+#      counter.scale.* gate against the committed baseline, and a
+#      planted over-budget probe + a planted silently-replicated
+#      probe must BOTH gate red (self-test)
 #
 # Usage:
-#   scripts/ci_check.sh                 # run all fifteen gates
+#   scripts/ci_check.sh                 # run all sixteen gates
 #   scripts/ci_check.sh --rebaseline    # recapture ALL baselines
 #                                       # (metrics + lint waivers +
 #                                       # lint counters + scale record
+#                                       # incl. the measured twin
 #                                       # + compile signatures; commit
 #                                       # the result deliberately)
 set -uo pipefail
@@ -1083,6 +1102,16 @@ if [[ "${1:-}" == "--rebaseline" ]]; then
     python -m spark_text_clustering_tpu.cli metrics check \
         "$work/lint_scale.jsonl" --baseline "$BASELINE" \
         --write-baseline --tolerance 0.0 --include lint.scale || exit 1
+    # re-run the measured-scale probe, re-commit the measured twin
+    # section of the scale record, and fold the gate-16 counters
+    python -m spark_text_clustering_tpu.cli metrics scale-check --run \
+        --baseline scripts/records/scale_baseline.json \
+        --telemetry-file "$work/scale_check.jsonl" \
+        --write-record --fail-on-divergence || exit 1
+    python -m spark_text_clustering_tpu.cli metrics check \
+        "$work/scale_check.jsonl" --baseline "$BASELINE" \
+        --write-baseline --tolerance 0.0 --include counter.scale. \
+        || exit 1
     # fold the exactly-once drill's ledger counters the same way
     run_ledger_drill "$work" || exit 1
     python -m spark_text_clustering_tpu.cli metrics check \
@@ -1137,12 +1166,12 @@ fail=0
 work=$(mktemp -d)
 trap 'rm -rf "$work"' EXIT
 
-echo "== [1/15] stc lint (AST rules + jaxpr audit) =="
+echo "== [1/16] stc lint (AST rules + jaxpr audit) =="
 python -m spark_text_clustering_tpu.cli lint \
     --telemetry-file "$work/lint.jsonl"
 if [[ $? -ne 0 ]]; then echo "FAIL: stc lint"; fail=1; fi
 
-echo "== [2/15] ruff (generic-Python tier) =="
+echo "== [2/16] ruff (generic-Python tier) =="
 if command -v ruff >/dev/null 2>&1; then
     ruff check spark_text_clustering_tpu
     if [[ $? -ne 0 ]]; then echo "FAIL: ruff"; fail=1; fi
@@ -1150,17 +1179,17 @@ else
     echo "ruff not installed — skipped (stc lint STC101/102/006 cover it)"
 fi
 
-echo "== [3/15] tier-1 tests =="
+echo "== [3/16] tier-1 tests =="
 timeout -k 10 870 python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors -p no:cacheprovider \
     -p no:xdist -p no:randomly
 if [[ $? -ne 0 ]]; then echo "FAIL: tier-1"; fail=1; fi
 
-echo "== [4/15] telemetry overhead budget =="
+echo "== [4/16] telemetry overhead budget =="
 python scripts/check_telemetry_overhead.py
 if [[ $? -ne 0 ]]; then echo "FAIL: telemetry overhead"; fail=1; fi
 
-echo "== [5/15] metrics regression gate =="
+echo "== [5/16] metrics regression gate =="
 if run_ci_train "$work"; then
     # lint., ledger., fleet., serve., and alert. families are captured
     # by their own gates (1/6, 8, 10, 11, and 12) — a batch train run
@@ -1169,14 +1198,15 @@ if run_ci_train "$work"; then
         --baseline "$BASELINE" "${EXCLUDES[@]}" --exclude lint. \
         --exclude ledger. --exclude fleet. --exclude serve. \
         --exclude alert. --exclude monitor. --exclude drift. \
-        --exclude compile.cache --exclude trace. --exclude lineage.
+        --exclude compile.cache --exclude trace. --exclude lineage. \
+        --exclude scale.
     if [[ $? -ne 0 ]]; then echo "FAIL: metrics check"; fail=1; fi
 else
     echo "FAIL: CI training run"
     fail=1
 fi
 
-echo "== [6/15] lint metrics gate (waiver count version-gated) =="
+echo "== [6/16] lint metrics gate (waiver count version-gated) =="
 if [[ -s "$work/lint.jsonl" ]]; then
     # lint.scale_* belong to the gate-15 --scale stream, not stage 1's
     python -m spark_text_clustering_tpu.cli metrics check "$work/lint.jsonl" \
@@ -1187,7 +1217,7 @@ else
     fail=1
 fi
 
-echo "== [7/15] cross-host skew gate (metrics merge) =="
+echo "== [7/16] cross-host skew gate (metrics merge) =="
 if make_skew_streams "$work"; then
     python -m spark_text_clustering_tpu.cli metrics merge \
         "$work/skew-p0.jsonl" "$work/skew-p1.jsonl" --fail-on-skew \
@@ -1208,7 +1238,7 @@ else
     fail=1
 fi
 
-echo "== [8/15] exactly-once ledger chaos drill (STC_FAULTS) =="
+echo "== [8/16] exactly-once ledger chaos drill (STC_FAULTS) =="
 if run_ledger_drill "$work"; then
     python -m spark_text_clustering_tpu.cli metrics check \
         "$work/ledger_drill.jsonl" --baseline "$BASELINE" \
@@ -1219,7 +1249,7 @@ else
     fail=1
 fi
 
-echo "== [9/15] recompile sentinel (metrics compile-check) =="
+echo "== [9/16] recompile sentinel (metrics compile-check) =="
 if [[ -s "$work/run.jsonl" ]] && run_ci_score "$work" \
     && run_ci_nmf "$work"; then
     python -m spark_text_clustering_tpu.cli metrics compile-check \
@@ -1246,7 +1276,7 @@ else
     fail=1
 fi
 
-echo "== [10/15] supervisor drill (lease expiry -> SIGKILL -> respawn) =="
+echo "== [10/16] supervisor drill (lease expiry -> SIGKILL -> respawn) =="
 if run_supervisor_drill "$work"; then
     # the ladder's counters are deterministic: 3 spawns (2 + 1
     # respawn), 1 lease expiry, 1 preemption (the drain SIGTERM the
@@ -1260,7 +1290,7 @@ else
     fail=1
 fi
 
-echo "== [11/15] serve drill (hot-swap + drain + zero-recompile) =="
+echo "== [11/16] serve drill (hot-swap + drain + zero-recompile) =="
 if [[ -d "$work/models" ]] && run_serve_drill "$work"; then
     # requests (32 = two exact 16-doc volleys) and swaps (1) are
     # machine-independent; batch counts depend on coalescing timing
@@ -1274,7 +1304,7 @@ else
     fail=1
 fi
 
-echo "== [12/15] monitor drill (alerts fire/resolve + resize-on-alert) =="
+echo "== [12/16] monitor drill (alerts fire/resolve + resize-on-alert) =="
 if run_monitor_once_drill "$work"; then
     # the --once storm run's alert counters are deterministic: exactly
     # one firing (retrace_storm), nothing pending/resolved
@@ -1295,7 +1325,7 @@ if ! run_monitor_resize_drill "$work"; then
     fail=1
 fi
 
-echo "== [13/15] executable-cache cold-start drill (compilecache) =="
+echo "== [13/16] executable-cache cold-start drill (compilecache) =="
 if [[ -d "$work/models" ]] && run_cold_start_drill "$work"; then
     # the warm B run's cache counters are deterministic: one hit per
     # score-path digest, zero misses/stores/invalidations
@@ -1308,7 +1338,7 @@ else
     fail=1
 fi
 
-echo "== [14/15] end-to-end lineage drill (causal tracing) =="
+echo "== [14/16] end-to-end lineage drill (causal tracing) =="
 if run_lineage_drill "$work"; then
     # the serve run's trace counters are deterministic: ONE sampled
     # request, four emitted spans, nothing dropped
@@ -1321,7 +1351,7 @@ else
     fail=1
 fi
 
-echo "== [15/15] scale audit (stc lint --scale, STC210-215) =="
+echo "== [15/16] scale audit (stc lint --scale, STC210-215) =="
 python -m spark_text_clustering_tpu.cli lint --scale \
     --telemetry-file "$work/lint_scale.jsonl" >/dev/null
 if [[ $? -ne 0 ]]; then
@@ -1390,6 +1420,62 @@ print(
 EOF
 if [[ $? -ne 0 ]]; then
     echo "FAIL: planted scale violations not flagged"
+    fail=1
+fi
+
+echo "== [16/16] measured-scale observatory (probe + scale-check) =="
+# run the sharded entry families for REAL on the forced 2x4 host mesh
+# and reconcile the measured evidence against the gate-15 static
+# record: sharding match, tolerance, zero retraces, V=10M
+# extrapolation under budget, measured-record drift
+python -m spark_text_clustering_tpu.cli metrics scale-check --run \
+    --probe-out "$work/scale_probe.json" \
+    --baseline scripts/records/scale_baseline.json \
+    --telemetry-file "$work/scale_check.jsonl" \
+    --fail-on-divergence
+if [[ $? -ne 0 ]]; then
+    echo "FAIL: measured sharded path diverged from the static scale audit"
+    fail=1
+fi
+# the probe must really have forced the 8-device dryrun mesh — a 1x1
+# fallback would reconcile nothing worth gating on
+if ! grep -q '"device_count": 8' "$work/scale_probe.json" \
+    || ! grep -q '"model_shards": 4' "$work/scale_probe.json"; then
+    echo "FAIL: scale probe did not run on the forced 2x4 dryrun mesh"
+    fail=1
+fi
+if [[ -s "$work/scale_check.jsonl" ]]; then
+    # probe_runs/divergences/sharding_mismatches are deterministic:
+    # exactly one probe, zero of both failure counters
+    python -m spark_text_clustering_tpu.cli metrics check \
+        "$work/scale_check.jsonl" --baseline "$BASELINE" \
+        --include counter.scale.
+    if [[ $? -ne 0 ]]; then echo "FAIL: scale counters"; fail=1; fi
+else
+    echo "FAIL: no scale-check telemetry stream"
+    fail=1
+fi
+# self-test: a planted over-budget probe (measured peak x30 -> the
+# V=10M extrapolation blows the HBM budget) and a planted
+# silently-replicated probe must BOTH gate red — the measurement tier
+# is only a gate if the hazards it exists for actually trip it
+python - "$work" <<'EOF'
+import json, sys
+
+work = sys.argv[1]
+ev = json.load(open(f"{work}/scale_probe.json"))
+bad = json.loads(json.dumps(ev))
+e = bad["entries"]["em_lda.bucket_step"]
+e["measured"]["per_chip_peak_bytes"] *= 30
+bad["entries"]["sharded_eval.topic_inference"]["model_sharded"] = False
+json.dump(bad, open(f"{work}/scale_probe_bad.json", "w"))
+EOF
+python -m spark_text_clustering_tpu.cli metrics scale-check \
+    "$work/scale_probe_bad.json" \
+    --baseline scripts/records/scale_baseline.json \
+    --fail-on-divergence >/dev/null
+if [[ $? -ne 1 ]]; then
+    echo "FAIL: planted over-budget/replicated probe not flagged"
     fail=1
 fi
 

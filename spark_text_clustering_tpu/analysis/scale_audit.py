@@ -58,9 +58,11 @@ __all__ = [
     "HBM_UTILIZATION",
     "COLLECTIVE_BUDGET_BYTES",
     "PEAK_DRIFT_TOLERANCE",
+    "MEASURED_DRIFT_TOLERANCE",
     "audit_entry_scale",
     "run_scale_audit",
     "compare_with_record",
+    "compare_measured_with_record",
     "load_scale_record",
     "save_scale_record",
 ]
@@ -75,6 +77,15 @@ HBM_UTILIZATION = 0.9
 COLLECTIVE_BUDGET_BYTES = 2 << 30
 # committed-record tolerance for byte estimates (signatures are exact)
 PEAK_DRIFT_TOLERANCE = 0.10
+# committed MEASURED-twin drift tolerance (telemetry.scale_probe /
+# `stc metrics scale-check`): absolute band on the measured/predicted
+# peak-byte ratio vs the ratio committed in the record's "measured"
+# section.  Ratios fold out machine-speed noise but memory_analysis
+# byte layouts still shift across XLA releases, so the band is wider
+# than the static one; a ratio stepping OUTSIDE it means the measured
+# anchoring of the scale claim changed and the record must be
+# re-committed deliberately (--write-record).
+MEASURED_DRIFT_TOLERANCE = 0.25
 
 DEFAULT_SCALE_BASELINE_PATH = os.path.join(
     "scripts", "records", "scale_baseline.json"
@@ -540,12 +551,86 @@ def load_scale_record(path: str) -> Optional[Dict]:
 
 
 def save_scale_record(report: Dict, path: str) -> None:
+    """Write the committed scale record.  The record schema carries TWO
+    sections: the static audit's ``entries`` (regenerated by
+    ``stc lint --scale --rebaseline``) and the measured-scale
+    observatory's ``measured`` twin (written by ``stc metrics
+    scale-check --write-record``).  Each writer owns only its own
+    section — a static rebaseline must not silently drop the committed
+    measured evidence, and vice versa."""
     import json
 
+    if "measured" not in report:
+        prev = load_scale_record(path)
+        if prev and "measured" in prev:
+            report = dict(report, measured=prev["measured"])
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     with open(path, "w", encoding="utf-8") as f:
         json.dump(report, f, indent=2, sort_keys=True)
         f.write("\n")
+
+
+def compare_measured_with_record(
+    fresh: Dict, record: Optional[Dict],
+    tolerance: float = MEASURED_DRIFT_TOLERANCE,
+) -> List[Dict]:
+    """Drift rules for the measured twin: a fresh probe's
+    measured/predicted ratios vs the record's committed ``measured``
+    section.  Returns plain finding dicts (``entry``/``field``/``old``/
+    ``new``/``why``) — the scale-check verb folds them into its
+    divergence count.  No committed measured section (or a different
+    probe geometry/mesh) yields no findings: drift needs a comparable
+    anchor, and the first ``--write-record`` creates one."""
+    old = (record or {}).get("measured")
+    if not old or not isinstance(old, dict):
+        return []
+    if (
+        old.get("geometry") != fresh.get("geometry")
+        or old.get("mesh") != fresh.get("mesh")
+    ):
+        return [{
+            "entry": "<record>", "field": "geometry",
+            "old": {"geometry": old.get("geometry"),
+                    "mesh": old.get("mesh")},
+            "new": {"geometry": fresh.get("geometry"),
+                    "mesh": fresh.get("mesh")},
+            "why": (
+                "committed measured section was captured at a "
+                "different probe geometry/mesh — re-commit with "
+                "--write-record (ratios are not comparable)"
+            ),
+        }]
+    out: List[Dict] = []
+    oe, ne = old.get("entries", {}), fresh.get("entries", {})
+    for name in sorted(set(oe) & set(ne)):
+        for fieldname in ("peak_ratio", "collective_ratio"):
+            ov, nv = oe[name].get(fieldname), ne[name].get(fieldname)
+            if ov is None or nv is None:
+                continue
+            if abs(float(nv) - float(ov)) > tolerance:
+                out.append({
+                    "entry": name, "field": fieldname,
+                    "old": ov, "new": nv,
+                    "why": (
+                        f"measured/predicted {fieldname} drifted "
+                        f"{ov} -> {nv} (> ±{tolerance} band) vs the "
+                        f"committed measured record — re-run the "
+                        f"probe and, if real, re-commit with "
+                        f"--write-record"
+                    ),
+                })
+        ov = oe[name].get("model_sharded")
+        nv = ne[name].get("model_sharded")
+        if ov is True and nv is False:
+            out.append({
+                "entry": name, "field": "model_sharded",
+                "old": ov, "new": nv,
+                "why": (
+                    "entry was measured model-sharded in the "
+                    "committed record but ran replicated now"
+                ),
+            })
+    return out
 
 
 def compare_with_record(

@@ -22,8 +22,8 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-__all__ = ["make_mesh", "data_sharding", "model_sharding", "replicated",
-           "initialize_distributed", "is_coordinator",
+__all__ = ["make_mesh", "dryrun_mesh", "data_sharding", "model_sharding",
+           "replicated", "initialize_distributed", "is_coordinator",
            "agree_checkpoint_exists", "agree_ledger_epoch",
            "DATA_AXIS", "MODEL_AXIS"]
 
@@ -48,6 +48,40 @@ def make_mesh(
         )
     arr = np.asarray(devices).reshape(data_shards, model_shards)
     return Mesh(arr, (DATA_AXIS, MODEL_AXIS))
+
+
+def dryrun_mesh(
+    model_shards: Optional[int] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """A mesh that FORCES model-axis sharding on whatever local devices
+    exist — the dryrun-multichip geometry the measured-scale probe runs
+    the vocab-sharded entry families on (telemetry.scale_probe).
+
+    On the 8-virtual-device host platform
+    (``XLA_FLAGS=--xla_force_host_platform_device_count=8``, the tier-1
+    harness and CI gate 16) this is a 2x4 (data, model) mesh: both axes
+    wider than 1, so a lost ``in_specs``/``out_specs`` mapping degrades
+    to OBSERVABLE replication instead of silently tracing through a 1x1
+    mesh the way the static audit's tracing mesh does.  ``model_shards``
+    defaults to the widest of (4, 2, 1) that divides the device count
+    while keeping the data axis >= the model choice's partner; a single
+    device degrades to 1x1 (the probe then reports, and the scale-check
+    gate flags, that sharding was NOT forced)."""
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if model_shards is None:
+        if n >= 8 and n % 4 == 0:
+            model_shards = 4
+        elif n >= 2 and n % 2 == 0:
+            model_shards = 2
+        else:
+            model_shards = 1
+    return make_mesh(
+        data_shards=n // model_shards,
+        model_shards=model_shards,
+        devices=devices,
+    )
 
 
 def data_sharding(mesh: Mesh, ndim: int = 2) -> NamedSharding:

@@ -117,6 +117,15 @@ class ExecutableRecord:
     # mem_source
     mem_bytes: Optional[Dict[str, int]] = None
     mem_source: str = "pending"
+    # the compiled executable's ACTUAL input/output shardings (captured
+    # on the same AOT retrace as cost/memory): the runtime twin of the
+    # static STC213 sharding-propagation check — a vocab-sharded entry
+    # whose executable consumes replicated wide operands is observable
+    # here, not just in a jaxpr.  Flat lists of jax sharding objects
+    # aligned with the tree-flattened call operands/results; None until
+    # captured (or when the executable cannot answer).
+    exec_in_shardings: Optional[list] = field(default=None, repr=False)
+    exec_out_shardings: Optional[list] = field(default=None, repr=False)
     # persistent executable cache (compilecache): "off" | "hit" |
     # "stored" | "miss" | "miss:<reason>"; a hit pins the deserialized
     # executable here and every later call for this digest uses it
@@ -269,6 +278,36 @@ def _attribute_compiled(rec: ExecutableRecord, compiled) -> None:
         rec.cost_source = f"error:{type(exc).__name__}"
         if rec.mem_source == "pending":
             rec.mem_source = f"unavailable:{type(exc).__name__}"
+    _capture_shardings(rec, compiled)
+
+
+def _capture_shardings(rec: ExecutableRecord, compiled) -> None:
+    """Stash the executable's input/output shardings on the record (the
+    measured-scale observatory's replication probe reads them; the
+    dispatch_executable announcement carries compact reprs).  Strictly
+    best-effort: deserialized cache entries and older jaxlibs may not
+    answer, and attribution never raises into the loop it observes."""
+    try:
+        ins, _ = compiled.input_shardings
+        import jax
+
+        rec.exec_in_shardings = list(jax.tree_util.tree_leaves(ins))
+        rec.exec_out_shardings = list(
+            jax.tree_util.tree_leaves(compiled.output_shardings)
+        )
+    except Exception:  # stc-lint: disable=STC002 -- sharding introspection is optional executable metadata (absent on deserialized cache entries and pre-AOT jaxlibs); cost/memory attribution above must survive its failure
+        rec.exec_in_shardings = None
+        rec.exec_out_shardings = None
+
+
+def _sharding_strs(shardings) -> Optional[list]:
+    if shardings is None:
+        return None
+    out = []
+    for s in shardings:
+        spec = getattr(s, "spec", None)
+        out.append(str(spec) if spec is not None else type(s).__name__)
+    return out
 
 
 def _analyze_cost(rec: ExecutableRecord, fn, args, kwargs):
@@ -433,6 +472,8 @@ def _account(rec: ExecutableRecord) -> None:
             compile_ordinal=rec.compile_ordinal,
             mem_peak_bytes=(rec.mem_bytes or {}).get("peak_bytes"),
             mem_source=rec.mem_source,
+            in_shardings=_sharding_strs(rec.exec_in_shardings),
+            out_shardings=_sharding_strs(rec.exec_out_shardings),
             cache=rec.cache_status,
             cache_load_seconds=rec.cache_load_seconds,
         )

@@ -17,8 +17,18 @@ does):
     every local device (``mem.device.bytes_in_use`` /
     ``.peak_bytes_in_use`` / ``.bytes_limit``, summed across devices)
     plus the host RSS (``mem.host.rss_bytes``), and emits one
-    ``memory_sample`` event.  CPU backends expose no ``memory_stats``;
-    the sample then carries ``device: "unavailable"`` and counts
+    ``memory_sample`` event.  Summed gauges are per-HOST pressure; under
+    sharding they hide per-device imbalance (one chip at 99% and seven
+    idle sums the same as eight at 50%), so the sample ALSO publishes a
+    per-device breakdown triple — ``mem.device.bytes_in_use_max`` /
+    ``..._min`` (likewise for ``peak_bytes_in_use``) and
+    ``mem.device.imbalance`` ((max-min)/max of the per-device peaks) —
+    the live twin of the static STC213 replication check:  a silently
+    replicated model reads as every device at FULL model width, a lost
+    data shard as one device far above the rest.  ``per_device_stats``
+    returns the raw per-device view (the measured-scale probe embeds
+    it).  CPU backends expose no ``memory_stats``; the sample then
+    carries ``device: "unavailable"`` and counts
     ``mem.device_stats_unavailable`` so dashboards can tell "no
     pressure" from "no data".  Call at epoch/trigger boundaries (the
     ``telemetry.sample_memory`` facade gates on enabled).
@@ -29,9 +39,16 @@ jax-free at import: jax is only touched if already loaded.
 from __future__ import annotations
 
 import sys
-from typing import Dict, Optional
+from typing import Dict, List, Optional
 
-__all__ = ["attribute_compiled", "sample", "host_rss_bytes", "device_stats"]
+__all__ = [
+    "attribute_compiled",
+    "sample",
+    "host_rss_bytes",
+    "device_stats",
+    "per_device_stats",
+    "device_breakdown",
+]
 
 # CompiledMemoryStats attribute -> gauge suffix
 _ANALYSIS_FIELDS = (
@@ -113,34 +130,93 @@ def host_rss_bytes() -> Optional[int]:
         return None
 
 
-def device_stats() -> Optional[Dict[str, int]]:
-    """Summed ``memory_stats()`` over local devices; None when no device
-    reports (the CPU backend) or jax was never imported."""
+def per_device_stats() -> Optional[List[Dict]]:
+    """Raw ``memory_stats()`` per local device — one dict per device
+    (``{"device": i, "kind": ..., "bytes_in_use": ..., ...}``, or
+    ``{"device": i, "kind": ..., "unavailable": <reason>}`` for a
+    device that cannot report, e.g. every CPU device).  None only when
+    jax was never imported or the backend cannot even enumerate
+    devices — an UNREPORTING device is data, not an error."""
     if "jax" not in sys.modules:
         return None
     import jax
 
-    totals: Dict[str, int] = {}
-    reported = 0
     try:
         devices = jax.local_devices()
     except Exception:  # stc-lint: disable=STC002 -- sampling is a best-effort probe: ANY backend bring-up failure degrades to the explicit "unavailable" marker, never a raise into the loop being observed
         return None
-    for d in devices:
+    rows: List[Dict] = []
+    for i, d in enumerate(devices):
+        row: Dict = {
+            "device": i,
+            "kind": str(getattr(d, "device_kind", "?")),
+        }
         stats_fn = getattr(d, "memory_stats", None)
         if stats_fn is None:
+            row["unavailable"] = "no_memory_stats"
+            rows.append(row)
             continue
         try:
             stats = stats_fn()
-        except Exception:  # stc-lint: disable=STC002 -- per-device memory_stats is optional runtime support (absent/raising on CPU and some plugin backends); an unreporting device is skipped, not fatal
+        except Exception as exc:  # stc-lint: disable=STC002 -- per-device memory_stats is optional runtime support (absent/raising on CPU and some plugin backends); an unreporting device is skipped, not fatal
+            row["unavailable"] = type(exc).__name__
+            rows.append(row)
             continue
         if not stats:
+            row["unavailable"] = "empty"
+            rows.append(row)
             continue
-        reported += 1
         for key, name in _DEVICE_FIELDS:
             v = stats.get(key)
             if isinstance(v, (int, float)) and v >= 0:
-                totals[name] = totals.get(name, 0) + int(v)
+                row[name] = int(v)
+        rows.append(row)
+    return rows
+
+
+def device_breakdown(
+    rows: Optional[List[Dict]],
+) -> Optional[Dict[str, float]]:
+    """Max/min/imbalance triple over the reporting devices of a
+    ``per_device_stats`` view — the gauges that make per-device
+    imbalance visible where the summed view hides it.  ``imbalance``
+    is (max-min)/max of the per-device PEAKS (0 = perfectly balanced,
+    -> 1 = one device carries everything).  None when no device
+    reports."""
+    reporting = [
+        r for r in (rows or []) if r and "unavailable" not in r
+    ]
+    if not reporting:
+        return None
+    out: Dict[str, float] = {"reporting_devices": len(reporting)}
+    for _, name in _DEVICE_FIELDS:
+        vals = [r[name] for r in reporting if name in r]
+        if not vals:
+            continue
+        out[f"{name}_max"] = max(vals)
+        out[f"{name}_min"] = min(vals)
+    peak_max = out.get("peak_bytes_in_use_max")
+    peak_min = out.get("peak_bytes_in_use_min")
+    if peak_max:
+        out["imbalance"] = (peak_max - peak_min) / peak_max
+    return out
+
+
+def device_stats() -> Optional[Dict[str, int]]:
+    """Summed ``memory_stats()`` over local devices; None when no device
+    reports (the CPU backend) or jax was never imported."""
+    rows = per_device_stats()
+    if rows is None:
+        return None
+    totals: Dict[str, int] = {}
+    reported = 0
+    for row in rows:
+        if "unavailable" in row:
+            continue
+        reported += 1
+        for _, name in _DEVICE_FIELDS:
+            if name in row:
+                totals[name] = totals.get(name, 0) + row[name]
     return totals if reported else None
 
 
@@ -157,6 +233,7 @@ def sample(label: str = "") -> Dict:
     if rss is not None:
         reg.gauge("mem.host.rss_bytes").set(rss)
         result["host_rss_bytes"] = rss
+    rows = per_device_stats()
     dev = device_stats()
     if dev is None:
         reg.counter("mem.device_stats_unavailable").inc()
@@ -165,6 +242,20 @@ def sample(label: str = "") -> Dict:
         for name, v in dev.items():
             reg.gauge(f"mem.device.{name}").set(v)
             result[f"device_{name}"] = v
+        # per-device breakdown alongside the sums: the summed view hides
+        # imbalance under sharding (docstring above)
+        br = device_breakdown(rows)
+        if br is not None:
+            for name, v in br.items():
+                if name == "reporting_devices":
+                    continue
+                reg.gauge(f"mem.device.{name}").set(v)
+                result[f"device_{name}"] = v
+    if rows is not None:
+        result["devices"] = len(rows)
+        result["devices_reporting"] = sum(
+            1 for r in rows if "unavailable" not in r
+        )
     w = get_writer()
     if w is not None:
         w.emit("memory_sample", **result)

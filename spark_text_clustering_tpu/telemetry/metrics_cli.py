@@ -58,6 +58,7 @@ __all__ = [
     "serving_health",
     "alert_health",
     "compile_health",
+    "memory_health",
     "cmd_summarize",
     "cmd_tail",
     "cmd_diff",
@@ -66,6 +67,7 @@ __all__ = [
     "cmd_trace",
     "cmd_roofline",
     "cmd_compile_check",
+    "cmd_scale_check",
     "add_metrics_subparser",
 ]
 
@@ -794,6 +796,50 @@ def compile_health(
     return out
 
 
+def memory_health(metrics: Dict[str, float]) -> Optional[Dict]:
+    """Memory-health summary from the live-sampling gauges
+    (telemetry.memory): device totals, the per-device max/min/imbalance
+    breakdown (the line that says one chip is carrying the model while
+    the sum looks fine), host RSS, and the unavailable-device counter.
+    None when the run never sampled memory."""
+    sampled = _is_num(metrics.get("counter.mem.samples"))
+    have_dev = any(
+        k.startswith("gauge.mem.device.") for k in metrics
+    )
+    if not sampled and not have_dev:
+        return None
+    out: Dict = {}
+    if sampled:
+        out["samples"] = int(metrics["counter.mem.samples"])
+    for k, name in (
+        ("gauge.mem.device.bytes_in_use", "device_bytes_in_use"),
+        ("gauge.mem.device.peak_bytes_in_use",
+         "device_peak_bytes_in_use"),
+        ("gauge.mem.device.bytes_limit", "device_bytes_limit"),
+        ("gauge.mem.host.rss_bytes", "host_rss_bytes"),
+    ):
+        if _is_num(metrics.get(k)):
+            out[name] = int(metrics[k])
+    per_dev = {}
+    for k, name in (
+        ("gauge.mem.device.peak_bytes_in_use_max", "peak_max"),
+        ("gauge.mem.device.peak_bytes_in_use_min", "peak_min"),
+        ("gauge.mem.device.bytes_in_use_max", "in_use_max"),
+        ("gauge.mem.device.bytes_in_use_min", "in_use_min"),
+    ):
+        if _is_num(metrics.get(k)):
+            per_dev[name] = int(metrics[k])
+    imb = metrics.get("gauge.mem.device.imbalance")
+    if _is_num(imb):
+        per_dev["imbalance"] = round(imb, 4)
+    if per_dev:
+        out["per_device"] = per_dev
+    unavail = metrics.get("counter.mem.device_stats_unavailable")
+    if _is_num(unavail):
+        out["device_stats_unavailable"] = int(unavail)
+    return out
+
+
 def alert_health(
     events: List[Dict], metrics: Dict[str, float]
 ) -> Optional[Dict]:
@@ -911,6 +957,51 @@ def _print_compile_health(ch: Dict, file=None) -> None:
         print(
             f"  INVALIDATED {inv['digest']} ({inv['label']}): "
             f"{inv['reason']}", file=file,
+        )
+
+
+def _print_memory_health(mh: Dict, file=None) -> None:
+    file = file if file is not None else sys.stdout
+    print("memory health:", file=file)
+    parts = []
+    if "device_bytes_in_use" in mh:
+        parts.append(
+            f"device in use {_fmt_bytes(mh['device_bytes_in_use'])}"
+        )
+    if "device_peak_bytes_in_use" in mh:
+        parts.append(
+            f"peak {_fmt_bytes(mh['device_peak_bytes_in_use'])}"
+        )
+    if "device_bytes_limit" in mh:
+        parts.append(
+            f"limit {_fmt_bytes(mh['device_bytes_limit'])}"
+        )
+    if "host_rss_bytes" in mh:
+        parts.append(f"host rss {_fmt_bytes(mh['host_rss_bytes'])}")
+    if parts:
+        print(
+            "  " + "  ".join(parts)
+            + (f"  ({mh['samples']} sample(s))"
+               if "samples" in mh else ""),
+            file=file,
+        )
+    pd = mh.get("per_device")
+    if pd:
+        imb = pd.get("imbalance")
+        print(
+            f"  per-device peak: max "
+            f"{_fmt_bytes(pd.get('peak_max'))}  min "
+            f"{_fmt_bytes(pd.get('peak_min'))}  imbalance "
+            + (f"{imb:.1%}" if imb is not None else "-")
+            + ("  <<IMBALANCED" if (imb or 0) > 0.5 else ""),
+            file=file,
+        )
+    if mh.get("device_stats_unavailable"):
+        print(
+            f"  device stats unavailable: "
+            f"{mh['device_stats_unavailable']} sample(s) (backend "
+            f"reports no memory_stats — no data, not no pressure)",
+            file=file,
         )
 
 
@@ -1088,6 +1179,7 @@ def _cmd_summarize(args) -> int:
     sh = serving_health(events, metrics)
     ah = alert_health(events, metrics)
     ch = compile_health(events, metrics)
+    mh = memory_health(metrics)
     if getattr(args, "json", False):
         doc = {"manifest": manifest, "metrics": metrics}
         if lh is not None:
@@ -1100,6 +1192,8 @@ def _cmd_summarize(args) -> int:
             doc["alert_health"] = ah
         if ch is not None:
             doc["compile_health"] = ch
+        if mh is not None:
+            doc["memory_health"] = mh
         print(json.dumps(doc, sort_keys=True))
         return 0
     print(f"run: {args.run}")
@@ -1116,6 +1210,8 @@ def _cmd_summarize(args) -> int:
         _print_alert_health(ah)
     if ch is not None:
         _print_compile_health(ch)
+    if mh is not None:
+        _print_memory_health(mh)
     print("metrics:")
     for k in sorted(metrics):
         v = metrics[k]
@@ -1388,16 +1484,27 @@ def _cmd_roofline(args) -> int:
         )
         return 2
     print(f"run: {args.run}")
+    hbm_note = (
+        f", {peaks['hbm_bytes'] / 2**30:.0f} GiB HBM"
+        if peaks.get("hbm_bytes") else ""
+    )
     print(
         f"peaks [{key}]: {peaks['flops_per_s'] / 1e12:.1f} TFLOP/s, "
-        f"{peaks['bytes_per_s'] / 1e9:.0f} GB/s — {peaks['note']}"
+        f"{peaks['bytes_per_s'] / 1e9:.0f} GB/s{hbm_note} — "
+        f"{peaks['note']}"
     )
     w = max(len(r["label"]) for r in rows)
     print(
         f"{'label'.ljust(w)}  {'digest':>10}  {'calls':>6}  "
         f"{'seconds':>9}  {'GFLOP/s':>9}  {'%peak':>6}  {'GB/s':>8}  "
-        f"{'%bw':>6}  {'%roof':>6}  {'bound':>7}  {'peak_mem':>9}"
+        f"{'%bw':>6}  {'%roof':>6}  {'bound':>7}  {'peak_mem':>9}  "
+        f"{'%hbm':>6}"
     )
+
+    def _hbm_cell(r):
+        hf = r.get("hbm_frac")
+        return f"{hf:.1%}" if hf is not None else "-"
+
     for r in rows:
         mem = _fmt_bytes(r.get("mem_peak_bytes"))
         if not r["available"]:
@@ -1405,7 +1512,7 @@ def _cmd_roofline(args) -> int:
                 f"{r['label'].ljust(w)}  {r['digest']:>10}  "
                 f"{r['calls']:>6}  {r['seconds']:>9.4f}  "
                 f"[unavailable: {r['why_unavailable']}]  "
-                f"peak_mem={mem}"
+                f"peak_mem={mem}  %hbm={_hbm_cell(r)}"
             )
             continue
         fb = r.get("frac_peak_bytes")
@@ -1418,14 +1525,17 @@ def _cmd_roofline(args) -> int:
             f"{(f'{fb:.1%}' if fb is not None else '-'):>6}  "
             f"{r['roofline_frac']:>6.1%}"
             f"{'!' if r.get('overunity') else ' '}  "
-            f"{r.get('bound', '-'):>6}  {mem:>9}"
+            f"{r.get('bound', '-'):>6}  {mem:>9}  "
+            f"{_hbm_cell(r):>6}"
         )
     n_avail = sum(1 for r in rows if r["available"])
     print(
         f"# {len(rows)} executable(s), {n_avail} with a full roofline "
         f"join (worst-first by % of attainable); '!' = over-unity: the "
         f"measured window missed device time (unsynced async dispatch) "
-        f"or the peaks understate this host"
+        f"or the peaks understate this host; %hbm = memory_analysis "
+        f"peak vs the backend's per-chip HBM (same hbm_bytes column "
+        f"the static scale audit budgets against)"
     )
     return 0
 
@@ -1510,6 +1620,221 @@ def cmd_compile_check(args) -> int:
         f"within the committed signature baseline"
     )
     return 1 if finds else 0
+
+
+def cmd_scale_check(args) -> int:
+    try:
+        return _cmd_scale_check(args)
+    except BrokenPipeError:      # `... | head` closed the pipe
+        return 0
+
+
+def _cmd_scale_check(args) -> int:
+    """Reconcile measured-scale probe evidence against the committed
+    static scale record (docs/OBSERVABILITY.md "Measured-scale
+    observatory").  ``--run`` executes the probe in-process on the
+    dryrun mesh; otherwise the positional argument is an evidence JSON
+    from an earlier run."""
+    from . import configure, count, event, manifest, shutdown
+    from .scale_probe import (
+        COLLECTIVE_TOLERANCE,
+        PEAK_TOLERANCE,
+        measured_section,
+        reconcile,
+    )
+    from ..analysis.scale_audit import (
+        compare_measured_with_record,
+        load_scale_record,
+        save_scale_record,
+    )
+
+    own_telemetry = bool(getattr(args, "telemetry_file", None))
+    if own_telemetry:
+        configure(args.telemetry_file)
+        manifest(kind="scale_check")
+
+    rc = 0
+    try:
+        if args.run:
+            from .scale_probe import run_probe
+
+            evidence = run_probe(entries=args.entries or None)
+            if args.probe_out:
+                with open(args.probe_out, "w", encoding="utf-8") as f:
+                    json.dump(evidence, f, indent=2, sort_keys=True)
+                    f.write("\n")
+        elif args.probe:
+            try:
+                with open(args.probe, "r", encoding="utf-8") as f:
+                    evidence = json.load(f)
+            except (OSError, json.JSONDecodeError) as exc:
+                print(
+                    f"cannot read probe evidence {args.probe}: {exc}",
+                    file=sys.stderr,
+                )
+                return 2
+        else:
+            print(
+                "scale-check needs probe evidence: pass a probe JSON "
+                "or --run to execute the probe now",
+                file=sys.stderr,
+            )
+            return 2
+
+        record = load_scale_record(args.baseline)
+        if record is None:
+            print(
+                f"warning: no committed scale record at "
+                f"{args.baseline} — reconciling against the static "
+                f"law only (no extrapolation, no drift gate)",
+                file=sys.stderr,
+            )
+
+        tol = (
+            args.tolerance if args.tolerance is not None
+            else PEAK_TOLERANCE
+        )
+        ctol = (
+            args.collective_tolerance
+            if args.collective_tolerance is not None
+            else COLLECTIVE_TOLERANCE
+        )
+        recon = reconcile(
+            evidence, record,
+            peak_tolerance=tol, collective_tolerance=ctol,
+        )
+        fresh = measured_section(evidence, recon)
+        drift = (
+            [] if args.write_record
+            else compare_measured_with_record(fresh, record)
+        )
+
+        divergences = int(recon["divergences"]) + len(drift)
+        mismatches = int(recon["sharding_mismatches"])
+        # the scale. family: always materialized (exact-zero baselines
+        # need the counters present, not absent)
+        count("scale.probe_runs", 0)
+        count("scale.divergences", divergences)
+        count("scale.sharding_mismatches", mismatches)
+        event(
+            "scale_check",
+            baseline=args.baseline,
+            entries=len(recon["entries"]),
+            divergences=divergences,
+            sharding_mismatches=mismatches,
+            record_drift=len(drift),
+        )
+
+        if args.write_record:
+            if record is None:
+                print(
+                    f"cannot --write-record: no committed scale record "
+                    f"at {args.baseline} (run `stc lint --scale "
+                    f"--rebaseline` first)",
+                    file=sys.stderr,
+                )
+                return 2
+            record["measured"] = fresh
+            save_scale_record(record, args.baseline)
+
+        if getattr(args, "json", False):
+            doc = {
+                "reconciliation": recon,
+                "record_drift": drift,
+                "measured_section": fresh,
+                "baseline": args.baseline,
+            }
+            print(json.dumps(doc, sort_keys=True))
+        else:
+            _render_scale_check(args, evidence, recon, drift)
+        if args.write_record:
+            print(
+                f"measured record committed: {args.baseline} "
+                f"({len(fresh['entries'])} entr(ies))"
+            )
+        status_fail = bool(divergences or mismatches)
+        print(
+            f"{'FAIL' if status_fail else 'PASS'}: "
+            f"{len(recon['entries'])} probed entr(ies), "
+            f"{divergences} divergence(s), {mismatches} sharding "
+            f"mismatch(es) vs {args.baseline} "
+            f"(tolerance +{tol:.0%} peak / +{ctol:.0%} collective)"
+        )
+        if args.fail_on_divergence and status_fail:
+            rc = 1
+        return rc
+    finally:
+        if own_telemetry:
+            shutdown()
+
+
+def _render_scale_check(args, evidence, recon, drift) -> None:
+    mesh = recon["probe"].get("mesh") or {}
+    geom = recon["probe"].get("geometry") or {}
+    print(
+        f"probe: backend={recon['probe'].get('backend')} mesh="
+        f"{mesh.get('data_shards')}x{mesh.get('model_shards')} "
+        f"(data x model) devices={recon['probe'].get('device_count')} "
+        f"geometry "
+        + " ".join(f"{k}={v}" for k, v in sorted(geom.items()))
+    )
+    if recon.get("probe_divergence"):
+        print(f"PROBE DIVERGENCE: {recon['probe_divergence']}")
+    names = list(recon["entries"])
+    w = max((len(n) for n in names), default=5)
+    print(
+        f"{'entry'.ljust(w)}  {'pred_peak':>9}  {'meas_peak':>9}  "
+        f"{'err':>7}  {'pred_coll':>9}  {'meas_coll':>9}  {'err':>7}  "
+        f"{'shard':>5}  {'retr':>4}  {'V=10M GiB':>9}  {'budget':>7}"
+    )
+
+    def _err(v):
+        return f"{v:+.1%}" if v is not None else "-"
+
+    for name in names:
+        r = recon["entries"][name]
+        sh = r.get("sharding", {})
+        shard_cell = (
+            "-" if sh.get("measured_model_sharded") is None
+            else "yes" if sh.get("measured_model_sharded") else "NO"
+        )
+        extra = r.get("extrapolation") or {}
+        implied = extra.get("implied_per_chip_bytes")
+        budget = extra.get("hbm_budget_bytes")
+        print(
+            f"{name.ljust(w)}  "
+            f"{_fmt_bytes(r.get('predicted_peak_bytes')):>9}  "
+            f"{_fmt_bytes(r.get('measured_peak_bytes')):>9}  "
+            f"{_err(r.get('peak_rel_error')):>7}  "
+            f"{_fmt_bytes(r.get('predicted_collective_bytes')):>9}  "
+            f"{_fmt_bytes(r.get('measured_collective_bytes')):>9}  "
+            f"{_err(r.get('collective_rel_error')):>7}  "
+            f"{shard_cell:>5}  {r.get('retraces_after_first', 0):>4}  "
+            f"{(f'{implied / 2**30:.2f}' if implied is not None else '-'):>9}  "
+            f"{(f'{budget / 2**30:.1f}' if budget else '-'):>7}"
+            + ("  <<OVER BUDGET"
+               if extra.get("within_budget") is False else "")
+        )
+    for name in names:
+        r = recon["entries"][name]
+        for d in r.get("divergences", ()):
+            print(f"DIVERGENCE {name}: {d}")
+        for n_ in r.get("notes", ()):
+            print(f"note {name}: {n_}")
+    for d in drift:
+        print(
+            f"RECORD DRIFT {d['entry']}.{d['field']}: {d['why']}"
+        )
+    dm = evidence.get("device_memory", {})
+    if dm:
+        print(
+            f"device memory_stats: {dm.get('reporting', 0)}/"
+            f"{dm.get('devices', 0)} device(s) reporting"
+            + ("" if dm.get("reporting") else
+               " (CPU backend: per-device peaks unavailable — "
+               "memory_analysis per-shard peaks carry the "
+               "reconciliation)")
+        )
 
 
 def add_metrics_subparser(sub) -> None:
@@ -1668,3 +1993,70 @@ def add_metrics_subparser(sub) -> None:
              "checking",
     )
     cc.set_defaults(fn=cmd_compile_check)
+
+    sc = msub.add_parser(
+        "scale-check",
+        help="measured-scale observatory gate: run (or load) the "
+             "dryrun-mesh probe of the vocab-sharded entry families "
+             "and reconcile measured per-chip peak bytes, collective "
+             "bytes, and executable shardings against the committed "
+             "static scale record (scripts/records/"
+             "scale_baseline.json), with a V=10M extrapolation row "
+             "against the HBM budget",
+    )
+    sc.add_argument(
+        "probe", nargs="?", default=None,
+        help="probe evidence JSON from an earlier run "
+             "(scale-check --run --probe-out writes one)",
+    )
+    sc.add_argument(
+        "--run", action="store_true",
+        help="execute the probe now on this process's devices "
+             "(forces a model-sharded dryrun mesh; the CI gate runs "
+             "this under the 8-virtual-device host platform)",
+    )
+    sc.add_argument(
+        "--entries", action="append", default=[],
+        help="probe only these entry families (repeatable; default: "
+             "all vocab-sharded families)",
+    )
+    sc.add_argument(
+        "--probe-out", default=None,
+        help="with --run: also write the probe evidence JSON here",
+    )
+    sc.add_argument(
+        "--baseline",
+        default=os.path.join(
+            "scripts", "records", "scale_baseline.json"
+        ),
+        help="the committed static scale record to reconcile against",
+    )
+    sc.add_argument(
+        "--tolerance", type=float, default=None,
+        help="relative band by which measured per-chip peak bytes may "
+             "EXCEED the static estimate (default: the committed "
+             "scale_probe.PEAK_TOLERANCE)",
+    )
+    sc.add_argument(
+        "--collective-tolerance", type=float, default=None,
+        help="same band for measured collective bytes per step",
+    )
+    sc.add_argument(
+        "--fail-on-divergence", action="store_true",
+        help="exit 1 on any divergence / sharding mismatch / retrace "
+             "/ over-budget extrapolation / measured-record drift "
+             "(the CI gate)",
+    )
+    sc.add_argument(
+        "--write-record", action="store_true",
+        help="commit the fresh measured section into --baseline "
+             "(the measured twin of `stc lint --scale --rebaseline`)",
+    )
+    sc.add_argument("--json", action="store_true")
+    sc.add_argument(
+        "--telemetry-file", default=None,
+        help="emit the check's own run stream (scale.* counters, "
+             "scale_check event; with --run the probe's dispatch "
+             "attribution and scale_probe_entry events land here too)",
+    )
+    sc.set_defaults(fn=cmd_scale_check)

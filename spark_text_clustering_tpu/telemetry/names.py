@@ -155,6 +155,21 @@ METRICS: Dict[str, str] = {
     "lineage.degraded":
         "lineage reads that degraded typed (torn/corrupt ledger tail, "
         "unreadable meta, legacy pre-trace records) instead of crashing",
+    # -- measured-scale observatory (telemetry.scale_probe /
+    #    `stc metrics scale-check`; docs/OBSERVABILITY.md
+    #    "Measured-scale observatory") ----------------------------------
+    "scale.probe_runs":
+        "measured-scale probe runs completed (the sharded entry "
+        "families executed on a forced model-sharded dryrun mesh)",
+    "scale.divergences":
+        "measured-vs-static reconciliation breaches found by the last "
+        "`stc metrics scale-check` (peak/collective bytes over "
+        "tolerance, V=10M extrapolation over the HBM budget, retraces "
+        "after the first step, committed-measured-record drift)",
+    "scale.sharding_mismatches":
+        "probed entries whose executable consumed/produced NO "
+        "model-axis-sharded wide operand despite declared sharded_dims "
+        "(the runtime twin of a static STC213 finding)",
     # -- static analysis (docs/STATIC_ANALYSIS.md) ----------------------
     "lint.findings": "unwaived stc lint findings in the last run",
     "lint.waived": "stc lint findings suppressed by pragma or baseline",
@@ -188,7 +203,10 @@ PREFIXES: Dict[str, str] = {
     "mem.":
         "telemetry.memory: per-digest memory_analysis attribution "
         "(arg/out/temp/peak bytes) + live device memory_stats and "
-        "host-RSS gauges sampled at epoch/trigger boundaries",
+        "host-RSS gauges sampled at epoch/trigger boundaries, incl. "
+        "the per-device max/min/imbalance breakdown "
+        "(mem.device.*_max/_min, mem.device.imbalance) that exposes "
+        "per-device imbalance the summed gauges hide under sharding",
     # CLI-derived families (written by `metrics merge`, never by a hot
     # path): cross-process aggregates and skew-report findings
     "merge.": "metrics merge: per-metric min/median/max across processes",

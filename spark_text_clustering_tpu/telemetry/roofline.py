@@ -84,11 +84,14 @@ def resolve_peaks(
     device kind string ('TPU v5e' -> tpu-v5e); anything unmatched
     falls back to the cpu defaults so the verb always reports."""
     if override and "flops_per_s" in override and "bytes_per_s" in override:
-        return "override", {
+        peaks = {
             "flops_per_s": float(override["flops_per_s"]),
             "bytes_per_s": float(override["bytes_per_s"]),
             "note": str(override.get("note", "user-supplied peaks")),
         }
+        if isinstance(override.get("hbm_bytes"), (int, float)):
+            peaks["hbm_bytes"] = int(override["hbm_bytes"])
+        return "override", peaks
     backend = (backend or "").lower()
     kind = (device_kind or "").lower().replace(" ", "")
     if backend == "tpu" or kind.startswith("tpu"):
@@ -134,6 +137,14 @@ def roofline_row(
         "cost_source": cost_source,
         "available": False,
     }
+    # HBM headroom: the memory roofline next to the compute one — the
+    # hbm_bytes column the static scale audit budgets against (STC212),
+    # read off the SAME peaks table so both rooflines share one source
+    hbm = peaks.get("hbm_bytes")
+    if hbm and mem_peak_bytes is not None and mem_peak_bytes >= 0:
+        row["hbm_bytes"] = int(hbm)
+        row["hbm_frac"] = mem_peak_bytes / hbm
+        row["hbm_headroom_bytes"] = int(hbm - mem_peak_bytes)
     if compile_seconds is not None and calls >= 1:
         calls = calls - 1
         seconds = seconds - float(compile_seconds)

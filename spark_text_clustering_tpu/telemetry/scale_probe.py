@@ -1,0 +1,765 @@
+"""Measured-scale observatory: RUN the sharded path, measure it, and
+reconcile the measurement against the static scale audit.
+
+The layer-3 scale audit (``analysis.scale_audit``, CI gate 15) proves
+k=500/V=10M fits STATICALLY — abstract traces, liveness estimates, a
+committed evidence record.  A static estimate that is never reconciled
+against a real executable is a prediction that can rot silently; this
+module is the empirical twin: it executes the vocab-sharded entry-point
+families (EM bucket step, online sufficient stats, sharded eval,
+sharded top-words) on a real dryrun mesh (the 8-virtual-device host
+platform, ``parallel.mesh.dryrun_mesh`` — geometry scaled down but
+model-axis sharding FORCED) and captures per-entry **measured**
+evidence:
+
+  * the compiled executable's ``memory_analysis()`` per-shard peak
+    (arg + out + temp bytes of the partitioned per-device program) —
+    the measured twin of the STC212 liveness estimate;
+  * the executable's ACTUAL input/output shardings plus the runtime
+    shard shapes of every wide (vocab-width) operand — silent
+    replication becomes observable at runtime, the empirical twin of
+    STC213;
+  * measured collective bytes per step from the existing
+    ``parallel.collectives`` accounting (captured on the first traced
+    call by the dispatch layer) — the twin of STC214;
+  * per-device ``memory_stats()`` peaks (NOT the summed view; CPU
+    devices report an explicit ``unavailable``, never a crash);
+  * zero-retrace evidence: warm steps after the first must add no
+    compiled signatures.
+
+Each probed entry is also traced abstractly at the SAME dryrun
+geometry through the scale audit's own byte accounting, so
+``predicted vs measured`` compares like with like, and the ratio is a
+measured correction factor for the static scaling law:
+``stc metrics scale-check`` multiplies the committed V=10M prediction
+(``scripts/records/scale_baseline.json``) by the measured/predicted
+ratio to get an empirically-anchored per-chip byte estimate against
+the v5e HBM budget.  Reconciliation math and the gate live in
+``reconcile``/``metrics_cli.cmd_scale_check``; the probe itself only
+measures.
+
+Probe runs ride the normal telemetry rails: instrumented dispatch
+(``dispatch.<digest>.*``), ``roofline.measured``-style rows
+(``telemetry.roofline.rows_live``), a ``memory_sample`` with the
+per-device breakdown, one ``scale_probe_entry`` event per entry, and
+the ``scale.probe_runs`` counter — so ``metrics roofline`` and the
+bench rails see measured sharded shapes with no extra plumbing.
+
+jax-free at import (the CLI help path never brings jax up); jax comes
+up inside ``run_probe``.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+__all__ = [
+    "PROBE_VERSION",
+    "PROBE_DIMS",
+    "PEAK_TOLERANCE",
+    "COLLECTIVE_TOLERANCE",
+    "ProbeSpec",
+    "PROBE_SPECS",
+    "probe_spec_names",
+    "run_probe",
+    "reconcile",
+    "measured_section",
+]
+
+PROBE_VERSION = 1
+
+# dryrun geometry: small enough to compile in seconds on the CPU
+# sandbox, wide enough that the vocab axis DOMINATES the byte
+# accounting (V=64Ki f32 lambda = 2 MiB full / 512 KiB per shard on the
+# 2x4 mesh) so predicted-vs-measured reconciles on the same buffers the
+# V=10M budget is about.  V and B must divide the dryrun mesh axes.
+PROBE_DIMS: Dict[str, int] = {
+    "k": 8,
+    "v": 65536,
+    "b": 16,
+    "l": 16,
+    "n": 10,        # top-words per topic per shard
+}
+WARM_STEPS = 2
+
+# committed reconciliation tolerances (the scale-check gate defaults).
+# The static liveness estimate holds inputs/outputs live for a whole
+# nesting level and gives no donation/aliasing credit, so it reads
+# conservatively HIGH: measured peaks land at 60-100% of predicted on
+# the dryrun mesh (measured here; see docs/OBSERVABILITY.md).  The
+# hazard the gate exists for is the OTHER direction — a real executable
+# exceeding its static budget (or a silently replicated one blowing
+# past it by ~model_shards x) — so the tolerance bounds measured ABOVE
+# predicted.
+PEAK_TOLERANCE = 0.25
+COLLECTIVE_TOLERANCE = 0.25
+
+
+@dataclass(frozen=True)
+class ProbeSpec:
+    """One probed entry family.
+
+    ``build(mesh, dims)`` returns ``(fn, args, placements)``: a callable
+    dispatched exactly as production drivers dispatch it, concrete
+    numpy arguments at the dryrun geometry, and a placement pytree of
+    the SAME structure whose leaves are ``PartitionSpec``s (device_put
+    onto the probe mesh) or the string ``"host"`` (pass as-is —
+    scalars).  ``name`` joins the entry against the committed scale
+    record; ``label`` is the dispatch label used when the built fn is
+    not already instrumented."""
+
+    name: str
+    build: Callable
+    label: str
+    expects_sharding: bool = True
+    note: str = ""
+
+
+# ---------------------------------------------------------------------------
+# builders — the vocab-sharded entry families, dispatched for real
+# ---------------------------------------------------------------------------
+def _probe_arrays(dims: Dict[str, int]):
+    import numpy as np
+
+    rng = np.random.default_rng(7)
+    k, v, b, l = dims["k"], dims["v"], dims["b"], dims["l"]
+    wide = np.abs(rng.normal(size=(k, v))).astype(np.float32) + 0.1
+    n_dk = np.abs(rng.normal(size=(b, k))).astype(np.float32) + 0.1
+    ids = rng.integers(0, v, size=(b, l)).astype(np.int32)
+    wts = np.ones((b, l), np.float32)
+    return wide, n_dk, ids, wts
+
+
+def _batch_placement():
+    from jax.sharding import PartitionSpec as P
+
+    from ..ops.sparse import DocTermBatch
+    from ..parallel.mesh import DATA_AXIS
+
+    return DocTermBatch(P(DATA_AXIS, None), P(DATA_AXIS, None))
+
+
+def _build_em_bucket_step(mesh, dims):
+    from jax.sharding import PartitionSpec as P
+
+    from ..models.em_lda import make_em_bucket_step
+    from ..ops.sparse import DocTermBatch
+    from ..parallel.mesh import DATA_AXIS, MODEL_AXIS
+
+    fn = make_em_bucket_step(
+        mesh, alpha=1.1, eta=1.1, vocab_size=dims["v"]
+    )
+    n_wk, n_dk, ids, wts = _probe_arrays(dims)
+    return fn, (n_wk, n_dk, DocTermBatch(ids, wts)), (
+        P(None, MODEL_AXIS), P(DATA_AXIS, None), _batch_placement(),
+    )
+
+
+def _build_online_train_step(mesh, dims):
+    import numpy as np
+    from jax.sharding import PartitionSpec as P
+
+    from ..models.online_lda import TrainState, make_online_train_step
+    from ..ops.sparse import DocTermBatch
+    from ..parallel.mesh import DATA_AXIS, MODEL_AXIS
+
+    fn = make_online_train_step(
+        mesh, alpha=0.1, eta=0.01, tau0=1024.0, kappa=0.51,
+        corpus_size=None,
+    )
+    lam, gamma0, ids, wts = _probe_arrays(dims)
+    state = TrainState(lam, np.int32(0))
+    return fn, (
+        state, DocTermBatch(ids, wts), gamma0, np.float32(1000.0),
+    ), (
+        TrainState(P(None, MODEL_AXIS), "host"), _batch_placement(),
+        P(DATA_AXIS, None), "host",
+    )
+
+
+def _build_sharded_topic_inference(mesh, dims):
+    import numpy as np
+    from jax.sharding import PartitionSpec as P
+
+    from ..models.sharded_eval import make_sharded_topic_inference
+    from ..ops.sparse import DocTermBatch
+    from ..parallel.mesh import DATA_AXIS, MODEL_AXIS
+
+    alpha = np.full((dims["k"],), 0.1, np.float32)
+    fn = make_sharded_topic_inference(
+        mesh, alpha=alpha, vocab_size=dims["v"], max_inner=5
+    )
+    lam, gamma0, ids, wts = _probe_arrays(dims)
+    return fn, (lam, DocTermBatch(ids, wts), gamma0), (
+        P(None, MODEL_AXIS), _batch_placement(), P(DATA_AXIS, None),
+    )
+
+
+def _build_sharded_em_log_likelihood(mesh, dims):
+    from jax.sharding import PartitionSpec as P
+
+    from ..models.sharded_eval import make_sharded_em_log_likelihood
+    from ..ops.sparse import DocTermBatch
+    from ..parallel.mesh import DATA_AXIS, MODEL_AXIS
+
+    fn = make_sharded_em_log_likelihood(
+        mesh, alpha=1.1, eta=1.1, vocab_size=dims["v"]
+    )
+    n_wk, n_dk, ids, wts = _probe_arrays(dims)
+    return fn, (n_wk, n_dk, DocTermBatch(ids, wts)), (
+        P(None, MODEL_AXIS), P(DATA_AXIS, None), _batch_placement(),
+    )
+
+
+def _build_sharded_top_terms(mesh, dims):
+    from jax.sharding import PartitionSpec as P
+
+    from ..models.sharded_eval import make_sharded_top_terms
+    from ..parallel.mesh import MODEL_AXIS
+
+    fn = make_sharded_top_terms(
+        mesh, vocab_size=dims["v"], n=dims["n"]
+    )
+    lam, _, _, _ = _probe_arrays(dims)
+    return fn, (lam,), (P(None, MODEL_AXIS),)
+
+
+PROBE_SPECS: Tuple[ProbeSpec, ...] = (
+    ProbeSpec(
+        "em_lda.bucket_step", _build_em_bucket_step,
+        label="scale_probe.em_bucket_step",
+    ),
+    ProbeSpec(
+        "online_lda.train_step", _build_online_train_step,
+        label="scale_probe.online_train_step",
+        note="the online sufficient-stats step (E+M fused)",
+    ),
+    ProbeSpec(
+        "sharded_eval.topic_inference", _build_sharded_topic_inference,
+        label="sharded_eval.topic_inference",
+    ),
+    ProbeSpec(
+        "sharded_eval.em_log_likelihood",
+        _build_sharded_em_log_likelihood,
+        label="sharded_eval.em_log_likelihood",
+    ),
+    ProbeSpec(
+        "sharded_eval.top_terms", _build_sharded_top_terms,
+        label="scale_probe.top_terms",
+        note=(
+            "sharded top-words extraction; no static scale record row "
+            "yet, so scale-check reconciles shardings only"
+        ),
+    ),
+)
+
+
+def probe_spec_names() -> List[str]:
+    return [s.name for s in PROBE_SPECS]
+
+
+# ---------------------------------------------------------------------------
+# measurement
+# ---------------------------------------------------------------------------
+def _wide_widths(dims: Dict[str, int]) -> frozenset:
+    # the scatter paths pad the vocab axis by one drop row — same
+    # convention as the static audit's _is_sharded_width
+    return frozenset((dims["v"], dims["v"] + 1))
+
+
+def _leaf_sharding_rows(
+    leaves, shardings, wide: frozenset, side: str
+) -> List[Dict]:
+    """One row per wide (vocab-width) leaf: its global shape, the
+    sharding spec the executable used, the runtime shard shape, and
+    whether the wide dim is actually partitioned."""
+    rows: List[Dict] = []
+    for i, leaf in enumerate(leaves):
+        shape = tuple(int(d) for d in getattr(leaf, "shape", ()))
+        wide_dims = [j for j, d in enumerate(shape) if d in wide]
+        if not wide_dims:
+            continue
+        row: Dict = {"side": side, "index": i, "shape": list(shape)}
+        sh = None
+        if shardings is not None and i < len(shardings):
+            sh = shardings[i]
+        elif hasattr(leaf, "sharding"):
+            sh = leaf.sharding
+        if sh is None:
+            row["sharded"] = None
+            row["spec"] = "unavailable"
+        else:
+            row["spec"] = str(getattr(sh, "spec", sh))
+            try:
+                shard_shape = tuple(
+                    int(d) for d in sh.shard_shape(shape)
+                )
+                row["shard_shape"] = list(shard_shape)
+                row["sharded"] = any(
+                    shard_shape[j] < shape[j] for j in wide_dims
+                )
+            except Exception as exc:  # stc-lint: disable=STC002 -- shard_shape is optional sharding-object API (GSPMD/callback shardings may not answer); an unreadable leaf degrades to sharded=None, never a probe crash
+                row["sharded"] = None
+                row["spec_error"] = type(exc).__name__
+        rows.append(row)
+    return rows
+
+
+def _collective_counter_total(snapshot: Dict) -> int:
+    return int(sum(
+        v for k, v in snapshot.get("counters", {}).items()
+        if k.startswith("collective.") and k.endswith(".traced_bytes")
+    ))
+
+
+def _cache_size(fn) -> Optional[int]:
+    for cand in (fn, getattr(fn, "__wrapped__", None)):
+        m = getattr(cand, "_cache_size", None)
+        if m is not None:
+            try:
+                return int(m())
+            except Exception:  # stc-lint: disable=STC002 -- _cache_size is private jit API used as a cross-check only; any failure degrades to the dispatch-record digest count
+                return None
+    return None
+
+
+def _probe_entry(
+    spec: ProbeSpec, mesh, audit_mesh, dims: Dict[str, int],
+    model_shards: int, warm_steps: int,
+) -> Dict:
+    import jax
+    from jax.sharding import NamedSharding
+
+    from . import event, get_registry, instrument_dispatch
+    from . import dispatch as dispatch_attr
+    from ..analysis.scale_audit import _collective_bytes, _peak_live_bytes
+
+    fn, args, placements = spec.build(mesh, dims)
+    if getattr(fn, "dispatch_label", None) is None:
+        fn = instrument_dispatch(spec.label, fn)
+    leaves, treedef = jax.tree_util.tree_flatten(args)
+    pleaves = jax.tree_util.tree_leaves(placements)
+    if len(pleaves) != len(leaves):
+        raise ValueError(
+            f"{spec.name}: placement pytree has {len(pleaves)} leaves "
+            f"for {len(leaves)} arguments"
+        )
+    dev_leaves = [
+        a if p == "host"
+        else jax.device_put(a, NamedSharding(mesh, p))
+        for a, p in zip(leaves, pleaves)
+    ]
+    args_dev = jax.tree_util.tree_unflatten(treedef, dev_leaves)
+
+    before = set(dispatch_attr.records())
+    coll0 = _collective_counter_total(get_registry().snapshot())
+    t0 = time.perf_counter()
+    out = fn(*args_dev)
+    jax.block_until_ready(out)
+    first_seconds = time.perf_counter() - t0
+    coll_delta = (
+        _collective_counter_total(get_registry().snapshot()) - coll0
+    )
+    after_first = set(dispatch_attr.records())
+    new_digests = sorted(after_first - before)
+
+    warm_seconds: List[float] = []
+    for _ in range(max(0, warm_steps)):
+        t0 = time.perf_counter()
+        out = fn(*args_dev)
+        jax.block_until_ready(out)
+        warm_seconds.append(time.perf_counter() - t0)
+    after_warm = set(dispatch_attr.records())
+    retraces = len(after_warm) - len(after_first)
+    cache = _cache_size(fn)
+    if cache is not None and cache > 1:
+        # the jit cache is the ground truth when the dispatch table
+        # missed a retrace (e.g. a pre-existing record got reused)
+        retraces = max(retraces, cache - 1)
+
+    rec = None
+    recs = dispatch_attr.records()
+    for d in new_digests:
+        if recs[d].label in (spec.label, getattr(fn, "dispatch_label", "")):
+            rec = recs[d]
+            break
+    if rec is None and new_digests:
+        rec = recs[new_digests[0]]
+
+    wide = _wide_widths(dims)
+    out_leaves = jax.tree_util.tree_leaves(out)
+    sharding_rows = _leaf_sharding_rows(
+        dev_leaves,
+        getattr(rec, "exec_in_shardings", None),
+        wide, "in",
+    )
+    sharding_rows += _leaf_sharding_rows(
+        out_leaves,
+        getattr(rec, "exec_out_shardings", None),
+        wide, "out",
+    )
+    observed = [r["sharded"] for r in sharding_rows
+                if r["sharded"] is not None]
+    model_sharded = any(observed) if observed else None
+
+    measured: Dict = {
+        "per_chip_peak_bytes": (rec.mem_bytes or {}).get("peak_bytes")
+        if rec is not None else None,
+        "mem_source": rec.mem_source if rec is not None else "no_record",
+        "collective_bytes_per_step": (
+            rec.collective_bytes_per_call
+            if rec is not None
+            and rec.collective_bytes_per_call is not None
+            else coll_delta
+        ),
+        "first_call_seconds": round(first_seconds, 6),
+        "warm_step_seconds": [round(s, 6) for s in warm_seconds],
+    }
+    if rec is not None and rec.mem_bytes:
+        measured["mem_bytes"] = dict(rec.mem_bytes)
+
+    # predicted twin: the SAME entry traced abstractly on the audit's
+    # 1x1 tracing mesh at the SAME dryrun geometry, run through the
+    # scale audit's byte accounting with the PROBE's shard count — the
+    # static scaling law evaluated at the measured point
+    fn1, args1, _ = spec.build(audit_mesh, dims)
+    closed = jax.make_jaxpr(fn1)(*args1)
+    shard_widths = frozenset((dims["v"],))
+    predicted = {
+        "per_chip_peak_bytes": int(_peak_live_bytes(
+            closed, shard_widths, model_shards
+        )),
+        "collective_bytes_per_step": int(_collective_bytes(
+            closed, shard_widths, model_shards
+        )),
+    }
+
+    entry: Dict = {
+        "label": rec.label if rec is not None else spec.label,
+        "digests": new_digests,
+        "expects_sharding": spec.expects_sharding,
+        "measured": measured,
+        "predicted": predicted,
+        "model_sharded": model_sharded,
+        "shardings": sharding_rows,
+        "retraces_after_first": int(retraces),
+    }
+    if spec.note:
+        entry["note"] = spec.note
+    event(
+        "scale_probe_entry",
+        name=spec.name,
+        label=entry["label"],
+        measured_peak_bytes=measured["per_chip_peak_bytes"],
+        predicted_peak_bytes=predicted["per_chip_peak_bytes"],
+        measured_collective_bytes=measured["collective_bytes_per_step"],
+        predicted_collective_bytes=predicted[
+            "collective_bytes_per_step"
+        ],
+        model_sharded=model_sharded,
+        retraces_after_first=int(retraces),
+    )
+    return entry
+
+
+def run_probe(
+    entries: Optional[Sequence[str]] = None,
+    *,
+    model_shards: Optional[int] = None,
+    dims: Optional[Dict[str, int]] = None,
+    warm_steps: int = WARM_STEPS,
+) -> Dict:
+    """Execute the probe and return the evidence document.
+
+    Requires a live jax backend (the caller owns platform pinning; the
+    tier-1 harness and CI force an 8-virtual-device CPU host platform).
+    Enables registry-only telemetry when the caller has not configured
+    a run stream — the probe's counters and dispatch attribution are
+    always live."""
+    import jax
+
+    from . import configure, count, enabled, sample_memory
+    from ..parallel.mesh import DATA_AXIS, MODEL_AXIS, dryrun_mesh, make_mesh
+
+    if not enabled():
+        configure(None)
+    dims = dict(PROBE_DIMS, **(dims or {}))
+    mesh = dryrun_mesh(model_shards=model_shards)
+    n_model = int(mesh.shape[MODEL_AXIS])
+    n_data = int(mesh.shape[DATA_AXIS])
+    if dims["v"] % n_model or dims["b"] % n_data:
+        raise ValueError(
+            f"probe geometry v={dims['v']}/b={dims['b']} does not "
+            f"divide the {n_data}x{n_model} dryrun mesh"
+        )
+    audit_mesh = make_mesh(
+        data_shards=1, model_shards=1, devices=jax.devices()[:1]
+    )
+    try:
+        kind = jax.devices()[0].device_kind
+    except (RuntimeError, IndexError):
+        kind = "?"
+
+    selected = [
+        s for s in PROBE_SPECS
+        if entries is None or s.name in set(entries)
+    ]
+    if entries is not None:
+        unknown = set(entries) - {s.name for s in PROBE_SPECS}
+        if unknown:
+            raise ValueError(
+                f"unknown probe entries {sorted(unknown)}; known: "
+                f"{probe_spec_names()}"
+            )
+
+    evidence: Dict = {
+        "version": PROBE_VERSION,
+        "backend": jax.default_backend(),
+        "device_kind": str(kind),
+        "device_count": len(jax.devices()),
+        "mesh": {"data_shards": n_data, "model_shards": n_model},
+        "forced_model_sharding": n_model > 1,
+        "geometry": dict(dims),
+        "warm_steps": int(warm_steps),
+        "entries": {},
+    }
+    for spec in selected:
+        evidence["entries"][spec.name] = _probe_entry(
+            spec, mesh, audit_mesh, dims, n_model, warm_steps
+        )
+
+    from .memory import per_device_stats
+
+    rows = per_device_stats()
+    evidence["device_memory"] = {
+        "devices": len(rows) if rows is not None else 0,
+        "reporting": sum(
+            1 for r in rows or () if "unavailable" not in r
+        ),
+        "per_device": rows if rows is not None else "unavailable",
+    }
+    # one live memory sample so the run stream carries the per-device
+    # breakdown gauges next to the probe's dispatch attribution
+    sample_memory("scale_probe")
+
+    from .roofline import rows_live
+
+    digests = {
+        d for e in evidence["entries"].values() for d in e["digests"]
+    }
+    evidence["roofline"] = [
+        r for r in rows_live() if r["digest"] in digests
+    ]
+    count("scale.probe_runs")
+    return evidence
+
+
+# ---------------------------------------------------------------------------
+# reconciliation (the scale-check math; CLI rendering lives in
+# metrics_cli.cmd_scale_check)
+# ---------------------------------------------------------------------------
+def _rel_error(measured: float, predicted: float) -> Optional[float]:
+    if predicted is None or predicted <= 0 or measured is None:
+        return None
+    return (float(measured) - float(predicted)) / float(predicted)
+
+
+def reconcile(
+    evidence: Dict,
+    record: Optional[Dict],
+    *,
+    peak_tolerance: float = PEAK_TOLERANCE,
+    collective_tolerance: float = COLLECTIVE_TOLERANCE,
+) -> Dict:
+    """Join probe evidence against the committed static scale record.
+
+    Per entry: signed relative error of measured vs predicted per-chip
+    peak bytes and collective bytes at the PROBE geometry (divergence
+    when measured exceeds predicted beyond tolerance — the static
+    estimate is conservative by construction, so the gate bounds the
+    dangerous direction), a measured-sharding match column, a
+    zero-retrace check, and the extrapolation row: the committed V=10M
+    static prediction scaled by the measured/predicted ratio, against
+    the committed HBM budget.  Entries without a static record row
+    reconcile shardings/retraces only (noted, not gated)."""
+    rec_entries = (record or {}).get("entries", {})
+    out: Dict = {
+        "peak_tolerance": peak_tolerance,
+        "collective_tolerance": collective_tolerance,
+        "probe": {
+            "backend": evidence.get("backend"),
+            "mesh": evidence.get("mesh"),
+            "geometry": evidence.get("geometry"),
+            "device_count": evidence.get("device_count"),
+        },
+        "entries": {},
+        "divergences": 0,
+        "sharding_mismatches": 0,
+    }
+    if not evidence.get("forced_model_sharding"):
+        out["divergences"] += 1
+        out["probe_divergence"] = (
+            "probe mesh did not force model-axis sharding "
+            f"({evidence.get('mesh')}) — nothing measured here can "
+            "stand in for the sharded path"
+        )
+    for name, ev in sorted(evidence.get("entries", {}).items()):
+        row: Dict = {"label": ev.get("label")}
+        divs: List[str] = []
+        notes: List[str] = []
+        meas, pred = ev.get("measured", {}), ev.get("predicted", {})
+
+        mp = meas.get("per_chip_peak_bytes")
+        pp = pred.get("per_chip_peak_bytes")
+        row["predicted_peak_bytes"] = pp
+        row["measured_peak_bytes"] = mp
+        if mp is None:
+            notes.append(
+                "measured peak unavailable "
+                f"({meas.get('mem_source', '?')})"
+            )
+        else:
+            err = _rel_error(mp, pp)
+            row["peak_rel_error"] = (
+                round(err, 4) if err is not None else None
+            )
+            if err is not None and err > peak_tolerance:
+                divs.append(
+                    f"measured per-chip peak {mp} exceeds the static "
+                    f"estimate {pp} by {err:+.1%} "
+                    f"(tolerance +{peak_tolerance:.0%})"
+                )
+
+        mc = meas.get("collective_bytes_per_step")
+        pc = pred.get("collective_bytes_per_step")
+        row["predicted_collective_bytes"] = pc
+        row["measured_collective_bytes"] = mc
+        if mc is not None:
+            err = _rel_error(mc, pc)
+            row["collective_rel_error"] = (
+                round(err, 4) if err is not None else None
+            )
+            if err is not None and err > collective_tolerance:
+                divs.append(
+                    f"measured collective bytes {mc} exceed the "
+                    f"static estimate {pc} by {err:+.1%} "
+                    f"(tolerance +{collective_tolerance:.0%})"
+                )
+        elif pc:
+            notes.append("measured collective bytes unavailable")
+
+        retr = int(ev.get("retraces_after_first", 0))
+        row["retraces_after_first"] = retr
+        if retr:
+            divs.append(
+                f"{retr} retrace(s) after the first step — the probe "
+                "geometry must run zero-recompile warm"
+            )
+
+        static = rec_entries.get(name)
+        declared_sharded = (
+            int(static.get("model_shards", 1)) > 1
+            if static is not None
+            else bool(ev.get("expects_sharding"))
+        )
+        ms = ev.get("model_sharded")
+        row["sharding"] = {
+            "declared": declared_sharded,
+            "measured_model_sharded": ms,
+            "match": (ms == declared_sharded) if ms is not None
+            else None,
+        }
+        if declared_sharded and ms is False:
+            out["sharding_mismatches"] += 1
+            divs.append(
+                "no wide operand was model-axis sharded at runtime — "
+                "the entry ran REPLICATED (empirical STC213)"
+            )
+        elif ms is None:
+            notes.append("sharding unobservable (no wide leaves read)")
+
+        if static is None:
+            row["record"] = False
+            notes.append(
+                "no static scale record row — extrapolation skipped"
+            )
+        else:
+            row["record"] = True
+            if mp is not None and pp:
+                ratio = float(mp) / float(pp)
+                implied = int(
+                    float(static["per_chip_peak_bytes"]) * ratio
+                )
+                budget = int(static.get("hbm_budget_bytes", 0))
+                extra = {
+                    "peak_ratio": round(ratio, 4),
+                    "implied_per_chip_bytes": implied,
+                    "static_per_chip_bytes": int(
+                        static["per_chip_peak_bytes"]
+                    ),
+                    "hbm_budget_bytes": budget,
+                    "within_budget": (
+                        implied <= budget if budget else None
+                    ),
+                }
+                if mc is not None and pc:
+                    extra["collective_ratio"] = round(
+                        float(mc) / float(pc), 4
+                    )
+                    extra["implied_collective_bytes"] = int(
+                        float(static["collective_bytes_per_step"])
+                        * extra["collective_ratio"]
+                    )
+                row["extrapolation"] = extra
+                if budget and implied > budget:
+                    divs.append(
+                        f"measured-anchored extrapolation "
+                        f"{implied / 2**30:.2f} GiB/chip at the "
+                        f"declared scale exceeds the "
+                        f"{budget / 2**30:.2f} GiB HBM budget"
+                    )
+
+        row["divergences"] = divs
+        if notes:
+            row["notes"] = notes
+        out["divergences"] += len(divs)
+        out["entries"][name] = row
+    return out
+
+
+def measured_section(evidence: Dict, recon: Dict) -> Dict:
+    """The ``measured`` twin section committed into
+    ``scale_baseline.json`` (``stc metrics scale-check --write-record``)
+    — the empirically-anchored summary the drift rules in
+    ``analysis.scale_audit.compare_measured_with_record`` gate future
+    probe runs against."""
+    entries: Dict[str, Dict] = {}
+    for name, row in recon.get("entries", {}).items():
+        e: Dict = {
+            "model_sharded": row.get("sharding", {}).get(
+                "measured_model_sharded"
+            ),
+            "retraces_after_first": row.get("retraces_after_first"),
+        }
+        if row.get("peak_rel_error") is not None:
+            e["peak_rel_error"] = row["peak_rel_error"]
+        extra = row.get("extrapolation")
+        if extra:
+            e["peak_ratio"] = extra["peak_ratio"]
+            e["implied_per_chip_bytes"] = extra[
+                "implied_per_chip_bytes"
+            ]
+            e["within_budget"] = extra["within_budget"]
+            if "collective_ratio" in extra:
+                e["collective_ratio"] = extra["collective_ratio"]
+        entries[name] = e
+    return {
+        "version": PROBE_VERSION,
+        "backend": evidence.get("backend"),
+        "device_kind": evidence.get("device_kind"),
+        "mesh": evidence.get("mesh"),
+        "geometry": evidence.get("geometry"),
+        "entries": entries,
+    }

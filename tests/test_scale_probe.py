@@ -1,0 +1,600 @@
+"""Measured-scale observatory (docs/OBSERVABILITY.md "Measured-scale
+observatory"):
+
+  * reconciliation math units: predicted-vs-measured relative error,
+    the V=10M extrapolation row, tolerance gating direction;
+  * a planted over-budget divergence and a planted silently-replicated
+    run must both gate red through `stc metrics scale-check`;
+  * the live probe on the 8-virtual-device harness: forced model
+    sharding observed at runtime, zero retraces after the first step,
+    reconciliation against the committed scale record passes;
+  * graceful degradation when ``memory_stats()`` is absent (CPU
+    devices report ``unavailable``, never a crash);
+  * the ``measured`` twin section of scale_baseline.json + drift rules;
+  * per-device memory breakdown gauges and the summarize memory-health
+    section; the roofline HBM-headroom column; the Prometheus
+    exposition of the ``scale.`` family.
+"""
+
+import copy
+import json
+
+import pytest
+
+from spark_text_clustering_tpu import telemetry
+from spark_text_clustering_tpu.analysis.scale_audit import (
+    compare_measured_with_record,
+    load_scale_record,
+    save_scale_record,
+)
+from spark_text_clustering_tpu.cli import main
+from spark_text_clustering_tpu.telemetry import dispatch as dispatch_attr
+from spark_text_clustering_tpu.telemetry import memory as mem
+from spark_text_clustering_tpu.telemetry.metrics_cli import memory_health
+from spark_text_clustering_tpu.telemetry.prometheus import render
+from spark_text_clustering_tpu.telemetry.roofline import (
+    resolve_peaks,
+    roofline_row,
+)
+from spark_text_clustering_tpu.telemetry.scale_probe import (
+    PROBE_DIMS,
+    measured_section,
+    probe_spec_names,
+    reconcile,
+    run_probe,
+)
+
+SCALE_RECORD = "scripts/records/scale_baseline.json"
+
+
+@pytest.fixture(autouse=True)
+def _telemetry_reset():
+    telemetry.shutdown()
+    telemetry.get_registry().reset()
+    dispatch_attr.reset()
+    yield
+    telemetry.shutdown()
+    telemetry.get_registry().reset()
+    dispatch_attr.reset()
+
+
+# ---------------------------------------------------------------------------
+# synthetic fixtures for the pure reconciliation units
+# ---------------------------------------------------------------------------
+def _evidence():
+    return {
+        "version": 1,
+        "backend": "cpu",
+        "device_kind": "cpu",
+        "device_count": 8,
+        "mesh": {"data_shards": 2, "model_shards": 4},
+        "forced_model_sharding": True,
+        "geometry": dict(PROBE_DIMS),
+        "warm_steps": 2,
+        "entries": {
+            "em_lda.bucket_step": {
+                "label": "scale_probe.em_bucket_step",
+                "digests": ["d1"],
+                "expects_sharding": True,
+                "measured": {
+                    "per_chip_peak_bytes": 2_000_000,
+                    "mem_source": "memory_analysis",
+                    "collective_bytes_per_step": 500_000,
+                    "first_call_seconds": 0.2,
+                    "warm_step_seconds": [0.01, 0.01],
+                },
+                "predicted": {
+                    "per_chip_peak_bytes": 2_100_000,
+                    "collective_bytes_per_step": 520_000,
+                },
+                "model_sharded": True,
+                "shardings": [],
+                "retraces_after_first": 0,
+            },
+        },
+        "device_memory": {"devices": 8, "reporting": 0,
+                          "per_device": []},
+        "roofline": [],
+    }
+
+
+def _record():
+    return {
+        "entries": {
+            "em_lda.bucket_step": {
+                "per_chip_peak_bytes": 5_531_529_978,
+                "hbm_budget_bytes": 15_461_882_265,
+                "collective_bytes_per_step": 1_774_290_000,
+                "model_shards": 16,
+            },
+        },
+    }
+
+
+class TestReconcileMath:
+    def test_relative_error_and_extrapolation(self):
+        recon = reconcile(_evidence(), _record())
+        row = recon["entries"]["em_lda.bucket_step"]
+        assert row["peak_rel_error"] == pytest.approx(
+            (2_000_000 - 2_100_000) / 2_100_000, abs=1e-4
+        )
+        assert row["collective_rel_error"] == pytest.approx(
+            (500_000 - 520_000) / 520_000, abs=1e-4
+        )
+        extra = row["extrapolation"]
+        ratio = 2_000_000 / 2_100_000
+        assert extra["peak_ratio"] == pytest.approx(ratio, abs=1e-4)
+        assert extra["implied_per_chip_bytes"] == pytest.approx(
+            5_531_529_978 * ratio, rel=1e-3
+        )
+        assert extra["within_budget"] is True
+        assert recon["divergences"] == 0
+        assert recon["sharding_mismatches"] == 0
+
+    def test_conservative_underprediction_does_not_gate(self):
+        # the static law is conservative HIGH: measured far below
+        # predicted is expected, never a divergence
+        ev = _evidence()
+        ev["entries"]["em_lda.bucket_step"]["measured"][
+            "per_chip_peak_bytes"] = 500_000
+        recon = reconcile(ev, _record())
+        assert recon["divergences"] == 0
+
+    def test_measured_over_tolerance_diverges(self):
+        ev = _evidence()
+        ev["entries"]["em_lda.bucket_step"]["measured"][
+            "per_chip_peak_bytes"] = int(2_100_000 * 1.3)
+        recon = reconcile(ev, _record())
+        assert recon["divergences"] == 1
+        assert "exceeds the static estimate" in \
+            recon["entries"]["em_lda.bucket_step"]["divergences"][0]
+
+    def test_over_budget_extrapolation_diverges(self):
+        ev = _evidence()
+        ev["entries"]["em_lda.bucket_step"]["measured"][
+            "per_chip_peak_bytes"] = 2_100_000 * 30
+        recon = reconcile(ev, _record())
+        row = recon["entries"]["em_lda.bucket_step"]
+        assert row["extrapolation"]["within_budget"] is False
+        # over tolerance AND over budget: two divergences
+        assert recon["divergences"] == 2
+        assert any("HBM budget" in d for d in row["divergences"])
+
+    def test_collective_over_tolerance_diverges(self):
+        ev = _evidence()
+        ev["entries"]["em_lda.bucket_step"]["measured"][
+            "collective_bytes_per_step"] = int(520_000 * 1.4)
+        recon = reconcile(ev, _record())
+        assert recon["divergences"] == 1
+
+    def test_replicated_run_flags_sharding_mismatch(self):
+        ev = _evidence()
+        ev["entries"]["em_lda.bucket_step"]["model_sharded"] = False
+        recon = reconcile(ev, _record())
+        assert recon["sharding_mismatches"] == 1
+        assert any(
+            "REPLICATED" in d
+            for d in recon["entries"]["em_lda.bucket_step"][
+                "divergences"]
+        )
+
+    def test_retraces_diverge(self):
+        ev = _evidence()
+        ev["entries"]["em_lda.bucket_step"]["retraces_after_first"] = 2
+        recon = reconcile(ev, _record())
+        assert recon["divergences"] == 1
+
+    def test_measured_unavailable_degrades_to_note(self):
+        ev = _evidence()
+        ev["entries"]["em_lda.bucket_step"]["measured"][
+            "per_chip_peak_bytes"] = None
+        ev["entries"]["em_lda.bucket_step"]["measured"][
+            "mem_source"] = "unavailable:no_memory_analysis"
+        recon = reconcile(ev, _record())
+        row = recon["entries"]["em_lda.bucket_step"]
+        assert recon["divergences"] == 0
+        assert any("unavailable" in n for n in row["notes"])
+        assert "extrapolation" not in row
+
+    def test_entry_without_record_row_reconciles_shardings_only(self):
+        ev = _evidence()
+        recon = reconcile(ev, {"entries": {}})
+        row = recon["entries"]["em_lda.bucket_step"]
+        assert row["record"] is False
+        assert "extrapolation" not in row
+        assert recon["divergences"] == 0
+        # ... but a replicated run still gates even without a record
+        ev["entries"]["em_lda.bucket_step"]["model_sharded"] = False
+        recon = reconcile(ev, {"entries": {}})
+        assert recon["sharding_mismatches"] == 1
+
+    def test_unforced_mesh_is_a_probe_divergence(self):
+        ev = _evidence()
+        ev["forced_model_sharding"] = False
+        ev["mesh"] = {"data_shards": 1, "model_shards": 1}
+        recon = reconcile(ev, _record())
+        assert recon["divergences"] >= 1
+        assert "did not force model-axis sharding" in \
+            recon["probe_divergence"]
+
+
+class TestMeasuredRecord:
+    def test_measured_section_shape(self):
+        recon = reconcile(_evidence(), _record())
+        sec = measured_section(_evidence(), recon)
+        e = sec["entries"]["em_lda.bucket_step"]
+        assert e["model_sharded"] is True
+        assert e["retraces_after_first"] == 0
+        assert 0 < e["peak_ratio"] < 1.01
+        assert e["within_budget"] is True
+        assert sec["mesh"] == {"data_shards": 2, "model_shards": 4}
+
+    def test_drift_rules(self):
+        recon = reconcile(_evidence(), _record())
+        sec = measured_section(_evidence(), recon)
+        record = dict(_record(), measured=copy.deepcopy(sec))
+        # identical -> quiet
+        assert compare_measured_with_record(sec, record) == []
+        # ratio stepping outside the band -> drift finding
+        moved = copy.deepcopy(sec)
+        moved["entries"]["em_lda.bucket_step"]["peak_ratio"] += 0.5
+        finds = compare_measured_with_record(moved, record)
+        assert [f["field"] for f in finds] == ["peak_ratio"]
+        # sharded -> replicated is drift even inside the ratio band
+        repl = copy.deepcopy(sec)
+        repl["entries"]["em_lda.bucket_step"]["model_sharded"] = False
+        finds = compare_measured_with_record(repl, record)
+        assert [f["field"] for f in finds] == ["model_sharded"]
+        # a different probe geometry is not comparable
+        other = copy.deepcopy(sec)
+        other["geometry"] = dict(other["geometry"], v=1234)
+        finds = compare_measured_with_record(other, record)
+        assert [f["field"] for f in finds] == ["geometry"]
+        # no committed measured section: nothing to drift against
+        assert compare_measured_with_record(sec, _record()) == []
+
+    def test_static_rebaseline_preserves_measured_section(self, tmp_path):
+        path = str(tmp_path / "sb.json")
+        rec = dict(_record(), measured={"entries": {},
+                                        "geometry": {}, "mesh": {}})
+        save_scale_record(rec, path)
+        # a static-audit rewrite (no "measured" key in its report)
+        # must carry the committed measured section forward
+        save_scale_record(_record(), path)
+        again = load_scale_record(path)
+        assert "measured" in again
+        # ... and a measured rewrite owns only its own section
+        rec2 = load_scale_record(path)
+        rec2["measured"] = {"entries": {"x": {}}, "geometry": {},
+                            "mesh": {}}
+        save_scale_record(rec2, path)
+        assert load_scale_record(path)["measured"]["entries"] == {
+            "x": {}
+        }
+
+
+# ---------------------------------------------------------------------------
+# the live probe on the 8-virtual-device harness
+# ---------------------------------------------------------------------------
+@pytest.fixture(scope="module")
+def live_probe(eight_devices):
+    telemetry.shutdown()
+    telemetry.get_registry().reset()
+    dispatch_attr.reset()
+    telemetry.configure(None)
+    evidence = run_probe(
+        entries=["em_lda.bucket_step", "sharded_eval.em_log_likelihood"]
+    )
+    counters = dict(
+        telemetry.get_registry().snapshot()["counters"]
+    )
+    telemetry.shutdown()
+    return evidence, counters
+
+
+class TestLiveProbe:
+    def test_forces_model_sharding(self, live_probe):
+        evidence, _ = live_probe
+        assert evidence["device_count"] == 8
+        assert evidence["mesh"] == {"data_shards": 2,
+                                    "model_shards": 4}
+        assert evidence["forced_model_sharding"] is True
+
+    def test_em_bucket_step_measured_sharded(self, live_probe):
+        evidence, _ = live_probe
+        e = evidence["entries"]["em_lda.bucket_step"]
+        assert e["model_sharded"] is True
+        v = evidence["geometry"]["v"]
+        wide = [r for r in e["shardings"] if r["sharded"]]
+        assert wide, e["shardings"]
+        for r in wide:
+            # the wide axis is really partitioned 4 ways at runtime
+            assert v // 4 in r["shard_shape"]
+            assert "model" in r["spec"]
+
+    def test_zero_retraces_and_measured_evidence(self, live_probe):
+        evidence, _ = live_probe
+        for name, e in evidence["entries"].items():
+            assert e["retraces_after_first"] == 0, name
+            assert e["measured"]["per_chip_peak_bytes"] > 0, name
+            assert e["measured"]["mem_source"] == "memory_analysis"
+            assert e["predicted"]["per_chip_peak_bytes"] > 0
+            assert e["measured"]["collective_bytes_per_step"] > 0
+
+    def test_memory_stats_absent_degrades(self, live_probe):
+        """CPU devices expose no memory_stats: every per-device row
+        must say so explicitly, and nothing crashes."""
+        evidence, _ = live_probe
+        dm = evidence["device_memory"]
+        assert dm["devices"] == 8
+        assert dm["reporting"] == 0
+        assert all(
+            "unavailable" in r for r in dm["per_device"]
+        )
+
+    def test_roofline_rows_and_counter(self, live_probe):
+        evidence, counters = live_probe
+        digests = {
+            d for e in evidence["entries"].values()
+            for d in e["digests"]
+        }
+        rows = {r["digest"] for r in evidence["roofline"]}
+        assert rows == digests
+        assert counters.get("scale.probe_runs") == 1
+
+    def test_reconciles_against_committed_record(self, live_probe):
+        evidence, _ = live_probe
+        record = load_scale_record(SCALE_RECORD)
+        assert record is not None
+        recon = reconcile(evidence, record)
+        assert recon["divergences"] == 0, json.dumps(
+            recon["entries"], indent=2, default=str
+        )
+        assert recon["sharding_mismatches"] == 0
+        extra = recon["entries"]["em_lda.bucket_step"][
+            "extrapolation"]
+        assert extra["within_budget"] is True
+        # the measured anchor keeps the V=10M claim in the same range
+        # the static audit committed (~5.15 GiB/chip vs 14.4 budget)
+        assert 2 * 2**30 < extra["implied_per_chip_bytes"] < 10 * 2**30
+
+
+# ---------------------------------------------------------------------------
+# the scale-check CLI (gate semantics)
+# ---------------------------------------------------------------------------
+def _write_probe(tmp_path, evidence, name="probe.json"):
+    p = tmp_path / name
+    p.write_text(json.dumps(evidence))
+    return str(p)
+
+
+class TestScaleCheckCli:
+    def test_clean_probe_passes(self, tmp_path, capsys):
+        probe = _write_probe(tmp_path, _evidence())
+        rec = tmp_path / "sb.json"
+        rec.write_text(json.dumps(_record()))
+        rc = main([
+            "metrics", "scale-check", probe,
+            "--baseline", str(rec), "--fail-on-divergence",
+        ])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "PASS:" in out
+
+    def test_planted_over_budget_gates_red(self, tmp_path, capsys):
+        ev = _evidence()
+        ev["entries"]["em_lda.bucket_step"]["measured"][
+            "per_chip_peak_bytes"] = 2_100_000 * 30
+        probe = _write_probe(tmp_path, ev)
+        rec = tmp_path / "sb.json"
+        rec.write_text(json.dumps(_record()))
+        rc = main([
+            "metrics", "scale-check", probe,
+            "--baseline", str(rec), "--fail-on-divergence",
+        ])
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert "HBM budget" in out
+
+    def test_planted_replication_gates_red(self, tmp_path, capsys):
+        ev = _evidence()
+        ev["entries"]["em_lda.bucket_step"]["model_sharded"] = False
+        probe = _write_probe(tmp_path, ev)
+        rec = tmp_path / "sb.json"
+        rec.write_text(json.dumps(_record()))
+        rc = main([
+            "metrics", "scale-check", probe,
+            "--baseline", str(rec), "--fail-on-divergence",
+        ])
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert "REPLICATED" in out
+
+    def test_no_gate_flag_reports_but_passes_rc(self, tmp_path, capsys):
+        ev = _evidence()
+        ev["entries"]["em_lda.bucket_step"]["model_sharded"] = False
+        probe = _write_probe(tmp_path, ev)
+        rec = tmp_path / "sb.json"
+        rec.write_text(json.dumps(_record()))
+        rc = main([
+            "metrics", "scale-check", probe, "--baseline", str(rec),
+        ])
+        assert rc == 0
+        assert "FAIL:" in capsys.readouterr().out
+
+    def test_telemetry_stream_carries_scale_counters(
+        self, tmp_path, capsys
+    ):
+        ev = _evidence()
+        ev["entries"]["em_lda.bucket_step"]["model_sharded"] = False
+        probe = _write_probe(tmp_path, ev)
+        rec = tmp_path / "sb.json"
+        rec.write_text(json.dumps(_record()))
+        stream = tmp_path / "check.jsonl"
+        main([
+            "metrics", "scale-check", probe, "--baseline", str(rec),
+            "--telemetry-file", str(stream),
+        ])
+        capsys.readouterr()
+        from spark_text_clustering_tpu.telemetry.metrics_cli import (
+            load_run,
+            run_metrics,
+        )
+
+        _, events = load_run(str(stream))
+        metrics = run_metrics(events)
+        assert metrics["counter.scale.probe_runs"] == 0
+        assert metrics["counter.scale.divergences"] >= 1
+        assert metrics["counter.scale.sharding_mismatches"] == 1
+        assert any(
+            e.get("event") == "scale_check" for e in events
+        )
+
+    def test_write_record_then_drift_gates(self, tmp_path, capsys):
+        probe = _write_probe(tmp_path, _evidence())
+        rec = tmp_path / "sb.json"
+        rec.write_text(json.dumps(_record()))
+        rc = main([
+            "metrics", "scale-check", probe, "--baseline", str(rec),
+            "--write-record",
+        ])
+        capsys.readouterr()
+        assert rc == 0
+        assert "measured" in json.loads(rec.read_text())
+        # same probe again: within the drift band, still green
+        rc = main([
+            "metrics", "scale-check", probe, "--baseline", str(rec),
+            "--fail-on-divergence",
+        ])
+        capsys.readouterr()
+        assert rc == 0
+        # a probe whose measured anchor moved: +24% is inside the
+        # reconciliation tolerance but ~0.29 above the committed
+        # ratio — the DRIFT rule, not the tolerance, must gate it
+        ev = _evidence()
+        ev["entries"]["em_lda.bucket_step"]["measured"][
+            "per_chip_peak_bytes"] = int(2_100_000 * 1.24)
+        moved = _write_probe(tmp_path, ev, "probe2.json")
+        rc = main([
+            "metrics", "scale-check", moved, "--baseline", str(rec),
+            "--fail-on-divergence",
+        ])
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert "RECORD DRIFT" in out
+
+
+# ---------------------------------------------------------------------------
+# satellites: per-device memory, roofline HBM column, prometheus
+# ---------------------------------------------------------------------------
+class TestPerDeviceMemory:
+    def test_per_device_rows_on_cpu(self):
+        rows = mem.per_device_stats()
+        assert rows is not None and len(rows) == 8
+        assert all("unavailable" in r for r in rows)
+        assert mem.device_stats() is None
+
+    def test_breakdown_math(self):
+        rows = [
+            {"device": 0, "kind": "tpu", "bytes_in_use": 100,
+             "peak_bytes_in_use": 400, "bytes_limit": 1000},
+            {"device": 1, "kind": "tpu", "bytes_in_use": 300,
+             "peak_bytes_in_use": 100, "bytes_limit": 1000},
+            {"device": 2, "kind": "tpu", "unavailable": "x"},
+        ]
+        br = mem.device_breakdown(rows)
+        assert br["reporting_devices"] == 2
+        assert br["peak_bytes_in_use_max"] == 400
+        assert br["peak_bytes_in_use_min"] == 100
+        assert br["bytes_in_use_max"] == 300
+        assert br["imbalance"] == pytest.approx(0.75)
+        assert mem.device_breakdown(None) is None
+        assert mem.device_breakdown(
+            [{"device": 0, "unavailable": "x"}]
+        ) is None
+
+    def test_sample_publishes_breakdown_gauges(self, monkeypatch):
+        telemetry.configure(None)
+        rows = [
+            {"device": i, "kind": "tpu", "bytes_in_use": 100 * (i + 1),
+             "peak_bytes_in_use": 200 * (i + 1), "bytes_limit": 10_000}
+            for i in range(4)
+        ]
+        monkeypatch.setattr(mem, "per_device_stats", lambda: rows)
+        result = mem.sample("t")
+        snap = telemetry.get_registry().snapshot()["gauges"]
+        assert snap["mem.device.peak_bytes_in_use"] == 2000  # the sum
+        assert snap["mem.device.peak_bytes_in_use_max"] == 800
+        assert snap["mem.device.peak_bytes_in_use_min"] == 200
+        assert snap["mem.device.imbalance"] == pytest.approx(0.75)
+        assert result["devices_reporting"] == 4
+
+    def test_memory_health_summary(self):
+        metrics = {
+            "counter.mem.samples": 3.0,
+            "gauge.mem.device.bytes_in_use": 1000.0,
+            "gauge.mem.device.peak_bytes_in_use": 2000.0,
+            "gauge.mem.device.peak_bytes_in_use_max": 800.0,
+            "gauge.mem.device.peak_bytes_in_use_min": 200.0,
+            "gauge.mem.device.imbalance": 0.75,
+            "gauge.mem.host.rss_bytes": 5000.0,
+        }
+        mh = memory_health(metrics)
+        assert mh["samples"] == 3
+        assert mh["per_device"]["imbalance"] == 0.75
+        assert mh["per_device"]["peak_max"] == 800
+        assert memory_health({"counter.serve.requests": 1.0}) is None
+
+
+class TestRooflineHbm:
+    def test_hbm_headroom_fields(self):
+        peaks = {"flops_per_s": 1e12, "bytes_per_s": 1e11,
+                 "hbm_bytes": 16 * 2**30}
+        row = roofline_row(
+            digest="d", label="l", calls=2, seconds=1.0,
+            est_flops=1e9, est_bytes=1e8, peaks=peaks,
+            mem_peak_bytes=4 * 2**30,
+        )
+        assert row["hbm_bytes"] == 16 * 2**30
+        assert row["hbm_frac"] == pytest.approx(0.25)
+        assert row["hbm_headroom_bytes"] == 12 * 2**30
+        # no mem attribution -> no hbm columns, no crash
+        row = roofline_row(
+            digest="d", label="l", calls=2, seconds=1.0,
+            est_flops=1e9, est_bytes=1e8, peaks=peaks,
+        )
+        assert "hbm_frac" not in row
+
+    def test_override_peaks_keep_hbm(self):
+        key, peaks = resolve_peaks("cpu", "", {
+            "flops_per_s": 1e12, "bytes_per_s": 1e11,
+            "hbm_bytes": 123,
+        })
+        assert key == "override"
+        assert peaks["hbm_bytes"] == 123
+        # built-in tables already carry the column
+        _, cpu = resolve_peaks("cpu", "")
+        assert cpu["hbm_bytes"] > 0
+
+
+class TestPrometheusScaleFamily:
+    def test_scale_counters_expose(self):
+        out = render({
+            "counters": {"scale.probe_runs": 1,
+                         "scale.divergences": 0,
+                         "scale.sharding_mismatches": 0},
+            "gauges": {}, "histograms": {},
+        })
+        assert "stc_scale_probe_runs_total 1" in out
+        assert "stc_scale_divergences_total 0" in out
+        assert "stc_scale_sharding_mismatches_total 0" in out
+
+
+def test_probe_registry_names():
+    assert probe_spec_names() == [
+        "em_lda.bucket_step",
+        "online_lda.train_step",
+        "sharded_eval.topic_inference",
+        "sharded_eval.em_log_likelihood",
+        "sharded_eval.top_terms",
+    ]
