@@ -122,9 +122,24 @@
 #      counter.scale.* gate against the committed baseline, and a
 #      planted over-budget probe + a planted silently-replicated
 #      probe must BOTH gate red (self-test)
+#  17. serve-fleet chaos drill (`stc supervise --role serve` +
+#      serving/front, docs/SERVING.md "Serve fleet"): a 2-replica
+#      serve fleet over the gate-5 model behind the lease-discovered
+#      routing front, with the shared executable cache armed; exact
+#      concurrent client volleys flow through the front around (a) a
+#      mid-traffic model publish that must ROLL replica-by-replica
+#      through the control files and (b) a replica SIGKILL the front
+#      must absorb by retrying onto the survivor while the supervisor
+#      respawns; asserts ZERO failed client requests, one-generation-
+#      per-client-stream (no stream ever observes stamps interleave),
+#      both replicas swapped, exactly one respawn/crash/roll, and
+#      replicas after the canary warming up on compile-cache HITS with
+#      zero retraces (the gate-13 contract extended to the fleet
+#      path); the front's exact request counter and the fleet respawn
+#      counter gate against the committed baseline
 #
 # Usage:
-#   scripts/ci_check.sh                 # run all sixteen gates
+#   scripts/ci_check.sh                 # run all seventeen gates
 #   scripts/ci_check.sh --rebaseline    # recapture ALL baselines
 #                                       # (metrics + lint waivers +
 #                                       # lint counters + scale record
@@ -1076,6 +1091,247 @@ print(
 EOF
 }
 
+run_serve_fleet_drill() {
+    # gate 17: the serve-fleet chaos drill on the gate-5 model.  Exact
+    # request counts (3 volleys x 8 clients x 2 docs = 48) make
+    # counter.front.requests machine-independent; per-replica splits
+    # and retry counts depend on kill timing and stay unbaselined.
+    local workdir="$1"
+    rm -rf "$workdir/fleet_cc" "$workdir/sfleet" "$workdir/fleet_wtel"
+    STC_COMPILE_CACHE="$workdir/fleet_cc" \
+        python - "$workdir" <<'EOF'
+import http.client
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+workdir = sys.argv[1]
+models = os.path.join(workdir, "models")
+fleet = os.path.join(workdir, "sfleet")
+books = os.path.join(workdir, "books")
+log_path = os.path.join(workdir, "serve_fleet.log")
+env = dict(os.environ)
+proc = subprocess.Popen(
+    [sys.executable, "-m", "spark_text_clustering_tpu.cli",
+     "supervise", "--role", "serve",
+     "--fleet-dir", fleet, "--workers", "2", "--front-port", "0",
+     "--models-dir", models, "--no-lemmatize",
+     "--heartbeat-interval", "0.2", "--lease-timeout", "12",
+     "--grace-seconds", "6", "--sweep-interval", "0.1",
+     "--startup-grace", "240", "--swap-timeout", "120",
+     "--serve-max-batch", "8", "--serve-linger-ms", "2",
+     "--worker-arg=--token-bucket", "--worker-arg=256",
+     "--worker-arg=--token-bucket", "--worker-arg=1024",
+     "--max-seconds", "600",
+     "--telemetry-file", os.path.join(workdir, "fleet_serve.jsonl"),
+     "--worker-telemetry-dir", os.path.join(workdir, "fleet_wtel")],
+    env=env, stdout=open(log_path, "w"), stderr=subprocess.STDOUT,
+)
+
+
+def fail(msg):
+    proc.send_signal(signal.SIGKILL)
+    sys.exit(f"serve-fleet drill: {msg}")
+
+
+deadline = time.time() + 420
+port = None
+while time.time() < deadline and port is None:
+    if proc.poll() is not None:
+        sys.exit(f"supervisor died at startup (rc={proc.returncode})")
+    try:
+        with open(os.path.join(fleet, "front.json")) as f:
+            port = json.load(f)["port"]
+    except (OSError, json.JSONDecodeError, KeyError):
+        time.sleep(0.3)
+if port is None:
+    fail("front never announced")
+
+
+def healthz():
+    c = http.client.HTTPConnection("127.0.0.1", port, timeout=15)
+    c.request("GET", "/healthz")
+    doc = json.loads(c.getresponse().read())
+    c.close()
+    return doc
+
+
+while time.time() < deadline:
+    try:
+        if healthz()["ready"] == 2:
+            break
+    except (OSError, http.client.HTTPException, ValueError):
+        pass
+    time.sleep(0.5)
+else:
+    fail("fleet never reached 2 ready replicas")
+
+texts = [
+    open(os.path.join(books, n)).read()
+    for n in sorted(os.listdir(books))
+]
+lock = threading.Lock()
+results = []
+per_stream = {}
+
+
+def volley(round_id):
+    # 8 concurrent client streams x 2 docs = 16 requests, exactly
+    def client(i):
+        stream = f"s{i}"
+        conn = http.client.HTTPConnection("127.0.0.1", port,
+                                          timeout=120)
+        for j in range(2):
+            body = json.dumps(
+                {"texts": [texts[(i + j) % len(texts)]]}
+            ).encode()
+            conn.request(
+                "POST", "/score", body=body,
+                headers={"Content-Type": "application/json",
+                         "X-STC-Stream": stream},
+            )
+            resp = conn.getresponse()
+            payload = json.loads(resp.read())
+            with lock:
+                results.append(
+                    (resp.status, payload, round_id, stream)
+                )
+                g = resp.headers.get("X-STC-Generation")
+                if g is not None:
+                    per_stream.setdefault(stream, []).append(int(g))
+        conn.close()
+
+    ths = [
+        threading.Thread(target=client, args=(i,)) for i in range(8)
+    ]
+    for t in ths:
+        t.start()
+    for t in ths:
+        t.join()
+
+
+def lease(i):
+    try:
+        with open(os.path.join(fleet, "leases",
+                               f"w{i:03d}.json")) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+volley(0)
+# (a) mid-traffic publish: must roll replica-by-replica
+from spark_text_clustering_tpu.models.persistence import (
+    load_model, save_model,
+)
+import numpy as np
+
+path_a = (lease(0) or {}).get("model_path")
+m = load_model(path_a)
+m.lam = (np.asarray(m.lam) * 1.01 + 0.01).astype(np.float32)
+new_dir = os.path.join(models, f"LdaModel_EN_{int(time.time()*1000)}")
+save_model(m, new_dir)
+new_stamp = int(new_dir.rsplit("_", 1)[1])
+while time.time() < deadline:
+    l0, l1 = lease(0), lease(1)
+    if l0 and l1 and l0.get("model_stamp") == new_stamp \
+            and l1.get("model_stamp") == new_stamp:
+        break
+    time.sleep(0.3)
+else:
+    fail("rolling swap never completed on both replicas")
+volley(1)
+# (b) SIGKILL replica 0 and keep scoring THROUGH the kill window
+victim = lease(0)
+os.kill(victim["pid"], signal.SIGKILL)
+volley(2)
+while time.time() < deadline:
+    l0 = lease(0)
+    if l0 and l0.get("spawn_id") != victim["spawn_id"] \
+            and l0.get("state") == "ready":
+        break
+    time.sleep(0.3)
+else:
+    fail("SIGKILLed replica never respawned")
+
+assert len(results) == 48, f"{len(results)} responses, want 48"
+for status, payload, round_id, stream in results:
+    assert status == 200, (status, payload)
+    for r in payload["results"]:
+        assert "topic" in r, f"failed request: {r}"
+for stream, stamps in per_stream.items():
+    assert stamps == sorted(stamps), (
+        f"stream {stream} observed interleaved generations: {stamps}"
+    )
+assert any(new_stamp in s for s in per_stream.values()), \
+    "no stream ever reached the new generation"
+proc.send_signal(signal.SIGTERM)
+assert proc.wait(timeout=180) == 0, "fleet drain did not exit 0"
+print(
+    f"serve-fleet drill: 48/48 requests OK through publish "
+    f"{new_stamp} + SIGKILL, all streams monotone"
+)
+EOF
+    [[ $? -ne 0 ]] && return 1
+    # supervisor-side evidence: one rolling swap over both replicas,
+    # one crash -> one respawn, zero swap stalls; front evidence: 48
+    # exact routed requests, zero no-replica failures
+    python - "$workdir" <<'EOF'
+import glob, os, sys
+
+from spark_text_clustering_tpu.telemetry.metrics_cli import (
+    fleet_health, load_run, run_metrics, serve_fleet_health,
+)
+
+workdir = sys.argv[1]
+_, events = load_run(os.path.join(workdir, "fleet_serve.jsonl"))
+m = run_metrics(events)
+assert int(m.get("counter.front.requests", 0)) == 48, m.get(
+    "counter.front.requests"
+)
+assert int(m.get("counter.front.no_replica", 0)) == 0
+assert int(m.get("counter.fleet.respawns", 0)) == 1
+assert int(m.get("counter.fleet.crashes", 0)) == 1
+assert int(m.get("counter.fleet.swap_rolls", 0)) == 1
+assert int(m.get("counter.fleet.swap_stalls", 0)) == 0
+fh = fleet_health(events)
+assert fh["swap_rolls"] == 1 and fh["replica_swaps"] == 2, fh
+sfh = serve_fleet_health(events, m)
+assert sfh["requests"] == 48 and len(sfh["replicas"]) >= 2, sfh
+# compile-cache contract on the fleet path (gate 13 extended):
+# every replica AFTER the canary — the staggered second replica AND
+# the respawned one — must warm up on cache hits with 0 retraces
+streams = sorted(glob.glob(
+    os.path.join(workdir, "fleet_wtel", "worker-*.jsonl")
+))
+assert len(streams) == 3, streams        # w000-s0, w001-s1, w000-s2
+warm_clean = 0
+for s in streams:
+    _, ev = load_run(s)
+    warm = next(
+        (e for e in ev if e.get("event") == "serve_warmup"), None
+    )
+    if warm is None:
+        continue                         # SIGKILLed stream may be torn
+    if os.path.basename(s) == "worker-w000-s0.jsonl":
+        assert warm.get("cache_stores", 0) >= 1, warm
+        continue                         # the canary populates
+    assert warm.get("cache_hits", 0) >= 1, (s, warm)
+    assert warm.get("cache_misses", 0) == 0, (s, warm)
+    assert warm.get("retraces_at_warmup") == 0, (s, warm)
+    warm_clean += 1
+assert warm_clean == 2, f"only {warm_clean} cache-hit warmups"
+print(
+    "serve-fleet drill: roll=1 (2 replicas), respawn=1, "
+    "2 cache-hit warmups with 0 retraces"
+)
+EOF
+}
+
 if [[ "${1:-}" == "--rebaseline" ]]; then
     # --scale: regenerate the waiver allowlist AND the committed scale
     # evidence record (scripts/records/scale_baseline.json) together —
@@ -1151,6 +1407,14 @@ if [[ "${1:-}" == "--rebaseline" ]]; then
         "$work/lin_serve.jsonl" --baseline "$BASELINE" \
         --write-baseline --tolerance 0.0 \
         --include counter.trace. || exit 1
+    # fold the serve-fleet drill's exact routed-request counter (48)
+    # and respawn counter (1, consistent with the gate-10 value)
+    run_serve_fleet_drill "$work" || exit 1
+    python -m spark_text_clustering_tpu.cli metrics check \
+        "$work/fleet_serve.jsonl" --baseline "$BASELINE" \
+        --write-baseline --tolerance 0.0 \
+        --include counter.front.requests \
+        --include counter.fleet.respawns || exit 1
     # recapture the recompile sentinel's expected-signature table from
     # the same train run plus a score run and an NMF fit+transform run
     # (gate 9's fixture triple)
@@ -1166,12 +1430,12 @@ fail=0
 work=$(mktemp -d)
 trap 'rm -rf "$work"' EXIT
 
-echo "== [1/16] stc lint (AST rules + jaxpr audit) =="
+echo "== [1/17] stc lint (AST rules + jaxpr audit) =="
 python -m spark_text_clustering_tpu.cli lint \
     --telemetry-file "$work/lint.jsonl"
 if [[ $? -ne 0 ]]; then echo "FAIL: stc lint"; fail=1; fi
 
-echo "== [2/16] ruff (generic-Python tier) =="
+echo "== [2/17] ruff (generic-Python tier) =="
 if command -v ruff >/dev/null 2>&1; then
     ruff check spark_text_clustering_tpu
     if [[ $? -ne 0 ]]; then echo "FAIL: ruff"; fail=1; fi
@@ -1179,17 +1443,17 @@ else
     echo "ruff not installed — skipped (stc lint STC101/102/006 cover it)"
 fi
 
-echo "== [3/16] tier-1 tests =="
+echo "== [3/17] tier-1 tests =="
 timeout -k 10 870 python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors -p no:cacheprovider \
     -p no:xdist -p no:randomly
 if [[ $? -ne 0 ]]; then echo "FAIL: tier-1"; fail=1; fi
 
-echo "== [4/16] telemetry overhead budget =="
+echo "== [4/17] telemetry overhead budget =="
 python scripts/check_telemetry_overhead.py
 if [[ $? -ne 0 ]]; then echo "FAIL: telemetry overhead"; fail=1; fi
 
-echo "== [5/16] metrics regression gate =="
+echo "== [5/17] metrics regression gate =="
 if run_ci_train "$work"; then
     # lint., ledger., fleet., serve., and alert. families are captured
     # by their own gates (1/6, 8, 10, 11, and 12) — a batch train run
@@ -1199,14 +1463,14 @@ if run_ci_train "$work"; then
         --exclude ledger. --exclude fleet. --exclude serve. \
         --exclude alert. --exclude monitor. --exclude drift. \
         --exclude compile.cache --exclude trace. --exclude lineage. \
-        --exclude scale.
+        --exclude scale. --exclude front.
     if [[ $? -ne 0 ]]; then echo "FAIL: metrics check"; fail=1; fi
 else
     echo "FAIL: CI training run"
     fail=1
 fi
 
-echo "== [6/16] lint metrics gate (waiver count version-gated) =="
+echo "== [6/17] lint metrics gate (waiver count version-gated) =="
 if [[ -s "$work/lint.jsonl" ]]; then
     # lint.scale_* belong to the gate-15 --scale stream, not stage 1's
     python -m spark_text_clustering_tpu.cli metrics check "$work/lint.jsonl" \
@@ -1217,7 +1481,7 @@ else
     fail=1
 fi
 
-echo "== [7/16] cross-host skew gate (metrics merge) =="
+echo "== [7/17] cross-host skew gate (metrics merge) =="
 if make_skew_streams "$work"; then
     python -m spark_text_clustering_tpu.cli metrics merge \
         "$work/skew-p0.jsonl" "$work/skew-p1.jsonl" --fail-on-skew \
@@ -1238,7 +1502,7 @@ else
     fail=1
 fi
 
-echo "== [8/16] exactly-once ledger chaos drill (STC_FAULTS) =="
+echo "== [8/17] exactly-once ledger chaos drill (STC_FAULTS) =="
 if run_ledger_drill "$work"; then
     python -m spark_text_clustering_tpu.cli metrics check \
         "$work/ledger_drill.jsonl" --baseline "$BASELINE" \
@@ -1249,7 +1513,7 @@ else
     fail=1
 fi
 
-echo "== [9/16] recompile sentinel (metrics compile-check) =="
+echo "== [9/17] recompile sentinel (metrics compile-check) =="
 if [[ -s "$work/run.jsonl" ]] && run_ci_score "$work" \
     && run_ci_nmf "$work"; then
     python -m spark_text_clustering_tpu.cli metrics compile-check \
@@ -1276,7 +1540,7 @@ else
     fail=1
 fi
 
-echo "== [10/16] supervisor drill (lease expiry -> SIGKILL -> respawn) =="
+echo "== [10/17] supervisor drill (lease expiry -> SIGKILL -> respawn) =="
 if run_supervisor_drill "$work"; then
     # the ladder's counters are deterministic: 3 spawns (2 + 1
     # respawn), 1 lease expiry, 1 preemption (the drain SIGTERM the
@@ -1290,7 +1554,7 @@ else
     fail=1
 fi
 
-echo "== [11/16] serve drill (hot-swap + drain + zero-recompile) =="
+echo "== [11/17] serve drill (hot-swap + drain + zero-recompile) =="
 if [[ -d "$work/models" ]] && run_serve_drill "$work"; then
     # requests (32 = two exact 16-doc volleys) and swaps (1) are
     # machine-independent; batch counts depend on coalescing timing
@@ -1304,7 +1568,7 @@ else
     fail=1
 fi
 
-echo "== [12/16] monitor drill (alerts fire/resolve + resize-on-alert) =="
+echo "== [12/17] monitor drill (alerts fire/resolve + resize-on-alert) =="
 if run_monitor_once_drill "$work"; then
     # the --once storm run's alert counters are deterministic: exactly
     # one firing (retrace_storm), nothing pending/resolved
@@ -1325,7 +1589,7 @@ if ! run_monitor_resize_drill "$work"; then
     fail=1
 fi
 
-echo "== [13/16] executable-cache cold-start drill (compilecache) =="
+echo "== [13/17] executable-cache cold-start drill (compilecache) =="
 if [[ -d "$work/models" ]] && run_cold_start_drill "$work"; then
     # the warm B run's cache counters are deterministic: one hit per
     # score-path digest, zero misses/stores/invalidations
@@ -1338,7 +1602,7 @@ else
     fail=1
 fi
 
-echo "== [14/16] end-to-end lineage drill (causal tracing) =="
+echo "== [14/17] end-to-end lineage drill (causal tracing) =="
 if run_lineage_drill "$work"; then
     # the serve run's trace counters are deterministic: ONE sampled
     # request, four emitted spans, nothing dropped
@@ -1351,7 +1615,7 @@ else
     fail=1
 fi
 
-echo "== [15/16] scale audit (stc lint --scale, STC210-215) =="
+echo "== [15/17] scale audit (stc lint --scale, STC210-215) =="
 python -m spark_text_clustering_tpu.cli lint --scale \
     --telemetry-file "$work/lint_scale.jsonl" >/dev/null
 if [[ $? -ne 0 ]]; then
@@ -1423,7 +1687,7 @@ if [[ $? -ne 0 ]]; then
     fail=1
 fi
 
-echo "== [16/16] measured-scale observatory (probe + scale-check) =="
+echo "== [16/17] measured-scale observatory (probe + scale-check) =="
 # run the sharded entry families for REAL on the forced 2x4 host mesh
 # and reconcile the measured evidence against the gate-15 static
 # record: sharding match, tolerance, zero retraces, V=10M
@@ -1476,6 +1740,22 @@ python -m spark_text_clustering_tpu.cli metrics scale-check \
     --fail-on-divergence >/dev/null
 if [[ $? -ne 1 ]]; then
     echo "FAIL: planted over-budget/replicated probe not flagged"
+    fail=1
+fi
+
+echo "== [17/17] serve-fleet chaos drill (rolling publish + SIGKILL) =="
+if [[ -d "$work/models" ]] && run_serve_fleet_drill "$work"; then
+    # the front's routed-request counter (48 = three exact 16-doc
+    # volleys) and the fleet respawn counter (1 — consistent with the
+    # gate-10 drill's committed value) are machine-independent;
+    # per-replica splits and retry counts depend on kill timing
+    python -m spark_text_clustering_tpu.cli metrics check \
+        "$work/fleet_serve.jsonl" --baseline "$BASELINE" \
+        --include counter.front.requests \
+        --include counter.fleet.respawns
+    if [[ $? -ne 0 ]]; then echo "FAIL: serve-fleet counters"; fail=1; fi
+else
+    echo "FAIL: serve-fleet chaos drill"
     fail=1
 fi
 

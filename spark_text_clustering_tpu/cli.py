@@ -440,6 +440,76 @@ def cmd_score(args: argparse.Namespace) -> int:
     return 0
 
 
+def _serve_replica_loop(
+    args, service, lease, preempt, port: int, deadline,
+) -> None:
+    """A supervised serve replica's main loop (docs/SERVING.md "Serve
+    fleet"): renew the role=serve lease with the routing front's
+    discovery fields (port, state, served model path/stamp), and poll
+    the per-replica control file for the supervisor's rolling-swap
+    commands — the replica acks a swap by reporting the new
+    ``model_stamp`` in its lease."""
+    import json
+    import time as _time
+
+    from .resilience import sleep as _idle_sleep
+    from .resilience.supervisor import control_path
+
+    ctrl = control_path(args.fleet_dir, int(args.worker_index))
+    ctrl_stamp = None
+    cmd = None
+    last_ctrl_id = 0
+    last_attempt = 0.0
+    reg = telemetry.get_registry()
+    telemetry.gauge("serve.replica.index", int(args.worker_index))
+    telemetry.gauge("serve.replica.draining", 0)
+    while not preempt:
+        if deadline is not None and _time.monotonic() >= deadline:
+            break
+        scorer = service.scorer
+        telemetry.gauge(
+            "serve.replica.stamp",
+            scorer.stamp if scorer.stamp is not None else -1,
+        )
+        lease.beat(
+            queue_depth=service.coalescer.queue_depth(),
+            state="draining" if service.draining else "ready",
+            port=port,
+            model_path=scorer.path,
+            model_stamp=scorer.stamp,
+            swap_id=last_ctrl_id,
+            requests=int(reg.counter("serve.requests").value),
+        )
+        # control poll (mtime-cached): a new swap command re-resolves
+        # the shared selection path until the commanded stamp serves
+        try:
+            st = os.stat(ctrl)
+            stamp = (st.st_mtime, st.st_size)
+        except OSError:
+            stamp = None
+        if stamp is not None and stamp != ctrl_stamp:
+            ctrl_stamp = stamp
+            try:
+                with open(ctrl, "r", encoding="utf-8") as f:
+                    cmd = json.load(f)
+            except (OSError, json.JSONDecodeError, ValueError):
+                cmd = None              # mid-write; next loop re-reads
+                ctrl_stamp = None
+        if isinstance(cmd, dict) and isinstance(cmd.get("id"), int) \
+                and cmd["id"] > last_ctrl_id:
+            want = cmd.get("stamp")
+            cur = scorer.stamp if scorer.stamp is not None else -1
+            if want is None or cur >= int(want):
+                last_ctrl_id = cmd["id"]
+            elif _time.monotonic() - last_attempt > 0.25:
+                last_attempt = _time.monotonic()
+                service.poll_model_once()
+                new = service.scorer.stamp
+                if new is not None and new >= int(want):
+                    last_ctrl_id = cmd["id"]
+        _idle_sleep(0.05)
+
+
 def cmd_serve(args: argparse.Namespace) -> int:
     """Persistent scoring service (docs/SERVING.md): load the newest
     ledger-verified model ONCE, AOT-warm the scoring executables per
@@ -455,11 +525,22 @@ def cmd_serve(args: argparse.Namespace) -> int:
     # histograms, and the compile sentinel all need a live registry
     telemetry.configure(args.telemetry_file if own_telemetry else None)
 
-    from .resilience.supervisor import PreemptionNotice
+    # fleet wiring FIRST (when `stc supervise --role serve` spawned
+    # us): the initial role=serve lease beat must land before the slow
+    # jax-touching ScoringService construction below, or a supervisor
+    # with a tight startup grace would declare a warming replica stuck
+    preempt, lease, _fence, _ = _fleet_worker_context(
+        args, lease_fields={"role": "serve"},
+    )
+    if lease is not None:
+        lease.beat(force=True, state="starting", port=0)
     from .serving import ScoringService, make_http_server
 
-    preempt = PreemptionNotice().install()
     buckets = tuple(args.token_bucket) or None
+    emulate = (
+        args.emulate_doc_ms / 1000.0
+        if args.emulate_doc_ms is not None else None
+    )
     try:
         service = ScoringService(
             args.models_dir,
@@ -474,8 +555,17 @@ def cmd_serve(args: argparse.Namespace) -> int:
             model_poll_interval=args.model_poll_interval,
             quarantine_dir=args.quarantine_dir,
             alerts_file=args.alerts_file,
+            # supervised replicas swap when the supervisor says so
+            # (rolling, one replica at a time) — never on their own
+            watch_model=lease is None,
+            replica_index=(
+                int(args.worker_index) if lease is not None else None
+            ),
+            emulate_doc_seconds=emulate,
         )
     except CorruptArtifactError as exc:
+        if lease is not None:
+            lease.mark_done("corrupt_model")
         print(f"error: {exc}", file=sys.stderr)
         return 2
     scorer = service.scorer
@@ -485,6 +575,7 @@ def cmd_serve(args: argparse.Namespace) -> int:
         telemetry.manifest(
             kind="serve", model=scorer.path, lang=args.lang,
             vocab_width=scorer.model.vocab_size,
+            **_worker_manifest_fields(args),
         )
     httpd = make_http_server(service, args.host, args.port)
     host, port = httpd.server_address[:2]
@@ -505,21 +596,95 @@ def cmd_serve(args: argparse.Namespace) -> int:
         _time.monotonic() + args.max_seconds
         if args.max_seconds else None
     )
-    while not preempt:
-        if deadline is not None and _time.monotonic() >= deadline:
-            break
-        _idle_sleep(0.1)
+    if lease is not None:
+        _serve_replica_loop(
+            args, service, lease, preempt, port, deadline,
+        )
+    else:
+        while not preempt:
+            if deadline is not None and _time.monotonic() >= deadline:
+                break
+            _idle_sleep(0.1)
     # preemption notice (or drill deadline): finish queued documents,
     # refuse new ones (503), then take the port down — the PR 7 drain
-    # discipline applied to a server
+    # discipline applied to a server.  A fleet replica surfaces the
+    # draining state through its lease FIRST so the front stops
+    # routing to it before the 503s would even start.
+    if lease is not None:
+        lease.beat(
+            force=True, state="draining", port=port,
+            model_path=service.scorer.path,
+            model_stamp=service.scorer.stamp,
+        )
+        telemetry.gauge("serve.replica.draining", 1)
     report = service.begin_drain()
     httpd.shutdown()
     telemetry.event("serve_drained", **report)
+    if lease is not None:
+        lease.mark_done("preempted")
     print(
         f"drain complete: {report['requests']} request(s) in "
         f"{report['batches']} batch(es), {report['swaps']} hot-swap(s), "
         f"{report['rejected']} refused while draining, "
         f"{report['retraces_after_warmup']} recompile(s) after warmup"
+    )
+    if own_telemetry:
+        telemetry.shutdown()
+    return 0
+
+
+def cmd_front(args: argparse.Namespace) -> int:
+    """Serve-fleet routing front (docs/SERVING.md "Serve fleet"):
+    one port spreading /score load across the replicas a
+    ``stc supervise --role serve`` fleet leases — least-outstanding
+    routing, drain-aware exclusion, retry-on-other-replica, and
+    per-stream generation pinning.  jax-free, like `supervise`."""
+    import threading
+    import time as _time
+
+    own_telemetry = bool(getattr(args, "telemetry_file", None))
+    telemetry.configure(args.telemetry_file if own_telemetry else None)
+    if own_telemetry:
+        telemetry.manifest(kind="front", fleet_dir=args.fleet_dir)
+
+    from .resilience import sleep as _idle_sleep
+    from .resilience.supervisor import PreemptionNotice
+    from .serving.front import (
+        FrontRouter,
+        make_front_server,
+        write_front_announce,
+    )
+
+    preempt = PreemptionNotice().install()
+    router = FrontRouter(
+        args.fleet_dir,
+        lease_timeout=args.lease_timeout,
+        wait_for_replica_s=args.wait_for_replica,
+    )
+    httpd = make_front_server(router, args.host, args.port)
+    host, port = httpd.server_address[:2]
+    write_front_announce(args.fleet_dir, host, port)
+    print(
+        f"fronting fleet {args.fleet_dir} on http://{host}:{port} — "
+        f"POST /score, GET /healthz /metrics"
+    )
+    thread = threading.Thread(
+        target=httpd.serve_forever, name="stc-front-http", daemon=True
+    )
+    thread.start()
+    deadline = (
+        _time.monotonic() + args.max_seconds
+        if args.max_seconds else None
+    )
+    while not preempt:
+        if deadline is not None and _time.monotonic() >= deadline:
+            break
+        _idle_sleep(0.1)
+    httpd.shutdown()
+    h = router.health()
+    print(
+        f"front drained: {h['requests']} request(s) routed across "
+        f"{len(h['replicas'])} replica(s), {h['retries']} retried"
     )
     if own_telemetry:
         telemetry.shutdown()
@@ -927,6 +1092,10 @@ def cmd_supervise(args: argparse.Namespace) -> int:
     from .resilience import FleetSupervisor, ResilienceError
     from .resilience.supervisor import worker_dir
 
+    if args.role != "serve" and not args.watch_dir:
+        print("--watch-dir is required for stream roles",
+              file=sys.stderr)
+        return 2
     own_telemetry = bool(getattr(args, "telemetry_file", None))
     if own_telemetry:
         telemetry.configure(args.telemetry_file)
@@ -934,6 +1103,8 @@ def cmd_supervise(args: argparse.Namespace) -> int:
             kind="supervise", role=args.role,
             watch_dir=args.watch_dir, fleet_dir=args.fleet_dir,
         )
+    if args.role == "serve":
+        return _supervise_serve(args, own_telemetry)
 
     def build_argv(index, count, generation, spawn_id):
         argv = [
@@ -1048,6 +1219,127 @@ def cmd_supervise(args: argparse.Namespace) -> int:
         f"{rep.spawns} spawn(s), {rep.respawns} respawn(s), "
         f"{rep.resizes} resize(s), {rep.lease_expiries} lease "
         f"expiry(ies), {rep.preemptions} preemption(s) survived, "
+        f"{rep.crashes} crash(es)"
+    )
+    if own_telemetry:
+        telemetry.shutdown()
+    return 0
+
+
+def _supervise_serve(args: argparse.Namespace, own_telemetry: bool) -> int:
+    """``stc supervise --role serve``: N hot ``stc serve`` replicas on
+    auto-picked ports behind one lease-discovered routing front
+    (docs/SERVING.md "Serve fleet").  The supervisor stays jax-free —
+    replicas bring jax up; the embedded front is pure stdlib."""
+    import threading
+
+    from .resilience import ResilienceError
+    from .resilience.supervisor import (
+        PreemptionNotice,
+        ServeFleetSupervisor,
+    )
+
+    def build_argv(index, count, generation, spawn_id):
+        argv = [
+            sys.executable, "-m", "spark_text_clustering_tpu.cli",
+            "serve",
+        ]
+        if args.worker_telemetry_dir:
+            argv += [
+                "--telemetry-file",
+                os.path.join(
+                    args.worker_telemetry_dir,
+                    f"worker-w{index:03d}-s{spawn_id}.jsonl",
+                ),
+            ]
+        argv += [
+            "--models-dir", args.models_dir,
+            "--lang", args.lang,
+            "--port", "0",              # auto-picked; announced via lease
+            "--max-batch", str(args.serve_max_batch),
+            "--linger-ms", str(args.serve_linger_ms),
+            "--fleet-dir", args.fleet_dir,
+            "--worker-index", str(index),
+            "--fleet-generation", str(generation),
+            "--fleet-spawn-id", str(spawn_id),
+            "--heartbeat-interval", str(args.heartbeat_interval),
+            "--lease-timeout", str(args.lease_timeout),
+        ]
+        if args.model:
+            argv += ["--model", args.model]
+        if args.no_lemmatize:
+            argv.append("--no-lemmatize")
+        if args.stop_words:
+            argv += ["--stop-words", args.stop_words]
+        if args.quarantine_dir:
+            argv += ["--quarantine-dir", args.quarantine_dir]
+        if args.serve_emulate_doc_ms is not None:
+            argv += [
+                "--emulate-doc-ms", str(args.serve_emulate_doc_ms),
+            ]
+        argv += args.worker_arg or []
+        return argv
+
+    preempt = PreemptionNotice().install()
+    sup = ServeFleetSupervisor(
+        args.fleet_dir,
+        build_argv,
+        models_dir=args.models_dir,
+        lang=args.lang,
+        stop=preempt,
+        max_seconds=args.max_seconds,
+        swap_timeout=args.swap_timeout,
+        workers=args.workers,
+        min_workers=args.min_workers,
+        max_workers=args.max_workers,
+        heartbeat_interval=args.heartbeat_interval,
+        lease_timeout=args.lease_timeout,
+        grace_seconds=args.grace_seconds,
+        startup_grace_seconds=args.startup_grace,
+        sweep_interval=args.sweep_interval,
+        max_respawns=args.max_respawns,
+        actions_file=args.actions_file,
+    )
+    front_httpd = None
+    front_thread = None
+    if args.front_port is not None:
+        from .serving.front import (
+            FrontRouter,
+            make_front_server,
+            write_front_announce,
+        )
+
+        router = FrontRouter(
+            args.fleet_dir, lease_timeout=max(
+                5.0, 2.0 * args.lease_timeout
+            ),
+        )
+        front_httpd = make_front_server(
+            router, "127.0.0.1", args.front_port
+        )
+        fhost, fport = front_httpd.server_address[:2]
+        write_front_announce(args.fleet_dir, fhost, fport)
+        front_thread = threading.Thread(
+            target=front_httpd.serve_forever,
+            name="stc-front-http", daemon=True,
+        )
+        front_thread.start()
+        print(f"serve-fleet front on http://{fhost}:{fport}")
+    try:
+        rep = sup.run()
+    except ResilienceError as exc:
+        print(f"error: {exc}", file=sys.stderr)
+        if front_httpd is not None:
+            front_httpd.shutdown()
+        if own_telemetry:
+            telemetry.shutdown()
+        return 1
+    if front_httpd is not None:
+        front_httpd.shutdown()
+    print(
+        f"serve fleet drained: {rep.final_workers} replica(s) — "
+        f"{rep.spawns} spawn(s), {rep.respawns} respawn(s), "
+        f"{rep.resizes} resize(s), {rep.swap_rolls} rolling swap(s), "
         f"{rep.crashes} crash(es)"
     )
     if own_telemetry:
@@ -1260,7 +1552,9 @@ def cmd_doctor(args: argparse.Namespace) -> int:
     return 0
 
 
-def _fleet_worker_context(args: argparse.Namespace):
+def _fleet_worker_context(
+    args: argparse.Namespace, lease_fields: Optional[dict] = None,
+):
     """Supervised-worker wiring shared by ``stream-score`` and
     ``stream-train``: the SIGTERM drain notice (installed for EVERY
     stream — a preemption notice must end the stream after the
@@ -1300,6 +1594,7 @@ def _fleet_worker_context(args: argparse.Namespace):
         worker_index=idx,
         generation=generation,
         spawn_id=spawn_id,
+        static_fields=lease_fields,
     )
     fence = FleetFence(
         fleet_dir=fleet_dir,
@@ -1577,8 +1872,65 @@ def build_parser() -> argparse.ArgumentParser:
                          "hot-swap events, dispatch/compile attribution) "
                          "— `metrics summarize` renders its "
                          "serving-health section from this")
+    se.add_argument("--emulate-doc-ms", type=float, default=None,
+                    help="bench harness: replace the jax dispatch with "
+                         "this synthetic per-document device time "
+                         "(time.sleep) — the serve_fleet scaling sweep "
+                         "uses it because the 1-core CPU sandbox cannot "
+                         "host N compute replicas (docs/SERVING.md)")
+    # fleet-replica flags (normally injected by `stc supervise --role
+    # serve`, not typed by hand): identity + lease cadence; the replica
+    # announces its auto-picked port through the lease and obeys the
+    # supervisor's rolling-swap control file
+    se.add_argument("--fleet-dir", default=None,
+                    help="fleet dir of a supervising `stc supervise "
+                         "--role serve`: enables the role=serve "
+                         "heartbeat lease (port/state/model discovery "
+                         "for the routing front) and the per-replica "
+                         "swap control file")
+    se.add_argument("--worker-index", type=int, default=0,
+                    help="this replica's index in the serve fleet")
+    se.add_argument("--fleet-generation", type=int, default=0,
+                    help="fence token: topology generation at spawn")
+    se.add_argument("--fleet-spawn-id", type=int, default=0,
+                    help="fence token: this incarnation's spawn id")
+    se.add_argument("--heartbeat-interval", type=float, default=0.5,
+                    help="seconds between lease renewals")
+    se.add_argument("--lease-timeout", type=float, default=None,
+                    help="supervisor's lease timeout: installed as the "
+                         "process-wide retry deadline")
     _add_compile_cache_arg(se)
     se.set_defaults(fn=cmd_serve)
+
+    fr = sub.add_parser(
+        "front",
+        help="serve-fleet routing front: one port spreading /score "
+             "load across an `stc supervise --role serve` fleet "
+             "(least-outstanding routing, drain-aware, "
+             "retry-on-other-replica, per-stream generation pinning)",
+    )
+    fr.add_argument("--fleet-dir", required=True,
+                    help="the serve fleet's state dir (replicas are "
+                         "discovered from its role=serve lease files)")
+    fr.add_argument("--host", default="127.0.0.1")
+    fr.add_argument("--port", type=int, default=8766,
+                    help="TCP port (0 picks a free one, announced in "
+                         "<fleet-dir>/front.json)")
+    fr.add_argument("--lease-timeout", type=float, default=10.0,
+                    help="seconds without a lease renewal before a "
+                         "replica leaves the rotation")
+    fr.add_argument("--wait-for-replica", type=float, default=30.0,
+                    help="seconds a request waits for ANY ready "
+                         "replica before failing 503")
+    fr.add_argument("--max-seconds", type=float, default=None,
+                    help="drain + exit after this many seconds "
+                         "(drills); default: run until SIGTERM")
+    fr.add_argument("--telemetry-file", default=None,
+                    help="front run stream (front.* counters, "
+                         "front.replica.<i>.* families, swap "
+                         "observations) — `metrics summarize` renders "
+                         "the serve-fleet-health section from this")
+    fr.set_defaults(fn=cmd_front)
 
     ss = sub.add_parser(
         "stream-score",
@@ -1662,9 +2014,14 @@ def build_parser() -> argparse.ArgumentParser:
              "ledger-gated resize with zombie fencing)",
     )
     sv.add_argument("--role", default="stream-score",
-                    choices=["stream-score", "stream-train"],
-                    help="worker verb the fleet runs")
-    sv.add_argument("--watch-dir", required=True)
+                    choices=["stream-score", "stream-train", "serve"],
+                    help="worker verb the fleet runs (`serve` runs N "
+                         "hot scoring replicas behind the lease-"
+                         "discovered routing front instead of "
+                         "partitioned stream workers)")
+    sv.add_argument("--watch-dir", default=None,
+                    help="directory stream workers watch (required "
+                         "for stream roles; unused by --role serve)")
     sv.add_argument("--fleet-dir", required=True,
                     help="fleet state dir: fleet.jsonl (fence records), "
                          "leases/, and per-worker checkpoint dirs "
@@ -1746,6 +2103,28 @@ def build_parser() -> argparse.ArgumentParser:
     sv.add_argument("--worker-arg", action="append", default=[],
                     help="extra argv appended verbatim to every worker "
                          "command (repeatable)")
+    # serve-role flags (docs/SERVING.md "Serve fleet")
+    sv.add_argument("--front-port", type=int, default=None,
+                    help="--role serve: also run the routing front in "
+                         "this (jax-free) process on the given port "
+                         "(0 picks one; announced in "
+                         "<fleet-dir>/front.json)")
+    sv.add_argument("--max-seconds", type=float, default=None,
+                    help="--role serve: drain the fleet and exit "
+                         "after this long (drills); default: run "
+                         "until SIGTERM")
+    sv.add_argument("--swap-timeout", type=float, default=60.0,
+                    help="--role serve: seconds one replica may take "
+                         "to ack a rolling swap before the roll "
+                         "skips it (fleet.swap_stalls)")
+    sv.add_argument("--serve-max-batch", type=int, default=64,
+                    help="--role serve: replica coalescer capacity")
+    sv.add_argument("--serve-linger-ms", type=float, default=5.0,
+                    help="--role serve: replica batch linger")
+    sv.add_argument("--serve-emulate-doc-ms", type=float, default=None,
+                    help="--role serve: forward `serve "
+                         "--emulate-doc-ms` to every replica (the "
+                         "serve_fleet bench harness)")
     _add_compile_cache_arg(sv)
     sv.set_defaults(fn=cmd_supervise)
 
@@ -1871,9 +2250,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     # bring jax up; the supervisor must survive anything they do to it
     # `monitor` is a pure host-side reader like `metrics`: no jax ever
     # `lineage` walks ledgers and run streams on the host: no jax ever
+    # `front` is pure lease-files-and-sockets routing: no jax ever
     if (
         args.cmd not in ("doctor", "metrics", "lint", "stream",
-                         "supervise", "monitor", "lineage")
+                         "supervise", "monitor", "lineage", "front")
         and getattr(args, "coordinator", None) is None
     ):
         from .utils.env import enable_persistent_compile_cache

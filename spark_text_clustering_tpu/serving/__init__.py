@@ -31,14 +31,16 @@ built into a resident process:
 
 Transport is stdlib-only: ``http.server.ThreadingHTTPServer`` on
 localhost, JSON in/out, ``/score`` + ``/healthz`` + ``/metrics``.
+
+One replica saturates one process; ``serving.front`` (jax-free — NOT
+imported here, so supervisors and fronts never pull jax through this
+package) scales the service sideways: ``stc supervise --role serve``
+runs N replicas on auto-picked ports behind the lease-discovered
+routing front with rolling hot-swap and per-stream generation pinning
+(docs/SERVING.md "Serve fleet").
 """
 
 from .coalescer import PendingDoc, RequestCoalescer, ServiceDraining
-from .server import (
-    ScoringService,
-    ServeScorer,
-    make_http_server,
-)
 
 __all__ = [
     "PendingDoc",
@@ -48,3 +50,19 @@ __all__ = [
     "ServeScorer",
     "make_http_server",
 ]
+
+# ``server`` reaches jax through the model layer; importing it lazily
+# (PEP 562) keeps ``serving.front`` — and therefore the supervisor and
+# `stc front` processes that import it — genuinely jax-free while
+# ``from .serving import ScoringService`` keeps working unchanged.
+_SERVER_EXPORTS = ("ScoringService", "ServeScorer", "make_http_server")
+
+
+def __getattr__(name):
+    if name in _SERVER_EXPORTS:
+        from . import server
+
+        return getattr(server, name)
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}"
+    )

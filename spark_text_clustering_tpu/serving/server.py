@@ -72,14 +72,26 @@ class ServeScorer:
         lemmatize: bool = True,
         max_batch: int = 64,
         token_buckets: Sequence[int] = DEFAULT_TOKEN_BUCKETS,
+        emulate_doc_seconds: Optional[float] = None,
     ) -> None:
         from ..models.base import LDAModel
         from ..pipeline import TextPreprocessor, make_vectorizer
+        from .front import model_stamp
 
         self.model = model
         self.path = path
         self.max_batch = int(max_batch)
         self.token_buckets = tuple(sorted(int(t) for t in token_buckets))
+        # publish-order stamp of the served artifact (the fleet front's
+        # generation-pinning key; None for unstamped explicit dirs)
+        self.stamp = model_stamp(path)
+        # fleet-bench harness: replace the jax dispatch with a pinned
+        # synthetic per-document device time (time.sleep) — the 1-core
+        # CPU sandbox cannot host N compute replicas, so the serve_fleet
+        # sweep measures the fleet path (routing/transport/coalescing)
+        # around an accelerator-shaped service time instead of
+        # pretending N python processes share a core gracefully
+        self.emulate_doc_seconds = emulate_doc_seconds
         self.pre = TextPreprocessor(
             stop_words=stop_words, lemmatize=lemmatize
         )
@@ -103,7 +115,10 @@ class ServeScorer:
             # events) link the serving trace back to the trace that
             # ingested and trained the bytes being served
             self.attribution["publish_trace"] = publish_trace
-        self._lda = isinstance(model, LDAModel)
+        self._lda = (
+            isinstance(model, LDAModel)
+            and emulate_doc_seconds is None
+        )
         if self._lda:
             import jax.numpy as jnp
 
@@ -174,6 +189,14 @@ class ServeScorer:
             raise ValueError(f"{n} rows > max_batch {self.max_batch}")
         if n == 0:
             return np.zeros((0, self.k), np.float32)
+        if self.emulate_doc_seconds is not None:
+            # accelerator-shaped service time, deterministic output:
+            # block (like a device dispatch would) for the pinned
+            # per-document seconds, answer uniform-ish distributions
+            _sleep(self.emulate_doc_seconds * n)
+            out = np.full((n, self.k), 1.0 / self.k, np.float32)
+            out[:, 0] += 1e-3           # argmax pinned to topic 0
+            return out
         if not self._lda:
             return np.asarray(
                 self.model.topic_distribution(rows), np.float32
@@ -224,10 +247,13 @@ class ServeScorer:
         }
         t0 = time.perf_counter()
         v = max(1, self.model.vocab_size)
-        for t in self.token_buckets:
-            live = max(1, t // 2 + 1)    # lands exactly in bucket t
-            ids = (np.arange(live, dtype=np.int32) % v).astype(np.int32)
-            self.score_rows([(ids, np.ones(live, np.float32))])
+        if self.emulate_doc_seconds is None:
+            for t in self.token_buckets:
+                live = max(1, t // 2 + 1)  # lands exactly in bucket t
+                ids = (
+                    np.arange(live, dtype=np.int32) % v
+                ).astype(np.int32)
+                self.score_rows([(ids, np.ones(live, np.float32))])
         retraces = reg.counter("compile.retraces").value
         report = {
             "buckets": list(self.token_buckets),
@@ -238,6 +264,8 @@ class ServeScorer:
                 "on" if compilecache.active() else "off"
             ),
         }
+        if self.emulate_doc_seconds is not None:
+            report["emulated_doc_seconds"] = self.emulate_doc_seconds
         if compilecache.active():
             for k, v0 in cache0.items():
                 report[f"cache_{k}"] = int(
@@ -265,6 +293,9 @@ class ScoringService:
         quarantine_dir: Optional[str] = None,
         request_timeout: float = 60.0,
         alerts_file: Optional[str] = None,
+        watch_model: bool = True,
+        replica_index: Optional[int] = None,
+        emulate_doc_seconds: Optional[float] = None,
     ) -> None:
         self.models_dir = models_dir
         self.lang = lang
@@ -273,11 +304,16 @@ class ScoringService:
         # a monitor's alerts.jsonl: firing alerts degrade /healthz
         # (docs/OBSERVABILITY.md "Live monitoring & alerting")
         self.alerts_file = alerts_file
+        # fleet identity: responses carry X-STC-Replica, and the
+        # Prometheus exposition labels every series with the index so a
+        # scraper sees N replicas as one labeled family, not N clashes
+        self.replica_index = replica_index
         self._scorer_kw = dict(
             stop_words=stop_words,
             lemmatize=lemmatize,
             max_batch=max_batch,
             token_buckets=token_buckets,
+            emulate_doc_seconds=emulate_doc_seconds,
         )
         self.model_poll_interval = float(model_poll_interval)
         self.request_timeout = float(request_timeout)
@@ -304,9 +340,12 @@ class ScoringService:
             self._dispatch, max_batch=max_batch, linger_s=linger_s,
         )
         self._watcher = None
-        if model is None:
+        if model is None and watch_model:
             # an explicitly pinned --model never swaps; discovery mode
-            # polls the selection path for a newer published artifact
+            # polls the selection path for a newer published artifact.
+            # Fleet replicas run with watch_model=False: the supervisor
+            # sequences swaps replica-by-replica through control files
+            # so the fleet never re-warms everywhere at once.
             self._watcher = threading.Thread(
                 target=self._watch, name="stc-serve-watcher", daemon=True
             )
@@ -630,6 +669,9 @@ class _ServeHandler(BaseHTTPRequestHandler):
         pass
 
     def _send(self, code: int, doc: dict, trace=None) -> None:
+        from .front import GENERATION_HEADER, REPLICA_HEADER
+
+        service: ScoringService = self.server.service
         body = json.dumps(doc).encode("utf-8")
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
@@ -638,6 +680,16 @@ class _ServeHandler(BaseHTTPRequestHandler):
             # the served byte's end of the causal chain: clients (and
             # `stc lineage`) resume the walk from this header
             self.send_header(tracing.HEADER, trace.format())
+        # fleet attribution: which publish generation answered (the
+        # front's generation-pinning key) and which replica (forwarded
+        # verbatim by the front as X-STC-Replica)
+        stamp = service.scorer.stamp
+        if stamp is not None:
+            self.send_header(GENERATION_HEADER, str(stamp))
+        if service.replica_index is not None:
+            self.send_header(
+                REPLICA_HEADER, str(service.replica_index)
+            )
         self.end_headers()
         self.wfile.write(body)
 
@@ -666,10 +718,15 @@ class _ServeHandler(BaseHTTPRequestHandler):
             if query == "format=prometheus" or (
                 not query and prometheus.wants_prometheus(accept)
             ):
+                labels = (
+                    {"replica": str(service.replica_index)}
+                    if service.replica_index is not None else None
+                )
                 self._send_text(
                     200,
                     prometheus.render(
-                        telemetry.get_registry().snapshot()
+                        telemetry.get_registry().snapshot(),
+                        labels=labels,
                     ),
                     prometheus.CONTENT_TYPE,
                 )

@@ -399,6 +399,18 @@ BUILTIN_RULES: Dict[str, Dict] = {
         "op": ">", "value": 60.0, "resolve_seconds": 5.0,
         "description": "no micro-batch completed within the window",
     },
+    # serve fleet: a replica's lease DISAPPEARED (the serve supervisor
+    # retires a dead replica's lease file before respawning it, so the
+    # gap between death and the respawned replica's first heartbeat is
+    # an absence — fires on the kill, resolves on the fresh lease)
+    "replica_down": {
+        "kind": "absence",
+        "signal": {"event": "lease", "by": "worker",
+                   "where": {"role": "serve"}},
+        "op": ">", "value": 3.0, "resolve_seconds": 0.5,
+        "description": "a serve replica's lease vanished and no "
+                       "respawn has heartbeat yet",
+    },
     # serving: latency / fill / quarantine regressions
     "serve_p99": {
         "kind": "threshold",
@@ -406,7 +418,10 @@ BUILTIN_RULES: Dict[str, Dict] = {
                    "agg": "p99", "window_seconds": 60.0},
         "op": ">", "value": 0.5, "for_seconds": 5.0,
         "resolve_seconds": 15.0,
-        "description": "serve batch p99 beyond the latency budget",
+        "action": {"kind": "scale_out"},
+        "description": "serve batch p99 beyond the latency budget — "
+                       "a serve fleet scales out a replica "
+                       "(drain-free; docs/SERVING.md)",
     },
     "serve_batch_fill": {
         "kind": "threshold",
@@ -414,8 +429,10 @@ BUILTIN_RULES: Dict[str, Dict] = {
                    "agg": "mean", "window_seconds": 60.0},
         "op": "<", "value": 0.05, "for_seconds": 10.0,
         "resolve_seconds": 15.0,
+        "action": {"kind": "scale_in"},
         "description": "batches dispatch nearly empty — linger/bucket "
-                       "tuning is off for this traffic",
+                       "tuning is off for this traffic, or a serve "
+                       "fleet is over-provisioned (scale in)",
     },
     "serve_quarantine_rate": {
         "kind": "threshold",
@@ -959,6 +976,11 @@ class AlertEngine:
                 "queue_depth": int(lease.get("queue_depth", 0)),
                 "epoch": int(lease.get("epoch", -1)),
                 "generation": lease.get("generation"),
+                # serve-fleet identity: replica leases carry role=serve
+                # (+ state/port) — the replica_down absence rule and
+                # serve-aware dashboards filter on it
+                "role": lease.get("role", "stream"),
+                "state": lease.get("state"),
             })
         return out
 

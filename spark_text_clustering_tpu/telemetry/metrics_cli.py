@@ -40,6 +40,7 @@ from __future__ import annotations
 import json
 import math
 import os
+import re
 import sys
 from typing import Dict, List, Optional, Tuple
 
@@ -55,6 +56,7 @@ __all__ = [
     "skew_findings",
     "ledger_health",
     "fleet_health",
+    "serve_fleet_health",
     "serving_health",
     "alert_health",
     "compile_health",
@@ -621,6 +623,101 @@ def fleet_health(events: List[Dict]) -> Optional[Dict]:
         out["converged"] = True
         if _is_num(conv[-1].get("committed_epochs")):
             out["committed_epochs"] = int(conv[-1]["committed_epochs"])
+    # serve-role rolling swaps (fleet_swap_roll / fleet_replica_swapped
+    # / fleet_swap_roll_done): per-roll swap lag between the FIRST and
+    # LAST replica swap — the window a pinned client stream can still
+    # land on the old generation
+    rolls = by.get("fleet_swap_roll_done", ())
+    if rolls:
+        out["swap_rolls"] = len(rolls)
+        out["replica_swaps"] = len(by.get("fleet_replica_swapped", ()))
+        lags = [
+            float(e["swap_lag_seconds"]) for e in rolls
+            if _is_num(e.get("swap_lag_seconds"))
+        ]
+        if lags:
+            out["swap_lag_seconds_max"] = round(max(lags), 6)
+    if by.get("fleet_swap_stalled"):
+        out["swap_stalls"] = len(by["fleet_swap_stalled"])
+    return out
+
+
+def serve_fleet_health(
+    events: List[Dict], metrics: Dict[str, float]
+) -> Optional[Dict]:
+    """Serve-fleet-health summary for a routing-front run
+    (docs/SERVING.md "Serve fleet"): request volume and retries, the
+    per-replica request share and p99 spread (the load-balance view),
+    and the observed swap lag per rolling publish.  None when the run
+    never fronted a fleet."""
+    if not any(k.startswith(("counter.front.", "hist.front."))
+               for k in metrics) and not any(
+        e.get("event") == "front_swap_observed" for e in events
+    ):
+        return None
+    out: Dict = {
+        "requests": int(metrics.get("counter.front.requests", 0)),
+        "retries": int(metrics.get("counter.front.retries", 0)),
+        "no_replica": int(metrics.get("counter.front.no_replica", 0)),
+        "repins": int(metrics.get("counter.front.repins", 0)),
+    }
+    lat = {}
+    for q in ("p50", "p99", "mean", "count"):
+        v = metrics.get(f"hist.front.request_seconds.{q}")
+        if v is not None:
+            lat[q] = v
+    if lat:
+        out["request_seconds"] = lat
+    # per-replica share + p99 spread from the front.replica.<i>.*
+    # families (the Prometheus 'replica' label's run-stream twin)
+    rep_re = re.compile(r"^counter\.front\.replica\.(\d+)\.requests$")
+    replicas = []
+    total = max(1, out["requests"])
+    for k in sorted(metrics):
+        m = rep_re.match(k)
+        if not m:
+            continue
+        i = int(m.group(1))
+        row = {
+            "replica": i,
+            "requests": int(metrics[k]),
+            "share": round(metrics[k] / total, 4),
+            "retries": int(metrics.get(
+                f"counter.front.replica.{i}.retries", 0
+            )),
+        }
+        p99 = metrics.get(
+            f"hist.front.replica.{i}.request_seconds.p99"
+        )
+        if p99 is not None:
+            row["p99_seconds"] = p99
+        replicas.append(row)
+    if replicas:
+        out["replicas"] = replicas
+        p99s = [r["p99_seconds"] for r in replicas
+                if "p99_seconds" in r]
+        if len(p99s) >= 2:
+            out["p99_spread_seconds"] = round(max(p99s) - min(p99s), 6)
+    # swap lag as the FRONT observed it: per target stamp, first vs
+    # last replica whose lease crossed to the new generation
+    swaps: Dict[str, List[float]] = {}
+    for e in events:
+        if e.get("event") != "front_swap_observed":
+            continue
+        if not _is_num(e.get("ts")):
+            continue
+        swaps.setdefault(str(e.get("to_stamp")), []).append(
+            float(e["ts"])
+        )
+    if swaps:
+        out["swaps_observed"] = [
+            {
+                "stamp": stamp,
+                "replicas": len(ts),
+                "swap_lag_seconds": round(max(ts) - min(ts), 6),
+            }
+            for stamp, ts in sorted(swaps.items())
+        ]
     return out
 
 
@@ -1093,6 +1190,42 @@ def _print_serving_health(sh: Dict, file=None) -> None:
         )
 
 
+def _print_serve_fleet_health(sfh: Dict, file=None) -> None:
+    file = file if file is not None else sys.stdout
+    print("serve fleet health (front):", file=file)
+    lat = sfh.get("request_seconds", {})
+    lat_s = (
+        f"  p50 {lat['p50'] * 1000:.1f}ms  p99 {lat['p99'] * 1000:.1f}ms"
+        if "p50" in lat and "p99" in lat else ""
+    )
+    print(
+        f"  requests: {sfh['requests']}  retries: {sfh['retries']}  "
+        f"no-replica: {sfh['no_replica']}  repins: {sfh['repins']}"
+        f"{lat_s}",
+        file=file,
+    )
+    for r in sfh.get("replicas", ()):
+        p99 = (
+            f"  p99 {r['p99_seconds'] * 1000:.1f}ms"
+            if "p99_seconds" in r else ""
+        )
+        print(
+            f"  replica {r['replica']}: {r['requests']} request(s) "
+            f"({r['share']:.1%} share)  retries {r['retries']}{p99}",
+            file=file,
+        )
+    if "p99_spread_seconds" in sfh:
+        print(
+            f"  p99 spread across replicas: "
+            f"{sfh['p99_spread_seconds'] * 1000:.1f}ms", file=file,
+        )
+    for s in sfh.get("swaps_observed", ()):
+        print(
+            f"  swap to {s['stamp']}: {s['replicas']} replica(s), "
+            f"lag {s['swap_lag_seconds']:.3f}s first->last", file=file,
+        )
+
+
 def _print_fleet_health(fh: Dict, file=None) -> None:
     file = file if file is not None else sys.stdout
     print("fleet health:", file=file)
@@ -1120,6 +1253,21 @@ def _print_fleet_health(fh: Dict, file=None) -> None:
         print(
             f"  lease slack: mean {fh['mean_lease_slack_seconds']:.3f}s"
             f"  min {fh['min_lease_slack_seconds']:.3f}s", file=file,
+        )
+    if "swap_rolls" in fh:
+        print(
+            f"  rolling swaps: {fh['swap_rolls']}  replica swaps: "
+            f"{fh['replica_swaps']}"
+            + (
+                f"  max swap lag {fh['swap_lag_seconds_max']:.3f}s "
+                f"first->last"
+                if "swap_lag_seconds_max" in fh else ""
+            )
+            + (
+                f"  stalls: {fh['swap_stalls']}"
+                if "swap_stalls" in fh else ""
+            ),
+            file=file,
         )
     if fh.get("converged"):
         print(
@@ -1176,6 +1324,7 @@ def _cmd_summarize(args) -> int:
     metrics = run_metrics(events)
     lh = ledger_health(events)
     fh = fleet_health(events)
+    sfh = serve_fleet_health(events, metrics)
     sh = serving_health(events, metrics)
     ah = alert_health(events, metrics)
     ch = compile_health(events, metrics)
@@ -1186,6 +1335,8 @@ def _cmd_summarize(args) -> int:
             doc["ledger_health"] = lh
         if fh is not None:
             doc["fleet_health"] = fh
+        if sfh is not None:
+            doc["serve_fleet_health"] = sfh
         if sh is not None:
             doc["serving_health"] = sh
         if ah is not None:
@@ -1204,6 +1355,8 @@ def _cmd_summarize(args) -> int:
         _print_ledger_health(lh)
     if fh is not None:
         _print_fleet_health(fh)
+    if sfh is not None:
+        _print_serve_fleet_health(sfh)
     if sh is not None:
         _print_serving_health(sh)
     if ah is not None:

@@ -78,6 +78,31 @@ METRICS: Dict[str, str] = {
     "fleet.actions_applied":
         "monitor actions-file requests applied by the supervisor "
         "(alert-driven resize/drain — the telemetry -> topology loop)",
+    # -- serve fleet (docs/SERVING.md "Serve fleet") ---------------------
+    "fleet.swap_rolls":
+        "rolling model swaps started by the serve supervisor (one "
+        "committed publish rolled replica-by-replica)",
+    "fleet.swap_stalls":
+        "replica swaps that timed out mid-roll (the replica keeps "
+        "serving its verified old model; the roll moves on)",
+    "front.requests":
+        "documents routed to a replica by the serve-fleet front "
+        "(successful forwards; retries and refusals count separately)",
+    "front.retries":
+        "forwards retried on another replica after a connection-level "
+        "failure or a draining (503) answer — scoring is idempotent "
+        "per document, so a killed replica costs a retry, not a "
+        "failed client request",
+    "front.no_replica":
+        "front requests refused because no ready replica existed "
+        "within the wait budget (the fleet was empty or all-draining)",
+    "front.repins":
+        "client streams re-pinned to a newer model generation after "
+        "their pinned generation left the fleet (rolling swap "
+        "completed under them)",
+    "front.request_seconds":
+        "per-request front latency: accept -> replica response "
+        "relayed (includes routing, transport, and any retries)",
     # -- quarantine requeue (stc stream requeue) ------------------------
     "requeue.replayed":
         "quarantined documents replayed back into a watch directory",
@@ -186,6 +211,14 @@ METRICS: Dict[str, str] = {
 # prefix -> owner/description of the dynamic family
 PREFIXES: Dict[str, str] = {
     "span.": "telemetry facade: per-span latency/error families",
+    "front.replica.":
+        "serving.front: per-replica routed-request counters and "
+        "latency histograms (front.replica.<i>.requests/.retries/"
+        ".request_seconds — the index surfaces as the Prometheus "
+        "'replica' label on the exposition path)",
+    "serve.replica.":
+        "serve fleet replica self-identity gauges written by the "
+        "replica lease loop (serve.replica.index/.stamp/.draining)",
     "device_sync.": "telemetry facade: attributed block_until_ready waits",
     "train.": "telemetry facade: per-optimizer iteration histograms",
     "collective.": "parallel.collectives: per-op trace-time calls/bytes",

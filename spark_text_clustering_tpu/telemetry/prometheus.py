@@ -29,7 +29,7 @@ from __future__ import annotations
 
 import math
 import re
-from typing import Dict, List
+from typing import Dict, List, Optional, Tuple
 
 __all__ = ["CONTENT_TYPE", "sanitize", "render", "wants_prometheus"]
 
@@ -38,10 +38,37 @@ CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
 _SANITIZE_RE = re.compile(r"[^a-zA-Z0-9_]")
 
+# per-replica dotted families (``front.replica.3.requests``) expose the
+# index as a proper ``replica`` label instead of minting one series
+# name per index — dashboards aggregate across the fleet with a single
+# selector (docs/SERVING.md "Serve fleet")
+_REPLICA_RE = re.compile(r"^(.*)\.replica\.(\d+)\.(.+)$")
+
 
 def sanitize(name: str) -> str:
     """Dotted telemetry name -> Prometheus metric name."""
     return "stc_" + _SANITIZE_RE.sub("_", name)
+
+
+def _labels_text(labels: Dict[str, str]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(
+        f'{k}="{v}"' for k, v in sorted(labels.items())
+    )
+    return "{" + inner + "}"
+
+
+def _split(
+    name: str, base: Optional[Dict[str, str]]
+) -> Tuple[str, Dict[str, str]]:
+    """(prometheus name, label set) for one dotted telemetry name."""
+    labels = dict(base or {})
+    m = _REPLICA_RE.match(name)
+    if m:
+        labels["replica"] = m.group(2)
+        name = f"{m.group(1)}.replica.{m.group(3)}"
+    return sanitize(name), labels
 
 
 def _num(v) -> str:
@@ -57,29 +84,52 @@ def _num(v) -> str:
     return repr(f)
 
 
-def render(snapshot: Dict) -> str:
-    """The exposition text for one ``MetricRegistry.snapshot()``."""
+def render(
+    snapshot: Dict, labels: Optional[Dict[str, str]] = None
+) -> str:
+    """The exposition text for one ``MetricRegistry.snapshot()``.
+
+    ``labels`` stamps every sample with a constant label set — a fleet
+    replica passes ``{"replica": "2"}`` so N scraped replicas land as
+    one labeled family instead of N colliding series.  Per-replica
+    dotted names additionally surface their embedded index as the same
+    ``replica`` label (see ``_REPLICA_RE``).  HELP/TYPE lines are
+    emitted once per metric name (repeat label sets share them).
+    """
     lines: List[str] = []
+    typed: set = set()
+
+    def head(pn: str, kind: str, name: str, note: str = "") -> None:
+        if pn in typed:
+            return
+        typed.add(pn)
+        lines.append(f"# HELP {pn} {kind} {name}{note}")
+        lines.append(f"# TYPE {pn} {kind}")
+
     for name, v in sorted(snapshot.get("counters", {}).items()):
-        pn = sanitize(name) + "_total"
-        lines.append(f"# HELP {pn} counter {name}")
-        lines.append(f"# TYPE {pn} counter")
-        lines.append(f"{pn} {_num(v)}")
+        pn, lbl = _split(name, labels)
+        pn += "_total"
+        head(pn, "counter", name)
+        lines.append(f"{pn}{_labels_text(lbl)} {_num(v)}")
     for name, v in sorted(snapshot.get("gauges", {}).items()):
-        pn = sanitize(name)
-        lines.append(f"# HELP {pn} gauge {name}")
-        lines.append(f"# TYPE {pn} gauge")
-        lines.append(f"{pn} {_num(v)}")
+        pn, lbl = _split(name, labels)
+        head(pn, "gauge", name)
+        lines.append(f"{pn}{_labels_text(lbl)} {_num(v)}")
     for name, h in sorted(snapshot.get("histograms", {}).items()):
-        pn = sanitize(name)
-        lines.append(f"# HELP {pn} histogram {name} (as summary)")
-        lines.append(f"# TYPE {pn} summary")
+        pn, lbl = _split(name, labels)
+        head(pn, "summary", name, note=" (histogram)")
         for q, fld in (("0.5", "p50"), ("0.95", "p95"), ("0.99", "p99")):
+            qlbl = dict(lbl)
+            qlbl["quantile"] = q
             lines.append(
-                f'{pn}{{quantile="{q}"}} {_num(h.get(fld))}'
+                f"{pn}{_labels_text(qlbl)} {_num(h.get(fld))}"
             )
-        lines.append(f"{pn}_sum {_num(h.get('sum', 0.0))}")
-        lines.append(f"{pn}_count {_num(h.get('count', 0))}")
+        lines.append(
+            f"{pn}_sum{_labels_text(lbl)} {_num(h.get('sum', 0.0))}"
+        )
+        lines.append(
+            f"{pn}_count{_labels_text(lbl)} {_num(h.get('count', 0))}"
+        )
     return "\n".join(lines) + "\n"
 
 

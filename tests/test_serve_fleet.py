@@ -1,0 +1,965 @@
+"""Serve fleet: N hot replicas behind one routing front.
+
+Covers the jax-free front (lease-driven replica discovery, least-
+outstanding routing, drain-aware exclusion, retry-on-other-replica,
+per-stream generation pinning), the ``ServeFleetSupervisor`` serve role
+against stub replicas (staggered bring-up, rolling hot-swap through
+control files, drain-free scale-out from the actions file, SIGKILL
+respawn with lease retirement), the ``replica_down`` absence rule, the
+``serve_fleet_health`` summarize section, the Prometheus ``replica``
+label, and a real-subprocess chaos drill: concurrent HTTP traffic
+through `stc supervise --role serve --front-port 0` across a rolling
+model publish AND a replica SIGKILL, asserting zero failed client
+requests and one-generation-per-client-stream.
+"""
+
+import http.client
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from spark_text_clustering_tpu import telemetry
+from spark_text_clustering_tpu.resilience import faultinject
+from spark_text_clustering_tpu.resilience.supervisor import (
+    FleetLedger,
+    ServeFleetSupervisor,
+    control_path,
+    lease_path,
+)
+from spark_text_clustering_tpu.serving.front import (
+    GENERATION_HEADER,
+    REPLICA_HEADER,
+    STREAM_HEADER,
+    FrontRouter,
+    NoReplicaAvailable,
+    discover_latest_model_dir,
+    make_front_server,
+    model_stamp,
+    read_replicas,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    faultinject.reset()
+    telemetry.configure(None)       # registry-only; counters live
+    yield
+    faultinject.reset()
+    telemetry.shutdown()
+    telemetry.get_registry().reset()
+
+
+def _write_lease(fleet, index, **fields):
+    path = lease_path(str(fleet), index)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    payload = {
+        "pid": os.getpid(), "worker": index, "generation": 0,
+        "spawn_id": index, "ts": time.time(), "role": "serve",
+        "state": "ready", "port": 40000 + index,
+        "model_path": f"/models/LdaModel_EN_1000",
+        "model_stamp": 1000, "queue_depth": 0,
+    }
+    payload.update(fields)
+    with open(path, "w") as f:
+        json.dump(payload, f)
+    return path
+
+
+# ---------------------------------------------------------------------------
+# Stamps + discovery
+# ---------------------------------------------------------------------------
+class TestModelStamp:
+    def test_stamp_parsing(self):
+        assert model_stamp("/m/LdaModel_EN_1723456789") == 1723456789
+        assert model_stamp("LdaModel_GE_42/") == 42
+        assert model_stamp("/m/unstamped") is None
+        assert model_stamp(None) is None
+
+    def test_discover_latest_requires_commit(self, tmp_path):
+        m = tmp_path / "models"
+        for stamp, committed in ((1000, True), (2000, True),
+                                 (3000, False)):
+            d = m / f"LdaModel_EN_{stamp}"
+            d.mkdir(parents=True)
+            if committed:
+                (d / "COMMIT").write_text("x")
+        (m / "LdaModel_GE_9000").mkdir()
+        ((m / "LdaModel_GE_9000") / "COMMIT").write_text("x")
+        assert discover_latest_model_dir(str(m), "EN") == str(
+            m / "LdaModel_EN_2000"
+        )
+        assert discover_latest_model_dir(str(m), "FR") is None
+        assert discover_latest_model_dir(str(tmp_path / "nope"),
+                                         "EN") is None
+
+
+class TestReplicaTable:
+    def test_reads_only_live_serve_leases(self, tmp_path):
+        _write_lease(tmp_path, 0)
+        _write_lease(tmp_path, 1, state="draining")
+        _write_lease(tmp_path, 2, done=True, reason="preempted")
+        _write_lease(tmp_path, 3, role="stream")
+        p = lease_path(str(tmp_path), 4)
+        with open(p, "w") as f:
+            f.write("{torn")
+        got = read_replicas(str(tmp_path))
+        assert [r.index for r in got] == [0, 1]
+        assert got[0].ready and got[0].port == 40000
+        assert got[1].state == "draining" and not got[1].ready
+        assert got[0].stamp == 1000
+
+
+# ---------------------------------------------------------------------------
+# Router selection units (no HTTP)
+# ---------------------------------------------------------------------------
+class TestRouterSelection:
+    def _router(self, tmp_path, **kw):
+        kw.setdefault("refresh_s", 0.0)
+        return FrontRouter(str(tmp_path), **kw)
+
+    def test_least_outstanding_selection(self, tmp_path):
+        _write_lease(tmp_path, 0)
+        _write_lease(tmp_path, 1)
+        r = self._router(tmp_path)
+        first = r.pick()                 # outstanding: {first: 1}
+        second = r.pick()
+        assert {first.index, second.index} == {0, 1}
+        # both now hold one outstanding; release one and it wins
+        r._release(first.index)
+        assert r.pick().index == first.index
+
+    def test_draining_and_stale_excluded(self, tmp_path):
+        _write_lease(tmp_path, 0, state="draining")
+        _write_lease(tmp_path, 1, ts=time.time() - 60.0)
+        with pytest.raises(NoReplicaAvailable):
+            self._router(tmp_path, lease_timeout=5.0).pick()
+        _write_lease(tmp_path, 2)
+        assert self._router(tmp_path).pick().index == 2
+
+    def test_generation_pinning_holds_then_repins(self, tmp_path):
+        _write_lease(tmp_path, 0, model_stamp=1000)
+        _write_lease(tmp_path, 1, model_stamp=2000)
+        r = self._router(tmp_path)
+        r._pins["s1"] = 1000
+        # while generation 1000 exists anywhere, the stream stays on it
+        for _ in range(4):
+            got = r.pick("s1")
+            assert got.index == 0
+            r._release(0)
+        # a NEVER-pinned stream spreads freely
+        assert {r.pick().index, r.pick().index} == {0, 1}
+        reg = telemetry.get_registry()
+        assert reg.counter("front.repins").value == 0
+        # the old generation disappears (rolling swap finished): the
+        # stream re-pins FORWARD, never backward
+        _write_lease(tmp_path, 0, model_stamp=2000)
+        r.refresh(force=True)
+        got = r.pick("s1")
+        assert got.stamp == 2000
+        assert reg.counter("front.repins").value == 1
+
+    def test_pin_never_routes_backward(self, tmp_path):
+        _write_lease(tmp_path, 0, model_stamp=1000)
+        r = self._router(tmp_path)
+        r._pins["s1"] = 2000
+        with pytest.raises(NoReplicaAvailable):
+            r.pick("s1")
+
+    def test_swap_observation_events(self, tmp_path):
+        stream = tmp_path / "front.jsonl"
+        telemetry.configure(str(stream))
+        telemetry.manifest(kind="front")
+        _write_lease(tmp_path, 0, model_stamp=1000)
+        r = self._router(tmp_path)
+        r.refresh(force=True)
+        _write_lease(tmp_path, 0, model_stamp=2000)
+        r.refresh(force=True)
+        telemetry.shutdown()
+        from spark_text_clustering_tpu.telemetry.metrics_cli import (
+            load_run,
+        )
+
+        _, events = load_run(str(stream))
+        (sw,) = [
+            e for e in events if e.get("event") == "front_swap_observed"
+        ]
+        assert sw["replica"] == 0
+        assert sw["from_stamp"] == 1000 and sw["to_stamp"] == 2000
+
+
+# ---------------------------------------------------------------------------
+# Router + front HTTP against stub replica servers
+# ---------------------------------------------------------------------------
+class _StubReplica:
+    """A minimal /score HTTP server impersonating one serve replica."""
+
+    def __init__(self, index, stamp, *, draining=False):
+        from http.server import (
+            BaseHTTPRequestHandler,
+            ThreadingHTTPServer,
+        )
+
+        stub = self
+
+        class H(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):  # noqa: A003
+                pass
+
+            def do_POST(self):  # noqa: N802
+                n = int(self.headers.get("Content-Length", "0"))
+                self.rfile.read(n)
+                stub.hits += 1
+                if stub.draining:
+                    body = json.dumps(
+                        {"error": "draining", "status": "draining"}
+                    ).encode()
+                    self.send_response(503)
+                else:
+                    body = json.dumps(
+                        {"results": [{"name": "d", "topic": 0}]}
+                    ).encode()
+                    self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.send_header(GENERATION_HEADER, str(stub.stamp))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self.index = index
+        self.stamp = stamp
+        self.draining = draining
+        self.hits = 0
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        self.httpd.daemon_threads = True
+        self.port = self.httpd.server_address[1]
+        threading.Thread(
+            target=self.httpd.serve_forever, daemon=True
+        ).start()
+
+    def close(self):
+        self.httpd.shutdown()
+
+
+class TestRouterTransport:
+    def _fleet(self, tmp_path, stubs):
+        for s in stubs:
+            _write_lease(
+                tmp_path, s.index, port=s.port, model_stamp=s.stamp,
+            )
+        return FrontRouter(str(tmp_path), refresh_s=0.0,
+                           wait_for_replica_s=3.0, retry_wait_s=0.01)
+
+    def test_route_and_retry_on_refused(self, tmp_path):
+        live = _StubReplica(1, 1000)
+        try:
+            # replica 0's lease points at a CLOSED port (SIGKILLed but
+            # lease not yet retired): the front must retry onto 1
+            _write_lease(tmp_path, 0, port=live.port + 1 or 1)
+            r = self._fleet(tmp_path, [live])
+            seen = set()
+            for _ in range(6):
+                status, body, headers, idx = r.route(
+                    b'{"texts": ["x"]}', stream="c"
+                )
+                assert status == 200
+                assert json.loads(body)["results"][0]["topic"] == 0
+                seen.add(idx)
+            assert seen == {1}
+            reg = telemetry.get_registry()
+            assert reg.counter("front.requests").value == 6
+            assert reg.counter("front.retries").value >= 1
+            assert reg.counter("front.replica.1.requests").value == 6
+        finally:
+            live.close()
+
+    def test_draining_answer_retried_on_other_replica(self, tmp_path):
+        a = _StubReplica(0, 1000, draining=True)
+        b = _StubReplica(1, 1000)
+        try:
+            r = self._fleet(tmp_path, [a, b])
+            for _ in range(4):
+                status, _, _, idx = r.route(b"{}")
+                assert status == 200 and idx == 1
+        finally:
+            a.close()
+            b.close()
+
+    def test_response_generation_pins_stream(self, tmp_path):
+        old = _StubReplica(0, 1000)
+        new = _StubReplica(1, 2000)
+        try:
+            r = self._fleet(tmp_path, [old, new])
+            # force the first route onto the NEW generation
+            with r._lock:
+                r._outstanding[0] = 5
+            status, _, headers, idx = r.route(b"{}", stream="s1")
+            assert idx == 1
+            assert headers[GENERATION_HEADER] == "2000"
+            with r._lock:
+                r._outstanding[0] = 0
+            # pinned at 2000 now: replica 0 (older) is never eligible
+            for _ in range(5):
+                _, _, _, idx = r.route(b"{}", stream="s1")
+                assert idx == 1
+            # an unpinned stream still reaches both
+            seen = {r.route(b"{}")[3] for _ in range(6)}
+            assert seen == {0, 1}
+        finally:
+            old.close()
+            new.close()
+
+    def test_front_server_end_to_end(self, tmp_path):
+        stub = _StubReplica(0, 1000)
+        router = self._fleet(tmp_path, [stub])
+        httpd = make_front_server(router, "127.0.0.1", 0)
+        port = httpd.server_address[1]
+        threading.Thread(
+            target=httpd.serve_forever, daemon=True
+        ).start()
+        try:
+            conn = http.client.HTTPConnection("127.0.0.1", port,
+                                              timeout=10)
+            conn.request(
+                "POST", "/score", body=b'{"texts": ["x"]}',
+                headers={STREAM_HEADER: "c1",
+                         "Content-Type": "application/json"},
+            )
+            resp = conn.getresponse()
+            body = json.loads(resp.read())
+            assert resp.status == 200
+            assert resp.headers[REPLICA_HEADER] == "0"
+            assert resp.headers[GENERATION_HEADER] == "1000"
+            assert body["results"][0]["topic"] == 0
+            conn.request("GET", "/healthz")
+            h = json.loads(conn.getresponse().read())
+            assert h["ready"] == 1 and h["requests"] == 1
+            conn.request("GET", "/metrics?format=prometheus")
+            text = conn.getresponse().read().decode()
+            assert 'stc_front_replica_requests_total{replica="0"} 1' \
+                in text
+            conn.close()
+        finally:
+            httpd.shutdown()
+            stub.close()
+
+
+# ---------------------------------------------------------------------------
+# ServeFleetSupervisor against stub replicas (no jax)
+# ---------------------------------------------------------------------------
+SERVE_STUB = r"""
+import json, os, signal, sys, time
+
+lease, ctrl, gen, sid, idx = (
+    sys.argv[1], sys.argv[2], int(sys.argv[3]), int(sys.argv[4]),
+    int(sys.argv[5]),
+)
+models = os.environ.get("STUB_MODELS", "")
+ready_delay = float(os.environ.get("STUB_READY_DELAY", "0.2"))
+stop = {"v": False}
+signal.signal(signal.SIGTERM, lambda s, f: stop.update(v=True))
+
+
+def latest_stamp():
+    best = -1
+    try:
+        for n in os.listdir(models):
+            if n.startswith("LdaModel_EN_") and os.path.exists(
+                os.path.join(models, n, "COMMIT")
+            ):
+                best = max(best, int(n.rsplit("_", 1)[1]))
+    except (OSError, ValueError):
+        pass
+    return best
+
+
+marks = {"spawned": time.time()}
+
+
+def write(state, stamp, **kw):
+    payload = {
+        "pid": os.getpid(), "worker": idx, "generation": gen,
+        "spawn_id": sid, "ts": time.time(), "role": "serve",
+        "state": state, "port": 40000 + idx,
+        "model_path": os.path.join(models, f"LdaModel_EN_{stamp}"),
+        "model_stamp": stamp, "queue_depth": 0, **marks, **kw,
+    }
+    tmp = lease + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(payload, f)
+    os.replace(tmp, lease)
+
+
+stamp = latest_stamp()
+write("starting", stamp)
+time.sleep(ready_delay)
+marks["ready_at"] = time.time()
+write("ready", stamp)
+while not stop["v"]:
+    time.sleep(0.04)
+    try:
+        with open(ctrl) as f:
+            cmd = json.load(f)
+        want = int(cmd.get("stamp", -1))
+    except (OSError, ValueError):
+        want = -1
+    if want > stamp:
+        time.sleep(float(os.environ.get("STUB_SWAP_DELAY", "0.1")))
+        stamp = want
+        marks["swapped_at"] = time.time()
+        write("ready", stamp)
+    else:
+        write("ready", stamp)
+write("ready", stamp, done=True, reason="preempted")
+"""
+
+
+def _committed_model_dir(models, stamp):
+    d = os.path.join(str(models), f"LdaModel_EN_{stamp}")
+    os.makedirs(d, exist_ok=True)
+    with open(os.path.join(d, "COMMIT"), "w") as f:
+        f.write("x")
+
+
+def _stub_fleet(tmp_path, fleet, models, **kw):
+    stub = tmp_path / "serve_stub.py"
+    stub.write_text(SERVE_STUB)
+    os.makedirs(os.path.join(fleet, "control"), exist_ok=True)
+
+    def build(index, count, generation, spawn_id):
+        return [
+            sys.executable, str(stub), lease_path(fleet, index),
+            control_path(fleet, index), str(generation),
+            str(spawn_id), str(index),
+        ]
+
+    env = dict(os.environ)
+    env["STUB_MODELS"] = str(models)
+    env.update(kw.pop("stub_env", {}))
+    base = dict(
+        models_dir=str(models), lang="EN", workers=2,
+        lease_timeout=2.0, grace_seconds=1.0, sweep_interval=0.05,
+        startup_grace_seconds=10.0, swap_timeout=5.0, env=env,
+        max_seconds=kw.pop("max_seconds", 30.0),
+    )
+    base.update(kw)
+    return ServeFleetSupervisor(fleet, build, **base)
+
+
+def _wait(cond, timeout=15.0, interval=0.05, what="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        v = cond()
+        if v:
+            return v
+        time.sleep(interval)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def _lease(fleet, i):
+    from spark_text_clustering_tpu.resilience.supervisor import (
+        read_lease,
+    )
+
+    return read_lease(lease_path(fleet, i))
+
+
+class TestServeFleetSupervisorStub:
+    def _run_in_thread(self, sup):
+        out = {}
+
+        def run():
+            out["report"] = sup.run()
+
+        t = threading.Thread(target=run, daemon=True)
+        t.start()
+        return t, out
+
+    def test_staggered_bringup_and_clean_drain(self, tmp_path):
+        telemetry.configure(None)
+        fleet = str(tmp_path / "fleet")
+        models = tmp_path / "models"
+        _committed_model_dir(models, 1000)
+        sup = _stub_fleet(
+            tmp_path, fleet, models,
+            stub_env={"STUB_READY_DELAY": "0.3"},
+        )
+        t, out = self._run_in_thread(sup)
+        _wait(
+            lambda: (
+                (_lease(fleet, 0) or {}).get("state") == "ready"
+                and (_lease(fleet, 1) or {}).get("state") == "ready"
+            ),
+            what="both replicas ready",
+        )
+        l0, l1 = _lease(fleet, 0), _lease(fleet, 1)
+        # replica 1 spawned only after the canary reached READY — its
+        # warmup rides the cache replica 0 just populated
+        assert l1["spawned"] >= l0["ready_at"]
+        sup.request_stop()
+        t.join(20)
+        assert out["report"].converged
+        assert out["report"].spawns == 2
+        assert out["report"].respawns == 0
+        cur = FleetLedger(fleet).current()
+        assert cur["kind"] == "spawn" and cur["worker_count"] == 2
+
+    def test_rolling_swap_is_sequential_and_complete(self, tmp_path):
+        stream = tmp_path / "sup.jsonl"
+        telemetry.configure(str(stream))
+        telemetry.manifest(kind="supervise", role="serve")
+        fleet = str(tmp_path / "fleet")
+        models = tmp_path / "models"
+        _committed_model_dir(models, 1000)
+        sup = _stub_fleet(
+            tmp_path, fleet, models,
+            stub_env={"STUB_SWAP_DELAY": "0.2"},
+        )
+        t, out = self._run_in_thread(sup)
+        _wait(
+            lambda: (
+                (_lease(fleet, 0) or {}).get("state") == "ready"
+                and (_lease(fleet, 1) or {}).get("state") == "ready"
+            ),
+            what="fleet ready",
+        )
+        # a newer committed publish lands: the supervisor must roll it
+        # replica-by-replica through the control files
+        _committed_model_dir(models, 2000)
+        _wait(
+            lambda: (
+                (_lease(fleet, 0) or {}).get("model_stamp") == 2000
+                and (_lease(fleet, 1) or {}).get("model_stamp") == 2000
+            ),
+            what="both replicas swapped",
+        )
+        l0, l1 = _lease(fleet, 0), _lease(fleet, 1)
+        # strict roll order: replica 1's swap STARTED after replica
+        # 0's finished (one replica re-warming at a time)
+        assert l1["swapped_at"] >= l0["swapped_at"]
+        _wait(lambda: sup._roll is None, what="roll bookkeeping done")
+        sup.request_stop()
+        t.join(20)
+        assert out["report"].swap_rolls == 1
+        telemetry.shutdown()
+        from spark_text_clustering_tpu.telemetry.metrics_cli import (
+            fleet_health,
+            load_run,
+        )
+
+        _, events = load_run(str(stream))
+        names = [e.get("event") for e in events]
+        assert "fleet_swap_roll" in names
+        assert names.count("fleet_replica_swapped") == 2
+        assert "fleet_swap_roll_done" in names
+        swapped = [
+            e for e in events
+            if e.get("event") == "fleet_replica_swapped"
+        ]
+        assert [e["worker"] for e in swapped] == [0, 1]
+        fh = fleet_health(events)
+        assert fh["swap_rolls"] == 1 and fh["replica_swaps"] == 2
+        assert fh["swap_lag_seconds_max"] >= 0.0
+
+    def test_sigkill_respawns_and_retires_lease(self, tmp_path):
+        telemetry.configure(None)
+        fleet = str(tmp_path / "fleet")
+        models = tmp_path / "models"
+        _committed_model_dir(models, 1000)
+        sup = _stub_fleet(tmp_path, fleet, models)
+        t, out = self._run_in_thread(sup)
+        l0 = _wait(
+            lambda: (
+                ((_lease(fleet, 0) or {}).get("state") == "ready"
+                 and _lease(fleet, 0)) or None
+            ),
+            what="replica 0 ready",
+        )
+        os.kill(l0["pid"], signal.SIGKILL)
+        fresh = _wait(
+            lambda: (
+                (lambda l: l and l["spawn_id"] != l0["spawn_id"]
+                 and l)( _lease(fleet, 0))
+            ),
+            what="respawned replica lease",
+        )
+        assert fresh["pid"] != l0["pid"]
+        sup.request_stop()
+        t.join(20)
+        assert out["report"].respawns == 1
+        assert out["report"].crashes == 1
+        assert FleetLedger(fleet).current()["kind"] == "respawn"
+
+    def test_actions_file_scale_out_without_drain(self, tmp_path):
+        telemetry.configure(None)
+        fleet = str(tmp_path / "fleet")
+        models = tmp_path / "models"
+        _committed_model_dir(models, 1000)
+        actions = str(tmp_path / "actions.json")
+        sup = _stub_fleet(
+            tmp_path, fleet, models, actions_file=actions,
+            max_workers=3,
+        )
+        t, out = self._run_in_thread(sup)
+        _wait(
+            lambda: (
+                (_lease(fleet, 0) or {}).get("state") == "ready"
+                and (_lease(fleet, 1) or {}).get("state") == "ready"
+            ),
+            what="fleet ready",
+        )
+        pids = {i: _lease(fleet, i)["pid"] for i in (0, 1)}
+        # the monitor's serve_p99 alert writes a scale_out request
+        with open(actions, "w") as f:
+            json.dump(
+                {"schema": 1, "actions": [
+                    {"id": 1, "kind": "scale_out",
+                     "alert": "serve_p99"},
+                ]},
+                f,
+            )
+        _wait(
+            lambda: (_lease(fleet, 2) or {}).get("state") == "ready",
+            what="scaled-out replica 2",
+        )
+        # drain-free: the serving replicas were never bounced
+        assert {i: _lease(fleet, i)["pid"] for i in (0, 1)} == pids
+        cur = FleetLedger(fleet).current()
+        assert cur["kind"] == "resize" and cur["worker_count"] == 3
+        with open(actions + ".ack") as f:
+            assert json.load(f)["last_id"] == 1
+        sup.request_stop()
+        t.join(20)
+        assert out["report"].resizes == 1
+        snap = telemetry.get_registry().snapshot()
+        assert snap["counters"]["fleet.actions_applied"] == 1
+
+
+# ---------------------------------------------------------------------------
+# Alert wiring: the serve rules drive the fleet
+# ---------------------------------------------------------------------------
+class TestServeAlertActions:
+    def test_serve_rules_carry_fleet_actions(self):
+        from spark_text_clustering_tpu.telemetry.alerts import (
+            BUILTIN_RULES,
+            builtin_rules,
+        )
+
+        assert BUILTIN_RULES["serve_p99"]["action"] == {
+            "kind": "scale_out"
+        }
+        assert BUILTIN_RULES["serve_batch_fill"]["action"] == {
+            "kind": "scale_in"
+        }
+        assert BUILTIN_RULES["replica_down"]["kind"] == "absence"
+        assert BUILTIN_RULES["replica_down"]["signal"]["where"] == {
+            "role": "serve"
+        }
+        # all three instantiate through the normal rule factory
+        assert len(builtin_rules(
+            ["serve_p99", "serve_batch_fill", "replica_down"]
+        )) == 3
+
+
+# ---------------------------------------------------------------------------
+# replica_down absence rule
+# ---------------------------------------------------------------------------
+class TestReplicaDownRule:
+    def test_fires_on_lease_retirement_and_resolves_on_respawn(
+        self, tmp_path
+    ):
+        from spark_text_clustering_tpu.telemetry.alerts import (
+            AlertEngine,
+            builtin_rules,
+        )
+
+        class Clock:
+            def __init__(self):
+                self.t = 100.0
+
+            def __call__(self):
+                return self.t
+
+        clock = Clock()
+        fleet = str(tmp_path)
+        path = _write_lease(tmp_path, 0)
+        _write_lease(tmp_path, 1, role="stream")  # never matches
+        eng = AlertEngine(
+            builtin_rules(["replica_down"]),
+            fleet_dir=fleet,
+            now_fn=clock,
+        )
+        assert eng.poll(clock.t) == []
+        # the supervisor retires the dead replica's lease file
+        os.remove(path)
+        clock.t += 4.0
+        trans = eng.poll(clock.t)
+        assert [(t["rule"], t["key"], t["state"]) for t in trans] == [
+            ("replica_down", "0", "firing")
+        ]
+        # the respawned replica's fresh lease resolves it (condition
+        # must stay clean past resolve_seconds, so poll twice)
+        trans = []
+        for _ in range(3):
+            _write_lease(tmp_path, 0, ts=clock.t)
+            trans += eng.poll(clock.t)
+            clock.t += 1.0
+        assert [(t["rule"], t["state"]) for t in trans] == [
+            ("replica_down", "resolved")
+        ]
+
+
+# ---------------------------------------------------------------------------
+# summarize: serve-fleet-health section
+# ---------------------------------------------------------------------------
+class TestServeFleetHealth:
+    def test_section_from_front_stream(self):
+        from spark_text_clustering_tpu.telemetry.metrics_cli import (
+            serve_fleet_health,
+        )
+
+        metrics = {
+            "counter.front.requests": 90.0,
+            "counter.front.retries": 2.0,
+            "counter.front.repins": 1.0,
+            "counter.front.replica.0.requests": 60.0,
+            "counter.front.replica.1.requests": 30.0,
+            "counter.front.replica.1.retries": 2.0,
+            "hist.front.request_seconds.p50": 0.01,
+            "hist.front.request_seconds.p99": 0.05,
+            "hist.front.replica.0.request_seconds.p99": 0.04,
+            "hist.front.replica.1.request_seconds.p99": 0.06,
+        }
+        events = [
+            {"event": "front_swap_observed", "ts": 10.0, "replica": 0,
+             "to_stamp": 2000},
+            {"event": "front_swap_observed", "ts": 10.4, "replica": 1,
+             "to_stamp": 2000},
+        ]
+        sfh = serve_fleet_health(events, metrics)
+        assert sfh["requests"] == 90 and sfh["retries"] == 2
+        assert sfh["repins"] == 1 and sfh["no_replica"] == 0
+        assert [r["replica"] for r in sfh["replicas"]] == [0, 1]
+        assert sfh["replicas"][0]["share"] == round(60 / 90, 4)
+        assert abs(sfh["p99_spread_seconds"] - 0.02) < 1e-9
+        (sw,) = sfh["swaps_observed"]
+        assert sw["replicas"] == 2
+        assert abs(sw["swap_lag_seconds"] - 0.4) < 1e-9
+
+    def test_absent_for_non_front_runs(self):
+        from spark_text_clustering_tpu.telemetry.metrics_cli import (
+            serve_fleet_health,
+        )
+
+        assert serve_fleet_health(
+            [{"event": "micro_batch"}],
+            {"counter.serve.requests": 3.0},
+        ) is None
+
+
+# ---------------------------------------------------------------------------
+# Real-subprocess chaos drill: publish + SIGKILL under traffic
+# ---------------------------------------------------------------------------
+def _post(conn, body, stream):
+    conn.request(
+        "POST", "/score", body=body,
+        headers={"Content-Type": "application/json",
+                 STREAM_HEADER: stream},
+    )
+    resp = conn.getresponse()
+    payload = json.loads(resp.read())
+    return resp.status, resp.headers, payload
+
+
+class TestServeFleetDrill:
+    def test_zero_failed_requests_across_publish_and_kill(
+        self, tmp_path
+    ):
+        """Real `stc supervise --role serve` fleet (2 replicas, front
+        embedded, dispatch emulated so the drill measures the FLEET
+        path, not the sandbox's single core): concurrent client
+        streams keep scoring while (a) a newer model publishes and
+        rolls through the fleet and (b) one replica is SIGKILLed.
+        Zero failed requests; every stream's observed generation
+        sequence is monotone (never interleaved)."""
+        from spark_text_clustering_tpu.models.base import LDAModel
+
+        rng = np.random.default_rng(0)
+        k, v = 2, 64
+        model = LDAModel(
+            lam=rng.random((k, v)).astype(np.float32) + 0.1,
+            vocab=[f"h{i}" for i in range(v)],
+            alpha=np.full(k, 0.5, np.float32), eta=0.1,
+        )
+        models = str(tmp_path / "models")
+        model.save(os.path.join(models, "LdaModel_EN_1000"))
+        fleet = str(tmp_path / "fleet")
+        env = dict(os.environ)
+        env.pop(faultinject.ENV_SPEC, None)
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        log = open(tmp_path / "sup.log", "w")
+        sup = subprocess.Popen(
+            [sys.executable, "-m", "spark_text_clustering_tpu.cli",
+             "supervise", "--role", "serve",
+             "--fleet-dir", fleet, "--workers", "2",
+             "--front-port", "0", "--models-dir", models,
+             "--no-lemmatize", "--heartbeat-interval", "0.2",
+             "--lease-timeout", "8", "--grace-seconds", "4",
+             "--sweep-interval", "0.1", "--swap-timeout", "30",
+             "--serve-emulate-doc-ms", "4", "--max-seconds", "120",
+             "--serve-linger-ms", "1"],
+            cwd=REPO, env=env, stdout=log, stderr=subprocess.STDOUT,
+        )
+        try:
+            port = _wait(
+                lambda: self._front_port(fleet), timeout=60,
+                what="front announce",
+            )
+            _wait(
+                lambda: self._ready(port) == 2, timeout=90,
+                what="2 ready replicas",
+            )
+            stop = threading.Event()
+            per_stream = {}
+            failures = []
+            lock = threading.Lock()
+
+            def client(ci):
+                conn = http.client.HTTPConnection(
+                    "127.0.0.1", port, timeout=60
+                )
+                body = json.dumps({"texts": [f"h{ci} h2 h3"]}).encode()
+                stamps = []
+                while not stop.is_set():
+                    try:
+                        status, headers, payload = _post(
+                            conn, body, f"drill-{ci}"
+                        )
+                        ok = status == 200 and "topic" in (
+                            payload["results"][0]
+                        )
+                    except (OSError, http.client.HTTPException,
+                            ValueError, KeyError) as exc:
+                        with lock:
+                            failures.append(repr(exc))
+                        conn.close()
+                        conn = http.client.HTTPConnection(
+                            "127.0.0.1", port, timeout=60
+                        )
+                        continue
+                    if not ok:
+                        with lock:
+                            failures.append(f"status={status}")
+                        continue
+                    g = headers.get(GENERATION_HEADER)
+                    if g is not None:
+                        stamps.append(int(g))
+                    time.sleep(0.02)
+                conn.close()
+                with lock:
+                    per_stream[ci] = stamps
+
+            threads = [
+                threading.Thread(target=client, args=(ci,))
+                for ci in range(4)
+            ]
+            for t in threads:
+                t.start()
+            time.sleep(1.0)
+            # (a) rolling publish under traffic
+            src = os.path.join(models, "LdaModel_EN_1000")
+            dst = os.path.join(models, "LdaModel_EN_2000")
+            self._republish(src, dst)
+            _wait(
+                lambda: self._stamps(fleet) == {2000}, timeout=60,
+                what="rolling swap to 2000",
+            )
+            # (b) SIGKILL one replica under traffic
+            from spark_text_clustering_tpu.resilience.supervisor \
+                import read_lease
+
+            victim = read_lease(lease_path(fleet, 0))
+            os.kill(victim["pid"], signal.SIGKILL)
+            _wait(
+                lambda: (
+                    (lambda l: l and l["spawn_id"] !=
+                     victim["spawn_id"])(read_lease(
+                         lease_path(fleet, 0)))
+                ),
+                timeout=60, what="replica 0 respawn",
+            )
+            _wait(
+                lambda: self._ready(port) == 2, timeout=60,
+                what="fleet back to 2 ready",
+            )
+            time.sleep(0.5)
+            stop.set()
+            for t in threads:
+                t.join(30)
+            assert failures == [], (
+                f"{len(failures)} failed request(s): {failures[:5]}"
+            )
+            total = sum(len(s) for s in per_stream.values())
+            assert total >= 40, f"only {total} requests completed"
+            # one generation per client stream at any moment: the
+            # observed stamp sequence never goes backward
+            for ci, stamps in per_stream.items():
+                assert stamps == sorted(stamps), (
+                    f"stream {ci} saw interleaved generations: "
+                    f"{stamps}"
+                )
+            assert any(
+                2000 in s for s in per_stream.values()
+            ), "no stream ever reached the new generation"
+        finally:
+            if sup.poll() is None:
+                sup.send_signal(signal.SIGTERM)
+            rc = sup.wait(timeout=120)
+            log.close()
+        assert rc == 0, open(tmp_path / "sup.log").read()[-2000:]
+
+    @staticmethod
+    def _front_port(fleet):
+        try:
+            with open(os.path.join(fleet, "front.json")) as f:
+                return json.load(f)["port"]
+        except (OSError, json.JSONDecodeError, KeyError):
+            return None
+
+    @staticmethod
+    def _ready(port):
+        try:
+            conn = http.client.HTTPConnection(
+                "127.0.0.1", port, timeout=10
+            )
+            conn.request("GET", "/healthz")
+            doc = json.loads(conn.getresponse().read())
+            conn.close()
+            return doc["ready"]
+        except (OSError, http.client.HTTPException, ValueError):
+            return -1
+
+    @staticmethod
+    def _stamps(fleet):
+        return {
+            r.stamp for r in read_replicas(fleet) if r.ready
+        }
+
+    @staticmethod
+    def _republish(src, dst):
+        """A newer committed artifact: byte-copy of the old one under
+        a fresh stamp (saved via the artifact discipline's files —
+        copying the sealed dir preserves manifest + COMMIT)."""
+        import shutil
+
+        shutil.copytree(src, dst)
