@@ -1584,6 +1584,64 @@ def _bench_scale():
     }
 
 
+def _bench_slo_overhead():
+    """Cost of one SLO engine pass (jax-free, host-side): evaluate_all
+    of the builtin objectives over a 10k-event buffer — the monitor
+    runs this every poll tick, so it must stay in low single-digit
+    milliseconds — plus the M/M/c predictor the queueing observatory
+    computes per estimate."""
+    from spark_text_clustering_tpu.telemetry.queueing import (
+        predicted_waits,
+    )
+    from spark_text_clustering_tpu.telemetry.slo import (
+        builtin_config,
+        evaluate_all,
+    )
+
+    cfg = builtin_config()
+    rng = np.random.default_rng(0)
+    n_events = 10_000
+    now = 1_000_000.0
+    lat = rng.exponential(0.05, n_events)
+    events = [
+        (
+            now - float(rng.uniform(0.0, cfg.max_window_seconds())),
+            {
+                "event": (
+                    "front_request" if i % 2 else "probe_request"
+                ),
+                "outcome": "ok" if i % 17 else "error",
+                "seconds": float(lat[i]),
+            },
+        )
+        for i in range(n_events)
+    ]
+    evaluate_all(cfg, events, now=now)  # warm
+    reps = 5
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        evaluate_all(cfg, events, now=now)
+    eval_s = (time.perf_counter() - t0) / reps
+
+    t0 = time.perf_counter()
+    for _ in range(1000):
+        predicted_waits(4, 30.0, 0.1)
+    erlang_us = (time.perf_counter() - t0) / 1000 * 1e6
+    rec = {
+        "events": n_events,
+        "objectives": len(cfg.objectives),
+        "evaluate_all_ms": round(eval_s * 1e3, 3),
+        "events_per_sec": round(n_events / max(eval_s, 1e-9), 0),
+        "erlang_c_predict_us": round(erlang_us, 2),
+    }
+    sys.stderr.write(
+        f"# slo_overhead: evaluate_all({n_events} events x "
+        f"{len(cfg.objectives)} objectives) = {eval_s * 1e3:.2f} ms, "
+        f"erlang predict {erlang_us:.1f} us\n"
+    )
+    return rec
+
+
 def _compile_signature_fields() -> dict:
     """Distinct compiled signatures per dispatch label (the recompile
     sentinel's view of this bench run) — a retrace regression shows up
@@ -1669,6 +1727,11 @@ def child_main() -> None:
         scale_rec = _bench_scale()
     except Exception as exc:
         sys.stderr.write(f"# scale bench skipped: {exc!r}\n")
+    slo_rec = None
+    try:
+        slo_rec = _bench_slo_overhead()
+    except Exception as exc:
+        sys.stderr.write(f"# slo_overhead bench skipped: {exc!r}\n")
     online_rec = {
         "corpus": "20ng-shaped-synthetic",
         "n_docs": ONLINE_N_DOCS,
@@ -1728,6 +1791,7 @@ def child_main() -> None:
                 "serve_fleet": serve_fleet_rec,
                 "cold_start": cold_start_rec,
                 "scale": scale_rec,
+                "slo_overhead": slo_rec,
                 "peak_memory": _peak_memory_fields(),
                 "compile_signatures": _compile_signature_fields(),
             }

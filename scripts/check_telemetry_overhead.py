@@ -86,12 +86,14 @@ def main() -> int:
         + len(snap["gauges"])
     )
 
-    # disabled per-call primitive cost (span + count + observe + a
-    # dispatch-instrumented call + the tracing layer's two disabled-mode
-    # touchpoints per loop — each must collapse to one global check:
-    # tracing.fields() is the per-micro-batch stamp with no context
-    # installed, emit_span the per-request span that must cost nothing
-    # with telemetry off)
+    # disabled per-call primitive cost (span + count + observe + event
+    # + a dispatch-instrumented call + the tracing layer's two
+    # disabled-mode touchpoints per loop — each must collapse to one
+    # global check: tracing.fields() is the per-micro-batch stamp with
+    # no context installed, emit_span the per-request span that must
+    # cost nothing with telemetry off.  event() is here because the SLO
+    # engine's typed request events ride it on every front/probe
+    # request)
     assert not telemetry.enabled()
     from spark_text_clustering_tpu.telemetry import tracing
 
@@ -105,13 +107,14 @@ def main() -> int:
             pass
         telemetry.count("overhead.probe")
         telemetry.observe("overhead.probe", 0.0)
+        telemetry.event("overhead.probe", outcome="ok", seconds=0.0)
         wrapped_noop()
         tracing.fields()
         tracing.emit_span(
             "overhead.probe", trace_id="0", span_id="0",
             start=0.0, seconds=0.0,
         )
-    per_call = (time.perf_counter() - t0) / (6 * PRIMITIVE_LOOP)
+    per_call = (time.perf_counter() - t0) / (7 * PRIMITIVE_LOOP)
 
     overhead_s = calls * per_call
     ratio = overhead_s / max(fit_s, 1e-9)

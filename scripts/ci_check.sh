@@ -137,9 +137,26 @@
 #      zero retraces (the gate-13 contract extended to the fleet
 #      path); the front's exact request counter and the fleet respawn
 #      counter gate against the committed baseline
+#  18. SLO/probe drill (telemetry.slo + serving.probe,
+#      docs/OBSERVABILITY.md "SLOs & error budgets"): a 2-replica
+#      serve fleet with replica 0 planted slow (STC_FAULTS
+#      serve.batch:slow@0.35 — alive, answering, over the 0.32768s
+#      latency objective) takes 18 exact black-box probes through the
+#      front; `stc monitor --once --builtin budget_burn` over the
+#      probe stream at window compression 400 must fire BOTH the fast
+#      (14.4x) and slow (6x) probe_latency burn pairs and nothing
+#      else, and `stc metrics slo --fail-on-burn` must exit 1 with the
+#      budget exhausted; the same drill on a clean fleet must exit 0
+#      from both verbs with a full error budget and zero probe
+#      failures; the live front /metrics must expose the queueing
+#      observatory (stc_queueing_lambda) and cumulative Prometheus
+#      _bucket series, the supervisor stream must carry
+#      queueing.lambda/rho; the probe stream's exact request counter
+#      and the monitor run's slo.evaluations gate against the
+#      committed baseline
 #
 # Usage:
-#   scripts/ci_check.sh                 # run all seventeen gates
+#   scripts/ci_check.sh                 # run all eighteen gates
 #   scripts/ci_check.sh --rebaseline    # recapture ALL baselines
 #                                       # (metrics + lint waivers +
 #                                       # lint counters + scale record
@@ -1332,6 +1349,129 @@ print(
 EOF
 }
 
+run_slo_probe_drill() {
+    # gate 18: the SLO/probe drill on the gate-5 model.  18 exact
+    # probes at 3/s make counter.probe.requests machine-independent;
+    # the least-outstanding front alternates two idle replicas, so the
+    # degraded half routes exactly half the probes onto the planted
+    # slow path (0.35s > the 0.32768s latency objective) — burn 50x at
+    # target 0.99, over BOTH SRE factors.
+    local workdir="$1" half="$2"
+    rm -rf "$workdir/slo_fleet_$half" "$workdir/slo_wtel_$half"
+    python - "$workdir" "$half" <<'EOF'
+import http.client
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+workdir = sys.argv[1]
+half = sys.argv[2]
+models = os.path.join(workdir, "models")
+fleet = os.path.join(workdir, f"slo_fleet_{half}")
+log_path = os.path.join(workdir, f"slo_fleet_{half}.log")
+argv = [
+    sys.executable, "-m", "spark_text_clustering_tpu.cli",
+    "supervise", "--role", "serve",
+    "--fleet-dir", fleet, "--workers", "2", "--front-port", "0",
+    "--models-dir", models, "--no-lemmatize",
+    "--heartbeat-interval", "0.2", "--lease-timeout", "12",
+    "--grace-seconds", "6", "--sweep-interval", "0.1",
+    "--startup-grace", "240", "--swap-timeout", "120",
+    "--serve-max-batch", "8", "--serve-linger-ms", "2",
+    "--max-seconds", "600",
+    "--telemetry-file",
+    os.path.join(workdir, f"fleet_slo_{half}.jsonl"),
+    "--worker-telemetry-dir",
+    os.path.join(workdir, f"slo_wtel_{half}"),
+]
+if half == "degraded":
+    argv += ["--chaos-worker", "0:serve.batch:slow@0.35"]
+proc = subprocess.Popen(
+    argv, env=dict(os.environ), stdout=open(log_path, "w"),
+    stderr=subprocess.STDOUT,
+)
+
+
+def fail(msg):
+    proc.send_signal(signal.SIGKILL)
+    sys.exit(f"slo drill ({half}): {msg}")
+
+
+deadline = time.time() + 420
+port = None
+while time.time() < deadline and port is None:
+    if proc.poll() is not None:
+        sys.exit(f"supervisor died at startup (rc={proc.returncode})")
+    try:
+        with open(os.path.join(fleet, "front.json")) as f:
+            port = json.load(f)["port"]
+    except (OSError, json.JSONDecodeError, KeyError):
+        time.sleep(0.3)
+if port is None:
+    fail("front never announced")
+
+while time.time() < deadline:
+    try:
+        c = http.client.HTTPConnection("127.0.0.1", port, timeout=15)
+        c.request("GET", "/healthz")
+        doc = json.loads(c.getresponse().read())
+        c.close()
+        if doc["ready"] == 2:
+            break
+    except (OSError, http.client.HTTPException, ValueError):
+        pass
+    time.sleep(0.5)
+else:
+    fail("fleet never reached 2 ready replicas")
+
+# 18 exact black-box probes through the front; --fail-on-error makes
+# a single failed or generation-regressed probe kill the gate
+rc = subprocess.call(
+    [sys.executable, "-m", "spark_text_clustering_tpu.cli", "probe",
+     "--fleet-dir", fleet, "--count", "18", "--rate", "3",
+     "--timeout", "5", "--fail-on-error", "--telemetry-file",
+     os.path.join(workdir, f"probe_{half}.jsonl")],
+    env=dict(os.environ),
+)
+if rc != 0:
+    fail(f"probe exited {rc}")
+
+# the live front must expose the queueing observatory and cumulative
+# Prometheus buckets (the Grafana-facing contract)
+c = http.client.HTTPConnection("127.0.0.1", port, timeout=15)
+c.request("GET", "/metrics?format=prometheus&buckets=1")
+body = c.getresponse().read().decode()
+c.close()
+if "stc_queueing_lambda" not in body:
+    fail("no stc_queueing_lambda gauge on the live front /metrics")
+if "_bucket{" not in body:
+    fail("no cumulative _bucket samples on the live front /metrics")
+
+proc.send_signal(signal.SIGTERM)
+if proc.wait(timeout=180) != 0:
+    fail("fleet drain did not exit 0")
+
+# supervisor-side evidence: the lambda/S/rho triple made it into the
+# manifested run stream (the post-hoc `metrics slo` / dashboard view)
+from spark_text_clustering_tpu.telemetry.metrics_cli import (
+    load_run, run_metrics,
+)
+
+_, fev = load_run(os.path.join(workdir, f"fleet_slo_{half}.jsonl"))
+fm = run_metrics(fev)
+assert fm.get("gauge.queueing.lambda", 0) > 0, \
+    "no queueing.lambda in the supervisor stream"
+assert "gauge.queueing.rho" in fm, sorted(fm)
+assert any(e.get("event") == "queueing_estimate" for e in fev), \
+    "no queueing_estimate events in the supervisor stream"
+print(f"slo drill ({half}): 18/18 probes OK, front exposes "
+      f"queueing gauges + cumulative buckets")
+EOF
+}
+
 if [[ "${1:-}" == "--rebaseline" ]]; then
     # --scale: regenerate the waiver allowlist AND the committed scale
     # evidence record (scripts/records/scale_baseline.json) together —
@@ -1415,6 +1555,22 @@ if [[ "${1:-}" == "--rebaseline" ]]; then
         --write-baseline --tolerance 0.0 \
         --include counter.front.requests \
         --include counter.fleet.respawns || exit 1
+    # fold the SLO/probe drill's deterministic counters (18 exact
+    # probes; one SLO evaluation pass per monitor --once run)
+    run_slo_probe_drill "$work" degraded || exit 1
+    python -m spark_text_clustering_tpu.cli monitor --once \
+        --stream "$work/probe_degraded.jsonl" --builtin budget_burn \
+        --slo-compression 400 --quiet \
+        --telemetry-file "$work/monitor_slo_degraded.jsonl" \
+        >/dev/null || exit 1
+    python -m spark_text_clustering_tpu.cli metrics check \
+        "$work/probe_degraded.jsonl" --baseline "$BASELINE" \
+        --write-baseline --tolerance 0.0 --include counter.probe. \
+        || exit 1
+    python -m spark_text_clustering_tpu.cli metrics check \
+        "$work/monitor_slo_degraded.jsonl" --baseline "$BASELINE" \
+        --write-baseline --tolerance 0.0 --include counter.slo. \
+        || exit 1
     # recapture the recompile sentinel's expected-signature table from
     # the same train run plus a score run and an NMF fit+transform run
     # (gate 9's fixture triple)
@@ -1430,12 +1586,12 @@ fail=0
 work=$(mktemp -d)
 trap 'rm -rf "$work"' EXIT
 
-echo "== [1/17] stc lint (AST rules + jaxpr audit) =="
+echo "== [1/18] stc lint (AST rules + jaxpr audit) =="
 python -m spark_text_clustering_tpu.cli lint \
     --telemetry-file "$work/lint.jsonl"
 if [[ $? -ne 0 ]]; then echo "FAIL: stc lint"; fail=1; fi
 
-echo "== [2/17] ruff (generic-Python tier) =="
+echo "== [2/18] ruff (generic-Python tier) =="
 if command -v ruff >/dev/null 2>&1; then
     ruff check spark_text_clustering_tpu
     if [[ $? -ne 0 ]]; then echo "FAIL: ruff"; fail=1; fi
@@ -1443,17 +1599,17 @@ else
     echo "ruff not installed — skipped (stc lint STC101/102/006 cover it)"
 fi
 
-echo "== [3/17] tier-1 tests =="
+echo "== [3/18] tier-1 tests =="
 timeout -k 10 870 python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors -p no:cacheprovider \
     -p no:xdist -p no:randomly
 if [[ $? -ne 0 ]]; then echo "FAIL: tier-1"; fail=1; fi
 
-echo "== [4/17] telemetry overhead budget =="
+echo "== [4/18] telemetry overhead budget =="
 python scripts/check_telemetry_overhead.py
 if [[ $? -ne 0 ]]; then echo "FAIL: telemetry overhead"; fail=1; fi
 
-echo "== [5/17] metrics regression gate =="
+echo "== [5/18] metrics regression gate =="
 if run_ci_train "$work"; then
     # lint., ledger., fleet., serve., and alert. families are captured
     # by their own gates (1/6, 8, 10, 11, and 12) — a batch train run
@@ -1470,7 +1626,7 @@ else
     fail=1
 fi
 
-echo "== [6/17] lint metrics gate (waiver count version-gated) =="
+echo "== [6/18] lint metrics gate (waiver count version-gated) =="
 if [[ -s "$work/lint.jsonl" ]]; then
     # lint.scale_* belong to the gate-15 --scale stream, not stage 1's
     python -m spark_text_clustering_tpu.cli metrics check "$work/lint.jsonl" \
@@ -1481,7 +1637,7 @@ else
     fail=1
 fi
 
-echo "== [7/17] cross-host skew gate (metrics merge) =="
+echo "== [7/18] cross-host skew gate (metrics merge) =="
 if make_skew_streams "$work"; then
     python -m spark_text_clustering_tpu.cli metrics merge \
         "$work/skew-p0.jsonl" "$work/skew-p1.jsonl" --fail-on-skew \
@@ -1502,7 +1658,7 @@ else
     fail=1
 fi
 
-echo "== [8/17] exactly-once ledger chaos drill (STC_FAULTS) =="
+echo "== [8/18] exactly-once ledger chaos drill (STC_FAULTS) =="
 if run_ledger_drill "$work"; then
     python -m spark_text_clustering_tpu.cli metrics check \
         "$work/ledger_drill.jsonl" --baseline "$BASELINE" \
@@ -1513,7 +1669,7 @@ else
     fail=1
 fi
 
-echo "== [9/17] recompile sentinel (metrics compile-check) =="
+echo "== [9/18] recompile sentinel (metrics compile-check) =="
 if [[ -s "$work/run.jsonl" ]] && run_ci_score "$work" \
     && run_ci_nmf "$work"; then
     python -m spark_text_clustering_tpu.cli metrics compile-check \
@@ -1540,7 +1696,7 @@ else
     fail=1
 fi
 
-echo "== [10/17] supervisor drill (lease expiry -> SIGKILL -> respawn) =="
+echo "== [10/18] supervisor drill (lease expiry -> SIGKILL -> respawn) =="
 if run_supervisor_drill "$work"; then
     # the ladder's counters are deterministic: 3 spawns (2 + 1
     # respawn), 1 lease expiry, 1 preemption (the drain SIGTERM the
@@ -1554,7 +1710,7 @@ else
     fail=1
 fi
 
-echo "== [11/17] serve drill (hot-swap + drain + zero-recompile) =="
+echo "== [11/18] serve drill (hot-swap + drain + zero-recompile) =="
 if [[ -d "$work/models" ]] && run_serve_drill "$work"; then
     # requests (32 = two exact 16-doc volleys) and swaps (1) are
     # machine-independent; batch counts depend on coalescing timing
@@ -1568,7 +1724,7 @@ else
     fail=1
 fi
 
-echo "== [12/17] monitor drill (alerts fire/resolve + resize-on-alert) =="
+echo "== [12/18] monitor drill (alerts fire/resolve + resize-on-alert) =="
 if run_monitor_once_drill "$work"; then
     # the --once storm run's alert counters are deterministic: exactly
     # one firing (retrace_storm), nothing pending/resolved
@@ -1589,7 +1745,7 @@ if ! run_monitor_resize_drill "$work"; then
     fail=1
 fi
 
-echo "== [13/17] executable-cache cold-start drill (compilecache) =="
+echo "== [13/18] executable-cache cold-start drill (compilecache) =="
 if [[ -d "$work/models" ]] && run_cold_start_drill "$work"; then
     # the warm B run's cache counters are deterministic: one hit per
     # score-path digest, zero misses/stores/invalidations
@@ -1602,7 +1758,7 @@ else
     fail=1
 fi
 
-echo "== [14/17] end-to-end lineage drill (causal tracing) =="
+echo "== [14/18] end-to-end lineage drill (causal tracing) =="
 if run_lineage_drill "$work"; then
     # the serve run's trace counters are deterministic: ONE sampled
     # request, four emitted spans, nothing dropped
@@ -1615,7 +1771,7 @@ else
     fail=1
 fi
 
-echo "== [15/17] scale audit (stc lint --scale, STC210-215) =="
+echo "== [15/18] scale audit (stc lint --scale, STC210-215) =="
 python -m spark_text_clustering_tpu.cli lint --scale \
     --telemetry-file "$work/lint_scale.jsonl" >/dev/null
 if [[ $? -ne 0 ]]; then
@@ -1687,7 +1843,7 @@ if [[ $? -ne 0 ]]; then
     fail=1
 fi
 
-echo "== [16/17] measured-scale observatory (probe + scale-check) =="
+echo "== [16/18] measured-scale observatory (probe + scale-check) =="
 # run the sharded entry families for REAL on the forced 2x4 host mesh
 # and reconcile the measured evidence against the gate-15 static
 # record: sharding match, tolerance, zero retraces, V=10M
@@ -1743,7 +1899,7 @@ if [[ $? -ne 1 ]]; then
     fail=1
 fi
 
-echo "== [17/17] serve-fleet chaos drill (rolling publish + SIGKILL) =="
+echo "== [17/18] serve-fleet chaos drill (rolling publish + SIGKILL) =="
 if [[ -d "$work/models" ]] && run_serve_fleet_drill "$work"; then
     # the front's routed-request counter (48 = three exact 16-doc
     # volleys) and the fleet respawn counter (1 — consistent with the
@@ -1758,6 +1914,106 @@ else
     echo "FAIL: serve-fleet chaos drill"
     fail=1
 fi
+
+echo "== [18/18] SLO/probe drill (burn-rate gate + queueing observatory) =="
+slo_ok=1
+if [[ -d "$work/models" ]] && run_slo_probe_drill "$work" degraded; then
+    # the planted slow replica (0.35s > the 0.32768s objective line)
+    # burns the probe latency budget: at compression 400 the fast
+    # (14.4x) AND slow (6x) pairs must fire — exit 1 under
+    # --fail-on-alert — and nothing else may
+    python -m spark_text_clustering_tpu.cli monitor --once \
+        --stream "$work/probe_degraded.jsonl" --builtin budget_burn \
+        --slo-compression 400 --fail-on-alert --quiet \
+        --alerts-file "$work/slo_alerts_degraded.jsonl" \
+        --telemetry-file "$work/monitor_slo_degraded.jsonl"
+    if [[ $? -ne 1 ]]; then
+        echo "FAIL: planted slow replica did not fire the burn-rate alert"
+        slo_ok=0
+    fi
+    python - "$work" <<'EOF'
+import json, sys
+
+work = sys.argv[1]
+keys = set()
+with open(f"{work}/slo_alerts_degraded.jsonl") as f:
+    for ln in f:
+        rec = json.loads(ln)
+        if rec.get("state") == "firing":
+            keys.add((rec["rule"], rec["key"]))
+assert keys == {("budget_burn", "probe_latency:fast"),
+                ("budget_burn", "probe_latency:slow")}, keys
+print("slo drill (degraded): fast+slow burn pairs fired, nothing else")
+EOF
+    [[ $? -ne 0 ]] && slo_ok=0
+    python -m spark_text_clustering_tpu.cli metrics slo \
+        "$work/probe_degraded.jsonl" --compression 400 --fail-on-burn \
+        >/dev/null
+    if [[ $? -ne 1 ]]; then
+        echo "FAIL: metrics slo --fail-on-burn did not exit 1 on the burn"
+        slo_ok=0
+    fi
+else
+    echo "FAIL: degraded SLO/probe drill"
+    slo_ok=0
+fi
+if [[ -d "$work/models" ]] && run_slo_probe_drill "$work" clean; then
+    # the clean half: zero probe failures (--fail-on-error inside the
+    # drill), no burn from either verb, full error budget
+    python -m spark_text_clustering_tpu.cli monitor --once \
+        --stream "$work/probe_clean.jsonl" --builtin budget_burn \
+        --slo-compression 400 --fail-on-alert --quiet \
+        --alerts-file "$work/slo_alerts_clean.jsonl" \
+        --telemetry-file "$work/monitor_slo_clean.jsonl"
+    if [[ $? -ne 0 ]]; then
+        echo "FAIL: clean fleet fired a burn-rate alert"
+        slo_ok=0
+    fi
+    python -m spark_text_clustering_tpu.cli metrics slo \
+        "$work/probe_clean.jsonl" --compression 400 --fail-on-burn \
+        --json > "$work/slo_clean.json"
+    if [[ $? -ne 0 ]]; then
+        echo "FAIL: metrics slo on the clean half did not exit 0"
+        slo_ok=0
+    fi
+    python - "$work" <<'EOF'
+import json, sys
+
+work = sys.argv[1]
+doc = json.load(open(f"{work}/slo_clean.json"))
+seen = 0
+for name, res in doc["objectives"].items():
+    if res["status"] == "no_data":
+        continue                 # front_* objectives: not this stream
+    assert res["status"] == "ok" and res["budget_remaining"] == 1.0, \
+        (name, res)
+    seen += 1
+assert seen >= 2, doc["objectives"].keys()
+print("slo drill (clean): full error budget on every probe objective")
+EOF
+    [[ $? -ne 0 ]] && slo_ok=0
+else
+    echo "FAIL: clean SLO/probe drill"
+    slo_ok=0
+fi
+if [[ $slo_ok -eq 1 ]]; then
+    # probe.requests (18 exact probes per half) and slo.evaluations
+    # (one pass per --once run) are machine-independent;
+    # probe.failures / probe.pin_violations stay zero-absent
+    for s in probe_degraded probe_clean; do
+        python -m spark_text_clustering_tpu.cli metrics check \
+            "$work/$s.jsonl" --baseline "$BASELINE" \
+            --include counter.probe.
+        if [[ $? -ne 0 ]]; then echo "FAIL: $s counters"; slo_ok=0; fi
+    done
+    for s in monitor_slo_degraded monitor_slo_clean; do
+        python -m spark_text_clustering_tpu.cli metrics check \
+            "$work/$s.jsonl" --baseline "$BASELINE" \
+            --include counter.slo.
+        if [[ $? -ne 0 ]]; then echo "FAIL: $s counters"; slo_ok=0; fi
+    done
+fi
+[[ $slo_ok -ne 1 ]] && fail=1
 
 if [[ $fail -ne 0 ]]; then
     echo "ci_check: FAILED"

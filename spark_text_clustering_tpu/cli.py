@@ -660,6 +660,7 @@ def cmd_front(args: argparse.Namespace) -> int:
         args.fleet_dir,
         lease_timeout=args.lease_timeout,
         wait_for_replica_s=args.wait_for_replica,
+        alerts_file=getattr(args, "alerts_file", None),
     )
     httpd = make_front_server(router, args.host, args.port)
     host, port = httpd.server_address[:2]
@@ -688,6 +689,63 @@ def cmd_front(args: argparse.Namespace) -> int:
     )
     if own_telemetry:
         telemetry.shutdown()
+    return 0
+
+
+def cmd_probe(args: argparse.Namespace) -> int:
+    """Black-box synthetic canary (docs/OBSERVABILITY.md "SLOs & error
+    budgets"): score one fixed sentinel document through the serve
+    front at a low fixed rate and record what a CLIENT experienced —
+    outcome, latency, and generation-pinning monotonicity — into the
+    probe's own manifested run stream.  jax-free by construction."""
+    from .serving.probe import (
+        SENTINEL_TEXT,
+        Prober,
+        read_front_announce,
+    )
+
+    if not args.url and not args.fleet_dir:
+        print("probe needs --fleet-dir or --url", file=sys.stderr)
+        return 2
+    own_telemetry = bool(getattr(args, "telemetry_file", None))
+    telemetry.configure(args.telemetry_file if own_telemetry else None)
+    try:
+        if args.url:
+            part = args.url.split("//")[-1].rstrip("/")
+            host, _, port_s = part.partition(":")
+            host, port = host or "127.0.0.1", int(port_s or 80)
+        else:
+            host, port = read_front_announce(
+                args.fleet_dir, wait_s=args.wait_front
+            )
+    except (RuntimeError, ValueError) as exc:
+        print(f"error: {exc}", file=sys.stderr)
+        if own_telemetry:
+            telemetry.shutdown()
+        return 2
+    if own_telemetry:
+        telemetry.manifest(
+            kind="probe", host=host, port=port,
+            fleet_dir=args.fleet_dir, stream=args.stream,
+            count=args.count, rate=args.rate,
+        )
+    prober = Prober(
+        host, port,
+        stream=args.stream,
+        timeout=args.timeout,
+        text=args.text or SENTINEL_TEXT,
+    )
+    rep = prober.run(count=args.count, rate=args.rate)
+    print(
+        f"probe done: {rep['sent']} probe(s) against "
+        f"http://{host}:{port}, {rep['failures']} failure(s), "
+        f"{rep['pin_violations']} pin violation(s)"
+    )
+    if own_telemetry:
+        telemetry.shutdown()
+    bad = rep["failures"] + rep["pin_violations"]
+    if args.fail_on_error and bad:
+        return 1
     return 0
 
 
@@ -1280,6 +1338,14 @@ def _supervise_serve(args: argparse.Namespace, own_telemetry: bool) -> int:
         argv += args.worker_arg or []
         return argv
 
+    worker_faults = {}
+    for spec in args.chaos_worker or []:
+        idx_s, _, fault = spec.partition(":")
+        if not fault:
+            print(f"bad --chaos-worker {spec!r} "
+                  f"(want <index>:<site>:<kind>[@arg])", file=sys.stderr)
+            return 2
+        worker_faults[int(idx_s)] = fault
     preempt = PreemptionNotice().install()
     sup = ServeFleetSupervisor(
         args.fleet_dir,
@@ -1289,6 +1355,7 @@ def _supervise_serve(args: argparse.Namespace, own_telemetry: bool) -> int:
         stop=preempt,
         max_seconds=args.max_seconds,
         swap_timeout=args.swap_timeout,
+        worker_faults=worker_faults,
         workers=args.workers,
         min_workers=args.min_workers,
         max_workers=args.max_workers,
@@ -1325,15 +1392,73 @@ def _supervise_serve(args: argparse.Namespace, own_telemetry: bool) -> int:
         )
         front_thread.start()
         print(f"serve-fleet front on http://{fhost}:{fport}")
+    queue_stop = threading.Event()
+    queue_thread = None
+    if front_httpd is not None:
+        # the queueing observatory's in-process half: arrivals off the
+        # embedded front's own outcome counters, service attribution
+        # off the replicas' run streams — its queueing.* gauges live in
+        # THIS registry, i.e. on the front's /metrics, live
+        import time as _time
+
+        from .telemetry.alerts import StreamSet
+        from .telemetry.queueing import QueueingEstimator
+
+        est = QueueingEstimator()
+        qstreams = (
+            StreamSet([os.path.join(
+                args.worker_telemetry_dir, "worker-*.jsonl"
+            )])
+            if args.worker_telemetry_dir else None
+        )
+
+        def _queue_loop() -> None:
+            reg = telemetry.get_registry()
+            seen = 0
+            while not queue_stop.is_set():
+                now = _time.time()
+                snap = reg.snapshot()["counters"]
+                total = sum(
+                    v for k, v in snap.items()
+                    if k.startswith("front.request_outcomes.")
+                )
+                if total > seen:
+                    est.note_arrivals(total - seen, now)
+                    seen = total
+                if qstreams is not None:
+                    for e in qstreams.poll():
+                        ts = e.get("ts")
+                        est.observe_event(
+                            float(ts)
+                            if isinstance(ts, (int, float))
+                            and not isinstance(ts, bool) else now,
+                            e,
+                        )
+                ev = est.estimate(now)
+                if ev is not None:
+                    telemetry.event("queueing_estimate", **{
+                        k: v for k, v in ev.items()
+                        if k not in ("event", "ts")
+                    })
+                queue_stop.wait(0.5)
+
+        queue_thread = threading.Thread(
+            target=_queue_loop, name="stc-queueing", daemon=True
+        )
+        queue_thread.start()
     try:
         rep = sup.run()
     except ResilienceError as exc:
         print(f"error: {exc}", file=sys.stderr)
+        queue_stop.set()
         if front_httpd is not None:
             front_httpd.shutdown()
         if own_telemetry:
             telemetry.shutdown()
         return 1
+    queue_stop.set()
+    if queue_thread is not None:
+        queue_thread.join(timeout=2.0)
     if front_httpd is not None:
         front_httpd.shutdown()
     print(
@@ -1930,7 +2055,51 @@ def build_parser() -> argparse.ArgumentParser:
                          "front.replica.<i>.* families, swap "
                          "observations) — `metrics summarize` renders "
                          "the serve-fleet-health section from this")
+    fr.add_argument("--alerts-file", default=None,
+                    help="an `stc monitor --alerts-file` log: /healthz "
+                         "reports degraded while it holds firing "
+                         "alerts (e.g. a burning SLO error budget)")
     fr.set_defaults(fn=cmd_front)
+
+    pb = sub.add_parser(
+        "probe",
+        help="black-box synthetic canary: score a fixed sentinel "
+             "document through the serve front at a fixed rate; "
+             "outside-in availability/latency + generation-pinning "
+             "check, recorded to the probe's own run stream (the SLO "
+             "engine's `probe` objective source)",
+    )
+    pb.add_argument("--fleet-dir", default=None,
+                    help="discover the front from <fleet-dir>/"
+                         "front.json (the announce the front/"
+                         "supervisor writes)")
+    pb.add_argument("--url", default=None,
+                    help="probe this front address directly "
+                         "(http://host:port) instead of discovering")
+    pb.add_argument("--count", type=int, default=60,
+                    help="number of probes to send")
+    pb.add_argument("--rate", type=float, default=1.0,
+                    help="probes per second (fixed wall-clock pacing)")
+    pb.add_argument("--timeout", type=float, default=5.0,
+                    help="per-probe HTTP timeout (a timeout is an "
+                         "`error` outcome, not a crash)")
+    pb.add_argument("--stream", default="stc-probe",
+                    help="X-STC-Stream header value: the pinned "
+                         "stream identity the generation check rides")
+    pb.add_argument("--text", default=None,
+                    help="override the sentinel document (default: "
+                         "the fixed built-in sentence)")
+    pb.add_argument("--wait-front", type=float, default=10.0,
+                    help="seconds to wait for front.json to appear")
+    pb.add_argument("--fail-on-error", action="store_true",
+                    help="exit 1 when any probe failed or observed a "
+                         "generation-pinning violation (CI)")
+    pb.add_argument("--telemetry-file", default=None,
+                    help="the probe's run stream (probe_request events "
+                         "+ probe.* counters) — feed it to `stc "
+                         "monitor`/`stc metrics slo` as the "
+                         "outside-in SLO source")
+    pb.set_defaults(fn=cmd_probe)
 
     ss = sub.add_parser(
         "stream-score",

@@ -28,6 +28,11 @@ Spec grammar (semicolon-separated rules)::
                   ignores a drain-style SIGTERM (the handler sets a flag
                   nothing is polling), so only the supervisor's
                   SIGKILL escalation can reclaim it
+    slow[@s]      sleep ``s`` seconds (default 1.0) on EVERY hit — a
+                  degraded-not-dead dependency: the site keeps
+                  answering, just late.  The latency-SLO drill plants
+                  this on one replica's ``serve.batch`` so the fleet
+                  stays 100% available while its latency budget burns
 
 Sites are dotted names owned by the code they live in: ``artifact.file``
 (between files of a model artifact write), ``ckpt.write``,
@@ -59,7 +64,7 @@ __all__ = [
 ENV_SPEC = "STC_FAULTS"
 ENV_SEED = "STC_FAULT_SEED"
 
-KINDS = ("ioerror", "fail", "kill", "partial", "hang")
+KINDS = ("ioerror", "fail", "kill", "partial", "hang", "slow")
 
 # Canonical registry of every injection point the production code owns.
 # ``stc lint`` rule STC003 enforces BOTH directions against this table:
@@ -110,7 +115,9 @@ class FaultRule:
         self.hits += 1
         if self.kind == "ioerror":
             return self._rng.random() < self.arg
-        return self.hits == int(self.arg)
+        if self.kind == "slow":
+            return True                 # a degradation, not an event:
+        return self.hits == int(self.arg)  # every hit is late
 
 
 class FaultPlan:
@@ -194,6 +201,11 @@ def check(site: str) -> None:
             from .retry import sleep as _sleep
 
             _sleep(3600.0)
+            continue
+        if rule.kind == "slow":
+            from .retry import sleep as _sleep
+
+            _sleep(rule.arg)
             continue
         raise InjectedIOError(
             f"injected fault at {site} (hit {rule.hits}, "

@@ -152,6 +152,7 @@ class RequestCoalescer:
                 telemetry.observe(
                     "serve.queue_seconds", now - d.enqueued_at
                 )
+            wait = sum(now - d.enqueued_at for d in batch) / len(batch)
             telemetry.count("serve.batches")
             fill = len(batch) / self.max_batch
             telemetry.observe(
@@ -182,11 +183,15 @@ class RequestCoalescer:
                 # the live per-batch record the `stc monitor` serve
                 # rules (p99/fill regressions) tail — the registry
                 # histograms only reach the stream at shutdown
+                # `wait` (mean queue seconds per doc) is the measured
+                # half of the queueing observatory's predicted-vs-
+                # measured wait divergence (telemetry/queueing.py)
                 telemetry.event(
                     "serve_batch",
                     docs=len(batch),
                     seconds=round(dt, 6),
                     fill=round(fill, 4),
+                    wait=round(wait, 6),
                 )
 
     # -- drain -----------------------------------------------------------

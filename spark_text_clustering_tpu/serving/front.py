@@ -48,6 +48,7 @@ import os
 import re
 import threading
 import time
+import urllib.parse
 from dataclasses import dataclass
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional, Tuple
@@ -201,9 +202,11 @@ class FrontRouter:
         retry_wait_s: float = 0.05,
         wait_for_replica_s: float = 30.0,
         request_timeout: float = 120.0,
+        alerts_file: Optional[str] = None,
     ) -> None:
         self.fleet_dir = fleet_dir
         self.host = host
+        self.alerts_file = alerts_file
         self.refresh_s = float(refresh_s)
         self.lease_timeout = float(lease_timeout)
         self.suspect_s = float(suspect_s)
@@ -378,6 +381,30 @@ class FrontRouter:
         self._pool_put(r, conn)
         return resp.status, payload, out_headers
 
+    def _account(
+        self,
+        outcome: str,
+        t0: float,
+        *,
+        status: Optional[int] = None,
+        replica: Optional[int] = None,
+    ) -> None:
+        """Typed per-request accounting, on EVERY ``route()`` exit path
+        — the availability SLO's denominator.  A request that exhausted
+        the retry budget or found no replica still happened and still
+        took this long; recording only successes (the pre-SLO behavior)
+        made ``front.request_seconds`` a survivorship-biased lie."""
+        dt = time.perf_counter() - t0
+        telemetry.count(f"front.request_outcomes.{outcome}")
+        telemetry.observe("front.request_seconds", dt)
+        telemetry.event(
+            "front_request",
+            outcome=outcome,
+            seconds=round(dt, 6),
+            status=status,
+            replica=replica,
+        )
+
     def route(
         self,
         body: bytes,
@@ -402,6 +429,7 @@ class FrontRouter:
             except NoReplicaAvailable:
                 if time.monotonic() >= deadline:
                     telemetry.count("front.no_replica")
+                    self._account("no_replica", t0)
                     raise
                 self.refresh(force=True)
                 _sleep(self.retry_wait_s)
@@ -418,6 +446,9 @@ class FrontRouter:
                 telemetry.count(f"front.replica.{r.index}.retries")
                 if time.monotonic() >= deadline:
                     telemetry.count("front.no_replica")
+                    self._account(
+                        "retry_exhausted", t0, replica=r.index
+                    )
                     raise NoReplicaAvailable(
                         f"replica {r.index} failed and the retry "
                         f"budget ran out"
@@ -431,6 +462,10 @@ class FrontRouter:
                 telemetry.count("front.retries")
                 telemetry.count(f"front.replica.{r.index}.retries")
                 if time.monotonic() >= deadline:
+                    self._account(
+                        "error_status", t0,
+                        status=status, replica=r.index,
+                    )
                     return status, payload, out_headers, r.index
                 continue
             served = out_headers.get(GENERATION_HEADER)
@@ -445,10 +480,13 @@ class FrontRouter:
                             self._pins[stream] = s
             dt = time.perf_counter() - t0
             telemetry.count("front.requests")
-            telemetry.observe("front.request_seconds", dt)
             telemetry.count(f"front.replica.{r.index}.requests")
             telemetry.observe(
                 f"front.replica.{r.index}.request_seconds", dt
+            )
+            self._account(
+                "ok" if status == 200 else "error_status", t0,
+                status=status, replica=r.index,
             )
             return status, payload, out_headers, r.index
 
@@ -474,8 +512,19 @@ class FrontRouter:
             ]
             pins = len(self._pins)
         ready = [r for r in replicas if r["state"] == "ready"]
-        return {
-            "status": "ok" if ready else "degraded",
+        firing: List[Dict] = []
+        if self.alerts_file:
+            # same degrade-on-firing contract as the replicas'
+            # /healthz: a burning error budget (the monitor's
+            # budget_burn rule) flips the front to degraded while the
+            # fleet still answers — the page-before-outage signal
+            from ..telemetry.alerts import firing_alerts
+
+            firing = firing_alerts(self.alerts_file)
+        out = {
+            "status": (
+                "ok" if ready and not firing else "degraded"
+            ),
             "fleet_dir": self.fleet_dir,
             "replicas": replicas,
             "ready": len(ready),
@@ -483,6 +532,12 @@ class FrontRouter:
             "retries": reg.counter("front.retries").value,
             "pinned_streams": pins,
         }
+        if self.alerts_file:
+            out["alerts"] = {
+                "source": self.alerts_file,
+                "firing": firing,
+            }
+        return out
 
 
 # ---------------------------------------------------------------------------
@@ -521,19 +576,30 @@ class _FrontHandler(BaseHTTPRequestHandler):
             self._send_json(200, router.health())
         elif path == "/metrics":
             accept = self.headers.get("Accept", "")
-            if query == "format=prometheus" or (
-                not query and prometheus.wants_prometheus(accept)
+            params = urllib.parse.parse_qs(query)
+            want_buckets = params.get("buckets", ["0"])[-1] in (
+                "1", "true", "yes"
+            )
+            if "prometheus" in params.get("format", []) or (
+                not params.get("format")
+                and prometheus.wants_prometheus(accept)
             ):
                 self._send(
                     200,
                     prometheus.render(
-                        telemetry.get_registry().snapshot()
+                        telemetry.get_registry().snapshot(
+                            include_buckets=want_buckets
+                        ),
+                        buckets=want_buckets,
                     ).encode("utf-8"),
                     prometheus.CONTENT_TYPE,
                 )
             else:
                 self._send_json(
-                    200, telemetry.get_registry().snapshot()
+                    200,
+                    telemetry.get_registry().snapshot(
+                        include_buckets=want_buckets
+                    ),
                 )
         else:
             self._send_json(404, {"error": f"no route {self.path}"})

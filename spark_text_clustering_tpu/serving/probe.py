@@ -1,0 +1,193 @@
+"""Black-box prober for the serve fleet (``stc probe``).
+
+Every serving signal so far is inside-out: counters the front and the
+replicas publish about themselves.  An SLO is a promise to *clients*,
+and the only measurement that can back it is outside-in — a synthetic
+canary that behaves exactly like a client and records what a client
+would have experienced (the Dapper/SRE black-box monitoring lineage).
+
+The prober scores one fixed sentinel document through the front at a
+low fixed rate, over a fresh TCP connection per probe (connection
+reuse would hide exactly the connect-level failures a real new client
+hits), under a pinned ``X-STC-Stream`` so generation pinning is
+checked from the outside too: the ``X-STC-Generation`` a probe stream
+observes must be monotone non-decreasing — a regression is a broken
+swap, counted in ``probe.pin_violations``.
+
+Its telemetry is its own manifested run stream: ``probe_request``
+events (outcome / seconds / status / replica / generation) feed the
+SLO engine's ``probe_availability`` / ``probe_latency`` objectives
+(``source="probe"`` in telemetry/slo.py) next to the front's
+inside-out accounting, and ``probe.*`` counters gate in CI.
+
+jax-free and stdlib-only: the prober must run where no accelerator
+exists — that is the point of a canary.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import os
+import time
+from typing import Dict, Optional, Tuple
+
+from .. import telemetry
+from ..resilience.retry import sleep as _sleep
+from .front import GENERATION_HEADER, REPLICA_HEADER, STREAM_HEADER
+
+__all__ = [
+    "SENTINEL_TEXT",
+    "DEFAULT_STREAM",
+    "read_front_announce",
+    "Prober",
+]
+
+# One fixed, boring, language-stable document: the probe measures the
+# serving path, not the model, so the input never varies — any latency
+# or outcome change is the fleet's, by construction.
+SENTINEL_TEXT = (
+    "The quick brown fox jumps over the lazy dog while the observant "
+    "shepherd counts sheep beside a quiet river in the early morning."
+)
+
+DEFAULT_STREAM = "stc-probe"
+
+
+def read_front_announce(
+    fleet_dir: str, wait_s: float = 10.0
+) -> Tuple[str, int]:
+    """The front's announced address from ``<fleet_dir>/front.json``
+    (serving.front.write_front_announce), polled until it lands or the
+    wait budget runs out — probes usually start alongside the fleet."""
+    path = os.path.join(fleet_dir, "front.json")
+    deadline = time.monotonic() + wait_s
+    while True:
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                doc = json.load(f)
+            return str(doc["host"]), int(doc["port"])
+        except (OSError, ValueError, KeyError, json.JSONDecodeError):
+            if time.monotonic() >= deadline:
+                raise RuntimeError(
+                    f"no front announce at {path} after {wait_s:.1f}s"
+                )
+            _sleep(0.1)
+
+
+class Prober:
+    """Fixed-rate synthetic canary against one front address.
+
+    ``probe_once()`` is one client-shaped request; ``run()`` paces
+    ``count`` of them at ``rate`` per second (sequential — a canary
+    measures the fleet, it must never load it).
+    """
+
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        *,
+        stream: str = DEFAULT_STREAM,
+        timeout: float = 5.0,
+        text: str = SENTINEL_TEXT,
+    ) -> None:
+        self.host = host
+        self.port = int(port)
+        self.stream = stream
+        self.timeout = float(timeout)
+        self.body = json.dumps(
+            {"text": text, "names": ["probe"]}
+        ).encode("utf-8")
+        self._pin: Optional[int] = None
+        self.sent = 0
+        self.failures = 0
+        self.pin_violations = 0
+
+    def probe_once(self) -> Dict:
+        """One outside-in request; returns the ``probe_request`` record
+        it also emitted.  Never raises: a dead front is an ``error``
+        outcome, which is exactly the measurement."""
+        t0 = time.perf_counter()
+        status: Optional[int] = None
+        replica: Optional[int] = None
+        generation: Optional[int] = None
+        outcome = "ok"
+        conn = http.client.HTTPConnection(
+            self.host, self.port, timeout=self.timeout
+        )
+        try:
+            conn.request(
+                "POST", "/score", body=self.body,
+                headers={
+                    "Content-Type": "application/json",
+                    STREAM_HEADER: self.stream,
+                },
+            )
+            resp = conn.getresponse()
+            resp.read()
+            status = resp.status
+            if status != 200:
+                outcome = "error_status"
+            r = resp.getheader(REPLICA_HEADER)
+            g = resp.getheader(GENERATION_HEADER)
+            replica = int(r) if r is not None and r.isdigit() else None
+            generation = (
+                int(g) if g is not None and g.lstrip("-").isdigit()
+                else None
+            )
+        except (http.client.HTTPException, OSError):
+            outcome = "error"
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+        dt = time.perf_counter() - t0
+
+        violation = False
+        if generation is not None:
+            if self._pin is not None and generation < self._pin:
+                # the stream observed an OLDER model generation than it
+                # was already answered with — the exact interleaving the
+                # front's pinning exists to forbid, seen from outside
+                violation = True
+                self.pin_violations += 1
+                telemetry.count("probe.pin_violations")
+            else:
+                self._pin = generation
+
+        self.sent += 1
+        telemetry.count("probe.requests")
+        if outcome != "ok":
+            self.failures += 1
+            telemetry.count("probe.failures")
+        telemetry.observe("probe.request_seconds", dt)
+        rec = {
+            "outcome": outcome,
+            "seconds": round(dt, 6),
+            "status": status,
+            "replica": replica,
+            "generation": generation,
+            "pin_violation": violation,
+        }
+        telemetry.event("probe_request", **rec)
+        return rec
+
+    def run(self, count: int, rate: float) -> Dict:
+        """``count`` probes at ``rate``/s (fixed pacing off the wall
+        clock, so a slow fleet cannot slow the probe cadence down and
+        flatter its own availability window)."""
+        interval = 1.0 / max(rate, 1e-6)
+        t_next = time.monotonic()
+        for _ in range(int(count)):
+            self.probe_once()
+            t_next += interval
+            delay = t_next - time.monotonic()
+            if delay > 0:
+                _sleep(delay)
+        return {
+            "sent": self.sent,
+            "failures": self.failures,
+            "pin_violations": self.pin_violations,
+        }

@@ -33,6 +33,7 @@ import json
 import os
 import threading
 import time
+import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import List, Optional, Sequence
 
@@ -715,8 +716,13 @@ class _ServeHandler(BaseHTTPRequestHandler):
             # (no Accept preference, or application/json) keep the
             # registry dump byte-compatible
             accept = self.headers.get("Accept", "")
-            if query == "format=prometheus" or (
-                not query and prometheus.wants_prometheus(accept)
+            params = urllib.parse.parse_qs(query)
+            want_buckets = params.get("buckets", ["0"])[-1] in (
+                "1", "true", "yes"
+            )
+            if "prometheus" in params.get("format", []) or (
+                not params.get("format")
+                and prometheus.wants_prometheus(accept)
             ):
                 labels = (
                     {"replica": str(service.replica_index)}
@@ -725,13 +731,21 @@ class _ServeHandler(BaseHTTPRequestHandler):
                 self._send_text(
                     200,
                     prometheus.render(
-                        telemetry.get_registry().snapshot(),
+                        telemetry.get_registry().snapshot(
+                            include_buckets=want_buckets
+                        ),
                         labels=labels,
+                        buckets=want_buckets,
                     ),
                     prometheus.CONTENT_TYPE,
                 )
             else:
-                self._send(200, telemetry.get_registry().snapshot())
+                self._send(
+                    200,
+                    telemetry.get_registry().snapshot(
+                        include_buckets=want_buckets
+                    ),
+                )
         else:
             self._send(404, {"error": f"no route {self.path}"})
 

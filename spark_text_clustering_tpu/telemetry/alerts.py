@@ -33,7 +33,17 @@ Rule kinds:
   * ``drift`` — the topic-drift probe: permutation-invariant symmetric
     KL / Hellinger distance between committed-epoch lambdas read from
     an epoch ledger's sharded state — the first model-QUALITY signal
-    in the stack (``drift.kl`` / ``drift.hellinger`` gauges).
+    in the stack (``drift.kl`` / ``drift.hellinger`` gauges);
+  * ``burn_rate`` — SLO error-budget burn (``telemetry.slo``): fires
+    when BOTH windows of a multi-window pair burn at or beyond the
+    pair's factor times the rule's ``value`` multiplier, one alert key
+    per ``<objective>:<window-pair>`` — the Google-SRE page/ticket
+    split riding the same pending/firing/resolved lifecycle.
+
+An engine whose rule set references ``queueing_estimate`` events also
+runs an in-loop ``telemetry.queueing`` estimator over the tailed
+streams, so the M/M/c gauges and the ``queue_wait_divergence`` rule
+work straight off a monitor with no extra process.
 
 Tailing is torn-line and truncation tolerant like ``metrics merge``: a
 partial trailing line is left for the next poll, a rewritten/rotated
@@ -65,6 +75,8 @@ from ..resilience.integrity import atomic_write_text, file_sha256
 from ..resilience.ledger import EpochLedger, record_checksum
 from ..resilience.retry import sleep as _sleep
 from .. import telemetry
+from . import slo as slo_defs
+from .queueing import QueueingEstimator
 
 __all__ = [
     "ALERTS_LOG_NAME",
@@ -99,7 +111,9 @@ DRIFT_PROBES_COUNTER = "drift.probes"
 DRIFT_KL_GAUGE = "drift.kl"
 DRIFT_HELLINGER_GAUGE = "drift.hellinger"
 
-RULE_KINDS = ("threshold", "absence", "divergence", "drift")
+RULE_KINDS = (
+    "threshold", "absence", "divergence", "drift", "burn_rate",
+)
 AGGS = (
     "last", "count", "rate", "sum", "rate_sum", "mean", "max", "min",
     "p50", "p95", "p99", "distinct",
@@ -252,6 +266,8 @@ class AlertRule:
     description: str = ""
     ledger_dir: Optional[str] = None    # drift rules
     metric: str = "kl"                  # drift rules: kl | hellinger
+    slo: Optional[str] = None           # burn_rate rules: objective
+                                        # name (None = every objective)
 
     def __post_init__(self) -> None:
         if self.kind not in RULE_KINDS:
@@ -288,6 +304,12 @@ class AlertRule:
                 f"rule {self.name!r}: divergence rules need "
                 f"signal['by'] (the cross-stream key)"
             )
+        if self.kind == "burn_rate":
+            # ``value`` is a MULTIPLIER on each window pair's burn
+            # factor (1.0 = the SRE defaults); the unset-field default
+            # of 0.0 reads as "the defaults", not "fire on any burn"
+            if self.value <= 0:
+                self.value = 1.0
         if self.kind == "drift" and self.metric not in (
             "kl", "hellinger"
         ):
@@ -314,7 +336,7 @@ def rule_from_dict(spec: Dict) -> AlertRule:
     known = {
         "name", "kind", "signal", "op", "value", "for_seconds",
         "resolve_seconds", "action", "description", "ledger_dir",
-        "metric",
+        "metric", "slo",
     }
     extra = set(spec) - known
     if extra:
@@ -450,6 +472,32 @@ BUILTIN_RULES: Dict[str, Dict] = {
         "op": ">", "value": 0.02, "resolve_seconds": 30.0,
         "description": "epochs are rolling back repeatedly — crash "
                        "loop or torn storage",
+    },
+    # SLO engine: error-budget burn on any objective's window pair
+    # (telemetry.slo; inert on streams with no typed request events —
+    # no data means no keys, never a fire)
+    "budget_burn": {
+        "kind": "burn_rate",
+        "op": ">=", "value": 1.0,
+        "for_seconds": 0.0, "resolve_seconds": 15.0,
+        "description": "an SLO error budget is burning fast enough to "
+                       "exhaust (both windows of a pair over the "
+                       "burn-rate factor — the Google-SRE "
+                       "multi-window multi-burn-rate condition)",
+    },
+    # queueing observatory: the M/M/c model stopped describing the
+    # fleet (measured coalescer wait far beyond the Erlang-C
+    # prediction at the current lambda/S/c)
+    "queue_wait_divergence": {
+        "kind": "threshold",
+        "signal": {"event": "queueing_estimate",
+                   "field": "wait_divergence", "agg": "mean",
+                   "window_seconds": 60.0},
+        "op": ">", "value": 8.0, "for_seconds": 5.0,
+        "resolve_seconds": 15.0,
+        "description": "measured queue wait diverges from the M/M/c "
+                       "prediction — routing skew, a stuck replica, "
+                       "or non-Poisson arrivals the model can't see",
     },
     # model quality: topic drift between committed-epoch lambdas
     "topic_drift": {
@@ -898,6 +946,8 @@ class AlertEngine:
         actions_path: Optional[str] = None,
         now_fn: Callable[[], float] = time.time,
         on_transition: Optional[Callable[[Dict], None]] = None,
+        slo_config: Optional["slo_defs.SLOConfig"] = None,
+        queueing: Optional[bool] = None,
     ) -> None:
         names = [r.name for r in rules]
         if len(set(names)) != len(names):
@@ -912,10 +962,34 @@ class AlertEngine:
         self.actions = ActionEmitter(actions_path) \
             if actions_path else None
 
+        # SLO evaluation: any burn_rate rule needs a config; the
+        # built-in objective set is the default (same UX as rules)
+        self.slo_config = slo_config
+        if self.slo_config is None and any(
+            r.kind == "burn_rate" for r in self.rules
+        ):
+            self.slo_config = slo_defs.builtin_config()
+        self._slo_results: Dict[str, Dict] = {}
+        self._slo_status: Dict[str, str] = {}
+        # queueing estimator: auto-on when a rule consumes its
+        # pseudo-events, so `queue_wait_divergence` works out of the
+        # box without changing engines that never asked for it
+        if queueing is None:
+            queueing = any(
+                isinstance(r.signal, dict)
+                and r.signal.get("event") == "queueing_estimate"
+                for r in self.rules
+            )
+        self.queueing = QueueingEstimator() if queueing else None
+
         self._buffer: Deque[Tuple[float, Dict]] = deque()
         self._max_window = max(
             [r.window() for r in self.rules], default=300.0
         )
+        if self.slo_config is not None:
+            self._max_window = max(
+                self._max_window, self.slo_config.max_window_seconds()
+            )
         # absence rules track last-seen OUTSIDE the window buffer so a
         # long-stale stream (older than every window) stays accusable
         self._last_seen: Dict[Tuple[str, Optional[str]], float] = {}
@@ -1008,6 +1082,56 @@ class AlertEngine:
         while len(self._buffer) > self.MAX_BUFFERED_EVENTS:
             self._buffer.popleft()
 
+    def _observe_signals(self, events: List[Dict], now: float) -> None:
+        """The derived-signal half of a cycle: feed the in-loop
+        queueing estimator (its estimate joins the buffer as a
+        pseudo-event for threshold rules) and re-evaluate the SLO set
+        against the current buffer — both publish gauges, and an
+        objective whose status changed emits one ``slo_status``
+        event."""
+        if self.queueing is not None:
+            for e in events:
+                ts = e.get("ts")
+                ts = float(ts) if isinstance(ts, (int, float)) and \
+                    not isinstance(ts, bool) else now
+                self.queueing.observe_event(ts, e)
+            est = self.queueing.estimate(now)
+            if est is not None:
+                self._buffer.append((now, est))
+                telemetry.event(
+                    "queueing_estimate",
+                    **{k: v for k, v in est.items()
+                       if k not in ("event", "ts")},
+                )
+        if self.slo_config is not None:
+            self._slo_results = slo_defs.evaluate_all(
+                self.slo_config, list(self._buffer), now
+            )
+            slo_defs.publish(self._slo_results)
+            for name, res in sorted(self._slo_results.items()):
+                prev = self._slo_status.get(name)
+                if res["status"] == prev:
+                    continue
+                self._slo_status[name] = res["status"]
+                if prev is None and res["status"] == "no_data":
+                    continue             # nothing-yet is not a change
+                telemetry.event(
+                    "slo_status",
+                    objective=name,
+                    status=res["status"],
+                    kind=res["kind"],
+                    source=res["source"],
+                    target=res["target"],
+                    good=res["good"],
+                    total=res["total"],
+                    budget_remaining=res["budget_remaining"],
+                    burning=res["burning"],
+                )
+
+    def slo_results(self) -> Dict[str, Dict]:
+        """The newest per-objective evaluation (for CLIs and tests)."""
+        return dict(self._slo_results)
+
     # -- evaluation ------------------------------------------------------
     def _conditions(
         self, rule: AlertRule, now: float
@@ -1055,6 +1179,28 @@ class AlertEngine:
                 )
             else:
                 out[""] = (False, None, {})
+        elif rule.kind == "burn_rate":
+            # one alert key per <objective>:<window-pair>; the value
+            # is min(long, short) burn, so `op value*factor` holds
+            # exactly when BOTH windows are over (the SRE condition).
+            # Objectives/pairs with no data emit no key — inert, never
+            # a fire, and an earlier fire still resolves via the
+            # missing-key sweep in _evaluate
+            for oname, res in sorted(self._slo_results.items()):
+                if rule.slo is not None and oname != rule.slo:
+                    continue
+                for w in res["windows"]:
+                    if w["burn"] is None:
+                        continue
+                    threshold = w["factor"] * rule.value
+                    out[f"{oname}:{w['name']}"] = (
+                        cmp(w["burn"], threshold), w["burn"],
+                        {"objective": oname, "window": w["name"],
+                         "burn_long": round(w["burn_long"], 6),
+                         "burn_short": round(w["burn_short"], 6),
+                         "burn_threshold": round(threshold, 6),
+                         "budget_remaining": res["budget_remaining"]},
+                    )
         else:                            # drift
             for r, probe in self._probes:
                 if r is not rule:
@@ -1184,6 +1330,7 @@ class AlertEngine:
             ev = probe.poll(now)
             if ev is not None:
                 self._buffer.append((now, ev))
+        self._observe_signals(events, now)
         before = len(self.transitions)
         for rule in self.rules:
             self._evaluate(rule, now, immediate)
@@ -1247,6 +1394,7 @@ class AlertEngine:
             ev = probe.poll(now)
             if ev is not None:
                 self._buffer.append((now, ev))
+        self._observe_signals(events, now)
         for rule in self.rules:
             self._evaluate(rule, now, True)
         telemetry.gauge(ACTIVE_GAUGE, len(self.firing()))

@@ -59,12 +59,14 @@ __all__ = [
     "serve_fleet_health",
     "serving_health",
     "alert_health",
+    "slo_health",
     "compile_health",
     "memory_health",
     "cmd_summarize",
     "cmd_tail",
     "cmd_diff",
     "cmd_check",
+    "cmd_slo",
     "cmd_merge",
     "cmd_trace",
     "cmd_roofline",
@@ -1018,6 +1020,81 @@ def alert_health(
     return out
 
 
+_SLO_GAUGE_RE = re.compile(r"^gauge\.slo\.([a-z0-9_]+)\.total$")
+
+
+def slo_health(
+    events: List[Dict], metrics: Dict[str, float]
+) -> Optional[Dict]:
+    """SLO-health summary (docs/OBSERVABILITY.md "SLOs & error
+    budgets"): per-objective latest status (from ``slo_status``
+    transition events), budget remaining and burning flags (from the
+    final ``slo.*`` gauges), and the evaluation count.  None when the
+    run never evaluated an SLO."""
+    statuses = [e for e in events if e.get("event") == "slo_status"]
+    touched = bool(statuses) or any(
+        k.startswith(("gauge.slo.", "counter.slo.")) for k in metrics
+    )
+    if not touched:
+        return None
+    latest: Dict[str, Dict] = {}
+    for e in statuses:
+        latest[str(e.get("objective", "?"))] = e
+    names = set(latest)
+    for k in metrics:
+        m = _SLO_GAUGE_RE.match(k)
+        if m:
+            names.add(m.group(1))
+    objectives: List[Dict] = []
+    for name in sorted(names):
+        rec: Dict = {"objective": name}
+        e = latest.get(name)
+        if e is not None:
+            for f in ("status", "kind", "source", "good", "total",
+                      "budget_remaining", "burning"):
+                if e.get(f) is not None:
+                    rec[f] = e[f]
+        for f, g in (
+            ("total", f"gauge.slo.{name}.total"),
+            ("good_fraction", f"gauge.slo.{name}.good_fraction"),
+            ("budget_remaining", f"gauge.slo.{name}.budget_remaining"),
+        ):
+            if _is_num(metrics.get(g)):
+                rec[f] = metrics[g]
+        if _is_num(metrics.get(f"gauge.slo.{name}.burning")):
+            rec["burning"] = bool(metrics[f"gauge.slo.{name}.burning"])
+        objectives.append(rec)
+    return {
+        "evaluations": int(metrics.get("counter.slo.evaluations", 0)),
+        "objectives_burning": int(
+            metrics.get("gauge.slo.objectives_burning", 0)
+        ),
+        "objectives": objectives,
+    }
+
+
+def _print_slo_health(slh: Dict, file=None) -> None:
+    file = file if file is not None else sys.stdout
+    print("slo health:", file=file)
+    print(
+        f"  objectives burning: {slh['objectives_burning']}  "
+        f"(over {slh['evaluations']} evaluation(s))", file=file,
+    )
+    for o in slh.get("objectives", ()):
+        parts = [f"status={o.get('status', '?')}"]
+        if "total" in o:
+            parts.append(f"total={int(o['total'])}")
+        if o.get("good_fraction") is not None:
+            parts.append(f"good={o['good_fraction']:.4f}")
+        if o.get("budget_remaining") is not None:
+            parts.append(f"budget={o['budget_remaining']:.1%}")
+        mark = "  <<BURNING" if o.get("burning") else ""
+        print(
+            f"  objective {o['objective']}: "
+            + "  ".join(parts) + mark, file=file,
+        )
+
+
 def _print_compile_health(ch: Dict, file=None) -> None:
     file = file if file is not None else sys.stdout
     print("compile health:", file=file)
@@ -1327,6 +1404,7 @@ def _cmd_summarize(args) -> int:
     sfh = serve_fleet_health(events, metrics)
     sh = serving_health(events, metrics)
     ah = alert_health(events, metrics)
+    slh = slo_health(events, metrics)
     ch = compile_health(events, metrics)
     mh = memory_health(metrics)
     if getattr(args, "json", False):
@@ -1341,6 +1419,8 @@ def _cmd_summarize(args) -> int:
             doc["serving_health"] = sh
         if ah is not None:
             doc["alert_health"] = ah
+        if slh is not None:
+            doc["slo_health"] = slh
         if ch is not None:
             doc["compile_health"] = ch
         if mh is not None:
@@ -1361,6 +1441,8 @@ def _cmd_summarize(args) -> int:
         _print_serving_health(sh)
     if ah is not None:
         _print_alert_health(ah)
+    if slh is not None:
+        _print_slo_health(slh)
     if ch is not None:
         _print_compile_health(ch)
     if mh is not None:
@@ -1579,6 +1661,81 @@ def cmd_check(args) -> int:
     print(f"{status}: {checked - len(failures)}/{checked} metrics "
           f"within tolerance vs {args.baseline}")
     return 1 if failures else 0
+
+
+def cmd_slo(args) -> int:
+    """``stc metrics slo``: evaluate the SLO set over recorded run
+    stream(s) at event time — budget remaining, burn rates per window,
+    and a status roll-up per objective.  ``--fail-on-burn`` exits 1
+    when any objective is burning or exhausted (the CI gate)."""
+    from .slo import builtin_config, config_from_dict, evaluate_all
+
+    try:
+        if args.slo:
+            with open(args.slo, "r", encoding="utf-8") as f:
+                cfg = config_from_dict(json.load(f))
+            if args.compression is not None:
+                cfg.compression = float(args.compression)
+        else:
+            cfg = builtin_config(
+                compression=float(args.compression or 1.0)
+            )
+    except (OSError, ValueError, json.JSONDecodeError) as exc:
+        print(f"error: {exc}", file=sys.stderr)
+        return 2
+    pairs: List[Tuple[float, Dict]] = []
+    for path in args.runs:
+        try:
+            _, events = load_run(path)
+        except OSError as exc:
+            print(f"error: {exc}", file=sys.stderr)
+            return 2
+        for e in events:
+            if _is_num(e.get("ts")):
+                pairs.append((float(e["ts"]), e))
+    if not pairs:
+        print("no timestamped events in the given run stream(s)",
+              file=sys.stderr)
+        return 2
+    # event-time evaluation, same discipline as `monitor --once`: the
+    # verdict depends on the recorded stream, not on when it runs
+    now = max(ts for ts, _ in pairs) + 1e-6
+    results = evaluate_all(cfg, pairs, now)
+    bad = sorted(
+        n for n, r in results.items()
+        if r["burning"] or r["status"] == "exhausted"
+    )
+    if getattr(args, "json", False):
+        print(json.dumps(
+            {"now": now, "burning": bad, "objectives": results},
+            sort_keys=True,
+        ))
+        return 1 if args.fail_on_burn and bad else 0
+    wname = max(
+        (len(n) for n in results), default=9
+    )
+    print(f"{'objective'.ljust(wname)}  {'status':>9}  {'good/total':>13}"
+          f"  {'budget':>7}  burn(windows)")
+    for name, r in sorted(results.items()):
+        gt = f"{r['good']}/{r['total']}" if r["total"] else "-"
+        budget = (
+            f"{r['budget_remaining']:.1%}"
+            if r["budget_remaining"] is not None else "-"
+        )
+        burns = "  ".join(
+            f"{w['name']}={w['burn']:.2f}x"
+            + ("!" if w["burning"] else "")
+            if w["burn"] is not None else f"{w['name']}=-"
+            for w in r["windows"]
+        )
+        mark = "  <<BURNING" if name in bad else ""
+        print(f"{name.ljust(wname)}  {r['status']:>9}  {gt:>13}"
+              f"  {budget:>7}  {burns}{mark}")
+    if bad:
+        print(f"# {len(bad)} objective(s) burning: {', '.join(bad)}")
+    if args.fail_on_burn and bad:
+        return 1
+    return 0
 
 
 def _fmt_rate(v: Optional[float], unit: str) -> str:
@@ -2070,6 +2227,37 @@ def add_metrics_subparser(sub) -> None:
              "baseline, refresh just these families in place",
     )
     ck.set_defaults(fn=cmd_check)
+
+    sl = msub.add_parser(
+        "slo",
+        help="evaluate SLO objectives over recorded run stream(s) at "
+             "event time: budget remaining, multi-window burn rates, "
+             "per-objective status (docs/OBSERVABILITY.md \"SLOs & "
+             "error budgets\")",
+    )
+    sl.add_argument(
+        "runs", nargs="+",
+        help="telemetry .jsonl stream(s) carrying front_request / "
+             "probe_request events (front, probe, or monitor runs; "
+             "evaluated together on one timeline)",
+    )
+    sl.add_argument(
+        "--slo", default=None,
+        help="JSON SLO objective file (same format as `stc monitor "
+             "--slo`); default: the built-in objective set",
+    )
+    sl.add_argument(
+        "--compression", type=float, default=None,
+        help="divide every burn/budget window by N (must match the "
+             "monitor run being reproduced)",
+    )
+    sl.add_argument("--json", action="store_true")
+    sl.add_argument(
+        "--fail-on-burn", action="store_true",
+        help="exit 1 when any objective is burning or its budget is "
+             "exhausted (the CI gate)",
+    )
+    sl.set_defaults(fn=cmd_slo)
 
     mg = msub.add_parser(
         "merge",

@@ -31,8 +31,35 @@ from .alerts import (
     builtin_rules,
     rule_from_dict,
 )
+from .slo import SLOConfig, builtin_config, config_from_dict
 
-__all__ = ["assemble_rules", "cmd_monitor", "add_monitor_subparser"]
+__all__ = [
+    "assemble_rules",
+    "assemble_slo_config",
+    "cmd_monitor",
+    "add_monitor_subparser",
+]
+
+
+def assemble_slo_config(
+    slo_path: Optional[str],
+    compression: Optional[float],
+) -> Optional[SLOConfig]:
+    """The verb's SLO set: ``--slo FILE`` replaces/extends the built-in
+    objectives (a file objective re-declaring a built-in name retunes
+    it); ``--slo-compression`` divides every burn window for drills.
+    None when neither flag is given — the engine then defaults to the
+    built-in set only if a ``burn_rate`` rule asks for it."""
+    if not slo_path and compression is None:
+        return None
+    if slo_path:
+        with open(slo_path, "r", encoding="utf-8") as f:
+            doc = json.load(f)
+        cfg = config_from_dict(doc)
+        if compression is not None:
+            cfg.compression = float(compression)
+        return cfg
+    return builtin_config(compression=float(compression or 1.0))
 
 
 def assemble_rules(
@@ -117,6 +144,10 @@ def cmd_monitor(args) -> int:
         )
     try:
         rules = assemble_rules(args.builtin, args.rules)
+        slo_config = assemble_slo_config(
+            getattr(args, "slo", None),
+            getattr(args, "slo_compression", None),
+        )
     except (OSError, ValueError, json.JSONDecodeError) as exc:
         print(f"error: {exc}", file=sys.stderr)
         return 2
@@ -144,6 +175,7 @@ def cmd_monitor(args) -> int:
         alerts_path=args.alerts_file,
         actions_path=args.actions_file,
         on_transition=None if args.quiet else _print_transition,
+        slo_config=slo_config,
     )
     print(
         f"monitoring {len(rules)} rule(s) over "
@@ -263,6 +295,20 @@ def add_monitor_subparser(sub) -> None:
     mo.add_argument(
         "--quiet", action="store_true",
         help="don't print transitions as they happen",
+    )
+    mo.add_argument(
+        "--slo", default=None, metavar="FILE",
+        help="JSON SLO objective file (a list of objective objects or "
+             "{'objectives': [...], 'windows': [...], 'compression': "
+             "N}; re-declaring a built-in objective name retunes it) — "
+             "enables burn-rate evaluation even without a burn_rate "
+             "rule selected",
+    )
+    mo.add_argument(
+        "--slo-compression", type=float, default=None, metavar="N",
+        help="divide every SLO burn window by N (a 3600 s window at "
+             "N=400 drills in 9 s) — CI's knob; implies the built-in "
+             "objective set when --slo is absent",
     )
     mo.add_argument(
         "--telemetry-file", default=None,

@@ -101,8 +101,55 @@ METRICS: Dict[str, str] = {
         "their pinned generation left the fleet (rolling swap "
         "completed under them)",
     "front.request_seconds":
-        "per-request front latency: accept -> replica response "
-        "relayed (includes routing, transport, and any retries)",
+        "per-request front latency on EVERY exit path: accept -> "
+        "replica response relayed, retry budget exhausted, or refused "
+        "with no ready replica (includes routing, transport, and any "
+        "retries — the latency-SLO denominator)",
+    # -- SLO engine & queueing observatory (docs/OBSERVABILITY.md
+    #    "SLOs & error budgets") -----------------------------------------
+    "probe.requests":
+        "sentinel canary requests sent through the front by stc probe "
+        "(the outside-in availability/latency sample)",
+    "probe.failures":
+        "canary requests that failed: non-200 status, connection "
+        "error, or timeout (each one spends probe-SLO budget)",
+    "probe.pin_violations":
+        "canary requests whose X-STC-Generation went BACKWARD on the "
+        "probe's pinned stream (a generation-pinning breach observed "
+        "from outside)",
+    "probe.request_seconds":
+        "per-canary-request latency: connect -> response read "
+        "(outside-in, fresh connection each probe)",
+    "queueing.updates":
+        "queueing estimates computed (each one re-publishes the "
+        "lambda/service/rho/wait gauges from the current window)",
+    "queueing.lambda":
+        "request arrival rate at the front, events/second over the "
+        "estimator window (ROADMAP item 3's lambda)",
+    "queueing.replicas":
+        "replica count c the M/M/c prediction used (distinct serve "
+        "streams in the window, or the configured override)",
+    "queueing.service_seconds":
+        "per-document service time S from serve_batch dispatch "
+        "records (batch seconds over batch docs — the "
+        "request-minus-queue attribution)",
+    "queueing.rho":
+        "fleet utilization lambda*S/c — the overload-control signal "
+        "(rho -> 1 means waits diverge before p99 ever fires)",
+    "queueing.predicted_wait_seconds":
+        "Erlang-C predicted mean M/M/c queueing wait at the current "
+        "(lambda, S, c); capped at the estimator window when "
+        "saturated",
+    "queueing.predicted_wait_p99_seconds":
+        "Erlang-C predicted p99 queueing wait (exponential tail of "
+        "the M/M/c waiting-time distribution)",
+    "queueing.measured_wait_seconds":
+        "measured mean coalescer wait from serve_batch wait fields "
+        "(doc-weighted enqueue -> dispatch)",
+    "queueing.wait_divergence":
+        "measured over predicted mean wait (floored) — sustained "
+        "divergence means the M/M/c model no longer describes the "
+        "fleet (queue_wait_divergence alert)",
     # -- quarantine requeue (stc stream requeue) ------------------------
     "requeue.replayed":
         "quarantined documents replayed back into a watch directory",
@@ -256,6 +303,20 @@ PREFIXES: Dict[str, str] = {
     "monitor.":
         "telemetry.alerts: monitor engine self-observation (polls, "
         "events consumed, actions emitted, poll errors, live streams)",
+    "front.request_outcomes.":
+        "serving.front: typed per-outcome request counters on every "
+        "exit path of FrontRouter.route (front.request_outcomes.ok/"
+        ".error_status/.retry_exhausted/.no_replica — the "
+        "availability-SLO numerator and denominator)",
+    "slo.":
+        "telemetry.slo: per-objective error-budget gauges "
+        "(slo.<objective>.budget_remaining/.good_fraction/"
+        ".burn_<window>/.burning) plus the engine's slo.evaluations "
+        "counter and slo.objectives_burning roll-up",
+    "queueing.replica.":
+        "telemetry.queueing: measured per-replica busy fraction "
+        "(queueing.replica.<i>.rho — spread across replicas exposes "
+        "routing skew the fleet-wide rho hides)",
 }
 
 

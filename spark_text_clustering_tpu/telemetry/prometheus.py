@@ -13,9 +13,15 @@ Mapping:
   * gauges   -> ``# TYPE ... gauge``;
   * histograms -> ``# TYPE ... summary`` with ``quantile`` labels: the
     registry's fixed-bucket histograms snapshot p50/p95/p99 (+ sum and
-    count), which maps exactly onto the summary type — bucket counts
-    are not in the snapshot, and re-deriving ``le`` buckets would
-    invent data the registry never kept.
+    count), which maps exactly onto the summary type — by default
+    bucket counts are not in the snapshot, and re-deriving ``le``
+    buckets would invent data the registry never kept.  When the
+    caller snapshots with ``include_buckets=True`` and renders with
+    ``buckets=True`` (the serve endpoints' ``?format=prometheus&``
+    ``buckets=1``), histograms become true ``# TYPE ... histogram``
+    families with cumulative ``_bucket{le="..."}`` samples — enough
+    for an external Prometheus to recompute latency-SLO burn rates
+    with ``histogram_quantile`` / bucket ratios.
 
 Metric names sanitize dots to underscores under an ``stc_`` namespace
 (``serve.request_seconds`` -> ``stc_serve_request_seconds``); the
@@ -85,7 +91,10 @@ def _num(v) -> str:
 
 
 def render(
-    snapshot: Dict, labels: Optional[Dict[str, str]] = None
+    snapshot: Dict,
+    labels: Optional[Dict[str, str]] = None,
+    *,
+    buckets: bool = False,
 ) -> str:
     """The exposition text for one ``MetricRegistry.snapshot()``.
 
@@ -95,6 +104,13 @@ def render(
     dotted names additionally surface their embedded index as the same
     ``replica`` label (see ``_REPLICA_RE``).  HELP/TYPE lines are
     emitted once per metric name (repeat label sets share them).
+
+    ``buckets=True`` renders histograms whose snapshot carries bucket
+    data (``MetricRegistry.snapshot(include_buckets=True)``) as native
+    Prometheus histogram families: cumulative ``_bucket{le="<bound>"}``
+    samples plus the mandatory ``le="+Inf"`` total, then ``_sum`` /
+    ``_count``.  Histograms without bucket data still fall back to the
+    summary mapping so mixed snapshots stay renderable.
     """
     lines: List[str] = []
     typed: set = set()
@@ -117,13 +133,34 @@ def render(
         lines.append(f"{pn}{_labels_text(lbl)} {_num(v)}")
     for name, h in sorted(snapshot.get("histograms", {}).items()):
         pn, lbl = _split(name, labels)
-        head(pn, "summary", name, note=" (histogram)")
-        for q, fld in (("0.5", "p50"), ("0.95", "p95"), ("0.99", "p99")):
-            qlbl = dict(lbl)
-            qlbl["quantile"] = q
-            lines.append(
-                f"{pn}{_labels_text(qlbl)} {_num(h.get(fld))}"
-            )
+        bounds = h.get("buckets")
+        counts = h.get("bucket_counts")
+        if buckets and isinstance(bounds, list) \
+                and isinstance(counts, list) \
+                and len(counts) == len(bounds) + 1:
+            head(pn, "histogram", name)
+            acc = 0
+            for bound, c in zip(bounds, counts):
+                acc += int(c)
+                blbl = dict(lbl)
+                blbl["le"] = _num(bound)
+                lines.append(
+                    f"{pn}_bucket{_labels_text(blbl)} {acc}"
+                )
+            acc += int(counts[-1])
+            blbl = dict(lbl)
+            blbl["le"] = "+Inf"
+            lines.append(f"{pn}_bucket{_labels_text(blbl)} {acc}")
+        else:
+            head(pn, "summary", name, note=" (histogram)")
+            for q, fld in (
+                ("0.5", "p50"), ("0.95", "p95"), ("0.99", "p99")
+            ):
+                qlbl = dict(lbl)
+                qlbl["quantile"] = q
+                lines.append(
+                    f"{pn}{_labels_text(qlbl)} {_num(h.get(fld))}"
+                )
         lines.append(
             f"{pn}_sum{_labels_text(lbl)} {_num(h.get('sum', 0.0))}"
         )

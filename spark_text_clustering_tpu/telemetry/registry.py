@@ -128,8 +128,8 @@ class Histogram:
     def mean(self) -> float:
         return self.sum / self.count if self.count else math.nan
 
-    def snapshot(self) -> Dict:
-        return {
+    def snapshot(self, include_buckets: bool = False) -> Dict:
+        out = {
             "count": self.count,
             "sum": round(self.sum, 9),
             "min": None if self.count == 0 else self.min,
@@ -139,6 +139,12 @@ class Histogram:
             "p95": None if self.count == 0 else self.percentile(95),
             "p99": None if self.count == 0 else self.percentile(99),
         }
+        if include_buckets:
+            # per-bucket (non-cumulative) counts aligned with bounds;
+            # counts has one extra overflow slot past the last bound
+            out["buckets"] = list(self.buckets)
+            out["bucket_counts"] = list(self.counts)
+        return out
 
 
 class MetricRegistry:
@@ -179,7 +185,7 @@ class MetricRegistry:
             return self._get(name, Histogram)
         return self._get(name, Histogram, buckets)
 
-    def snapshot(self) -> Dict:
+    def snapshot(self, include_buckets: bool = False) -> Dict:
         """JSON-ready view of every metric, grouped by kind."""
         out = {"counters": {}, "gauges": {}, "histograms": {}}
         for name, m in sorted(self._metrics.items()):
@@ -188,7 +194,7 @@ class MetricRegistry:
             elif isinstance(m, Gauge):
                 out["gauges"][name] = m.snapshot()
             else:
-                out["histograms"][name] = m.snapshot()
+                out["histograms"][name] = m.snapshot(include_buckets)
         return out
 
     def reset(self) -> None:

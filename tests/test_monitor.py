@@ -238,7 +238,9 @@ class TestRuleValidation:
         rules = builtin_rules()
         assert len(rules) == len(BUILTIN_RULES)
         kinds = {r.kind for r in rules}
-        assert kinds == {"threshold", "absence", "divergence", "drift"}
+        assert kinds == {
+            "threshold", "absence", "divergence", "drift", "burn_rate",
+        }
 
     def test_duplicate_rule_names_refused(self):
         r = AlertRule(name="r", signal={"event": "m"})

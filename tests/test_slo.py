@@ -1,0 +1,950 @@
+"""SLO engine & queueing observatory (telemetry.slo / telemetry
+.queueing / serving.probe): declarative objectives, error-budget
+accounting, the Google-SRE multi-window multi-burn-rate lifecycle
+through the alert engine, the M/M/c queueing estimator, the black-box
+prober, typed front request accounting on every route() exit path, the
+Prometheus cumulative ``_bucket`` exposition, and the ``stc metrics
+slo`` / ``slo-health`` surfacing.
+
+Everything here is jax-free and fast: SLO evaluation is a pure
+host-side reader over typed request events and must stay one.
+"""
+
+import json
+import math
+import os
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from spark_text_clustering_tpu import telemetry
+from spark_text_clustering_tpu.resilience import faultinject
+from spark_text_clustering_tpu.serving.front import (
+    GENERATION_HEADER,
+    REPLICA_HEADER,
+    FrontRouter,
+    NoReplicaAvailable,
+)
+from spark_text_clustering_tpu.serving.probe import (
+    DEFAULT_STREAM,
+    SENTINEL_TEXT,
+    Prober,
+    read_front_announce,
+)
+from spark_text_clustering_tpu.telemetry import prometheus
+from spark_text_clustering_tpu.telemetry.alerts import (
+    AlertEngine,
+    builtin_rules,
+)
+from spark_text_clustering_tpu.telemetry.metrics_cli import (
+    load_run,
+    run_metrics,
+    slo_health,
+)
+from spark_text_clustering_tpu.telemetry.monitor_cli import (
+    assemble_slo_config,
+)
+from spark_text_clustering_tpu.telemetry.queueing import (
+    QueueingEstimator,
+    erlang_c,
+    predicted_waits,
+)
+from spark_text_clustering_tpu.telemetry.registry import (
+    DEFAULT_SECONDS_BUCKETS,
+    MetricRegistry,
+)
+from spark_text_clustering_tpu.telemetry.slo import (
+    BUILTIN_OBJECTIVES,
+    DEFAULT_LATENCY_THRESHOLD,
+    SLOConfig,
+    SLOObjective,
+    builtin_config,
+    classify,
+    config_from_dict,
+    evaluate,
+    evaluate_all,
+    fraction_under,
+    objective_from_dict,
+)
+
+
+@pytest.fixture(autouse=True)
+def _telemetry_reset():
+    # registry-only mode: counters/gauges aggregate, nothing is written
+    telemetry.configure(None)
+    faultinject.reset()
+    yield
+    telemetry.shutdown()
+    telemetry.get_registry().reset()
+    faultinject.reset()
+
+
+def _avail(name="avail", target=0.99, event="req"):
+    return SLOObjective(
+        name=name, event=event, kind="availability", target=target,
+        good_where={"outcome": "ok"},
+    )
+
+
+def _req(ok=True):
+    return {"event": "req", "outcome": "ok" if ok else "error"}
+
+
+# small deterministic window pairs: fast pages at 14.4x, slow tickets
+# at 6x — the SRE factors over test-sized spans
+_WINDOWS = [
+    {"name": "fast", "long_seconds": 60.0, "short_seconds": 5.0,
+     "factor": 14.4},
+    {"name": "slow", "long_seconds": 360.0, "short_seconds": 30.0,
+     "factor": 6.0},
+]
+
+
+def _cfg(*objectives, **kw):
+    kw.setdefault("windows", [dict(w) for w in _WINDOWS])
+    kw.setdefault("budget_window_seconds", 3600.0)
+    return SLOConfig(objectives=list(objectives), **kw)
+
+
+# ---------------------------------------------------------------------------
+# Declaration & validation
+# ---------------------------------------------------------------------------
+class TestObjectiveValidation:
+    def test_bad_specs_raise_typed(self):
+        with pytest.raises(ValueError, match="unknown kind"):
+            SLOObjective(name="x", event="e", kind="throughput",
+                         good_where={"a": 1})
+        with pytest.raises(ValueError, match="target"):
+            SLOObjective(name="x", event="e", target=1.0,
+                         good_where={"a": 1})
+        with pytest.raises(ValueError, match="good_where"):
+            SLOObjective(name="x", event="e", kind="availability")
+        with pytest.raises(ValueError, match="threshold_seconds"):
+            SLOObjective(name="x", event="e", kind="latency",
+                         threshold_seconds=0.0)
+        with pytest.raises(ValueError, match="snake_case"):
+            SLOObjective(name="Bad-Name", event="e",
+                         good_where={"a": 1})
+        with pytest.raises(ValueError, match="event"):
+            SLOObjective(name="x", event="", good_where={"a": 1})
+
+    def test_latency_defaults_bucket_aligned_threshold(self):
+        o = SLOObjective(name="x", event="e", kind="latency")
+        assert o.threshold_seconds == DEFAULT_LATENCY_THRESHOLD
+        # the default line IS a registry bucket bound, so the stream
+        # fraction and the _bucket fraction agree exactly
+        assert any(
+            abs(b - DEFAULT_LATENCY_THRESHOLD) < 1e-12
+            for b in DEFAULT_SECONDS_BUCKETS
+        )
+
+    def test_from_dict_rejects_unknown_and_unnamed(self):
+        with pytest.raises(ValueError, match="unknown field"):
+            objective_from_dict(
+                {"name": "x", "event": "e", "good_where": {"a": 1},
+                 "burn": 2}
+            )
+        with pytest.raises(ValueError, match="name"):
+            objective_from_dict({"event": "e"})
+
+
+class TestConfigParsing:
+    def test_bare_list_and_builtin_retune_merge(self):
+        cfg = config_from_dict([
+            {"name": "probe_latency", "target": 0.9},
+            {"name": "my_avail", "event": "req", "kind": "availability",
+             "good_where": {"outcome": "ok"}},
+        ])
+        by_name = {o.name: o for o in cfg.objectives}
+        # the builtin's kind/event/threshold survive, the target retunes
+        pl = by_name["probe_latency"]
+        assert pl.kind == "latency" and pl.event == "probe_request"
+        assert pl.target == 0.9
+        assert pl.threshold_seconds == DEFAULT_LATENCY_THRESHOLD
+        assert by_name["my_avail"].kind == "availability"
+
+    def test_document_level_knobs(self):
+        cfg = config_from_dict({
+            "objectives": [{"name": "a", "event": "e",
+                            "good_where": {"ok": True}}],
+            "windows": [{"name": "only", "long_seconds": 100.0,
+                         "short_seconds": 10.0, "factor": 2.0}],
+            "budget_window_seconds": 500.0,
+            "compression": 50.0,
+        })
+        assert [w["name"] for w in cfg.windows] == ["only"]
+        assert cfg.scale(500.0) == 10.0
+        assert cfg.max_window_seconds() == 10.0
+
+    def test_bad_configs_raise_typed(self):
+        with pytest.raises(ValueError, match="duplicate"):
+            _cfg(_avail("a"), _avail("a"))
+        with pytest.raises(ValueError, match="compression"):
+            _cfg(_avail(), compression=0.0)
+        with pytest.raises(ValueError, match="long_seconds"):
+            _cfg(_avail(), windows=[
+                {"name": "w", "long_seconds": 5.0,
+                 "short_seconds": 60.0, "factor": 2.0},
+            ])
+        with pytest.raises(ValueError, match="objectives"):
+            config_from_dict({"objectives": "nope"})
+        with pytest.raises(ValueError, match="name"):
+            config_from_dict([{"event": "e"}])
+
+    def test_builtin_config_covers_both_sources(self):
+        cfg = builtin_config(compression=400.0)
+        assert [o.name for o in cfg.objectives] == sorted(
+            BUILTIN_OBJECTIVES
+        )
+        assert {o.source for o in cfg.objectives} == {"serve", "probe"}
+        assert cfg.compression == 400.0
+
+
+# ---------------------------------------------------------------------------
+# Classification & evaluation math
+# ---------------------------------------------------------------------------
+class TestClassify:
+    def test_availability_and_where_filter(self):
+        o = SLOObjective(
+            name="x", event="req", good_where={"outcome": "ok"},
+            where={"route": "/score"},
+        )
+        assert classify(o, {"event": "other"}) is None
+        assert classify(
+            o, {"event": "req", "route": "/metrics", "outcome": "ok"}
+        ) is None
+        assert classify(
+            o, {"event": "req", "route": "/score", "outcome": "ok"}
+        ) is True
+        assert classify(
+            o, {"event": "req", "route": "/score", "outcome": "error"}
+        ) is False
+
+    def test_latency_boundary_and_missing_field(self):
+        o = SLOObjective(name="x", event="req", kind="latency",
+                         threshold_seconds=0.5)
+        assert classify(o, {"event": "req", "seconds": 0.5}) is True
+        assert classify(o, {"event": "req", "seconds": 0.51}) is False
+        # a request that never produced a latency did not meet the SLO
+        assert classify(o, {"event": "req"}) is False
+        assert classify(o, {"event": "req", "seconds": True}) is False
+
+
+class TestEvaluate:
+    def test_no_data_and_all_good(self):
+        cfg = _cfg(_avail())
+        r = evaluate(cfg.objectives[0], cfg, [], now=1000.0)
+        assert r["status"] == "no_data"
+        assert r["budget_remaining"] is None
+        good = [(999.0, _req()) for _ in range(20)]
+        r = evaluate(cfg.objectives[0], cfg, good, now=1000.0)
+        assert r["status"] == "ok"
+        assert r["budget_remaining"] == 1.0
+        assert not r["burning"]
+
+    def test_slow_leak_burns_slow_pair_only(self):
+        # 10% bad at target 0.99 -> burn 10x everywhere: over the slow
+        # factor (6) but under the fast one (14.4) — a ticket, not a page
+        cfg = _cfg(_avail())
+        ev = [(999.0, _req(ok=(i % 10 != 0))) for i in range(100)]
+        r = evaluate(cfg.objectives[0], cfg, ev, now=1000.0)
+        by_name = {w["name"]: w for w in r["windows"]}
+        assert by_name["fast"]["burn"] == pytest.approx(10.0)
+        assert not by_name["fast"]["burning"]
+        assert by_name["slow"]["burning"]
+        assert r["burning"] and r["status"] == "exhausted"
+
+    def test_both_windows_required(self):
+        # bad events ONLY outside the short window: the long window
+        # burns but the short one is clean -> the pair must NOT fire
+        # (the bleeding has stopped; the SRE condition resolves it)
+        cfg = _cfg(_avail())
+        ev = [(950.0, _req(ok=False)) for _ in range(50)]
+        ev += [(999.0, _req()) for _ in range(50)]
+        r = evaluate(cfg.objectives[0], cfg, ev, now=1000.0)
+        by_name = {w["name"]: w for w in r["windows"]}
+        assert by_name["fast"]["burn_long"] == pytest.approx(50.0)
+        assert by_name["fast"]["burn_short"] == 0.0
+        assert not by_name["fast"]["burning"]
+
+    def test_compression_divides_windows_not_thresholds(self):
+        cfg = _cfg(_avail(), compression=10.0)
+        # bad events 20s ago: inside the uncompressed 60s fast-long
+        # window but outside the compressed 6s one
+        ev = [(980.0, _req(ok=False))] * 10 + [(999.5, _req())] * 10
+        r = evaluate(cfg.objectives[0], cfg, ev, now=1000.0)
+        by_name = {w["name"]: w for w in r["windows"]}
+        assert by_name["fast"]["long_seconds"] == 6.0
+        assert by_name["fast"]["burn_long"] == 0.0
+
+    def test_evaluate_all_counts_one_evaluation(self):
+        cfg = _cfg(_avail())
+        evaluate_all(cfg, [(999.0, _req())], now=1000.0)
+        reg = telemetry.get_registry()
+        assert reg.counter("slo.evaluations").value == 1
+
+
+# ---------------------------------------------------------------------------
+# Burn-rate alert lifecycle (the engine's burn_rate rule kind)
+# ---------------------------------------------------------------------------
+class _Clock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def _burn_engine(clock, **kw):
+    kw.setdefault("slo_config", _cfg(_avail()))
+    return AlertEngine(
+        builtin_rules(["budget_burn"]), now_fn=clock, **kw
+    )
+
+
+def _feed(eng, clock, n_ok, n_bad):
+    evs = [dict(_req(), ts=clock.t) for _ in range(n_ok)]
+    evs += [dict(_req(ok=False), ts=clock.t) for _ in range(n_bad)]
+    eng._ingest(evs, clock.t)
+    return eng.poll(clock.t)
+
+
+class TestBurnRateLifecycle:
+    def test_full_outage_fires_both_pairs(self):
+        clock = _Clock()
+        eng = _burn_engine(clock)
+        trs = _feed(eng, clock, 0, 20)
+        assert sorted(t["key"] for t in trs) == [
+            "avail:fast", "avail:slow"
+        ]
+        assert {t["state"] for t in trs} == {"firing"}
+        assert trs[0]["objective"] == "avail"
+        assert trs[0]["budget_remaining"] == 0.0
+
+    def test_slow_leak_fires_slow_pair_only(self):
+        clock = _Clock()
+        eng = _burn_engine(clock)
+        _feed(eng, clock, 90, 10)
+        assert eng.firing() == [("budget_burn", "avail:slow")]
+
+    def test_recovery_resolves_without_flap(self):
+        clock = _Clock()
+        eng = _burn_engine(clock)
+        _feed(eng, clock, 0, 20)             # both pairs firing
+        # the bleeding stops: good traffic only.  The short windows go
+        # clean first; resolve_seconds (15) must pass before the alert
+        # resolves, and it must not flap on the way down.
+        for _ in range(14):
+            clock.t += 5.0
+            _feed(eng, clock, 10, 0)
+        assert eng.firing() == []
+        states = [
+            (t["key"], t["state"]) for t in eng.transitions
+        ]
+        # exactly one firing and one resolved per pair — no flapping
+        assert states.count(("avail:fast", "firing")) == 1
+        assert states.count(("avail:fast", "resolved")) == 1
+        assert states.count(("avail:slow", "firing")) == 1
+        assert states.count(("avail:slow", "resolved")) == 1
+
+    def test_no_request_events_means_no_keys(self):
+        # gate-12a invariant: burn_rate is inert on streams with no
+        # typed request events — no data is never a fire
+        clock = _Clock()
+        eng = _burn_engine(clock)
+        eng._ingest(
+            [{"event": "micro_batch", "ts": clock.t, "docs": 4}],
+            clock.t,
+        )
+        assert eng.poll(clock.t) == []
+        assert eng.firing() == []
+
+    def test_rule_pinned_to_one_objective(self):
+        clock = _Clock()
+        cfg = _cfg(_avail("a"), _avail("b", event="req2"))
+        eng = AlertEngine(
+            builtin_rules(
+                ["budget_burn"], {"budget_burn": {"slo": "b"}}
+            ),
+            now_fn=clock, slo_config=cfg,
+        )
+        _feed(eng, clock, 0, 20)             # objective "a" burns hard
+        assert eng.firing() == []            # the rule only watches "b"
+
+    def test_status_change_emits_slo_status_event(self, tmp_path):
+        stream = str(tmp_path / "slo_run.jsonl")
+        telemetry.configure(stream, run_id="t")
+        clock = _Clock()
+        eng = _burn_engine(clock)
+        _feed(eng, clock, 0, 20)
+        _feed(eng, clock, 0, 20)             # same status: no re-emit
+        telemetry.shutdown()
+        _, events = load_run(stream)
+        st = [e for e in events if e.get("event") == "slo_status"]
+        assert [e["status"] for e in st] == ["exhausted"]
+        slh = slo_health(events, run_metrics(events))
+        assert slh is not None
+        assert slh["objectives_burning"] == 1
+        assert slh["objectives"][0]["objective"] == "avail"
+
+
+# ---------------------------------------------------------------------------
+# `stc metrics slo` + `monitor --once` determinism (event-time eval)
+# ---------------------------------------------------------------------------
+def _probe_stream(path, bad_seconds=0.35, base=1_700_000_000.0):
+    """18 probe_request events at 3/s, alternating slow/fast — the CI
+    drill's shape (compression 400: fast pair 9 s / 0.75 s)."""
+    with open(path, "w") as f:
+        for i in range(18):
+            e = {
+                "event": "probe_request", "ts": base + i / 3.0,
+                "outcome": "ok", "status": 200,
+                "seconds": bad_seconds if i % 2 == 0 else 0.01,
+                "replica": i % 2, "generation": 1000,
+                "pin_violation": False,
+            }
+            f.write(json.dumps(e) + "\n")
+
+
+class TestSloCli:
+    def test_fail_on_burn_exits_1_on_degraded_stream(
+        self, tmp_path, capsys
+    ):
+        from spark_text_clustering_tpu.cli import main
+
+        p = str(tmp_path / "probe.jsonl")
+        _probe_stream(p)
+        rc = main(["metrics", "slo", p, "--compression", "400",
+                   "--fail-on-burn", "--json"])
+        doc = json.loads(capsys.readouterr().out)
+        assert rc == 1
+        pl = doc["objectives"]["probe_latency"]
+        assert pl["status"] == "exhausted"
+        by_name = {w["name"]: w for w in pl["windows"]}
+        assert by_name["fast"]["burning"]
+        assert doc["objectives"]["probe_availability"]["status"] == "ok"
+
+    def test_clean_stream_exits_0_with_full_budget(
+        self, tmp_path, capsys
+    ):
+        from spark_text_clustering_tpu.cli import main
+
+        p = str(tmp_path / "probe.jsonl")
+        _probe_stream(p, bad_seconds=0.01)
+        rc = main(["metrics", "slo", p, "--compression", "400",
+                   "--fail-on-burn", "--json"])
+        doc = json.loads(capsys.readouterr().out)
+        assert rc == 0
+        assert doc["objectives"]["probe_latency"][
+            "budget_remaining"] == 1.0
+
+    def test_no_timestamped_events_exits_2(self, tmp_path, capsys):
+        from spark_text_clustering_tpu.cli import main
+
+        p = str(tmp_path / "empty.jsonl")
+        with open(p, "w") as f:
+            f.write(json.dumps({"event": "probe_request"}) + "\n")
+        rc = main(["metrics", "slo", p])
+        capsys.readouterr()
+        assert rc == 2
+
+    def test_monitor_once_is_deterministic(self, tmp_path, capsys):
+        # once() evaluates at event time (now = newest ts), so the same
+        # stream fires the same alerts no matter when the verb runs
+        from spark_text_clustering_tpu.cli import main
+
+        p = str(tmp_path / "probe.jsonl")
+        _probe_stream(p)
+        fired = []
+        for i in range(2):
+            alerts = str(tmp_path / f"alerts{i}.jsonl")
+            rc = main([
+                "monitor", "--once", "--stream", p,
+                "--builtin", "budget_burn", "--slo-compression", "400",
+                "--fail-on-alert", "--quiet", "--alerts-file", alerts,
+            ])
+            capsys.readouterr()
+            assert rc == 1
+            with open(alerts) as f:
+                recs = [json.loads(ln) for ln in f if ln.strip()]
+            fired.append(sorted(
+                r["record"]["key"] if "record" in r else r["key"]
+                for r in recs
+            ))
+        assert fired[0] == fired[1]
+        assert fired[0] == [
+            "probe_latency:fast", "probe_latency:slow"
+        ]
+
+    def test_assemble_slo_config(self, tmp_path):
+        assert assemble_slo_config(None, None) is None
+        cfg = assemble_slo_config(None, 400.0)
+        assert cfg.compression == 400.0
+        f = tmp_path / "slo.json"
+        f.write_text(json.dumps(
+            [{"name": "probe_latency", "target": 0.95}]
+        ))
+        cfg = assemble_slo_config(str(f), 10.0)
+        assert cfg.objectives[0].target == 0.95
+        assert cfg.compression == 10.0
+
+    def test_monitor_bad_slo_file_exits_2(self, tmp_path, capsys):
+        from spark_text_clustering_tpu.cli import main
+
+        p = str(tmp_path / "probe.jsonl")
+        _probe_stream(p)
+        bad = tmp_path / "bad_slo.json"
+        bad.write_text("{not json")
+        rc = main(["monitor", "--once", "--stream", p,
+                   "--slo", str(bad)])
+        capsys.readouterr()
+        assert rc == 2
+
+
+# ---------------------------------------------------------------------------
+# Front request accounting (every route() exit path)
+# ---------------------------------------------------------------------------
+class _StubReplica:
+    """One fake serve replica answering /score with a fixed status."""
+
+    def __init__(self, status=200, generation=1000):
+        stub = self
+
+        class H(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):  # noqa: A003
+                pass
+
+            def do_POST(self):  # noqa: N802
+                n = int(self.headers.get("Content-Length", "0"))
+                self.rfile.read(n)
+                body = json.dumps(
+                    {"results": [{"name": "d", "topic": 0}]}
+                ).encode()
+                self.send_response(stub.status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.send_header(GENERATION_HEADER,
+                                 str(stub.generation))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self.status = status
+        self.generation = generation
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        self.httpd.daemon_threads = True
+        self.port = self.httpd.server_address[1]
+        threading.Thread(
+            target=self.httpd.serve_forever, daemon=True
+        ).start()
+
+    def close(self):
+        self.httpd.shutdown()
+
+
+def _write_lease(fleet, index, **fields):
+    lease_dir = os.path.join(str(fleet), "leases")
+    os.makedirs(lease_dir, exist_ok=True)
+    payload = {
+        "pid": os.getpid(), "worker": index, "generation": 0,
+        "spawn_id": index, "ts": __import__("time").time(),
+        "role": "serve", "state": "ready", "port": 40000 + index,
+        "model_path": "/models/LdaModel_EN_1000",
+        "model_stamp": 1000, "queue_depth": 0,
+    }
+    payload.update(fields)
+    with open(os.path.join(lease_dir, f"w{index:03d}.json"),
+              "w") as f:
+        json.dump(payload, f)
+
+
+class TestFrontAccounting:
+    def _router(self, tmp_path, **kw):
+        kw.setdefault("refresh_s", 0.0)
+        kw.setdefault("wait_for_replica_s", 0.0)
+        kw.setdefault("retry_wait_s", 0.0)
+        return FrontRouter(str(tmp_path), **kw)
+
+    def _counters(self):
+        snap = telemetry.get_registry().snapshot()["counters"]
+        return {
+            k.split(".")[-1]: v for k, v in snap.items()
+            if k.startswith("front.request_outcomes.")
+        }
+
+    def test_ok_path_counts_outcome_and_event(self, tmp_path):
+        stream = str(tmp_path / "front.jsonl")
+        telemetry.configure(stream, run_id="t")
+        stub = _StubReplica()
+        try:
+            _write_lease(tmp_path, 0, port=stub.port)
+            r = self._router(tmp_path)
+            status, _, _, idx = r.route(b"{}")
+            assert status == 200 and idx == 0
+        finally:
+            stub.close()
+        assert self._counters() == {"ok": 1}
+        reg = telemetry.get_registry()
+        assert reg.histogram("front.request_seconds").count == 1
+        telemetry.shutdown()
+        _, events = load_run(stream)
+        fr = [e for e in events if e.get("event") == "front_request"]
+        assert len(fr) == 1
+        assert fr[0]["outcome"] == "ok" and fr[0]["status"] == 200
+        assert fr[0]["replica"] == 0 and fr[0]["seconds"] >= 0.0
+
+    def test_no_replica_path_accounts(self, tmp_path):
+        r = self._router(tmp_path)          # empty fleet dir
+        with pytest.raises(NoReplicaAvailable):
+            r.route(b"{}")
+        assert self._counters() == {"no_replica": 1}
+        reg = telemetry.get_registry()
+        assert reg.histogram("front.request_seconds").count == 1
+
+    def test_retry_exhausted_path_accounts(self, tmp_path):
+        # a lease pointing at a closed port: connection-level failure,
+        # zero wait budget -> retry_exhausted on the raise path
+        stub = _StubReplica()
+        stub.close()                        # port now refuses
+        _write_lease(tmp_path, 0, port=stub.port)
+        r = self._router(tmp_path)
+        with pytest.raises(NoReplicaAvailable):
+            r.route(b"{}")
+        assert self._counters() == {"retry_exhausted": 1}
+
+    def test_error_status_path_accounts(self, tmp_path):
+        # a replica stuck answering 503 past the deadline: the returned
+        # 503 is an error_status outcome, not an ok
+        stub = _StubReplica(status=503)
+        try:
+            _write_lease(tmp_path, 0, port=stub.port)
+            r = self._router(tmp_path)
+            status, _, _, _ = r.route(b"{}")
+            assert status == 503
+        finally:
+            stub.close()
+        assert self._counters() == {"error_status": 1}
+
+    def test_healthz_degrades_on_firing_alerts(self, tmp_path):
+        from spark_text_clustering_tpu.telemetry.alerts import AlertLog
+
+        alerts = str(tmp_path / "alerts.jsonl")
+        log = AlertLog(alerts)
+        log.append(
+            rule="budget_burn", key="probe_latency:fast",
+            state="firing", ts=1.0,
+        )
+        stub = _StubReplica()
+        try:
+            _write_lease(tmp_path, 0, port=stub.port)
+            r = self._router(tmp_path, alerts_file=alerts)
+            h = r.health()
+            assert h["ready"] == 1
+            assert h["status"] == "degraded"
+            assert h["alerts"]["firing"][0]["rule"] == "budget_burn"
+            # without the alerts file the same fleet reads ok
+            h2 = self._router(tmp_path).health()
+            assert h2["status"] == "ok" and "alerts" not in h2
+        finally:
+            stub.close()
+
+
+# ---------------------------------------------------------------------------
+# Prometheus cumulative buckets
+# ---------------------------------------------------------------------------
+class TestPrometheusBuckets:
+    def test_cumulative_bucket_rendering(self):
+        reg = MetricRegistry()
+        h = reg.histogram("front.request_seconds", buckets=[0.1, 1.0])
+        for v in (0.05, 0.5, 5.0):
+            h.observe(v)
+        text = prometheus.render(
+            reg.snapshot(include_buckets=True), buckets=True
+        )
+        assert "# TYPE stc_front_request_seconds histogram" in text
+        assert 'stc_front_request_seconds_bucket{le="0.1"} 1' in text
+        assert 'stc_front_request_seconds_bucket{le="1"} 2' in text
+        assert 'stc_front_request_seconds_bucket{le="+Inf"} 3' in text
+        assert "stc_front_request_seconds_count 3" in text
+
+    def test_summary_fallback_without_bucket_data(self):
+        reg = MetricRegistry()
+        reg.histogram("x.seconds", buckets=[0.1, 1.0]).observe(0.5)
+        # snapshot without buckets, or render without buckets=True:
+        # both fall back to the summary mapping
+        t1 = prometheus.render(reg.snapshot(), buckets=True)
+        t2 = prometheus.render(
+            reg.snapshot(include_buckets=True)
+        )
+        for text in (t1, t2):
+            assert "# TYPE stc_x_seconds summary" in text
+            assert "_bucket{" not in text
+
+    def test_replica_label_survives_bucket_mode(self):
+        reg = MetricRegistry()
+        reg.histogram(
+            "front.replica.2.request_seconds", buckets=[0.1]
+        ).observe(0.05)
+        text = prometheus.render(
+            reg.snapshot(include_buckets=True), buckets=True
+        )
+        assert ('stc_front_replica_request_seconds_bucket'
+                '{le="0.1",replica="2"} 1') in text
+
+    def test_fraction_under_matches_stream_classification(self):
+        # the cross-check the bucket-aligned thresholds exist for: the
+        # same latencies classified per-event and re-derived from the
+        # histogram's cumulative buckets agree exactly
+        obj = SLOObjective(
+            name="lat", event="req", kind="latency",
+            threshold_seconds=DEFAULT_LATENCY_THRESHOLD,
+        )
+        reg = MetricRegistry()
+        h = reg.histogram("req.seconds")
+        lats = [0.01, 0.1, 0.32768, 0.35, 0.5, 1.0]
+        good_stream = 0
+        for v in lats:
+            h.observe(v)
+            if classify(obj, {"event": "req", "seconds": v}):
+                good_stream += 1
+        snap = reg.snapshot(include_buckets=True)
+        frac = fraction_under(
+            snap["histograms"]["req.seconds"]["buckets"],
+            snap["histograms"]["req.seconds"]["bucket_counts"],
+            DEFAULT_LATENCY_THRESHOLD,
+        )
+        assert frac == pytest.approx(good_stream / len(lats))
+        assert fraction_under([0.1], [0, 0], 0.1) is None
+
+
+# ---------------------------------------------------------------------------
+# Queueing estimator (Erlang-C + the windowed triple)
+# ---------------------------------------------------------------------------
+class TestQueueingMath:
+    def test_erlang_c_known_values(self):
+        # M/M/1 at rho=0.5 -> P(wait) = rho = 0.5; M/M/2 at a=1 -> 1/3
+        assert erlang_c(1, 0.5) == pytest.approx(0.5)
+        assert erlang_c(2, 1.0) == pytest.approx(1.0 / 3.0)
+        assert erlang_c(4, 0.0) == 0.0
+        assert erlang_c(2, 2.5) == 1.0      # saturated: all wait
+
+    def test_predicted_waits_known_values(self):
+        # c=2, lam=10/s, S=0.1s -> a=1, drain=(2-1)/0.1=10/s,
+        # mean = (1/3)/10, p99 = ln((1/3)/0.01)/10
+        mean, p99 = predicted_waits(2, 10.0, 0.1)
+        assert mean == pytest.approx(1.0 / 30.0)
+        assert p99 == pytest.approx(math.log(100.0 / 3.0) / 10.0)
+        assert predicted_waits(2, 30.0, 0.1) == (math.inf, math.inf)
+        assert predicted_waits(2, 10.0, 0.0) == (0.0, 0.0)
+
+
+def _batch(ts, docs, seconds, wait, stream):
+    return ts, {
+        "event": "serve_batch", "docs": docs, "seconds": seconds,
+        "wait": wait, "_stream": stream,
+    }
+
+
+class TestQueueingEstimator:
+    def test_no_signal_returns_none(self):
+        est = QueueingEstimator()
+        assert est.estimate(1000.0) is None
+
+    def test_triple_and_divergence_published(self, tmp_path):
+        est = QueueingEstimator(window_seconds=30.0)
+        now = 1000.0
+        # 60 arrivals over the last 30s (lambda=2/s), service 0.05 s/doc
+        # split across two replicas
+        for i in range(60):
+            est.observe_event(
+                now - 30.0 + i / 2.0,
+                {"event": "front_request", "outcome": "ok"},
+            )
+        est.observe_events([
+            _batch(now - 20.0, 10, 0.5, 0.01, "worker-w000-s0.jsonl"),
+            _batch(now - 10.0, 10, 0.5, 0.03, "worker-w001-s1.jsonl"),
+        ])
+        ev = est.estimate(now)
+        assert ev["event"] == "queueing_estimate"
+        assert ev["lambda"] == pytest.approx(2.0, rel=0.05)
+        assert ev["replicas"] == 2
+        assert ev["service_seconds"] == pytest.approx(0.05)
+        assert ev["rho"] == pytest.approx(
+            ev["lambda"] * 0.05 / 2, rel=1e-6
+        )
+        assert ev["measured_wait_seconds"] == pytest.approx(0.02)
+        assert ev["wait_divergence"] > 0.0
+        reg = telemetry.get_registry()
+        assert reg.gauge("queueing.lambda").value == pytest.approx(
+            ev["lambda"]
+        )
+        assert reg.gauge("queueing.replica.0.rho").value == \
+            pytest.approx(0.5 / 30.0, rel=0.05)
+        assert reg.counter("queueing.updates").value == 1
+
+    def test_saturation_caps_at_window(self):
+        est = QueueingEstimator(window_seconds=30.0, replica_count=1)
+        now = 1000.0
+        for i in range(100):
+            est.note_arrivals(1, now - 10.0 + i / 10.0)
+        est.observe_event(
+            now - 5.0,
+            {"event": "serve_batch", "docs": 10, "seconds": 5.0,
+             "wait": 1.0},
+        )
+        ev = est.estimate(now)
+        # lambda * S >> c: no steady state; the published prediction is
+        # capped at the window instead of inf
+        assert ev["rho"] > 1.0
+        assert ev["predicted_wait_seconds"] == 30.0
+        assert ev["predicted_wait_p99_seconds"] == 30.0
+
+    def test_window_prunes_old_samples(self):
+        est = QueueingEstimator(window_seconds=30.0)
+        est.note_arrivals(100, 100.0)
+        est.observe_event(
+            100.0, {"event": "serve_batch", "docs": 5, "seconds": 0.1},
+        )
+        assert est.estimate(1000.0) is None
+
+
+# ---------------------------------------------------------------------------
+# The black-box prober
+# ---------------------------------------------------------------------------
+class _StubFront:
+    """A fake front answering /score with a scripted generation per
+    request (to provoke pin regressions)."""
+
+    def __init__(self, generations):
+        stub = self
+
+        class H(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):  # noqa: A003
+                pass
+
+            def do_POST(self):  # noqa: N802
+                n = int(self.headers.get("Content-Length", "0"))
+                stub.bodies.append(json.loads(self.rfile.read(n)))
+                g = stub.generations[
+                    min(len(stub.bodies) - 1,
+                        len(stub.generations) - 1)
+                ]
+                body = json.dumps({"results": []}).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.send_header(REPLICA_HEADER, "0")
+                self.send_header(GENERATION_HEADER, str(g))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self.generations = list(generations)
+        self.bodies = []
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        self.httpd.daemon_threads = True
+        self.port = self.httpd.server_address[1]
+        threading.Thread(
+            target=self.httpd.serve_forever, daemon=True
+        ).start()
+
+    def close(self):
+        self.httpd.shutdown()
+
+
+class TestProber:
+    def test_ok_probes_and_sentinel_body(self):
+        stub = _StubFront([1000, 1000])
+        try:
+            p = Prober("127.0.0.1", stub.port)
+            rec = p.probe_once()
+            p.probe_once()
+        finally:
+            stub.close()
+        assert rec["outcome"] == "ok" and rec["status"] == 200
+        assert rec["replica"] == 0 and rec["generation"] == 1000
+        assert not rec["pin_violation"]
+        assert p.sent == 2 and p.failures == 0
+        assert stub.bodies[0]["text"] == SENTINEL_TEXT
+        reg = telemetry.get_registry()
+        assert reg.counter("probe.requests").value == 2
+        assert reg.histogram("probe.request_seconds").count == 2
+
+    def test_generation_regression_is_a_pin_violation(self):
+        # 1000 -> 1001 -> 1000: the third answer regresses behind the
+        # stream's pin — the broken-swap signature seen from outside
+        stub = _StubFront([1000, 1001, 1000, 1001])
+        try:
+            p = Prober("127.0.0.1", stub.port)
+            recs = [p.probe_once() for _ in range(4)]
+        finally:
+            stub.close()
+        assert [r["pin_violation"] for r in recs] == [
+            False, False, True, False
+        ]
+        assert p.pin_violations == 1
+        reg = telemetry.get_registry()
+        assert reg.counter("probe.pin_violations").value == 1
+        assert reg.counter("probe.failures").value == 0
+
+    def test_dead_front_is_an_error_outcome_not_a_raise(self):
+        stub = _StubFront([1000])
+        stub.close()                        # port refuses now
+        p = Prober("127.0.0.1", stub.port, timeout=0.5)
+        rec = p.probe_once()
+        assert rec["outcome"] == "error" and rec["status"] is None
+        assert p.failures == 1
+        reg = telemetry.get_registry()
+        assert reg.counter("probe.failures").value == 1
+
+    def test_run_paces_count(self):
+        stub = _StubFront([1000])
+        try:
+            p = Prober("127.0.0.1", stub.port)
+            rep = p.run(count=3, rate=1000.0)
+        finally:
+            stub.close()
+        assert rep == {"sent": 3, "failures": 0, "pin_violations": 0}
+
+    def test_read_front_announce(self, tmp_path):
+        from spark_text_clustering_tpu.serving.front import (
+            write_front_announce,
+        )
+
+        with pytest.raises(RuntimeError, match="no front announce"):
+            read_front_announce(str(tmp_path), wait_s=0.05)
+        write_front_announce(str(tmp_path), "127.0.0.1", 12345)
+        assert read_front_announce(str(tmp_path), wait_s=0.05) == (
+            "127.0.0.1", 12345
+        )
+
+    def test_default_stream_header(self):
+        assert DEFAULT_STREAM == "stc-probe"
+
+
+# ---------------------------------------------------------------------------
+# The `slow` fault kind (the latency-SLO drill's chaos primitive)
+# ---------------------------------------------------------------------------
+class TestSlowFault:
+    def test_slow_sleeps_every_hit_and_never_raises(self, monkeypatch):
+        from spark_text_clustering_tpu.resilience import retry
+
+        slept = []
+        monkeypatch.setattr(retry, "sleep", slept.append)
+        faultinject.configure("serve.batch:slow@0.35")
+        for _ in range(3):
+            faultinject.check("serve.batch")     # must not raise
+        assert slept == [0.35, 0.35, 0.35]
+        # other sites stay untouched
+        faultinject.check("serve.accept")
+        assert len(slept) == 3
+
+    def test_slow_default_arg_and_registry(self):
+        assert "slow" in faultinject.KINDS
+        plan = faultinject.FaultPlan("serve.batch:slow")
+        assert plan.rules["serve.batch"][0].arg == 1.0
+        assert plan.rules["serve.batch"][0].should_fire()
+        assert plan.rules["serve.batch"][0].should_fire()
