@@ -154,9 +154,25 @@
 #      queueing.lambda/rho; the probe stream's exact request counter
 #      and the monitor run's slo.evaluations gate against the
 #      committed baseline
+#  19. protocol audit (`stc lint --protocol`, analysis/protocol_audit,
+#      docs/STATIC_ANALYSIS.md "Protocol audit"): the fleet's lock
+#      discipline and shared-file protocols checked statically on
+#      rules STC300-305 — cross-module lock-order cycles and blocking
+#      calls under held locks, thread-shared attributes escaping their
+#      lock, writes to lease/ledger/control/announce paths outside the
+#      registered atomic-publish writers, reads outside the registered
+#      torn-read-tolerant readers, fsync-before-rename durability, and
+#      writer/reader schema conformance over the supervisor<->front
+#      lease pair and the supervisor<->replica control pair — both
+#      directions against the analysis/protocol_sites.py registry
+#      (unregistered touchpoints AND stale registry entries are
+#      findings); the run's lint.protocol_* counters gate against the
+#      committed baseline, and a planted two-lock cycle (STC300), a
+#      planted bare lease write (STC302), and a planted never-emitted
+#      required field (STC305) must ALL gate red (self-test)
 #
 # Usage:
-#   scripts/ci_check.sh                 # run all eighteen gates
+#   scripts/ci_check.sh                 # run all nineteen gates
 #   scripts/ci_check.sh --rebaseline    # recapture ALL baselines
 #                                       # (metrics + lint waivers +
 #                                       # lint counters + scale record
@@ -1473,11 +1489,12 @@ EOF
 }
 
 if [[ "${1:-}" == "--rebaseline" ]]; then
-    # --scale: regenerate the waiver allowlist AND the committed scale
-    # evidence record (scripts/records/scale_baseline.json) together —
-    # a waiver-only rewrite would drop the scale:* entries
-    python -m spark_text_clustering_tpu.cli lint --scale --rebaseline \
-        || exit 1
+    # --scale --protocol: regenerate the waiver allowlist AND the
+    # committed scale evidence record (scripts/records/
+    # scale_baseline.json) together — a partial rewrite would drop the
+    # scale:* / protocol:* entries of the layer that did not run
+    python -m spark_text_clustering_tpu.cli lint --scale --protocol \
+        --rebaseline || exit 1
     work=$(mktemp -d)
     trap 'rm -rf "$work"' EXIT
     run_ci_train "$work" || exit 1
@@ -1487,17 +1504,25 @@ if [[ "${1:-}" == "--rebaseline" ]]; then
     # fold the lint counters into the same baseline (partial capture:
     # only the lint. family is refreshed, training entries stay put);
     # the plain stream owns lint.findings/waived, the gate-15 scale
-    # stream owns lint.scale_*
+    # stream owns lint.scale_*, the gate-19 protocol stream owns
+    # lint.protocol_*
     python -m spark_text_clustering_tpu.cli lint \
         --telemetry-file "$work/lint.jsonl" >/dev/null || exit 1
     python -m spark_text_clustering_tpu.cli metrics check "$work/lint.jsonl" \
         --baseline "$BASELINE" --write-baseline --tolerance 0.0 \
-        --include lint. --exclude lint.scale || exit 1
+        --include lint. --exclude lint.scale --exclude lint.protocol \
+        || exit 1
     python -m spark_text_clustering_tpu.cli lint --scale \
         --telemetry-file "$work/lint_scale.jsonl" >/dev/null || exit 1
     python -m spark_text_clustering_tpu.cli metrics check \
         "$work/lint_scale.jsonl" --baseline "$BASELINE" \
         --write-baseline --tolerance 0.0 --include lint.scale || exit 1
+    python -m spark_text_clustering_tpu.cli lint --no-jaxpr --protocol \
+        --telemetry-file "$work/lint_protocol.jsonl" >/dev/null || exit 1
+    python -m spark_text_clustering_tpu.cli metrics check \
+        "$work/lint_protocol.jsonl" --baseline "$BASELINE" \
+        --write-baseline --tolerance 0.0 --include lint.protocol \
+        || exit 1
     # re-run the measured-scale probe, re-commit the measured twin
     # section of the scale record, and fold the gate-16 counters
     python -m spark_text_clustering_tpu.cli metrics scale-check --run \
@@ -1586,12 +1611,12 @@ fail=0
 work=$(mktemp -d)
 trap 'rm -rf "$work"' EXIT
 
-echo "== [1/18] stc lint (AST rules + jaxpr audit) =="
+echo "== [1/19] stc lint (AST rules + jaxpr audit) =="
 python -m spark_text_clustering_tpu.cli lint \
     --telemetry-file "$work/lint.jsonl"
 if [[ $? -ne 0 ]]; then echo "FAIL: stc lint"; fail=1; fi
 
-echo "== [2/18] ruff (generic-Python tier) =="
+echo "== [2/19] ruff (generic-Python tier) =="
 if command -v ruff >/dev/null 2>&1; then
     ruff check spark_text_clustering_tpu
     if [[ $? -ne 0 ]]; then echo "FAIL: ruff"; fail=1; fi
@@ -1599,17 +1624,17 @@ else
     echo "ruff not installed — skipped (stc lint STC101/102/006 cover it)"
 fi
 
-echo "== [3/18] tier-1 tests =="
+echo "== [3/19] tier-1 tests =="
 timeout -k 10 870 python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors -p no:cacheprovider \
     -p no:xdist -p no:randomly
 if [[ $? -ne 0 ]]; then echo "FAIL: tier-1"; fail=1; fi
 
-echo "== [4/18] telemetry overhead budget =="
+echo "== [4/19] telemetry overhead budget =="
 python scripts/check_telemetry_overhead.py
 if [[ $? -ne 0 ]]; then echo "FAIL: telemetry overhead"; fail=1; fi
 
-echo "== [5/18] metrics regression gate =="
+echo "== [5/19] metrics regression gate =="
 if run_ci_train "$work"; then
     # lint., ledger., fleet., serve., and alert. families are captured
     # by their own gates (1/6, 8, 10, 11, and 12) — a batch train run
@@ -1626,18 +1651,20 @@ else
     fail=1
 fi
 
-echo "== [6/18] lint metrics gate (waiver count version-gated) =="
+echo "== [6/19] lint metrics gate (waiver count version-gated) =="
 if [[ -s "$work/lint.jsonl" ]]; then
-    # lint.scale_* belong to the gate-15 --scale stream, not stage 1's
+    # lint.scale_* belong to the gate-15 --scale stream and
+    # lint.protocol_* to the gate-19 --protocol stream, not stage 1's
     python -m spark_text_clustering_tpu.cli metrics check "$work/lint.jsonl" \
-        --baseline "$BASELINE" --include lint. --exclude lint.scale
+        --baseline "$BASELINE" --include lint. --exclude lint.scale \
+        --exclude lint.protocol
     if [[ $? -ne 0 ]]; then echo "FAIL: lint metrics check"; fail=1; fi
 else
     echo "FAIL: no lint telemetry stream from stage 1"
     fail=1
 fi
 
-echo "== [7/18] cross-host skew gate (metrics merge) =="
+echo "== [7/19] cross-host skew gate (metrics merge) =="
 if make_skew_streams "$work"; then
     python -m spark_text_clustering_tpu.cli metrics merge \
         "$work/skew-p0.jsonl" "$work/skew-p1.jsonl" --fail-on-skew \
@@ -1658,7 +1685,7 @@ else
     fail=1
 fi
 
-echo "== [8/18] exactly-once ledger chaos drill (STC_FAULTS) =="
+echo "== [8/19] exactly-once ledger chaos drill (STC_FAULTS) =="
 if run_ledger_drill "$work"; then
     python -m spark_text_clustering_tpu.cli metrics check \
         "$work/ledger_drill.jsonl" --baseline "$BASELINE" \
@@ -1669,7 +1696,7 @@ else
     fail=1
 fi
 
-echo "== [9/18] recompile sentinel (metrics compile-check) =="
+echo "== [9/19] recompile sentinel (metrics compile-check) =="
 if [[ -s "$work/run.jsonl" ]] && run_ci_score "$work" \
     && run_ci_nmf "$work"; then
     python -m spark_text_clustering_tpu.cli metrics compile-check \
@@ -1696,7 +1723,7 @@ else
     fail=1
 fi
 
-echo "== [10/18] supervisor drill (lease expiry -> SIGKILL -> respawn) =="
+echo "== [10/19] supervisor drill (lease expiry -> SIGKILL -> respawn) =="
 if run_supervisor_drill "$work"; then
     # the ladder's counters are deterministic: 3 spawns (2 + 1
     # respawn), 1 lease expiry, 1 preemption (the drain SIGTERM the
@@ -1710,7 +1737,7 @@ else
     fail=1
 fi
 
-echo "== [11/18] serve drill (hot-swap + drain + zero-recompile) =="
+echo "== [11/19] serve drill (hot-swap + drain + zero-recompile) =="
 if [[ -d "$work/models" ]] && run_serve_drill "$work"; then
     # requests (32 = two exact 16-doc volleys) and swaps (1) are
     # machine-independent; batch counts depend on coalescing timing
@@ -1724,7 +1751,7 @@ else
     fail=1
 fi
 
-echo "== [12/18] monitor drill (alerts fire/resolve + resize-on-alert) =="
+echo "== [12/19] monitor drill (alerts fire/resolve + resize-on-alert) =="
 if run_monitor_once_drill "$work"; then
     # the --once storm run's alert counters are deterministic: exactly
     # one firing (retrace_storm), nothing pending/resolved
@@ -1745,7 +1772,7 @@ if ! run_monitor_resize_drill "$work"; then
     fail=1
 fi
 
-echo "== [13/18] executable-cache cold-start drill (compilecache) =="
+echo "== [13/19] executable-cache cold-start drill (compilecache) =="
 if [[ -d "$work/models" ]] && run_cold_start_drill "$work"; then
     # the warm B run's cache counters are deterministic: one hit per
     # score-path digest, zero misses/stores/invalidations
@@ -1758,7 +1785,7 @@ else
     fail=1
 fi
 
-echo "== [14/18] end-to-end lineage drill (causal tracing) =="
+echo "== [14/19] end-to-end lineage drill (causal tracing) =="
 if run_lineage_drill "$work"; then
     # the serve run's trace counters are deterministic: ONE sampled
     # request, four emitted spans, nothing dropped
@@ -1771,7 +1798,7 @@ else
     fail=1
 fi
 
-echo "== [15/18] scale audit (stc lint --scale, STC210-215) =="
+echo "== [15/19] scale audit (stc lint --scale, STC210-215) =="
 python -m spark_text_clustering_tpu.cli lint --scale \
     --telemetry-file "$work/lint_scale.jsonl" >/dev/null
 if [[ $? -ne 0 ]]; then
@@ -1843,7 +1870,7 @@ if [[ $? -ne 0 ]]; then
     fail=1
 fi
 
-echo "== [16/18] measured-scale observatory (probe + scale-check) =="
+echo "== [16/19] measured-scale observatory (probe + scale-check) =="
 # run the sharded entry families for REAL on the forced 2x4 host mesh
 # and reconcile the measured evidence against the gate-15 static
 # record: sharding match, tolerance, zero retraces, V=10M
@@ -1899,7 +1926,7 @@ if [[ $? -ne 1 ]]; then
     fail=1
 fi
 
-echo "== [17/18] serve-fleet chaos drill (rolling publish + SIGKILL) =="
+echo "== [17/19] serve-fleet chaos drill (rolling publish + SIGKILL) =="
 if [[ -d "$work/models" ]] && run_serve_fleet_drill "$work"; then
     # the front's routed-request counter (48 = three exact 16-doc
     # volleys) and the fleet respawn counter (1 — consistent with the
@@ -1915,7 +1942,7 @@ else
     fail=1
 fi
 
-echo "== [18/18] SLO/probe drill (burn-rate gate + queueing observatory) =="
+echo "== [18/19] SLO/probe drill (burn-rate gate + queueing observatory) =="
 slo_ok=1
 if [[ -d "$work/models" ]] && run_slo_probe_drill "$work" degraded; then
     # the planted slow replica (0.35s > the 0.32768s objective line)
@@ -2014,6 +2041,148 @@ if [[ $slo_ok -eq 1 ]]; then
     done
 fi
 [[ $slo_ok -ne 1 ]] && fail=1
+
+echo "== [19/19] protocol audit (stc lint --protocol, STC300-305) =="
+python -m spark_text_clustering_tpu.cli lint --no-jaxpr --protocol \
+    --telemetry-file "$work/lint_protocol.jsonl" >/dev/null
+if [[ $? -ne 0 ]]; then
+    echo "FAIL: stc lint --protocol (rerun without >/dev/null for the report)"
+    fail=1
+fi
+if [[ -s "$work/lint_protocol.jsonl" ]]; then
+    # the protocol tier's coverage is version-gated: registered sites,
+    # unwaived findings (0), and the reasoned waiver count
+    python -m spark_text_clustering_tpu.cli metrics check \
+        "$work/lint_protocol.jsonl" --baseline "$BASELINE" \
+        --include lint.protocol
+    if [[ $? -ne 0 ]]; then echo "FAIL: protocol lint counters"; fail=1; fi
+else
+    echo "FAIL: no protocol lint telemetry stream"
+    fail=1
+fi
+# self-test: a planted two-lock cycle (STC300), a planted bare write
+# to a lease path (STC302), and a planted reader requiring a field no
+# writer emits (STC305) must ALL gate red — the protocol tier is only
+# a gate if the hazards it exists for actually trip it
+python - <<'EOF'
+import os, tempfile
+
+from spark_text_clustering_tpu.analysis import protocol_sites as ps
+from spark_text_clustering_tpu.analysis.protocol_audit import (
+    run_protocol_audit,
+)
+
+
+def plant(body):
+    root = tempfile.mkdtemp(prefix="stc300_selftest_")
+    pkg = os.path.join(root, "spark_text_clustering_tpu")
+    os.makedirs(pkg)
+    open(os.path.join(pkg, "__init__.py"), "w").close()
+    with open(os.path.join(pkg, "mod.py"), "w") as f:
+        f.write(body)
+    return root
+
+
+# two-lock cycle (fwd: a->b; back->helper: b->a) plus a blocking
+# sleep under a held lock
+root = plant('''
+import threading
+import time
+
+
+class Cycler:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def fwd(self):
+        with self._a:
+            with self._b:
+                pass
+
+    def back(self):
+        with self._b:
+            self.helper()
+
+    def helper(self):
+        with self._a:
+            time.sleep(1)
+''')
+f, rep = run_protocol_audit(root, ps.ProtocolSites(
+    threaded_modules=("spark_text_clustering_tpu/mod.py",),
+    path_literals=frozenset(), path_constants=frozenset(),
+    path_helpers=frozenset(), path_attrs=frozenset(),
+))
+assert sorted({x.rule for x in f}) == ["STC300"] \
+    and rep["lock_edges"] == 2, (
+        [(x.rule, x.message) for x in f], rep["lock_edges"])
+
+# bare (non-atomic, unregistered) write to a lease path
+root = plant('''
+def bare_write(d):
+    p = d + "/lease.json"
+    with open(p, "w") as f:
+        f.write("{}")
+''')
+f, _ = run_protocol_audit(root, ps.ProtocolSites(
+    threaded_modules=(),
+    path_literals=frozenset({"lease.json"}),
+    path_constants=frozenset(), path_helpers=frozenset(),
+    path_attrs=frozenset(),
+))
+assert [x.rule for x in f] == ["STC302"], [
+    (x.rule, x.message) for x in f
+]
+
+# reader requiring a field no writer emits
+root = plant('''
+import json
+
+
+def write_lease(path, worker):
+    from .util import atomic_write_text
+    atomic_write_text(path, json.dumps({"worker": worker, "ts": 1.0}))
+
+
+def read_lease(path):
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def consume(path):
+    lease = read_lease(path)
+    if lease is None:
+        return None
+    return lease["missing_field"], lease.get("worker")
+''')
+P = "spark_text_clustering_tpu/mod.py"
+f, rep = run_protocol_audit(root, ps.ProtocolSites(
+    threaded_modules=(),
+    path_literals=frozenset(), path_constants=frozenset(),
+    path_helpers=frozenset(), path_attrs=frozenset(),
+    writers=(ps.WriterSite(P, "write_lease"),),
+    readers=(ps.ReaderSite(P, "read_lease"),),
+    schema_pairs=(ps.SchemaPair(
+        name="lease", writers=((P, "write_lease"),),
+        readers=((P, "consume"),), reader_seed_calls=("read_lease",),
+    ),),
+))
+assert [x.rule for x in f] == ["STC305"], [
+    (x.rule, x.message) for x in f
+]
+assert rep["pairs"]["lease"]["missing"] == ["missing_field"], rep["pairs"]
+print(
+    "protocol self-test: planted STC300 lock cycle, STC302 bare lease "
+    "write, and STC305 schema drift all gate red"
+)
+EOF
+if [[ $? -ne 0 ]]; then
+    echo "FAIL: planted protocol violations not flagged"
+    fail=1
+fi
 
 if [[ $fail -ne 0 ]]; then
     echo "ci_check: FAILED"

@@ -4,6 +4,7 @@ Usage::
 
     python -m spark_text_clustering_tpu.cli lint                # layers 1+2
     python -m spark_text_clustering_tpu.cli lint --scale        # + layer 3
+    python -m spark_text_clustering_tpu.cli lint --protocol     # + layer 4
     python -m spark_text_clustering_tpu.cli lint --changed      # pre-commit
     python -m spark_text_clustering_tpu.cli lint --format json  # machine-readable
     python -m spark_text_clustering_tpu.cli lint --no-jaxpr     # AST layer only
@@ -13,16 +14,22 @@ Usage::
 every registered entry point traced abstractly at its declared
 V=10M/k=500 scale shapes, rules STC210-215, plus a drift gate against
 the committed ``scripts/records/scale_baseline.json`` evidence record.
-``--changed`` scopes the AST layer to git-changed files (and skips the
-trace layers unless a traced-surface file changed) — the fast
-pre-commit path; the full pass stays the CI gate.
+``--protocol`` adds the layer-4 protocol audit
+(``analysis.protocol_audit``): STC300-305 over the thread/shared-file
+coordination fabric, checked both directions against the
+``analysis.protocol_sites`` registry — pure AST, no jax import.
+``--changed`` scopes the AST layer to git-changed files (skips the
+trace layers unless a traced-surface file changed, and runs the
+protocol tier exactly when a registry-watched module changed) — the
+fast pre-commit path; the full pass stays the CI gate.
 
 Exit codes mirror ``metrics check``: 0 = clean (no unwaived findings),
 1 = findings, 2 = usage/config error.  Every run mirrors its outcome
 into the telemetry registry (``lint.findings`` / ``lint.waived``, plus
-``lint.scale_*`` under ``--scale``) and — with ``--telemetry-file`` —
-into a run stream the ``metrics`` verbs can diff, so analysis drift is
-observable the same way perf drift is.
+``lint.scale_*`` under ``--scale`` and ``lint.protocol_*`` under
+``--protocol``) and — with ``--telemetry-file`` — into a run stream
+the ``metrics`` verbs can diff, so analysis drift is observable the
+same way perf drift is.
 """
 
 from __future__ import annotations
@@ -88,13 +95,15 @@ def run_lint(
     *,
     jaxpr: bool = True,
     scale: bool = False,
+    protocol: bool = False,
     rules: Optional[List[str]] = None,
     baseline_path: Optional[str] = None,
     scale_baseline_path: Optional[str] = None,
     changed: Optional[Sequence[str]] = None,
 ):
     """Run the requested layers; returns
-    (findings, audited names, baseline, scale report | None).
+    (findings, audited names, baseline, scale report | None,
+    protocol report | None).
 
     Findings come back with pragma AND baseline waivers applied, plus
     any STC000 meta-findings (reasonless/stale waivers — stale checks
@@ -113,6 +122,16 @@ def run_lint(
         )
         jaxpr = jaxpr and trace_surface_changed
         scale = scale and trace_surface_changed
+        # protocol tier: cheap pure-AST, so under --changed it runs
+        # exactly when the protocol surface (a registry-watched module
+        # or the audit itself) changed — regardless of --protocol
+        from .protocol_sites import SITES
+
+        protocol_surface = SITES.watched_modules() | {
+            "spark_text_clustering_tpu/analysis/protocol_sites.py",
+            "spark_text_clustering_tpu/analysis/protocol_audit.py",
+        }
+        protocol = bool(keep_paths & protocol_surface)
     audited: List[str] = []
     if jaxpr:
         from .jaxpr_audit import run_jaxpr_audit
@@ -143,11 +162,24 @@ def run_lint(
             keep = set(rules)
             sf = [f for f in sf if f.rule in keep]
         findings.extend(sf)
+    protocol_report = None
+    if protocol:
+        from .protocol_audit import run_protocol_audit
+
+        pf, protocol_report = run_protocol_audit(root)
+        if rules:
+            keep = set(rules)
+            pf = [f for f in pf if f.rule in keep]
+        findings.extend(pf)
     bl_path = baseline_path or os.path.join(root, DEFAULT_BASELINE_PATH)
     baseline = Baseline.load(bl_path)
     exempt = tuple(
         p
-        for p, ran in (("jaxpr:", jaxpr), ("scale:", scale))
+        for p, ran in (
+            ("jaxpr:", jaxpr),
+            ("scale:", scale),
+            ("protocol:", protocol),
+        )
         if not ran
     )
     findings = apply_waivers(
@@ -156,7 +188,7 @@ def run_lint(
         check_stale=changed is None,
         stale_exempt_prefixes=exempt,
     )
-    return findings, audited, baseline, scale_report
+    return findings, audited, baseline, scale_report, protocol_report
 
 
 def cmd_lint(args: argparse.Namespace) -> int:
@@ -181,15 +213,17 @@ def cmd_lint(args: argparse.Namespace) -> int:
             print("stc lint --changed: no changed files — clean")
             return 0
 
-    findings, audited, baseline, scale_report = run_lint(
-        root,
-        jaxpr=not args.no_jaxpr,
-        scale=args.scale,
-        rules=rules,
-        baseline_path=bl_path,
-        scale_baseline_path=args.scale_baseline,
-        changed=changed,
-    )
+    findings, audited, baseline, scale_report, protocol_report = \
+        run_lint(
+            root,
+            jaxpr=not args.no_jaxpr,
+            scale=args.scale,
+            protocol=args.protocol,
+            rules=rules,
+            baseline_path=bl_path,
+            scale_baseline_path=args.scale_baseline,
+            changed=changed,
+        )
 
     if args.rebaseline:
         # keep reasons for entries that still match; new findings get an
@@ -255,6 +289,21 @@ def cmd_lint(args: argparse.Namespace) -> int:
         telemetry.count(
             "lint.scale_waived", len([f for f in scale_f if f.waived])
         )
+    if protocol_report is not None:
+        proto_f = [
+            f for f in findings if f.path.startswith("protocol:")
+        ]
+        telemetry.count(
+            "lint.protocol_sites", protocol_report["sites"]
+        )
+        telemetry.count(
+            "lint.protocol_findings",
+            len([f for f in proto_f if not f.waived]),
+        )
+        telemetry.count(
+            "lint.protocol_waived",
+            len([f for f in proto_f if f.waived]),
+        )
     if own_telemetry:
         telemetry.event(
             "lint_run",
@@ -264,13 +313,16 @@ def cmd_lint(args: argparse.Namespace) -> int:
             scale_entries=(
                 len(scale_report["entries"]) if scale_report else 0
             ),
+            protocol_sites=(
+                protocol_report["sites"] if protocol_report else 0
+            ),
         )
         telemetry.shutdown()
 
     out = (
-        render_json(findings, audited, scale_report)
+        render_json(findings, audited, scale_report, protocol_report)
         if args.format == "json"
-        else render_text(findings, audited, scale_report)
+        else render_text(findings, audited, scale_report, protocol_report)
     )
     print(out)
     return 1 if unwaived else 0
@@ -302,11 +354,20 @@ def add_lint_subparser(sub) -> None:
              "and enforce STC210-215 + the committed scale record",
     )
     p.add_argument(
+        "--protocol", action="store_true",
+        help="add layer 4: the STC300-305 concurrency & shared-file "
+             "protocol audit (lock graph, thread escapes, atomic "
+             "publish, torn-read tolerance, fsync ordering, "
+             "writer/reader schema conformance) against the "
+             "analysis/protocol_sites.py registry — pure AST",
+    )
+    p.add_argument(
         "--changed", action="store_true",
         help="diff-scoped fast mode: AST rules on git-changed files "
              "only; trace layers run only when a traced surface "
-             "(analysis/models/ops/parallel) changed — the pre-commit "
-             "path (docs/STATIC_ANALYSIS.md)",
+             "(analysis/models/ops/parallel) changed, the protocol "
+             "tier exactly when a protocol-registry module changed — "
+             "the pre-commit path (docs/STATIC_ANALYSIS.md)",
     )
     p.add_argument(
         "--baseline", default=None,
@@ -327,6 +388,7 @@ def add_lint_subparser(sub) -> None:
     p.add_argument(
         "--telemetry-file", default=None,
         help="emit a lint run stream (lint.findings / lint.waived / "
-             "lint.scale_*) consumable by the `metrics` verbs",
+             "lint.scale_* / lint.protocol_*) consumable by the "
+             "`metrics` verbs",
     )
     p.set_defaults(fn=cmd_lint)

@@ -221,6 +221,7 @@ def render_text(
     findings: Sequence[Finding],
     audited: Sequence[str],
     scale_report: Optional[Dict] = None,
+    protocol_report: Optional[Dict] = None,
 ) -> str:
     unwaived, waived = _split(findings)
     lines: List[str] = []
@@ -253,6 +254,21 @@ def render_text(
             f"{scale_report.get('backend', '?')} HBM budget "
             f"(worst per-chip fraction {worst[0]:.2f} at {worst[1]})"
         )
+    if protocol_report is not None:
+        pairs = protocol_report.get("pairs", {})
+        lines.append("")
+        lines.append(
+            f"protocol audit: {protocol_report.get('sites', 0)} "
+            f"registered site(s) over "
+            f"{protocol_report.get('modules', 0)} module(s), "
+            f"{protocol_report.get('lock_edges', 0)} lock edge(s), "
+            f"schema pairs "
+            + ", ".join(
+                f"{name} ({len(p.get('required', []))} required / "
+                f"{len(p.get('emitted', []))} emitted)"
+                for name, p in sorted(pairs.items())
+            )
+        )
     lines.append("")
     lines.append(
         f"stc lint: {len(unwaived)} finding(s), {len(waived)} waived, "
@@ -265,6 +281,7 @@ def render_json(
     findings: Sequence[Finding],
     audited: Sequence[str],
     scale_report: Optional[Dict] = None,
+    protocol_report: Optional[Dict] = None,
 ) -> str:
     unwaived, waived = _split(findings)
     doc = {
@@ -279,4 +296,6 @@ def render_json(
     }
     if scale_report is not None:
         doc["scale"] = scale_report
+    if protocol_report is not None:
+        doc["protocol"] = protocol_report
     return json.dumps(doc, indent=2, sort_keys=True)

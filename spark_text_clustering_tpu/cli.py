@@ -449,11 +449,10 @@ def _serve_replica_loop(
     the per-replica control file for the supervisor's rolling-swap
     commands — the replica acks a swap by reporting the new
     ``model_stamp`` in its lease."""
-    import json
     import time as _time
 
     from .resilience import sleep as _idle_sleep
-    from .resilience.supervisor import control_path
+    from .resilience.supervisor import control_path, read_control
 
     ctrl = control_path(args.fleet_dir, int(args.worker_index))
     ctrl_stamp = None
@@ -489,11 +488,8 @@ def _serve_replica_loop(
             stamp = None
         if stamp is not None and stamp != ctrl_stamp:
             ctrl_stamp = stamp
-            try:
-                with open(ctrl, "r", encoding="utf-8") as f:
-                    cmd = json.load(f)
-            except (OSError, json.JSONDecodeError, ValueError):
-                cmd = None              # mid-write; next loop re-reads
+            cmd = read_control(ctrl)
+            if cmd is None:             # mid-write; next loop re-reads
                 ctrl_stamp = None
         if isinstance(cmd, dict) and isinstance(cmd.get("id"), int) \
                 and cmd["id"] > last_ctrl_id:

@@ -80,6 +80,7 @@ __all__ = [
     "FleetFence",
     "WorkerLease",
     "read_lease",
+    "read_control",
     "PreemptionNotice",
     "partition_of",
     "worker_dir",
@@ -273,6 +274,18 @@ def read_lease(path: str) -> Optional[Dict]:
             return json.load(f)
     except (OSError, json.JSONDecodeError):
         return None
+
+
+def read_control(path: str) -> Optional[Dict]:
+    """A replica's latest control-file command, or None (missing, torn,
+    or non-object control files read as 'no command yet' — the replica
+    polls again next loop instead of crashing mid-swap)."""
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            doc = json.load(f)
+    except (OSError, json.JSONDecodeError, ValueError):
+        return None
+    return doc if isinstance(doc, dict) else None
 
 
 class WorkerLease:

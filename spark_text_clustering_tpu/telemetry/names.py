@@ -253,6 +253,14 @@ METRICS: Dict[str, str] = {
     "lint.scale_waived":
         "scale-audit findings suppressed by pragma or baseline (the "
         "reasoned single-chip-tier HBM exceptions)",
+    "lint.protocol_sites":
+        "registered protocol-surface sites (writers, readers, path "
+        "attrs, schema pairs, snapshots) checked by the last "
+        "`stc lint --protocol` run (the layer-4 audit)",
+    "lint.protocol_findings":
+        "unwaived STC300-305 protocol-audit findings in the last run",
+    "lint.protocol_waived":
+        "protocol-audit findings suppressed by pragma or baseline",
 }
 
 # prefix -> owner/description of the dynamic family

@@ -522,15 +522,16 @@ def test_json_report_shape(tmp_path):
 def test_repo_is_ast_lint_clean():
     """The merged tree carries zero unwaived AST-layer findings, and
     every waiver (pragma or baseline) has a non-empty reason.  The
-    jaxpr/scale layers did not run here, so their waivers are exempt
-    from the stale sweep (exactly what `lint --no-jaxpr` does)."""
+    jaxpr/scale/protocol layers did not run here, so their waivers are
+    exempt from the stale sweep (exactly what `lint --no-jaxpr`
+    does)."""
     findings = run_ast_rules(REPO_ROOT)
     baseline = Baseline.load(
         os.path.join(REPO_ROOT, DEFAULT_BASELINE_PATH)
     )
     out = apply_waivers(
         findings, baseline,
-        stale_exempt_prefixes=("jaxpr:", "scale:"),
+        stale_exempt_prefixes=("jaxpr:", "scale:", "protocol:"),
     )
     unwaived = [f for f in out if not f.waived]
     assert unwaived == [], "\n".join(
@@ -544,12 +545,15 @@ def test_changed_scope_skips_stale_sweep_and_filters_paths():
     no stale-waiver meta-findings for everything that didn't run."""
     from spark_text_clustering_tpu.analysis.cli import run_lint
 
-    findings, audited, _, scale_report = run_lint(
+    findings, audited, _, scale_report, protocol_report = run_lint(
         REPO_ROOT,
         jaxpr=False,
         changed=["spark_text_clustering_tpu/cli.py"],
     )
     assert audited == [] and scale_report is None
+    # cli.py holds the control-file reader, so it is protocol-watched:
+    # the protocol tier auto-runs (and the repo is protocol-clean)
+    assert protocol_report is not None
     assert all(
         f.path == "spark_text_clustering_tpu/cli.py" for f in findings
     ), [f.path for f in findings]

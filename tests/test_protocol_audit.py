@@ -1,0 +1,644 @@
+"""Protocol-audit subsystem self-tests (STC300-305,
+docs/STATIC_ANALYSIS.md "Protocol audit").
+
+Four groups, mirroring tests/test_lint.py:
+
+  * fixture modules with PLANTED violations for every protocol rule —
+    positive (each rule fires at the planted site) and negative (the
+    compliant twin next to it stays clean);
+  * registry both-direction checks — stale writers/readers/snapshots
+    and lost atomic/tolerant/fsync shapes are findings too;
+  * waiver round trips over the ``protocol:``-prefixed finding paths
+    (inline pragma, baseline entry, stale-exemption when the tier is
+    skipped);
+  * the real repo must be protocol-clean against the committed
+    registry, and the STC305 pairs must provably cover the
+    supervisor<->front lease contract and the supervisor<->replica
+    control contract.
+"""
+
+import os
+import textwrap
+
+from spark_text_clustering_tpu.analysis.ast_rules import PACKAGE
+from spark_text_clustering_tpu.analysis.findings import (
+    Baseline,
+    apply_waivers,
+)
+from spark_text_clustering_tpu.analysis.protocol_audit import (
+    PROTOCOL_RULES,
+    run_protocol_audit,
+)
+from spark_text_clustering_tpu.analysis.protocol_sites import (
+    SITES,
+    ProtocolSites,
+    ReaderSite,
+    SchemaPair,
+    WriterSite,
+)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+REL = f"{PACKAGE}/planted.py"
+
+
+def _root(tmp_path, source: str, name: str = "planted.py"):
+    pkg = tmp_path / PACKAGE
+    pkg.mkdir(parents=True, exist_ok=True)
+    (pkg / name).write_text(textwrap.dedent(source))
+    return str(tmp_path)
+
+
+def _sites(**kw):
+    base = dict(
+        threaded_modules=(),
+        path_literals=frozenset(),
+        path_constants=frozenset(),
+        path_helpers=frozenset(),
+        path_attrs=frozenset(),
+    )
+    base.update(kw)
+    return ProtocolSites(**base)
+
+
+def _rules(findings):
+    return sorted(f.rule for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# STC300 — lock-order deadlocks
+# ---------------------------------------------------------------------------
+def test_stc300_cycle_and_blocking_call_under_lock(tmp_path):
+    """fwd takes a->b; back reaches a via helper while holding b: a
+    cycle.  The helper also sleeps under the held lock."""
+    root = _root(tmp_path, """
+        import threading
+        import time
+
+        class Cycler:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def fwd(self):
+                with self._a:
+                    with self._b:
+                        pass
+
+            def back(self):
+                with self._b:
+                    self.helper()
+
+            def helper(self):
+                with self._a:
+                    time.sleep(1)
+    """)
+    f, rep = run_protocol_audit(root, _sites(threaded_modules=(REL,)))
+    assert {x.rule for x in f} == {"STC300"}, [
+        (x.rule, x.message) for x in f
+    ]
+    msgs = [x.message for x in f]
+    assert any("lock-order cycle" in m for m in msgs), msgs
+    # the sleep fires under each distinct held-lock context (helper
+    # alone, and helper reached from back while _b is held)
+    assert any("blocking call sleep()" in m for m in msgs), msgs
+    assert rep["lock_edges"] == 2 and rep["locks"] == 2
+
+
+def test_stc300_consistent_order_is_clean(tmp_path):
+    root = _root(tmp_path, """
+        import threading
+
+        class Ordered:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def one(self):
+                with self._a:
+                    with self._b:
+                        pass
+
+            def two(self):
+                with self._a:
+                    self.helper()
+
+            def helper(self):
+                with self._b:
+                    pass
+    """)
+    f, rep = run_protocol_audit(root, _sites(threaded_modules=(REL,)))
+    assert f == [], [(x.rule, x.message) for x in f]
+    assert rep["lock_edges"] == 1
+
+
+def test_stc300_nonreentrant_self_deadlock_rlock_twin_clean(tmp_path):
+    root = _root(tmp_path, """
+        import threading
+
+        class Bad:
+            def __init__(self):
+                self._l = threading.Lock()
+
+            def outer(self):
+                with self._l:
+                    self.inner()
+
+            def inner(self):
+                with self._l:
+                    pass
+
+        class Ok:
+            def __init__(self):
+                self._l = threading.RLock()
+
+            def outer(self):
+                with self._l:
+                    self.inner()
+
+            def inner(self):
+                with self._l:
+                    pass
+    """)
+    f, _ = run_protocol_audit(root, _sites(threaded_modules=(REL,)))
+    assert _rules(f) == ["STC300"], [(x.rule, x.message) for x in f]
+    assert "self-deadlock" in f[0].message and "Bad._l" in f[0].message
+
+
+def test_stc300_condition_wait_exempt_event_wait_flagged(tmp_path):
+    """cond.wait() RELEASES the held condition — exempt; ev.wait()
+    under the same lock parks the thread while holding it."""
+    root = _root(tmp_path, """
+        import threading
+
+        class W:
+            def __init__(self):
+                self._cond = threading.Condition()
+                self._ev = threading.Event()
+
+            def ok(self):
+                with self._cond:
+                    self._cond.wait()
+
+            def bad(self):
+                with self._cond:
+                    self._ev.wait()
+    """)
+    f, _ = run_protocol_audit(root, _sites(threaded_modules=(REL,)))
+    assert _rules(f) == ["STC300"], [(x.rule, x.message) for x in f]
+    assert "_ev.wait()" in f[0].message
+
+
+# ---------------------------------------------------------------------------
+# STC301 — shared-state escape from thread targets
+# ---------------------------------------------------------------------------
+_ESCAPE_SRC = """
+    import threading
+
+    class Worker:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.x = 0
+            self._t = threading.Thread(target=self._run)
+
+        def _run(self):
+            self.x = self.x + 1
+
+        def bump(self):
+            self.x = 2
+
+    class Guarded:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.y = 0
+            self._t = threading.Thread(target=self._run)
+
+        def _run(self):
+            with self._lock:
+                self.y = self.y + 1
+
+        def bump(self):
+            with self._lock:
+                self.y = 2
+"""
+
+
+def test_stc301_thread_escape_and_locked_twin(tmp_path):
+    root = _root(tmp_path, _ESCAPE_SRC)
+    f, _ = run_protocol_audit(root, _sites(threaded_modules=(REL,)))
+    assert _rules(f) == ["STC301"], [(x.rule, x.message) for x in f]
+    assert "Worker.x crosses" in f[0].message
+
+
+def test_stc301_atomic_snapshot_exemption_and_stale_entry(tmp_path):
+    root = _root(tmp_path, _ESCAPE_SRC)
+    # registering the attr as an atomically-swapped snapshot waives it
+    f, _ = run_protocol_audit(root, _sites(
+        threaded_modules=(REL,),
+        atomic_snapshots={(REL, "Worker", "x"): "rebind-only fixture"},
+    ))
+    assert f == [], [(x.rule, x.message) for x in f]
+    # ... but a snapshot entry naming a dead attribute is itself stale
+    f, _ = run_protocol_audit(root, _sites(
+        threaded_modules=(REL,),
+        atomic_snapshots={
+            (REL, "Worker", "x"): "rebind-only fixture",
+            (REL, "Worker", "gone"): "points at nothing",
+        },
+    ))
+    assert _rules(f) == ["STC301"], [(x.rule, x.message) for x in f]
+    assert "stale atomic_snapshots entry" in f[0].message
+
+
+# ---------------------------------------------------------------------------
+# STC302/303 — protocol-path write/read routing
+# ---------------------------------------------------------------------------
+def test_stc302_bare_write_vs_registered_atomic_writer(tmp_path):
+    root = _root(tmp_path, """
+        import json
+
+        def bare_write(d):
+            p = d + "/lease.json"
+            with open(p, "w") as f:
+                f.write("{}")
+
+        def good_write(d, doc):
+            from .integrity import atomic_write_text
+            atomic_write_text(d + "/lease.json", json.dumps(doc))
+    """)
+    f, _ = run_protocol_audit(root, _sites(
+        path_literals=frozenset({"lease.json"}),
+        writers=(WriterSite(REL, "good_write"),),
+    ))
+    assert _rules(f) == ["STC302"], [(x.rule, x.message) for x in f]
+    assert "bare open" in f[0].message and f[0].path == f"protocol:{REL}"
+
+
+def test_stc302_unregistered_atomic_write_text_is_flagged(tmp_path):
+    """Even the right primitive needs a registry entry — otherwise its
+    discipline silently drops out of the audit."""
+    root = _root(tmp_path, """
+        import json
+
+        def rogue(d, doc):
+            from .integrity import atomic_write_text
+            atomic_write_text(d + "/lease.json", json.dumps(doc))
+    """)
+    f, _ = run_protocol_audit(root, _sites(
+        path_literals=frozenset({"lease.json"}),
+    ))
+    assert _rules(f) == ["STC302"], [(x.rule, x.message) for x in f]
+    assert "not a registered writer" in f[0].message
+
+
+def test_stc302_registered_writer_that_lost_atomicity(tmp_path):
+    root = _root(tmp_path, """
+        def writes(d):
+            with open(d + "/lease.json", "w") as f:
+                f.write("{}")
+    """)
+    f, _ = run_protocol_audit(root, _sites(
+        path_literals=frozenset({"lease.json"}),
+        writers=(WriterSite(REL, "writes"),),
+    ))
+    assert _rules(f) == ["STC302"], [(x.rule, x.message) for x in f]
+    assert "no longer atomic" in f[0].message
+
+
+def test_stc303_bare_read_vs_registered_tolerant_reader(tmp_path):
+    root = _root(tmp_path, """
+        import json
+        import os
+
+        def bare_read(d):
+            with open(os.path.join(d, "lease.json")) as f:
+                return json.load(f)
+
+        def good_read(d):
+            try:
+                with open(os.path.join(d, "lease.json")) as f:
+                    return json.load(f)
+            except (OSError, ValueError):
+                return None
+    """)
+    f, _ = run_protocol_audit(root, _sites(
+        path_literals=frozenset({"lease.json"}),
+        readers=(ReaderSite(REL, "good_read"),),
+    ))
+    assert _rules(f) == ["STC303"], [(x.rule, x.message) for x in f]
+    assert "bare read" in f[0].message
+
+
+def test_stc303_registered_reader_without_try_is_flagged(tmp_path):
+    root = _root(tmp_path, """
+        import json
+
+        def brittle(path):
+            with open(path) as f:
+                return json.load(f)
+    """)
+    f, _ = run_protocol_audit(root, _sites(
+        readers=(ReaderSite(REL, "brittle"),),
+    ))
+    assert _rules(f) == ["STC303"], [(x.rule, x.message) for x in f]
+    assert "no try/except" in f[0].message
+
+
+def test_stale_registry_entries_are_findings(tmp_path):
+    root = _root(tmp_path, """
+        def unrelated():
+            return 1
+    """)
+    f, _ = run_protocol_audit(root, _sites(
+        writers=(WriterSite(REL, "gone_writer"),),
+        readers=(ReaderSite(REL, "gone_reader"),),
+        path_attrs=frozenset({(REL, "Gone", "path")}),
+    ))
+    assert _rules(f) == ["STC302", "STC302", "STC303"], [
+        (x.rule, x.message) for x in f
+    ]
+    assert all("stale" in x.message for x in f)
+
+
+def test_stc302_path_attr_and_helper_tagging(tmp_path):
+    """Paths flow through self.<attr> slots and helper calls, not just
+    literals — both must tag the expression."""
+    root = _root(tmp_path, """
+        import json
+
+        def lease_path(d, w):
+            return d + "/" + w + ".json"
+
+        class Ledger:
+            def __init__(self, path):
+                self.path = path
+
+            def rewrite(self):
+                with open(self.path, "w") as f:
+                    f.write("{}")
+
+        def write_via_helper(d, w):
+            p = lease_path(d, w)
+            with open(p, "w") as f:
+                f.write("{}")
+    """)
+    f, _ = run_protocol_audit(root, _sites(
+        path_helpers=frozenset({"lease_path"}),
+        path_attrs=frozenset({(REL, "Ledger", "path")}),
+    ))
+    assert _rules(f) == ["STC302", "STC302"], [
+        (x.rule, x.message) for x in f
+    ]
+
+
+# ---------------------------------------------------------------------------
+# STC304 — durability ordering
+# ---------------------------------------------------------------------------
+def test_stc304_durable_append_requires_fsync(tmp_path):
+    root = _root(tmp_path, """
+        import json
+        import os
+
+        class Led:
+            def __init__(self, path):
+                self.path = path
+
+            def append(self, rec):
+                with open(self.path, "a", encoding="utf-8") as f:
+                    f.write(json.dumps(rec) + chr(10))
+                    f.flush()
+
+        class DurableLed:
+            def __init__(self, path):
+                self.path = path
+
+            def append(self, rec):
+                with open(self.path, "a", encoding="utf-8") as f:
+                    f.write(json.dumps(rec) + chr(10))
+                    f.flush()
+                    os.fsync(f.fileno())
+    """)
+    f, _ = run_protocol_audit(root, _sites(
+        path_attrs=frozenset({
+            (REL, "Led", "path"), (REL, "DurableLed", "path"),
+        }),
+        writers=(
+            WriterSite(REL, "Led.append", kind="append", durable=True),
+            WriterSite(REL, "DurableLed.append", kind="append",
+                       durable=True),
+        ),
+    ))
+    assert _rules(f) == ["STC304"], [(x.rule, x.message) for x in f]
+    assert "os.fsync" in f[0].message and "Led.append" in f[0].message
+
+
+# ---------------------------------------------------------------------------
+# STC305 — writer/reader schema conformance
+# ---------------------------------------------------------------------------
+_SCHEMA_SRC = """
+    import json
+
+    def write_lease(path, worker):
+        from .integrity import atomic_write_text
+        doc = {"worker": worker, "ts": 1.0}
+        atomic_write_text(path, json.dumps(doc))
+
+    def beat(**fields):
+        return fields
+
+    def caller():
+        beat(queue_depth=3, force=True)
+
+    def read_lease(path):
+        try:
+            with open(path) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    def consume(path):
+        lease = read_lease(path)
+        if lease is None:
+            return None
+        return (
+            lease["queue_depth"],
+            lease.get("worker"),
+            lease.get("optional", 0.0),
+        )
+"""
+
+
+def _schema_sites(**pair_kw):
+    kw = dict(
+        name="lease",
+        writers=((REL, "write_lease"),),
+        readers=((REL, "consume"),),
+        reader_seed_calls=("read_lease",),
+    )
+    kw.update(pair_kw)
+    return _sites(
+        writers=(WriterSite(REL, "write_lease"),),
+        readers=(ReaderSite(REL, "read_lease"),),
+        schema_pairs=(SchemaPair(**kw),),
+    )
+
+
+def test_stc305_kwarg_funnel_satisfies_reader(tmp_path):
+    """queue_depth reaches the schema through the beat(**fields)
+    forwarding funnel; .get with a default is optional, not required;
+    exclude_fields drops writer-internal kwargs."""
+    root = _root(tmp_path, _SCHEMA_SRC)
+    f, rep = run_protocol_audit(root, _schema_sites(
+        field_call_names=("beat",),
+        exclude_fields=("force",),
+    ))
+    assert f == [], [(x.rule, x.message) for x in f]
+    pair = rep["pairs"]["lease"]
+    assert pair["emitted"] == ["queue_depth", "ts", "worker"]
+    assert pair["required"] == ["queue_depth", "worker"]
+    assert pair["missing"] == []
+
+
+def test_stc305_missing_field_is_schema_drift(tmp_path):
+    """Without the funnel registered, the reader's queue_depth demand
+    has no provable emitter — the exact cross-host drift STC305 exists
+    to catch."""
+    root = _root(tmp_path, _SCHEMA_SRC)
+    f, rep = run_protocol_audit(root, _schema_sites())
+    assert _rules(f) == ["STC305"], [(x.rule, x.message) for x in f]
+    assert "schema drift" in f[0].message
+    assert "queue_depth" in f[0].message
+    assert rep["pairs"]["lease"]["missing"] == ["queue_depth"]
+
+
+def test_stc305_unresolvable_pair_is_stale(tmp_path):
+    root = _root(tmp_path, """
+        def unrelated():
+            return 1
+    """)
+    f, _ = run_protocol_audit(root, _sites(schema_pairs=(
+        SchemaPair(
+            name="ghost",
+            writers=((REL, "gone_writer"),),
+            readers=((REL, "gone_reader"),),
+            reader_seed_calls=("read_ghost",),
+        ),
+    )))
+    assert f and all(x.rule == "STC305" for x in f), [
+        (x.rule, x.message) for x in f
+    ]
+    assert all("stale" in x.message for x in f)
+
+
+# ---------------------------------------------------------------------------
+# waiver round trips over protocol:-prefixed paths
+# ---------------------------------------------------------------------------
+_BARE_WRITE = """
+    def bare_write(d):
+        p = d + "/lease.json"
+        with open(p, "w") as f:{pragma}
+            f.write("{{}}")
+"""
+
+
+def test_protocol_pragma_waiver_round_trip(tmp_path):
+    sites = _sites(path_literals=frozenset({"lease.json"}))
+    root = _root(tmp_path, _BARE_WRITE.format(
+        pragma="  # stc-lint: disable=STC302 -- fixture stays torn"
+    ))
+    f, _ = run_protocol_audit(root, sites)
+    assert [x.rule for x in f] == ["STC302"]
+    assert f[0].waived and f[0].waived_by == "pragma"
+    assert f[0].reason == "fixture stays torn"
+    # and the reasonless twin degrades to STC000, not a silent waiver
+    root2 = _root(tmp_path / "b", _BARE_WRITE.format(
+        pragma="  # stc-lint: disable=STC302"
+    ))
+    f2, _ = run_protocol_audit(root2, sites)
+    out = apply_waivers(f2, Baseline())
+    assert [x.rule for x in out if not x.waived] == ["STC000"]
+
+
+def test_protocol_baseline_waiver_and_stale_exemption(tmp_path):
+    root = _root(tmp_path, _BARE_WRITE.format(pragma=""))
+    f, _ = run_protocol_audit(
+        root, _sites(path_literals=frozenset({"lease.json"}))
+    )
+    assert [x.rule for x in f] == ["STC302"] and not f[0].waived
+    bl = Baseline([{
+        "rule": "STC302", "path": f"protocol:{REL}",
+        "match": "open(p", "reason": "fixture documents the hazard",
+    }])
+    out = apply_waivers(f, bl)
+    assert f[0].waived and f[0].waived_by == "baseline"
+    assert not [x for x in out if x.rule == "STC000"]
+    # when the protocol tier did NOT run, its waivers are exempt from
+    # the stale sweep (what `lint` without --protocol does) ...
+    stale_bl = Baseline([{
+        "rule": "STC302", "path": f"protocol:{PACKAGE}/gone.py",
+        "match": "open(", "reason": "tier skipped this run",
+    }])
+    out = apply_waivers([], stale_bl,
+                        stale_exempt_prefixes=("protocol:",))
+    assert out == []
+    # ... and flagged stale when it did run
+    out = apply_waivers([], Baseline(stale_bl.waivers))
+    assert [x.rule for x in out] == ["STC000"]
+
+
+# ---------------------------------------------------------------------------
+# the real repo: clean, covered, and gated
+# ---------------------------------------------------------------------------
+def test_repo_is_protocol_clean():
+    """Zero findings against the committed registry — every protocol
+    touchpoint in the fleet is registered with the right shape, and no
+    registry entry is stale."""
+    findings, report = run_protocol_audit(REPO_ROOT)
+    assert findings == [], "\n".join(
+        f"{f.path}:{f.line}: {f.rule}: {f.message}" for f in findings
+    )
+    assert report["sites"] == SITES.site_count()
+    assert report["rules"] == {r: 0 for r in PROTOCOL_RULES}
+
+
+def test_stc305_covers_lease_and_control_pairs():
+    """The acceptance pins: the supervisor<->front lease contract and
+    the supervisor<->replica control contract both resolve, and every
+    field a reader requires is provably emitted."""
+    _, report = run_protocol_audit(REPO_ROOT)
+    pairs = report["pairs"]
+    assert sorted(pairs) == ["control", "lease"]
+    lease = pairs["lease"]
+    assert lease["missing"] == []
+    assert set(lease["required"]) >= {
+        "done", "generation", "model_path", "model_stamp", "role",
+        "state",
+    }
+    assert set(lease["emitted"]) >= {
+        "worker", "ts", "pid", "port", "epoch", "requests",
+    }
+    control = pairs["control"]
+    assert control["missing"] == []
+    assert set(control["required"]) == {"id", "stamp"}
+    assert set(control["emitted"]) == {"id", "stamp", "swap_to"}
+
+
+def test_changed_scope_gates_the_protocol_tier():
+    """`lint --changed` runs the protocol tier exactly when a
+    registry-watched module changed — and exempts protocol: waivers
+    from the stale sweep when it is skipped."""
+    from spark_text_clustering_tpu.analysis.cli import run_lint
+
+    watched = f"{PACKAGE}/resilience/supervisor.py"
+    assert watched in SITES.watched_modules()
+    _, _, _, _, protocol_report = run_lint(
+        REPO_ROOT, jaxpr=False, changed=[watched],
+    )
+    assert protocol_report is not None
+    assert protocol_report["sites"] == SITES.site_count()
+    unwatched = f"{PACKAGE}/streaming.py"
+    assert unwatched not in SITES.watched_modules()
+    _, _, _, _, protocol_report = run_lint(
+        REPO_ROOT, jaxpr=False, changed=[unwatched],
+    )
+    assert protocol_report is None
