@@ -88,16 +88,20 @@ def main() -> int:
 
     # disabled per-call primitive cost (span + count + observe + event
     # + a dispatch-instrumented call + the tracing layer's two
-    # disabled-mode touchpoints per loop — each must collapse to one
-    # global check: tracing.fields() is the per-micro-batch stamp with
-    # no context installed, emit_span the per-request span that must
-    # cost nothing with telemetry off.  event() is here because the SLO
-    # engine's typed request events ride it on every front/probe
-    # request)
+    # disabled-mode touchpoints + the transport hook per loop — each
+    # must collapse to one global check: tracing.fields() is the
+    # per-micro-batch stamp with no context installed, emit_span the
+    # per-request span that must cost nothing with telemetry off,
+    # transport.offer() the per-record shipping hook JsonlSink calls
+    # that with no shipper configured is one global read.  event() is
+    # here because the SLO engine's typed request events ride it on
+    # every front/probe request)
     assert not telemetry.enabled()
-    from spark_text_clustering_tpu.telemetry import tracing
+    from spark_text_clustering_tpu.telemetry import tracing, transport
 
     assert tracing.current() is None
+    assert transport.get_shipper() is None
+    _rec = {"ts": 0.0, "event": "overhead.probe"}
     wrapped_noop = telemetry.instrument_dispatch(
         "overhead.probe", lambda: None
     )
@@ -114,7 +118,8 @@ def main() -> int:
             "overhead.probe", trace_id="0", span_id="0",
             start=0.0, seconds=0.0,
         )
-    per_call = (time.perf_counter() - t0) / (7 * PRIMITIVE_LOOP)
+        transport.offer(_rec)
+    per_call = (time.perf_counter() - t0) / (8 * PRIMITIVE_LOOP)
 
     overhead_s = calls * per_call
     ratio = overhead_s / max(fit_s, 1e-9)

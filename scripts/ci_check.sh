@@ -170,9 +170,28 @@
 #      committed baseline, and a planted two-lock cycle (STC300), a
 #      planted bare lease write (STC302), and a planted never-emitted
 #      required field (STC305) must ALL gate red (self-test)
+#  20. telemetry transport drill (telemetry.transport + `stc collect`,
+#      docs/OBSERVABILITY.md "Telemetry transport") in two parts:
+#      (a) exactly-once chaos — two shippers push manifested streams
+#      to a real `stc collect` daemon over HTTP, the collector is
+#      SIGKILLed mid-run, both workers spool the outage batches
+#      durably, a restarted collector on the same port receives the
+#      replay plus a deliberately re-sent batch (a lost ack), and the
+#      drill asserts every event folded exactly once with the
+#      duplicate suppressed by seq dedup; the restarted collector's
+#      deterministic collect.* counters (batches/ingested/duplicates/
+#      sources) gate against the committed baseline, and `metrics
+#      summarize` over an aggregated stream must render the
+#      transport-health section; (b) observability-over-the-hop — the
+#      gate-9 planted retrace storm and the gate-18 degraded probe
+#      stream are shipped through a collector, then `stc monitor
+#      --once --collect-dir --builtin retrace_storm --fail-on-alert`
+#      must exit 1 and `stc metrics slo --fail-on-burn` over the
+#      collector-side probe stream must exit 1 — the whole analysis
+#      stack works unchanged over an aggregated dir
 #
 # Usage:
-#   scripts/ci_check.sh                 # run all nineteen gates
+#   scripts/ci_check.sh                 # run all twenty gates
 #   scripts/ci_check.sh --rebaseline    # recapture ALL baselines
 #                                       # (metrics + lint waivers +
 #                                       # lint counters + scale record
@@ -1488,6 +1507,186 @@ print(f"slo drill ({half}): 18/18 probes OK, front exposes "
 EOF
 }
 
+run_transport_drill() {
+    # gate 20a: exactly-once event shipping across a collector crash.
+    # Two shippers (a 2-worker fleet's transport plane, minus the jax
+    # workers) push manifested streams to a real `stc collect` daemon;
+    # it is SIGKILLed mid-run, the outage batches spool durably, and a
+    # restarted collector on the SAME port gets the replay plus a
+    # deliberately re-sent batch.  Every count below is exact: the
+    # restarted run's collect.* fold into the committed baseline.
+    local workdir="$1"
+    rm -rf "$workdir/collect_agg" "$workdir/ship_spools"
+    python - "$workdir" <<'EOF'
+import http.client
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+from spark_text_clustering_tpu.resilience.retry import RetryPolicy
+from spark_text_clustering_tpu.telemetry.transport import EventShipper
+
+workdir = sys.argv[1]
+agg = os.path.join(workdir, "collect_agg")
+FAST = RetryPolicy(attempts=1, base_delay=0.02, max_delay=0.02,
+                   retry_on=(OSError,), emit_events=False)
+
+# fixed port: the restarted incarnation must be reachable at the same
+# --ship-to target the workers hold
+s = socket.socket()
+s.bind(("127.0.0.1", 0))
+port = s.getsockname()[1]
+s.close()
+
+
+def start_collector(tag):
+    return subprocess.Popen([
+        sys.executable, "-m", "spark_text_clustering_tpu.cli",
+        "collect", "--dir", agg, "--host", "127.0.0.1",
+        "--port", str(port),
+        "--telemetry-file", os.path.join(workdir, f"collect_{tag}.jsonl"),
+    ], env=dict(os.environ), stdout=subprocess.DEVNULL)
+
+
+def wait_healthy(proc):
+    deadline = time.time() + 120
+    while time.time() < deadline:
+        if proc.poll() is not None:
+            sys.exit(f"collector died at startup (rc={proc.returncode})")
+        try:
+            c = http.client.HTTPConnection("127.0.0.1", port, timeout=5)
+            c.request("GET", "/healthz")
+            ok = c.getresponse().status == 200
+            c.close()
+            if ok:
+                return
+        except OSError:
+            pass
+        time.sleep(0.1)
+    sys.exit("collector never became healthy")
+
+
+proc_a = start_collector("a")
+wait_healthy(proc_a)
+
+ships = [
+    EventShipper(
+        "127.0.0.1", port, source_id=w,
+        spool_dir=os.path.join(workdir, "ship_spools", w), policy=FAST,
+    )
+    for w in ("w0", "w1")
+]
+for j, sh in enumerate(ships):
+    sh.offer({"ts": 0.0, "event": "manifest", "schema": 1,
+              "run_id": f"transport-drill-{sh.source_id}"})
+    for i in range(5):
+        sh.offer({"ts": float(i), "event": "drill", "i": i, "w": j})
+    sh.flush()                      # batch 1: acked + committed
+
+proc_a.send_signal(signal.SIGKILL)
+proc_a.wait()
+
+for j, sh in enumerate(ships):
+    for i in range(5, 10):
+        sh.offer({"ts": float(i), "event": "drill", "i": i, "w": j})
+    sh.flush()                      # collector dead -> durable spool
+    assert sh.spool.pending() == 5, (j, sh.spool.pending())
+
+proc_b = start_collector("b")
+wait_healthy(proc_b)
+
+for j, sh in enumerate(ships):
+    sh.offer({"ts": 10.0, "event": "drill", "i": 10, "w": j})
+    sh.flush()                      # replay batch 2, then live batch 3
+    assert sh.spool.load() == []    # compacted after the replay
+    sh.close()
+
+# a lost ack: re-ship w0's final batch — seq dedup must suppress it
+ack = ships[0]._ship({
+    "seq": 3, "sent_ts": 10.0,
+    "events": [{"ts": 10.0, "event": "drill", "i": 10, "w": 0}],
+}, replayed=True)
+assert ack.get("status") == "duplicate", ack
+
+proc_b.send_signal(signal.SIGTERM)
+if proc_b.wait(timeout=120) != 0:
+    sys.exit(f"collector drain exited {proc_b.returncode}")
+
+for w in ("w0", "w1"):
+    path = os.path.join(agg, f"{w}.jsonl")
+    evs = [json.loads(ln) for ln in open(path) if ln.strip()]
+    got = sorted(e["i"] for e in evs if e.get("event") == "drill")
+    assert got == list(range(11)), (w, got)
+    marks = [e for e in evs if e["event"] == "collect_batch"]
+    assert [m["seq"] for m in marks] == [1, 2, 3], (w, marks)
+    assert [m["replayed"] for m in marks] == [False, True, False], (
+        w, marks)
+    assert evs[0]["event"] == "manifest", w
+    assert evs[0]["source_id"] == w      # collector-stamped pairing key
+
+print("transport drill: 2 shippers x 11 events across a collector "
+      "SIGKILL folded exactly once (1 replayed batch each, 1 "
+      "duplicate suppressed)")
+EOF
+}
+
+run_transport_observe_drill() {
+    # gate 20b: the analysis stack over the HTTP hop.  The planted
+    # retrace storm and the gate-18 degraded probe stream are shipped
+    # through a collector; monitor/slo then run UNCHANGED over the
+    # aggregated dir (their gating asserted back in the gate body)
+    local workdir="$1"
+    rm -rf "$workdir/collect_obs"
+    if [[ ! -s "$workdir/storm.jsonl" ]]; then
+        make_retrace_storm "$workdir" || return 1
+    fi
+    python - "$workdir" <<'EOF'
+import os
+import sys
+import threading
+
+from spark_text_clustering_tpu import telemetry
+from spark_text_clustering_tpu.resilience.retry import RetryPolicy
+from spark_text_clustering_tpu.telemetry.transport import (
+    Collector, EventShipper, make_collector_server,
+)
+
+workdir = sys.argv[1]
+obs = os.path.join(workdir, "collect_obs")
+coll = Collector(obs)
+httpd = make_collector_server(coll)
+port = httpd.server_address[1]
+t = threading.Thread(target=httpd.serve_forever, daemon=True)
+t.start()
+FAST = RetryPolicy(attempts=1, base_delay=0.02, max_delay=0.02,
+                   retry_on=(OSError,), emit_events=False)
+shipped = []
+for name, sid in (("storm.jsonl", "storm"),
+                  ("probe_degraded.jsonl", "probe")):
+    path = os.path.join(workdir, name)
+    if not os.path.exists(path):
+        continue                # gate-18 half may have failed upstream
+    sh = EventShipper("127.0.0.1", port, source_id=sid, policy=FAST)
+    for ev in telemetry.read_events(path):
+        sh.offer(ev)
+    sh.flush()
+    sh.close()
+    shipped.append(sid)
+httpd.shutdown()
+httpd.server_close()
+t.join(timeout=5.0)
+assert "storm" in shipped, "retrace storm stream did not ship"
+for sid in shipped:
+    assert os.path.exists(os.path.join(obs, f"{sid}.jsonl"))
+print(f"transport observe drill: shipped {', '.join(shipped)} "
+      f"through the collector into {obs}")
+EOF
+}
+
 if [[ "${1:-}" == "--rebaseline" ]]; then
     # --scale --protocol: regenerate the waiver allowlist AND the
     # committed scale evidence record (scripts/records/
@@ -1596,6 +1795,12 @@ if [[ "${1:-}" == "--rebaseline" ]]; then
         "$work/monitor_slo_degraded.jsonl" --baseline "$BASELINE" \
         --write-baseline --tolerance 0.0 --include counter.slo. \
         || exit 1
+    # fold the transport drill's exactly-once fold accounting (the
+    # restarted collector's collect.* counters + sources gauge)
+    run_transport_drill "$work" || exit 1
+    python -m spark_text_clustering_tpu.cli metrics check \
+        "$work/collect_b.jsonl" --baseline "$BASELINE" \
+        --write-baseline --tolerance 0.0 --include collect. || exit 1
     # recapture the recompile sentinel's expected-signature table from
     # the same train run plus a score run and an NMF fit+transform run
     # (gate 9's fixture triple)
@@ -1611,12 +1816,12 @@ fail=0
 work=$(mktemp -d)
 trap 'rm -rf "$work"' EXIT
 
-echo "== [1/19] stc lint (AST rules + jaxpr audit) =="
+echo "== [1/20] stc lint (AST rules + jaxpr audit) =="
 python -m spark_text_clustering_tpu.cli lint \
     --telemetry-file "$work/lint.jsonl"
 if [[ $? -ne 0 ]]; then echo "FAIL: stc lint"; fail=1; fi
 
-echo "== [2/19] ruff (generic-Python tier) =="
+echo "== [2/20] ruff (generic-Python tier) =="
 if command -v ruff >/dev/null 2>&1; then
     ruff check spark_text_clustering_tpu
     if [[ $? -ne 0 ]]; then echo "FAIL: ruff"; fail=1; fi
@@ -1624,17 +1829,17 @@ else
     echo "ruff not installed — skipped (stc lint STC101/102/006 cover it)"
 fi
 
-echo "== [3/19] tier-1 tests =="
+echo "== [3/20] tier-1 tests =="
 timeout -k 10 870 python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors -p no:cacheprovider \
     -p no:xdist -p no:randomly
 if [[ $? -ne 0 ]]; then echo "FAIL: tier-1"; fail=1; fi
 
-echo "== [4/19] telemetry overhead budget =="
+echo "== [4/20] telemetry overhead budget =="
 python scripts/check_telemetry_overhead.py
 if [[ $? -ne 0 ]]; then echo "FAIL: telemetry overhead"; fail=1; fi
 
-echo "== [5/19] metrics regression gate =="
+echo "== [5/20] metrics regression gate =="
 if run_ci_train "$work"; then
     # lint., ledger., fleet., serve., and alert. families are captured
     # by their own gates (1/6, 8, 10, 11, and 12) — a batch train run
@@ -1644,14 +1849,14 @@ if run_ci_train "$work"; then
         --exclude ledger. --exclude fleet. --exclude serve. \
         --exclude alert. --exclude monitor. --exclude drift. \
         --exclude compile.cache --exclude trace. --exclude lineage. \
-        --exclude scale. --exclude front.
+        --exclude scale. --exclude front. --exclude collect.
     if [[ $? -ne 0 ]]; then echo "FAIL: metrics check"; fail=1; fi
 else
     echo "FAIL: CI training run"
     fail=1
 fi
 
-echo "== [6/19] lint metrics gate (waiver count version-gated) =="
+echo "== [6/20] lint metrics gate (waiver count version-gated) =="
 if [[ -s "$work/lint.jsonl" ]]; then
     # lint.scale_* belong to the gate-15 --scale stream and
     # lint.protocol_* to the gate-19 --protocol stream, not stage 1's
@@ -1664,7 +1869,7 @@ else
     fail=1
 fi
 
-echo "== [7/19] cross-host skew gate (metrics merge) =="
+echo "== [7/20] cross-host skew gate (metrics merge) =="
 if make_skew_streams "$work"; then
     python -m spark_text_clustering_tpu.cli metrics merge \
         "$work/skew-p0.jsonl" "$work/skew-p1.jsonl" --fail-on-skew \
@@ -1685,7 +1890,7 @@ else
     fail=1
 fi
 
-echo "== [8/19] exactly-once ledger chaos drill (STC_FAULTS) =="
+echo "== [8/20] exactly-once ledger chaos drill (STC_FAULTS) =="
 if run_ledger_drill "$work"; then
     python -m spark_text_clustering_tpu.cli metrics check \
         "$work/ledger_drill.jsonl" --baseline "$BASELINE" \
@@ -1696,7 +1901,7 @@ else
     fail=1
 fi
 
-echo "== [9/19] recompile sentinel (metrics compile-check) =="
+echo "== [9/20] recompile sentinel (metrics compile-check) =="
 if [[ -s "$work/run.jsonl" ]] && run_ci_score "$work" \
     && run_ci_nmf "$work"; then
     python -m spark_text_clustering_tpu.cli metrics compile-check \
@@ -1723,7 +1928,7 @@ else
     fail=1
 fi
 
-echo "== [10/19] supervisor drill (lease expiry -> SIGKILL -> respawn) =="
+echo "== [10/20] supervisor drill (lease expiry -> SIGKILL -> respawn) =="
 if run_supervisor_drill "$work"; then
     # the ladder's counters are deterministic: 3 spawns (2 + 1
     # respawn), 1 lease expiry, 1 preemption (the drain SIGTERM the
@@ -1737,7 +1942,7 @@ else
     fail=1
 fi
 
-echo "== [11/19] serve drill (hot-swap + drain + zero-recompile) =="
+echo "== [11/20] serve drill (hot-swap + drain + zero-recompile) =="
 if [[ -d "$work/models" ]] && run_serve_drill "$work"; then
     # requests (32 = two exact 16-doc volleys) and swaps (1) are
     # machine-independent; batch counts depend on coalescing timing
@@ -1751,7 +1956,7 @@ else
     fail=1
 fi
 
-echo "== [12/19] monitor drill (alerts fire/resolve + resize-on-alert) =="
+echo "== [12/20] monitor drill (alerts fire/resolve + resize-on-alert) =="
 if run_monitor_once_drill "$work"; then
     # the --once storm run's alert counters are deterministic: exactly
     # one firing (retrace_storm), nothing pending/resolved
@@ -1772,7 +1977,7 @@ if ! run_monitor_resize_drill "$work"; then
     fail=1
 fi
 
-echo "== [13/19] executable-cache cold-start drill (compilecache) =="
+echo "== [13/20] executable-cache cold-start drill (compilecache) =="
 if [[ -d "$work/models" ]] && run_cold_start_drill "$work"; then
     # the warm B run's cache counters are deterministic: one hit per
     # score-path digest, zero misses/stores/invalidations
@@ -1785,7 +1990,7 @@ else
     fail=1
 fi
 
-echo "== [14/19] end-to-end lineage drill (causal tracing) =="
+echo "== [14/20] end-to-end lineage drill (causal tracing) =="
 if run_lineage_drill "$work"; then
     # the serve run's trace counters are deterministic: ONE sampled
     # request, four emitted spans, nothing dropped
@@ -1798,7 +2003,7 @@ else
     fail=1
 fi
 
-echo "== [15/19] scale audit (stc lint --scale, STC210-215) =="
+echo "== [15/20] scale audit (stc lint --scale, STC210-215) =="
 python -m spark_text_clustering_tpu.cli lint --scale \
     --telemetry-file "$work/lint_scale.jsonl" >/dev/null
 if [[ $? -ne 0 ]]; then
@@ -1870,7 +2075,7 @@ if [[ $? -ne 0 ]]; then
     fail=1
 fi
 
-echo "== [16/19] measured-scale observatory (probe + scale-check) =="
+echo "== [16/20] measured-scale observatory (probe + scale-check) =="
 # run the sharded entry families for REAL on the forced 2x4 host mesh
 # and reconcile the measured evidence against the gate-15 static
 # record: sharding match, tolerance, zero retraces, V=10M
@@ -1926,7 +2131,7 @@ if [[ $? -ne 1 ]]; then
     fail=1
 fi
 
-echo "== [17/19] serve-fleet chaos drill (rolling publish + SIGKILL) =="
+echo "== [17/20] serve-fleet chaos drill (rolling publish + SIGKILL) =="
 if [[ -d "$work/models" ]] && run_serve_fleet_drill "$work"; then
     # the front's routed-request counter (48 = three exact 16-doc
     # volleys) and the fleet respawn counter (1 — consistent with the
@@ -1942,7 +2147,7 @@ else
     fail=1
 fi
 
-echo "== [18/19] SLO/probe drill (burn-rate gate + queueing observatory) =="
+echo "== [18/20] SLO/probe drill (burn-rate gate + queueing observatory) =="
 slo_ok=1
 if [[ -d "$work/models" ]] && run_slo_probe_drill "$work" degraded; then
     # the planted slow replica (0.35s > the 0.32768s objective line)
@@ -2042,7 +2247,7 @@ if [[ $slo_ok -eq 1 ]]; then
 fi
 [[ $slo_ok -ne 1 ]] && fail=1
 
-echo "== [19/19] protocol audit (stc lint --protocol, STC300-305) =="
+echo "== [19/20] protocol audit (stc lint --protocol, STC300-305) =="
 python -m spark_text_clustering_tpu.cli lint --no-jaxpr --protocol \
     --telemetry-file "$work/lint_protocol.jsonl" >/dev/null
 if [[ $? -ne 0 ]]; then
@@ -2181,6 +2386,47 @@ print(
 EOF
 if [[ $? -ne 0 ]]; then
     echo "FAIL: planted protocol violations not flagged"
+    fail=1
+fi
+
+echo "== [20/20] telemetry transport drill (ship -> SIGKILL collector -> replay) =="
+if run_transport_drill "$work"; then
+    # the restarted collector's fold accounting is exact: 4 batches
+    # (one replay + one live per worker), 12 events, 1 suppressed
+    # duplicate, 2 sources — machine-independent
+    python -m spark_text_clustering_tpu.cli metrics check \
+        "$work/collect_b.jsonl" --baseline "$BASELINE" \
+        --include collect.
+    if [[ $? -ne 0 ]]; then echo "FAIL: collector counters"; fail=1; fi
+    python -m spark_text_clustering_tpu.cli metrics summarize \
+        "$work/collect_agg/w0.jsonl" | grep -q "transport health:"
+    if [[ $? -ne 0 ]]; then
+        echo "FAIL: no transport-health section from the aggregated stream"
+        fail=1
+    fi
+else
+    echo "FAIL: transport chaos drill"
+    fail=1
+fi
+if run_transport_observe_drill "$work"; then
+    python -m spark_text_clustering_tpu.cli monitor --once \
+        --collect-dir "$work/collect_obs" --builtin retrace_storm \
+        --fail-on-alert --quiet >/dev/null
+    if [[ $? -ne 1 ]]; then
+        echo "FAIL: shipped retrace storm did not fire over --collect-dir"
+        fail=1
+    fi
+    if [[ -s "$work/collect_obs/probe.jsonl" ]]; then
+        python -m spark_text_clustering_tpu.cli metrics slo \
+            "$work/collect_obs/probe.jsonl" --compression 400 \
+            --fail-on-burn >/dev/null
+        if [[ $? -ne 1 ]]; then
+            echo "FAIL: collector-side probe stream did not burn under metrics slo"
+            fail=1
+        fi
+    fi
+else
+    echo "FAIL: transport observe drill"
     fail=1
 fi
 

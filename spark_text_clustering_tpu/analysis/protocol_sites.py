@@ -145,6 +145,7 @@ SITES = ProtocolSites(
         f"{_P}/serving/server.py",
         f"{_P}/serving/front.py",
         f"{_P}/telemetry/alerts.py",
+        f"{_P}/telemetry/transport.py",
         f"{_P}/resilience/supervisor.py",
     ),
     # Inline filename literals that mean "a protocol path".
@@ -164,6 +165,8 @@ SITES = ProtocolSites(
         "ENTRY_JSON",               # compilecache: entry.json
         "PAYLOAD_BIN",              # compilecache: executable.bin
         "TREES_PKL",                # compilecache: trees.pkl
+        "SPOOL_NAME",               # transport: ship-spool.jsonl
+        "COLLECT_ANNOUNCE_NAME",    # transport: collect.json
     }),
     # Functions whose return value IS a protocol path.
     path_helpers=frozenset({
@@ -171,6 +174,7 @@ SITES = ProtocolSites(
         "_intent_path", "_marker_path",                   # ledger
         "_ack_path",                                      # supervisor
         "entry_dir",                                      # compilecache
+        "source_stream_path",                             # transport
     }),
     # self.<attr> slots that hold a protocol path.
     path_attrs=frozenset({
@@ -182,6 +186,7 @@ SITES = ProtocolSites(
         (f"{_P}/telemetry/alerts.py", "JsonlTailer", "path"),
         (f"{_P}/telemetry/alerts.py", "AlertLog", "path"),
         (f"{_P}/telemetry/alerts.py", "ActionEmitter", "path"),
+        (f"{_P}/telemetry/transport.py", "ShipSpool", "path"),
     }),
     # Lock-free cross-thread reads STC301 accepts: the attribute is
     # only ever rebound to a fully-constructed immutable object, never
@@ -215,6 +220,22 @@ SITES = ProtocolSites(
                    kind="append", durable=True),
         WriterSite(f"{_P}/telemetry/alerts.py", "ActionEmitter.flush"),
         WriterSite(f"{_P}/serving/front.py", "write_front_announce"),
+        # telemetry transport plane (docs/OBSERVABILITY.md "Telemetry
+        # transport"): the spool append IS the durability contract —
+        # a batch counts as spooled only after its fsync'd checksummed
+        # line lands, exactly like a ledger commit
+        WriterSite(f"{_P}/telemetry/transport.py", "ShipSpool.append",
+                   kind="append", durable=True),
+        WriterSite(f"{_P}/telemetry/transport.py", "ShipSpool.compact"),
+        # the collector's batch fold: event lines + ONE collect_batch
+        # marker, fsync'd BEFORE the ack (marker-last = commit point)
+        WriterSite(f"{_P}/telemetry/transport.py", "Collector.ingest",
+                   kind="append", durable=True),
+        # restart recovery truncates an un-markered tail atomically
+        WriterSite(f"{_P}/telemetry/transport.py",
+                   "Collector._recover_stream"),
+        WriterSite(f"{_P}/telemetry/transport.py",
+                   "write_collect_announce"),
         # compile cache: stage dir then one os.rename publishes the
         # whole artifact (entry.json + payload + trees)
         WriterSite(f"{_P}/compilecache/store.py",
@@ -239,6 +260,11 @@ SITES = ProtocolSites(
         ReaderSite(f"{_P}/telemetry/alerts.py", "AlertLog.replay"),
         ReaderSite(f"{_P}/telemetry/alerts.py", "read_actions"),
         ReaderSite(f"{_P}/serving/probe.py", "read_front_announce"),
+        ReaderSite(f"{_P}/telemetry/transport.py", "ShipSpool.load"),
+        ReaderSite(f"{_P}/telemetry/transport.py",
+                   "Collector._recover_stream"),
+        ReaderSite(f"{_P}/telemetry/transport.py",
+                   "read_collect_announce"),
         ReaderSite(f"{_P}/compilecache/store.py",
                    "ExecutableStore._lookup"),
         ReaderSite(f"{_P}/compilecache/store.py",
@@ -287,6 +313,21 @@ SITES = ProtocolSites(
                 (f"{_P}/cli.py", "_serve_replica_loop"),
             ),
             reader_seed_calls=("read_control",),
+        ),
+        # shipper <-> collector: the HTTP batch envelope.  The shipper
+        # emits it as one dict literal in _ship; the collector's fold
+        # subscripts it off _decode_envelope — a field the fold starts
+        # requiring that the shipper never sends is caught here, not in
+        # a cross-host 400 storm.
+        SchemaPair(
+            name="ship_envelope",
+            writers=(
+                (f"{_P}/telemetry/transport.py", "EventShipper._ship"),
+            ),
+            readers=(
+                (f"{_P}/telemetry/transport.py", "Collector.ingest"),
+            ),
+            reader_seed_calls=("_decode_envelope",),
         ),
     ),
 )

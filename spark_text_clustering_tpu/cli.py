@@ -704,7 +704,10 @@ def cmd_probe(args: argparse.Namespace) -> int:
         print("probe needs --fleet-dir or --url", file=sys.stderr)
         return 2
     own_telemetry = bool(getattr(args, "telemetry_file", None))
-    telemetry.configure(args.telemetry_file if own_telemetry else None)
+    telemetry.configure(
+        args.telemetry_file if own_telemetry else None,
+        ship_to=getattr(args, "ship_to", None),
+    )
     try:
         if args.url:
             part = args.url.split("//")[-1].rstrip("/")
@@ -742,6 +745,74 @@ def cmd_probe(args: argparse.Namespace) -> int:
     bad = rep["failures"] + rep["pin_violations"]
     if args.fail_on_error and bad:
         return 1
+    return 0
+
+
+def cmd_collect(args: argparse.Namespace) -> int:
+    """jax-free telemetry collector daemon (docs/OBSERVABILITY.md
+    "Telemetry transport"): receives sequence-numbered batch pushes
+    from ``EventShipper``s on ``POST /ingest``, dedupes on
+    ``(source_id, seq)``, and folds each source into a manifested JSONL
+    stream under ``--dir`` — so every existing analysis verb works
+    unchanged over the aggregated dir.  Serves ``/healthz`` and
+    ``/metrics`` (Prometheus via content negotiation) and announces its
+    bound address in ``<dir>/collect.json``."""
+    import threading
+    import time
+
+    from .resilience.supervisor import PreemptionNotice
+    from .telemetry import transport
+
+    # a collector must never ship its OWN run stream to itself — an
+    # inherited STC_SHIP_TO would loop every folded event back in
+    os.environ.pop(transport.ENV_SHIP_TO, None)
+    own_telemetry = bool(getattr(args, "telemetry_file", None))
+    telemetry.configure(args.telemetry_file if own_telemetry else None)
+    collector = transport.Collector(
+        args.dir, registry=telemetry.get_registry()
+    )
+    try:
+        httpd = transport.make_collector_server(
+            collector, args.host, args.port
+        )
+    except OSError as exc:
+        print(f"error: cannot bind {args.host}:{args.port}: {exc}",
+              file=sys.stderr)
+        if own_telemetry:
+            telemetry.shutdown()
+        return 1
+    host, port = httpd.server_address[:2]
+    transport.write_collect_announce(args.dir, host, port)
+    if own_telemetry:
+        telemetry.manifest(
+            kind="collect", collect_dir=args.dir, host=host, port=port,
+        )
+    serve_thread = threading.Thread(
+        target=httpd.serve_forever, name="stc-collect-http", daemon=True,
+    )
+    serve_thread.start()
+    print(f"collector on http://{host}:{port} -> {args.dir}")
+    preempt = PreemptionNotice().install()
+    stop = threading.Event()
+    deadline = (
+        time.monotonic() + args.max_seconds
+        if args.max_seconds is not None else None
+    )
+    while not preempt():
+        if deadline is not None and time.monotonic() >= deadline:
+            break
+        stop.wait(0.2)
+    httpd.shutdown()
+    httpd.server_close()
+    serve_thread.join(timeout=5.0)
+    stats = collector.stats()
+    print(
+        f"collector drained: {stats['sources']} source(s), "
+        f"{stats['batches']} batch(es), {stats['ingested']} event(s), "
+        f"{stats['duplicates']} duplicate batch(es) suppressed"
+    )
+    if own_telemetry:
+        telemetry.shutdown()
     return 0
 
 
@@ -1150,6 +1221,14 @@ def cmd_supervise(args: argparse.Namespace) -> int:
         print("--watch-dir is required for stream roles",
               file=sys.stderr)
         return 2
+    if getattr(args, "ship_to", None):
+        # env, not argv: workers inherit the collector address through
+        # FleetSupervisor._worker_env (which copies this environment),
+        # and the supervisor's own stream ships through configure()'s
+        # STC_SHIP_TO pickup — one knob, every stream in the fleet
+        from .telemetry import transport as _transport
+
+        os.environ[_transport.ENV_SHIP_TO] = args.ship_to
     own_telemetry = bool(getattr(args, "telemetry_file", None))
     if own_telemetry:
         telemetry.configure(args.telemetry_file)
@@ -2095,7 +2174,34 @@ def build_parser() -> argparse.ArgumentParser:
                          "+ probe.* counters) — feed it to `stc "
                          "monitor`/`stc metrics slo` as the "
                          "outside-in SLO source")
+    pb.add_argument("--ship-to", default=None, metavar="HOST:PORT",
+                    help="also push the probe's run stream to an "
+                         "`stc collect` daemon so fleet SLOs evaluate "
+                         "off one aggregated dir")
     pb.set_defaults(fn=cmd_probe)
+
+    co = sub.add_parser(
+        "collect",
+        help="jax-free telemetry collector: HTTP ingest of shipped "
+             "run-stream batches, (source_id, seq) exactly-once "
+             "dedup, per-source manifested JSONL streams under --dir "
+             "(every metrics/monitor/slo verb works unchanged over "
+             "the aggregated dir)",
+    )
+    co.add_argument("--dir", required=True,
+                    help="aggregation dir: one <source_id>.jsonl per "
+                         "shipper, plus the collect.json announce")
+    co.add_argument("--host", default="127.0.0.1")
+    co.add_argument("--port", type=int, default=0,
+                    help="ingest port (0 picks one; announced in "
+                         "<dir>/collect.json)")
+    co.add_argument("--max-seconds", type=float, default=None,
+                    help="exit after this long (drills); default: "
+                         "run until SIGTERM")
+    co.add_argument("--telemetry-file", default=None,
+                    help="the collector's OWN run stream (collect.* "
+                         "counters; never shipped to itself)")
+    co.set_defaults(fn=cmd_collect)
 
     ss = sub.add_parser(
         "stream-score",
@@ -2265,6 +2371,13 @@ def build_parser() -> argparse.ArgumentParser:
                          "(worker-wNNN-sSS.jsonl) — the per-worker "
                          "tracks `metrics trace --causal` and `metrics "
                          "merge` join with the supervisor stream")
+    sv.add_argument("--ship-to", default=None, metavar="HOST:PORT",
+                    help="push every run stream in the fleet "
+                         "(supervisor, workers, embedded front) to an "
+                         "`stc collect` daemon at this address — "
+                         "workers inherit it via the STC_SHIP_TO env "
+                         "var (docs/OBSERVABILITY.md \"Telemetry "
+                         "transport\")")
     sv.add_argument("--worker-arg", action="append", default=[],
                     help="extra argv appended verbatim to every worker "
                          "command (repeatable)")

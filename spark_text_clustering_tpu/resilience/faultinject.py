@@ -80,6 +80,8 @@ SITES = frozenset({
     "stream.poll",        # streaming source directory poll
     "report.write",       # scoring report write
     "telemetry.write",    # telemetry run-stream append
+    "telemetry.ship",     # before a shipper batch POSTs to the collector
+    "collect.ingest",     # top of the collector's /ingest fold
     "ledger.stage",       # before an epoch intent record is staged
     "ledger.commit",      # before the epoch ledger append (commit point)
     "supervisor.spawn",   # before the supervisor spawns a worker process

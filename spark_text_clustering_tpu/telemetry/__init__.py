@@ -48,6 +48,7 @@ import time
 from contextlib import contextmanager
 from typing import Iterable, Optional
 
+from . import transport
 from .dispatch import instrument as instrument_dispatch
 from .dispatch import note_sync as _note_sync
 from .events import (
@@ -128,17 +129,26 @@ def configure(
     *,
     run_id: Optional[str] = None,
     fresh_registry: bool = True,
+    ship_to: Optional[str] = None,
 ) -> Optional[TelemetryWriter]:
     """Enable telemetry for this process.
 
     ``path`` is the run's JSONL stream (None = registry-only: spans and
     metrics aggregate in memory, nothing is written).  Reconfiguring
     closes any previous writer.  Returns the writer (or None).
+
+    ``ship_to`` (or the ``STC_SHIP_TO`` env var, which is how
+    supervised workers inherit the collector address) additionally
+    pushes every record of the run stream to an ``stc collect``
+    daemon at ``host:port`` — see ``telemetry.transport``.
     """
+    import os as _os
+
     global _writer, _enabled
     if _writer is not None:
         _writer.close()
         _writer = None
+    transport.close_shipping()
     if fresh_registry:
         _registry.reset()
     _writer = (
@@ -146,6 +156,11 @@ def configure(
         if path
         else None
     )
+    target = ship_to or _os.environ.get(transport.ENV_SHIP_TO, "")
+    if path and target:
+        transport.configure_shipping(
+            target, stream_path=path, registry=_registry
+        )
     _enabled = True
     return _writer
 
@@ -159,11 +174,14 @@ def manifest(**fields) -> None:
 
 def shutdown() -> None:
     """Disable telemetry; flush the final registry snapshot and close
-    the run stream."""
+    the run stream.  The writer closes FIRST so the final registry
+    snapshot flows through the sink into the shipper, then the shipper
+    drains (or spools) it."""
     global _writer, _enabled
     if _writer is not None:
         _writer.close()
         _writer = None
+    transport.close_shipping()
     _enabled = False
 
 

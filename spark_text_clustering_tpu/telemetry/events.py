@@ -29,6 +29,7 @@ import time
 import warnings
 from typing import Dict, List, Optional
 
+from . import transport
 from .registry import MetricRegistry
 
 __all__ = [
@@ -112,18 +113,23 @@ class JsonlSink:
         try:
             retry_call(_append, site="telemetry.write",
                        policy=TELEMETRY_POLICY)
-            return True
+            ok = True
         except RetryGiveUp as exc:
             last = exc.last
             self._surface(
                 last if isinstance(last, OSError) else OSError(last)
             )
-            return False
+            ok = False
         except (TypeError, ValueError) as exc:
             # unserializable field — drop the record, keep the run
             # alive, count the loss
             self._surface(OSError(exc))
             return False
+        # transport hook: a configured shipper also gets the record —
+        # deliberately even when the LOCAL append failed, so a full
+        # local disk does not blind the collector too
+        transport.offer(rec)
+        return ok
 
 
 def git_rev(cwd: Optional[str] = None) -> Optional[str]:

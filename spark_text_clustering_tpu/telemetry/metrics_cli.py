@@ -62,6 +62,7 @@ __all__ = [
     "slo_health",
     "compile_health",
     "memory_health",
+    "transport_health",
     "cmd_summarize",
     "cmd_tail",
     "cmd_diff",
@@ -376,33 +377,63 @@ def clock_corrections(streams) -> Dict[str, float]:
     offset plus the lease write->read latency (bounded by one sweep
     interval), so the MINIMUM over all renewals is the tightest offset
     estimate the filesystem protocol admits.  Worker streams pair with
-    their anchors by the ``worker_index`` manifest field; streams with
-    no anchor (the supervisor itself, standalone serve) correct by 0 —
-    correction is a refinement, never a requirement.
+    their anchors by the ``worker_index`` manifest field.
+
+    Collector-aggregated streams carry the SAME math at the HTTP hop:
+    every ``collect_batch`` marker pairs a shipper-clock ``sent_ts``
+    with a collector-clock ``recv_ts``, and ``recv - sent`` is the true
+    offset plus one push's transport latency — so the minimum over a
+    source's markers anchors that stream to the collector clock.
+    Remote streams have no fleet ``worker_index``, so they pair by the
+    ``source_id`` the collector injects into each manifest (falling
+    back to the marker's own source_id inside the stream).  Streams
+    with no anchor of either kind correct by 0 — correction is a
+    refinement, never a requirement.
     """
     out: Dict[str, float] = {s["label"]: 0.0 for s in streams}
     anchors: Dict[int, List[float]] = {}
+    source_anchors: Dict[str, List[float]] = {}
     for s in streams:
         for e in s["events"]:
-            if e.get("event") != "lease_sync":
-                continue
-            if not (_is_num(e.get("lease_ts"))
-                    and _is_num(e.get("observed_ts"))):
-                continue
-            try:
-                worker = int(e.get("worker", -1))
-            except (TypeError, ValueError):
-                continue
-            anchors.setdefault(worker, []).append(
-                float(e["observed_ts"]) - float(e["lease_ts"])
-            )
-    if not anchors:
+            kind = e.get("event")
+            if kind == "lease_sync":
+                if not (_is_num(e.get("lease_ts"))
+                        and _is_num(e.get("observed_ts"))):
+                    continue
+                try:
+                    worker = int(e.get("worker", -1))
+                except (TypeError, ValueError):
+                    continue
+                anchors.setdefault(worker, []).append(
+                    float(e["observed_ts"]) - float(e["lease_ts"])
+                )
+            elif kind == "collect_batch":
+                sid = e.get("source_id")
+                if not (isinstance(sid, str)
+                        and _is_num(e.get("sent_ts"))
+                        and _is_num(e.get("recv_ts"))):
+                    continue
+                source_anchors.setdefault(sid, []).append(
+                    float(e["recv_ts"]) - float(e["sent_ts"])
+                )
+    if not anchors and not source_anchors:
         return out
     for s in streams:
         widx = s["manifest"].get("worker_index")
-        if not (_is_num(widx) and int(widx) in anchors):
+        if _is_num(widx) and int(widx) in anchors:
+            out[s["label"]] = round(min(anchors[int(widx)]), 6)
             continue
-        out[s["label"]] = round(min(anchors[int(widx)]), 6)
+        sid = s["manifest"].get("source_id")
+        if not isinstance(sid, str):
+            # aggregated streams whose manifest predates the collector's
+            # source_id stamp still carry markers of exactly one source
+            sids = {
+                e.get("source_id") for e in s["events"]
+                if e.get("event") == "collect_batch"
+            } - {None}
+            sid = sids.pop() if len(sids) == 1 else None
+        if sid is not None and sid in source_anchors:
+            out[s["label"]] = round(min(source_anchors[sid]), 6)
     return out
 
 
@@ -1073,6 +1104,117 @@ def slo_health(
     }
 
 
+def transport_health(
+    events: List[Dict], metrics: Dict[str, float]
+) -> Optional[Dict]:
+    """Telemetry-transport health (docs/OBSERVABILITY.md "Telemetry
+    transport"): the shipper's delivery accounting (shipped/spooled/
+    dropped/replayed off its ``telemetry.*`` counters), the collector's
+    fold accounting (``collect.*`` counters), and a per-source view
+    derived from ``collect_batch`` markers — batches, events, replay
+    totals, and ship lag (the marker's collector-clock ``recv_ts``
+    minus its shipper-clock ``sent_ts``, i.e. how far behind the
+    collector's view of that source ran at the last push).  None when
+    the run never touched the transport plane."""
+    markers = [e for e in events if e.get("event") == "collect_batch"]
+    ship_keys = (
+        "telemetry.shipped", "telemetry.spooled", "telemetry.dropped",
+        "telemetry.ship_errors", "telemetry.ship_replayed",
+    )
+    shipper = {
+        k.split(".", 1)[1]: int(metrics[f"counter.{k}"])
+        for k in ship_keys if _is_num(metrics.get(f"counter.{k}"))
+    }
+    collect_keys = (
+        "collect.batches", "collect.ingested", "collect.duplicates",
+        "collect.duplicate_events", "collect.ingest_errors",
+        "collect.recovered_streams", "collect.truncated_events",
+    )
+    collector = {
+        k.split(".", 1)[1]: int(metrics[f"counter.{k}"])
+        for k in collect_keys if _is_num(metrics.get(f"counter.{k}"))
+    }
+    if _is_num(metrics.get("gauge.collect.sources")):
+        collector["sources"] = int(metrics["gauge.collect.sources"])
+    if not markers and not shipper and not collector:
+        return None
+    per_source: Dict[str, Dict] = {}
+    for e in markers:
+        sid = str(e.get("source_id", "?"))
+        rec = per_source.setdefault(sid, {
+            "batches": 0, "events": 0,
+            "replayed_batches": 0, "replayed_events": 0,
+        })
+        rec["batches"] += 1
+        n = e.get("events")
+        rec["events"] += int(n) if _is_num(n) else 0
+        if e.get("replayed"):
+            rec["replayed_batches"] += 1
+            rec["replayed_events"] += int(n) if _is_num(n) else 0
+        if _is_num(e.get("recv_ts")):
+            recv = float(e["recv_ts"])
+            if recv >= rec.get("last_recv_ts", float("-inf")):
+                rec["last_recv_ts"] = recv
+                if _is_num(e.get("sent_ts")):
+                    rec["ship_lag_s"] = round(
+                        recv - float(e["sent_ts"]), 6
+                    )
+    out: Dict = {}
+    if shipper:
+        out["shipper"] = shipper
+    if collector:
+        out["collector"] = collector
+    if per_source:
+        out["sources"] = {
+            sid: per_source[sid] for sid in sorted(per_source)
+        }
+        out["replayed_events"] = sum(
+            r["replayed_events"] for r in per_source.values()
+        )
+    return out
+
+
+def _print_transport_health(th: Dict, file=None) -> None:
+    file = file if file is not None else sys.stdout
+    print("transport health:", file=file)
+    sh = th.get("shipper")
+    if sh:
+        print(
+            f"  shipper: shipped={sh.get('shipped', 0)}  "
+            f"spooled={sh.get('spooled', 0)}  "
+            f"replayed={sh.get('ship_replayed', 0)}  "
+            f"dropped={sh.get('dropped', 0)}  "
+            f"ship_errors={sh.get('ship_errors', 0)}", file=file,
+        )
+    co = th.get("collector")
+    if co:
+        extra = ""
+        if co.get("recovered_streams"):
+            extra = (
+                f"  recovered={co['recovered_streams']} "
+                f"(truncated {co.get('truncated_events', 0)} event(s))"
+            )
+        print(
+            f"  collector: batches={co.get('batches', 0)}  "
+            f"events={co.get('ingested', 0)}  "
+            f"dedup_suppressed={co.get('duplicates', 0)} batch(es)/"
+            f"{co.get('duplicate_events', 0)} event(s)  "
+            f"ingest_errors={co.get('ingest_errors', 0)}"
+            + extra, file=file,
+        )
+    for sid, rec in (th.get("sources") or {}).items():
+        lag = rec.get("ship_lag_s")
+        lag_s = f"  lag={lag:+.3f}s" if lag is not None else ""
+        rp = (
+            f"  replayed={rec['replayed_events']}"
+            if rec.get("replayed_batches") else ""
+        )
+        print(
+            f"  source {sid}: {rec['batches']} batch(es), "
+            f"{rec['events']} event(s){rp}{lag_s}", file=file,
+        )
+
+
 def _print_slo_health(slh: Dict, file=None) -> None:
     file = file if file is not None else sys.stdout
     print("slo health:", file=file)
@@ -1407,6 +1549,7 @@ def _cmd_summarize(args) -> int:
     slh = slo_health(events, metrics)
     ch = compile_health(events, metrics)
     mh = memory_health(metrics)
+    th = transport_health(events, metrics)
     if getattr(args, "json", False):
         doc = {"manifest": manifest, "metrics": metrics}
         if lh is not None:
@@ -1425,6 +1568,8 @@ def _cmd_summarize(args) -> int:
             doc["compile_health"] = ch
         if mh is not None:
             doc["memory_health"] = mh
+        if th is not None:
+            doc["transport_health"] = th
         print(json.dumps(doc, sort_keys=True))
         return 0
     print(f"run: {args.run}")
@@ -1447,6 +1592,8 @@ def _cmd_summarize(args) -> int:
         _print_compile_health(ch)
     if mh is not None:
         _print_memory_health(mh)
+    if th is not None:
+        _print_transport_health(th)
     print("metrics:")
     for k in sorted(metrics):
         v = metrics[k]
@@ -1564,6 +1711,133 @@ def _cmd_diff(args) -> int:
         print(f"{k.ljust(w)}  {fa:>14}  {fb:>14}  {fr:>8}{mark}")
     print(f"# {len(rows)} metrics, {changed} changed beyond "
           f"±{args.highlight:.0%} (or one-sided)")
+    return 0
+
+
+# bench-diff: name-hint direction heuristics — which way is "worse"?
+# (unknown-direction metrics are reported but never gate)
+_BENCH_LOWER_BETTER = (
+    "seconds", "_ms", "_s_", "bytes", "errors", "failures", "dropped",
+    "retries", "retraces", "giveups", "lag",
+)
+_BENCH_HIGHER_BETTER = (
+    "per_s", "per_sec", "throughput", "docs_per", "hit_rate", "hits",
+)
+
+
+def _bench_direction(name: str) -> Optional[str]:
+    """``"lower"``/``"higher"`` = which value is BETTER, None = no
+    opinion.  Higher-better hints win ties ("cache_hits_per_s" is a
+    rate even though "hits" alone would also match)."""
+    n = name.lower()
+    if any(h in n for h in _BENCH_HIGHER_BETTER):
+        return "higher"
+    if any(h in n for h in _BENCH_LOWER_BETTER):
+        return "lower"
+    return None
+
+
+def cmd_bench_diff(args) -> int:
+    try:
+        return _cmd_bench_diff(args)
+    except BrokenPipeError:      # `... | head` closed the pipe
+        return 0
+
+
+def _cmd_bench_diff(args) -> int:
+    """Compare two BENCH_*.json records (or bench event streams) with
+    per-section relative-change columns and an optional regression
+    gate — the perf-trajectory view `metrics diff`'s flat ratio table
+    was never built for."""
+    _, ev_a = load_run(args.a)
+    _, ev_b = load_run(args.b)
+    ma, mb = run_metrics(ev_a), run_metrics(ev_b)
+    # BENCH records flatten under "bench."; restrict to that namespace
+    # when either side has it so stray events.* counts don't pollute
+    # the perf table.  Plain event streams compare whole.
+    if any(k.startswith("bench.") for k in (*ma, *mb)):
+        ma = {k: v for k, v in ma.items() if k.startswith("bench.")}
+        mb = {k: v for k, v in mb.items() if k.startswith("bench.")}
+    rows = []
+    for k in sorted(set(ma) | set(mb)):
+        a, b = ma.get(k), mb.get(k)
+        delta_pct = None
+        if a is not None and b is not None:
+            delta_pct = (
+                (b - a) / abs(a) * 100.0 if abs(a) > _EPS
+                else (0.0 if abs(b) <= _EPS else math.inf)
+            )
+        direction = _bench_direction(k)
+        worse = None
+        if delta_pct is not None and direction is not None:
+            worse = (
+                delta_pct if direction == "lower" else -delta_pct
+            )
+        # section = first meaningful component: strip the "bench."
+        # namespace and the "record" wrapper whole-file BENCH JSON
+        # flattens through, so `bench.record.assign.seconds` and a
+        # bench-stream's `bench.assign.seconds` both land in [assign]
+        parts = k.split(".")
+        if parts and parts[0] == "bench":
+            parts = parts[1:]
+        if len(parts) > 1 and parts[0] == "record":
+            parts = parts[1:]
+        sec = parts[0] if len(parts) > 1 else "(top)"
+        rows.append({
+            "metric": k, "section": sec, "a": a, "b": b,
+            "delta_pct": delta_pct, "direction": direction,
+            "worse_pct": worse,
+        })
+    rows.sort(key=lambda r: (r["section"], r["metric"]))
+    thresh = args.fail_on_regression
+    regressions = [
+        r for r in rows
+        if thresh is not None and r["worse_pct"] is not None
+        and r["worse_pct"] > thresh
+    ]
+    if getattr(args, "json", False):
+        sections: Dict[str, List[Dict]] = {}
+        for r in rows:
+            sections.setdefault(r["section"], []).append({
+                k: v for k, v in r.items() if k != "section"
+            })
+        print(json.dumps({
+            "a": args.a, "b": args.b,
+            "sections": sections,
+            "regressions": [r["metric"] for r in regressions],
+            "fail_on_regression_pct": thresh,
+        }, sort_keys=True))
+        return 1 if regressions else 0
+    w = max((len(r["metric"]) for r in rows), default=10)
+    print(f"bench diff: a={args.a}  b={args.b}")
+    last_sec = None
+    for r in rows:
+        if r["section"] != last_sec:
+            last_sec = r["section"]
+            print(f"[{last_sec}]")
+        fa = "-" if r["a"] is None else f"{r['a']:.6g}"
+        fb = "-" if r["b"] is None else f"{r['b']:.6g}"
+        if r["delta_pct"] is None:
+            fd = "only-one-side"
+        else:
+            fd = f"{r['delta_pct']:+.1f}%"
+        dirmark = {"lower": "v better", "higher": "^ better",
+                   None: ""}[r["direction"]]
+        mark = ""
+        if thresh is not None and r["worse_pct"] is not None \
+                and r["worse_pct"] > thresh:
+            mark = "  <<REGRESSION"
+        print(f"  {r['metric'].ljust(w)}  {fa:>14}  {fb:>14}  "
+              f"{fd:>14}  {dirmark:<8}{mark}")
+    if thresh is not None:
+        print(
+            f"# {len(rows)} metrics, {len(regressions)} regression(s) "
+            f"beyond {thresh:g}% in the worse direction"
+        )
+        if regressions:
+            return 1
+    else:
+        print(f"# {len(rows)} metrics")
     return 0
 
 
@@ -2200,6 +2474,25 @@ def add_metrics_subparser(sub) -> None:
         help="mark metrics whose ratio moved beyond this fraction",
     )
     df.set_defaults(fn=cmd_diff)
+
+    bd = msub.add_parser(
+        "bench-diff",
+        help="compare two BENCH_*.json records (or bench event "
+             "streams) section by section with relative-change "
+             "columns and a regression gate — the perf trajectory, "
+             "not just a flat ratio table",
+    )
+    bd.add_argument("a", help="baseline BENCH record / run stream")
+    bd.add_argument("b", help="candidate BENCH record / run stream")
+    bd.add_argument("--json", action="store_true")
+    bd.add_argument(
+        "--fail-on-regression", type=float, default=None,
+        metavar="PCT",
+        help="exit 1 when any known-direction metric moved more than "
+             "PCT%% in the WORSE direction (seconds/bytes/errors up, "
+             "throughput down); unknown-direction metrics never gate",
+    )
+    bd.set_defaults(fn=cmd_bench_diff)
 
     ck = msub.add_parser(
         "check", help="gate a run against a baseline JSON"

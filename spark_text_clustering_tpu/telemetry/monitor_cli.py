@@ -19,6 +19,7 @@ instead of re-firing).
 from __future__ import annotations
 
 import json
+import os
 import sys
 from typing import Dict, List, Optional
 
@@ -133,6 +134,13 @@ def _print_transition(rec: Dict) -> None:
 
 
 def cmd_monitor(args) -> int:
+    if getattr(args, "collect_dir", None):
+        # an `stc collect` aggregation dir is just N manifested streams:
+        # expand it onto --stream so the engine tail-follows sources
+        # that connect mid-run (the glob re-expands every poll)
+        args.stream = list(args.stream or []) + [
+            os.path.join(args.collect_dir, "*.jsonl")
+        ]
     own_telemetry = bool(getattr(args, "telemetry_file", None))
     telemetry.configure(args.telemetry_file if own_telemetry else None)
     if own_telemetry:
@@ -236,6 +244,12 @@ def add_monitor_subparser(sub) -> None:
         help="telemetry JSONL stream(s) to tail-follow (glob patterns "
              "re-expanded every poll, so per-process streams that "
              "appear mid-run are picked up live; repeatable)",
+    )
+    mo.add_argument(
+        "--collect-dir", default=None,
+        help="an `stc collect` aggregation dir: shorthand for "
+             "--stream '<dir>/*.jsonl' — tail the whole fleet's "
+             "shipped streams live off one collector",
     )
     mo.add_argument(
         "--fleet-dir", default=None,

@@ -157,6 +157,46 @@ METRICS: Dict[str, str] = {
         "error sidecars archived to quarantine .archive/ during requeue",
     # -- telemetry self-observation -------------------------------------
     "telemetry_write_errors": "run-stream appends that failed after retry",
+    # -- telemetry transport plane (telemetry.transport;
+    #    docs/OBSERVABILITY.md "Telemetry transport") --------------------
+    "telemetry.shipped":
+        "run-stream records acknowledged by the collector (fresh "
+        "sends; replays count separately)",
+    "telemetry.spooled":
+        "records written to the durable local spool because the "
+        "collector was unreachable (replayed on reconnect)",
+    "telemetry.dropped":
+        "records lost by the shipper and COUNTED: bounded-buffer "
+        "overflow, unserializable records, or a spool that also "
+        "failed — never silent",
+    "telemetry.ship_errors":
+        "batch pushes that exhausted their retry policy (each one "
+        "diverts its batch to the spool)",
+    "telemetry.ship_replayed":
+        "spooled records delivered to the collector on reconnect "
+        "(the replay half of the exactly-once contract)",
+    "collect.batches":
+        "wire batches folded into per-source streams by the "
+        "collector (each one committed by its collect_batch marker)",
+    "collect.ingested":
+        "events folded exactly once into collector-side streams",
+    "collect.duplicates":
+        "batches suppressed by (source_id, seq) dedup — the "
+        "at-least-once re-sends the exactly-once fold absorbed",
+    "collect.duplicate_events":
+        "events inside dedup-suppressed batches (the volume the "
+        "suppression saved)",
+    "collect.ingest_errors":
+        "POST /ingest requests rejected (malformed body or an "
+        "injected collect.ingest fault) — the shipper retries/spools",
+    "collect.recovered_streams":
+        "per-source streams whose un-markered tail was truncated at "
+        "collector restart (the crash window between append and ack)",
+    "collect.truncated_events":
+        "uncommitted event lines removed by recovery truncation "
+        "(re-shipped by their source, so folded exactly once)",
+    "collect.sources":
+        "distinct source_ids the collector has folded streams for",
     # -- streaming ------------------------------------------------------
     "stream.queue_depth": "new-but-unconsumed files seen by the last poll",
     "stream.trigger_cap":

@@ -602,12 +602,13 @@ def test_repo_is_protocol_clean():
 
 
 def test_stc305_covers_lease_and_control_pairs():
-    """The acceptance pins: the supervisor<->front lease contract and
-    the supervisor<->replica control contract both resolve, and every
-    field a reader requires is provably emitted."""
+    """The acceptance pins: the supervisor<->front lease contract, the
+    supervisor<->replica control contract, and the shipper<->collector
+    wire envelope all resolve, and every field a reader requires is
+    provably emitted."""
     _, report = run_protocol_audit(REPO_ROOT)
     pairs = report["pairs"]
-    assert sorted(pairs) == ["control", "lease"]
+    assert sorted(pairs) == ["control", "lease", "ship_envelope"]
     lease = pairs["lease"]
     assert lease["missing"] == []
     assert set(lease["required"]) >= {
@@ -621,6 +622,14 @@ def test_stc305_covers_lease_and_control_pairs():
     assert control["missing"] == []
     assert set(control["required"]) == {"id", "stamp"}
     assert set(control["emitted"]) == {"id", "stamp", "swap_to"}
+    ship = pairs["ship_envelope"]
+    assert ship["missing"] == []
+    assert set(ship["required"]) == {
+        "events", "sent_ts", "seq", "source_id",
+    }
+    assert set(ship["emitted"]) >= {
+        "events", "replayed", "schema", "sent_ts", "seq", "source_id",
+    }
 
 
 def test_changed_scope_gates_the_protocol_tier():

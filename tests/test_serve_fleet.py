@@ -248,7 +248,10 @@ class _StubReplica:
         ).start()
 
     def close(self):
+        # server_close() releases the listening socket so routed
+        # requests get ECONNREFUSED instead of hanging in the backlog
         self.httpd.shutdown()
+        self.httpd.server_close()
 
 
 class TestRouterTransport:
@@ -351,6 +354,7 @@ class TestRouterTransport:
             conn.close()
         finally:
             httpd.shutdown()
+            httpd.server_close()
             stub.close()
 
 

@@ -541,7 +541,11 @@ class _StubReplica:
         ).start()
 
     def close(self):
+        # server_close() releases the listening socket: a request to a
+        # closed stub must get ECONNREFUSED, not sit in the kernel
+        # backlog until the client's request timeout
         self.httpd.shutdown()
+        self.httpd.server_close()
 
 
 def _write_lease(fleet, index, **fields):
@@ -852,7 +856,11 @@ class _StubFront:
         ).start()
 
     def close(self):
+        # server_close() releases the listening socket: a request to a
+        # closed stub must get ECONNREFUSED, not sit in the kernel
+        # backlog until the client's request timeout
         self.httpd.shutdown()
+        self.httpd.server_close()
 
 
 class TestProber:

@@ -1,0 +1,555 @@
+"""Telemetry transport plane: spool lifecycle (outage -> spool ->
+replay exactly once), collector dedup + crash recovery, the facade's
+``ship_to`` hook, and the analysis-side views (transport-health
+summarize section, HTTP-hop clock anchors, ``metrics bench-diff``)."""
+
+import argparse
+import gzip
+import json
+import socket
+import threading
+
+import pytest
+
+from spark_text_clustering_tpu import telemetry
+from spark_text_clustering_tpu.resilience.retry import RetryPolicy
+from spark_text_clustering_tpu.telemetry import transport
+from spark_text_clustering_tpu.telemetry.metrics_cli import (
+    _bench_direction,
+    clock_corrections,
+    cmd_bench_diff,
+    transport_health,
+)
+from spark_text_clustering_tpu.telemetry.registry import MetricRegistry
+from spark_text_clustering_tpu.telemetry.transport import (
+    Collector,
+    EventShipper,
+    ShipSpool,
+    make_collector_server,
+    parse_ship_url,
+    sanitize_source_id,
+    source_stream_path,
+)
+
+# one attempt, millisecond back-off: tests exercise the failure paths
+# and must not pay the default ship fuse per batch
+_FAST = RetryPolicy(
+    attempts=1, base_delay=0.01, max_delay=0.01,
+    retry_on=(OSError,), emit_events=False,
+)
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _envelope(source_id, seq, events, sent_ts=100.0, replayed=False):
+    return gzip.compress(json.dumps({
+        "schema": transport.WIRE_SCHEMA,
+        "source_id": source_id,
+        "seq": seq,
+        "sent_ts": sent_ts,
+        "replayed": replayed,
+        "events": events,
+    }).encode("utf-8"))
+
+
+class _Server:
+    """In-process collector HTTP server bound to a real port."""
+
+    def __init__(self, collect_dir, port=0, registry=None):
+        self.collector = Collector(
+            str(collect_dir), registry=registry or MetricRegistry()
+        )
+        self.httpd = make_collector_server(self.collector, port=port)
+        self.port = self.httpd.server_address[1]
+        self.thread = threading.Thread(
+            target=self.httpd.serve_forever, daemon=True
+        )
+        self.thread.start()
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()   # release the port for restarts
+        self.thread.join(timeout=5.0)
+
+
+@pytest.fixture(autouse=True)
+def _telemetry_reset(monkeypatch):
+    """Transport state is process-global (module shipper + env target):
+    every test starts and ends unconfigured."""
+    monkeypatch.delenv(transport.ENV_SHIP_TO, raising=False)
+    telemetry.shutdown()
+    telemetry.get_registry().reset()
+    yield
+    telemetry.shutdown()
+    telemetry.get_registry().reset()
+
+
+class TestWireHelpers:
+    def test_sanitize_source_id_is_filesystem_safe(self):
+        assert sanitize_source_id("host-1-run") == "host-1-run"
+        # path metacharacters must never reach the stream filename
+        assert "/" not in sanitize_source_id("../../etc/passwd")
+        assert sanitize_source_id("a b:c") == "a_b_c"
+        assert sanitize_source_id("") == "unknown"
+
+    def test_parse_ship_url(self):
+        assert parse_ship_url("http://h1:9200") == ("h1", 9200)
+        assert parse_ship_url("h1:9200") == ("h1", 9200)
+        assert parse_ship_url(":9200") == ("127.0.0.1", 9200)
+        with pytest.raises(ValueError):
+            parse_ship_url("h1")            # no port
+        with pytest.raises(ValueError):
+            parse_ship_url("https://h1:9200")   # plain HTTP only
+
+    def test_source_stream_path_sanitizes(self, tmp_path):
+        import os
+
+        p = source_stream_path(str(tmp_path), "../../evil")
+        # the separator is replaced, so the stream can never escape
+        # the aggregation dir no matter what the wire says
+        assert os.path.dirname(os.path.abspath(p)) == str(tmp_path)
+
+
+class TestShipSpool:
+    def _batch(self, seq, n=2):
+        return {
+            "seq": seq, "sent_ts": float(seq),
+            "events": [{"event": "e", "i": seq * 10 + j}
+                       for j in range(n)],
+        }
+
+    def test_roundtrip_and_compact(self, tmp_path):
+        sp = ShipSpool(str(tmp_path / "spool"))
+        assert sp.load() == [] and sp.pending() == 0
+        sp.append(self._batch(1))
+        sp.append(self._batch(2, n=3))
+        got = sp.load()
+        assert [b["seq"] for b in got] == [1, 2]
+        assert sp.pending() == 5
+        sp.compact(got[1:])
+        assert [b["seq"] for b in sp.load()] == [2]
+        sp.compact([])
+        assert sp.load() == []
+
+    def test_torn_final_line_is_ignored(self, tmp_path):
+        sp = ShipSpool(str(tmp_path / "spool"))
+        sp.append(self._batch(1))
+        sp.append(self._batch(2))
+        with open(sp.path, "a", encoding="utf-8") as f:
+            f.write('{"seq": 3, "events": [{"tru')   # crash mid-append
+        assert [b["seq"] for b in sp.load()] == [1, 2]
+
+    def test_checksum_mismatch_final_line_is_ignored(self, tmp_path):
+        sp = ShipSpool(str(tmp_path / "spool"))
+        sp.append(self._batch(1))
+        rec = dict(self._batch(2), crc="0" * 16)
+        with open(sp.path, "a", encoding="utf-8") as f:
+            f.write(json.dumps(rec) + "\n")
+        assert [b["seq"] for b in sp.load()] == [1]
+
+    def test_corruption_before_tail_raises(self, tmp_path):
+        # data loss in the middle is NOT a torn tail: surface it
+        sp = ShipSpool(str(tmp_path / "spool"))
+        sp.append(self._batch(1))
+        with open(sp.path, "r", encoding="utf-8") as f:
+            good = f.read()
+        with open(sp.path, "w", encoding="utf-8") as f:
+            f.write("not json\n" + good)
+        with pytest.raises(json.JSONDecodeError):
+            sp.load()
+
+
+class TestCollector:
+    def test_ingest_folds_marker_last_and_stamps_manifest(
+        self, tmp_path
+    ):
+        reg = MetricRegistry()
+        coll = Collector(str(tmp_path), registry=reg)
+        events = [
+            {"event": "manifest", "schema": 1, "run_id": "r1",
+             "ts": 1.0},
+            {"event": "x", "ts": 2.0},
+        ]
+        ack = coll.ingest(
+            _envelope("w0", 1, events, sent_ts=100.0),
+            gzipped=True, recv_ts=102.5,
+        )
+        assert ack["status"] == "ok" and ack["seq"] == 1
+        lines = [
+            json.loads(ln) for ln in open(
+                source_stream_path(str(tmp_path), "w0"),
+                encoding="utf-8",
+            ).read().splitlines()
+        ]
+        assert [e["event"] for e in lines] == [
+            "manifest", "x", "collect_batch",
+        ]
+        # the collector stamps its view into the first manifest so
+        # merge/trace can pair the stream with its HTTP-hop anchors
+        assert lines[0]["source_id"] == "w0"
+        assert lines[0]["collect_recv_ts"] == 102.5
+        marker = lines[-1]
+        assert marker["seq"] == 1 and marker["events"] == 2
+        assert marker["sent_ts"] == 100.0
+        assert marker["recv_ts"] == 102.5
+        snap = reg.snapshot()["counters"]
+        assert snap["collect.batches"] == 1
+        assert snap["collect.ingested"] == 2
+
+    def test_duplicate_seq_suppressed_file_unchanged(self, tmp_path):
+        reg = MetricRegistry()
+        coll = Collector(str(tmp_path), registry=reg)
+        body = _envelope("w0", 1, [{"event": "x", "ts": 1.0}])
+        coll.ingest(body, gzipped=True)
+        before = open(
+            source_stream_path(str(tmp_path), "w0"), encoding="utf-8"
+        ).read()
+        ack = coll.ingest(body, gzipped=True)
+        assert ack["status"] == "duplicate"
+        after = open(
+            source_stream_path(str(tmp_path), "w0"), encoding="utf-8"
+        ).read()
+        assert after == before
+        snap = reg.snapshot()["counters"]
+        assert snap["collect.duplicates"] == 1
+        assert snap["collect.duplicate_events"] == 1
+
+    def test_recover_truncates_unmarkered_tail(self, tmp_path):
+        coll = Collector(str(tmp_path), registry=MetricRegistry())
+        coll.ingest(
+            _envelope("w0", 1, [{"event": "x", "ts": 1.0}]),
+            gzipped=True,
+        )
+        path = source_stream_path(str(tmp_path), "w0")
+        committed = open(path, encoding="utf-8").read()
+        with open(path, "a", encoding="utf-8") as f:
+            # crash mid-fold: events landed but the marker (the commit
+            # point) never did — plus a torn half-line
+            f.write(json.dumps({"event": "y", "ts": 2.0}) + "\n")
+            f.write('{"event": "z", "ts"')
+        reg2 = MetricRegistry()
+        coll2 = Collector(str(tmp_path), registry=reg2)
+        assert open(path, encoding="utf-8").read() == committed
+        snap = reg2.snapshot()["counters"]
+        assert snap["collect.recovered_streams"] == 1
+        assert snap["collect.truncated_events"] == 2
+        # the never-acked batch re-ships and folds exactly once; the
+        # already-committed seq stays suppressed
+        ack = coll2.ingest(
+            _envelope("w0", 2, [{"event": "y", "ts": 2.0},
+                                {"event": "z", "ts": 3.0}]),
+            gzipped=True,
+        )
+        assert ack["status"] == "ok"
+        dup = coll2.ingest(
+            _envelope("w0", 1, [{"event": "x", "ts": 1.0}]),
+            gzipped=True,
+        )
+        assert dup["status"] == "duplicate"
+        names = [
+            json.loads(ln)["event"]
+            for ln in open(path, encoding="utf-8").read().splitlines()
+        ]
+        assert names.count("x") == 1 and names.count("y") == 1
+
+    def test_malformed_envelope_raises_value_error(self, tmp_path):
+        coll = Collector(str(tmp_path), registry=MetricRegistry())
+        with pytest.raises(ValueError):
+            coll.ingest(b"not json", gzipped=False)
+        with pytest.raises(ValueError):
+            coll.ingest(b"\x1f\x8b broken gzip", gzipped=True)
+        with pytest.raises(ValueError):        # events not a list
+            coll.ingest(json.dumps({
+                "source_id": "w", "seq": 1, "events": "nope",
+            }).encode(), gzipped=False)
+
+
+class TestShipperLifecycle:
+    def test_outage_spool_restart_replay_exactly_once(self, tmp_path):
+        """The ISSUE's core drill: collector dead at first ship ->
+        spool accumulates -> collector starts -> replay delivers all
+        events exactly once (seq dedup asserted on the fold)."""
+        reg = MetricRegistry()
+        port = _free_port()             # nothing listening yet
+        s = EventShipper(
+            "127.0.0.1", port, source_id="w0", registry=reg,
+            spool_dir=str(tmp_path / "spool"), batch_events=4,
+            policy=_FAST,
+        )
+        for i in range(8):
+            s.offer({"ts": float(i), "event": "e", "i": i})
+        s.flush()                       # both batches refused -> spool
+        snap = reg.snapshot()["counters"]
+        assert snap["telemetry.spooled"] == 8
+        assert snap.get("telemetry.shipped", 0) == 0
+        assert snap["telemetry.ship_errors"] >= 1
+        assert s.spool.pending() == 8
+        creg = MetricRegistry()
+        srv = _Server(tmp_path / "agg", port=port, registry=creg)
+        try:
+            s.offer({"ts": 8.0, "event": "e", "i": 8})
+            s.flush()                   # replay first, then live batch
+        finally:
+            s.close()
+            srv.stop()
+        snap = reg.snapshot()["counters"]
+        assert snap["telemetry.ship_replayed"] == 8
+        assert snap["telemetry.shipped"] == 1
+        assert snap.get("telemetry.dropped", 0) == 0
+        assert s.spool.load() == []     # compacted after replay
+        lines = [
+            json.loads(ln) for ln in open(
+                source_stream_path(str(tmp_path / "agg"), "w0"),
+                encoding="utf-8",
+            ).read().splitlines()
+        ]
+        got = sorted(
+            e["i"] for e in lines if e.get("event") == "e"
+        )
+        assert got == list(range(9)), "each event exactly once"
+        markers = [
+            e for e in lines if e["event"] == "collect_batch"
+        ]
+        assert [m["seq"] for m in markers] == [1, 2, 3]
+        assert [m["replayed"] for m in markers] == [True, True, False]
+        csnap = creg.snapshot()["counters"]
+        assert csnap["collect.batches"] == 3
+        assert csnap["collect.ingested"] == 9
+        assert csnap.get("collect.duplicates", 0) == 0
+
+    def test_reship_after_lost_ack_is_deduped(self, tmp_path):
+        """At-least-once + seq dedup: the shipper re-sends a batch
+        whose ack it never saw; the collector folds it once."""
+        reg = MetricRegistry()
+        srv = _Server(tmp_path / "agg", registry=reg)
+        try:
+            s = EventShipper(
+                "127.0.0.1", srv.port, source_id="w0",
+                registry=MetricRegistry(), policy=_FAST,
+            )
+            batch = {
+                "seq": 1, "sent_ts": 1.0,
+                "events": [{"event": "e", "i": 0}],
+            }
+            s._ship(batch, replayed=False)
+            s._ship(batch, replayed=True)   # ack lost -> re-ship
+        finally:
+            srv.stop()
+        snap = reg.snapshot()["counters"]
+        assert snap["collect.batches"] == 1
+        assert snap["collect.duplicates"] == 1
+
+    def test_overflow_drops_are_counted_never_silent(self, tmp_path):
+        reg = MetricRegistry()
+        s = EventShipper(
+            "127.0.0.1", _free_port(), registry=reg, max_buffer=3,
+            policy=_FAST,
+        )
+        for i in range(10):
+            s.offer({"event": "e", "i": i})
+        assert reg.snapshot()["counters"]["telemetry.dropped"] == 7
+
+    def test_unserializable_record_is_counted_drop(self):
+        reg = MetricRegistry()
+        s = EventShipper(
+            "127.0.0.1", 1, registry=reg, policy=_FAST,
+        )
+        s.offer({"event": "e", "bad": object()})
+        assert reg.snapshot()["counters"]["telemetry.dropped"] == 1
+
+    def test_no_spool_failed_batch_drops_counted(self, tmp_path):
+        reg = MetricRegistry()
+        s = EventShipper(
+            "127.0.0.1", _free_port(), registry=reg, policy=_FAST,
+        )
+        s.offer({"event": "e", "i": 0})
+        s.flush()
+        snap = reg.snapshot()["counters"]
+        assert snap["telemetry.dropped"] == 1
+        assert snap["telemetry.ship_errors"] >= 1
+
+
+class TestFacade:
+    def test_configure_ship_to_ships_whole_stream(self, tmp_path):
+        creg = MetricRegistry()
+        srv = _Server(tmp_path / "agg", registry=creg)
+        try:
+            p = str(tmp_path / "run.jsonl")
+            telemetry.configure(
+                p, ship_to=f"127.0.0.1:{srv.port}", run_id="rid-9"
+            )
+            telemetry.manifest(kind="test")
+            telemetry.event("alpha", i=1)
+            telemetry.event("beta", i=2)
+            telemetry.shutdown()        # final flush rides shutdown
+        finally:
+            srv.stop()
+        agg = [
+            f for f in (tmp_path / "agg").iterdir()
+            if f.suffix == ".jsonl"
+        ]
+        assert len(agg) == 1
+        evs = telemetry.read_events(str(agg[0]))
+        names = [e["event"] for e in evs]
+        assert names[0] == "manifest"
+        assert "alpha" in names and "beta" in names
+        assert "registry" in names      # the closing snapshot shipped
+        assert "collect_batch" in names
+        assert evs[0]["run_id"] == "rid-9"
+        assert "source_id" in evs[0] and "collect_recv_ts" in evs[0]
+        # the shipper feeds the process registry, so the delivery
+        # accounting is visible locally once shutdown drained it
+        local = telemetry.get_registry().snapshot()["counters"]
+        assert local["telemetry.shipped"] == 4
+        assert creg.snapshot()["counters"]["collect.ingested"] >= 4
+
+    def test_env_var_configures_shipping(self, tmp_path, monkeypatch):
+        srv = _Server(tmp_path / "agg")
+        try:
+            monkeypatch.setenv(
+                transport.ENV_SHIP_TO, f"127.0.0.1:{srv.port}"
+            )
+            telemetry.configure(str(tmp_path / "run.jsonl"))
+            assert transport.get_shipper() is not None
+            telemetry.shutdown()
+            assert transport.get_shipper() is None
+        finally:
+            srv.stop()
+
+    def test_no_ship_target_no_shipper(self, tmp_path):
+        telemetry.configure(str(tmp_path / "run.jsonl"))
+        assert transport.get_shipper() is None
+
+
+class TestTransportHealth:
+    def test_sections_from_markers_and_counters(self):
+        events = [
+            {"event": "collect_batch", "source_id": "w0", "seq": 1,
+             "sent_ts": 10.0, "recv_ts": 10.5, "events": 3,
+             "replayed": False},
+            {"event": "collect_batch", "source_id": "w0", "seq": 2,
+             "sent_ts": 11.0, "recv_ts": 12.0, "events": 2,
+             "replayed": True},
+        ]
+        metrics = {
+            "counter.telemetry.shipped": 5.0,
+            "counter.telemetry.spooled": 2.0,
+            "counter.collect.batches": 2.0,
+            "counter.collect.ingested": 5.0,
+            "gauge.collect.sources": 1.0,
+        }
+        th = transport_health(events, metrics)
+        assert th["shipper"] == {"shipped": 5, "spooled": 2}
+        assert th["collector"]["batches"] == 2
+        assert th["collector"]["sources"] == 1
+        src = th["sources"]["w0"]
+        assert src["batches"] == 2 and src["events"] == 5
+        assert src["replayed_batches"] == 1
+        assert src["replayed_events"] == 2
+        assert src["ship_lag_s"] == 1.0     # newest marker's recv-sent
+        assert th["replayed_events"] == 2
+
+    def test_none_when_transport_untouched(self):
+        assert transport_health(
+            [{"event": "train_iteration"}], {"counter.other": 1.0}
+        ) is None
+
+
+class TestClockCorrections:
+    def test_http_hop_anchor_via_manifest_source_id(self):
+        streams = [{
+            "label": "b", "path": "b",
+            "manifest": {"source_id": "w8"},
+            "events": [
+                {"event": "collect_batch", "source_id": "w8",
+                 "sent_ts": 50.0, "recv_ts": 53.0},
+            ],
+        }]
+        assert clock_corrections(streams)["b"] == 3.0
+
+    def test_fallback_to_unique_marker_source_id(self):
+        # aggregated stream whose manifest predates the collector's
+        # source_id stamp: the markers inside it still pair it
+        streams = [
+            {
+                "label": "a", "path": "a", "manifest": {"ts": 0.0},
+                "events": [
+                    {"event": "collect_batch", "source_id": "w7",
+                     "sent_ts": 100.0, "recv_ts": 102.5},
+                    {"event": "collect_batch", "source_id": "w7",
+                     "sent_ts": 200.0, "recv_ts": 202.0},
+                ],
+            },
+            {"label": "c", "path": "c", "manifest": {}, "events": []},
+        ]
+        corr = clock_corrections(streams)
+        assert corr["a"] == 2.0         # min over the source's markers
+        assert corr["c"] == 0.0         # no anchor -> refinement only
+
+
+class TestBenchDiff:
+    def test_direction_heuristics(self):
+        assert _bench_direction("bench.assign.seconds") == "lower"
+        assert _bench_direction("bench.serve.p99_ms") == "lower"
+        assert _bench_direction("bench.assign.docs_per_s") == "higher"
+        assert _bench_direction("bench.serve.errors") == "lower"
+        assert _bench_direction("bench.serve.qps") is None
+
+    def _write(self, tmp_path, name, record):
+        p = tmp_path / name
+        p.write_text(json.dumps({
+            "schema": 1, "run_id": name, "record": record,
+        }))
+        return str(p)
+
+    def test_gate_fails_on_worse_direction_only(self, tmp_path, capsys):
+        a = self._write(tmp_path, "a.json", {
+            "assign": {"seconds": 1.0, "docs_per_s": 5000.0},
+        })
+        b = self._write(tmp_path, "b.json", {
+            "assign": {"seconds": 1.3, "docs_per_s": 5200.0},
+        })
+        args = argparse.Namespace(
+            a=a, b=b, json=True, fail_on_regression=10.0
+        )
+        assert cmd_bench_diff(args) == 1
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["regressions"] == ["bench.record.assign.seconds"]
+        rows = {
+            r["metric"]: r
+            for rs in doc["sections"].values() for r in rs
+        }
+        sec = rows["bench.record.assign.seconds"]
+        assert sec["direction"] == "lower"
+        assert round(sec["delta_pct"]) == 30
+        # throughput went UP: better direction, never a regression
+        thr = rows["bench.record.assign.docs_per_s"]
+        assert thr["worse_pct"] < 0
+
+    def test_improvement_passes_gate(self, tmp_path, capsys):
+        a = self._write(tmp_path, "a.json", {
+            "assign": {"seconds": 1.0},
+        })
+        b = self._write(tmp_path, "b.json", {
+            "assign": {"seconds": 0.8},
+        })
+        args = argparse.Namespace(
+            a=a, b=b, json=False, fail_on_regression=10.0
+        )
+        assert cmd_bench_diff(args) == 0
+        out = capsys.readouterr().out
+        assert "[assign]" in out and "REGRESSION" not in out
+
+    def test_no_gate_reports_only(self, tmp_path, capsys):
+        a = self._write(tmp_path, "a.json", {"s": {"seconds": 1.0}})
+        b = self._write(tmp_path, "b.json", {"s": {"seconds": 9.0}})
+        args = argparse.Namespace(
+            a=a, b=b, json=False, fail_on_regression=None
+        )
+        assert cmd_bench_diff(args) == 0
