@@ -1474,6 +1474,201 @@ def _bench_serve_fleet():
     }
 
 
+def _bench_overload():
+    """Overload sweep (ISSUE 20): offered load vs goodput PAST the
+    saturation point of a fixed 2-replica emulated fleet, through the
+    routing front with the whole traffic-shaping tier live (bounded
+    priority intake, Erlang-C-priced 429s, degraded-mode answers).
+
+    The closed-loop clients of ``serve_fleet`` can never measure this
+    regime — a slow fleet slows its own offered load (coordinated
+    omission), so saturation looks like latency instead of load.  The
+    sweep uses the open-loop ``serving.probe.Prober``: each request
+    fires AT its scheduled time whether or not earlier ones answered,
+    exactly like real independent clients.  Autoscaling is pinned off
+    (min=max=2) so the curve isolates the shedding tier itself.
+
+    The headline is the shape, not a number: goodput must stay FLAT
+    (not collapse) as offered load climbs past capacity, every
+    non-answer must be a typed 429 carrying a Retry-After price, and
+    the p99 of the answers that ARE served must stay bounded because
+    the bounded intake keeps the queue — and therefore the wait — from
+    growing without limit."""
+    import http.client
+    import shutil
+    import signal as _signal
+    import tempfile
+    import threading
+
+    from spark_text_clustering_tpu.models.base import LDAModel
+    from spark_text_clustering_tpu.models.persistence import save_model
+    from spark_text_clustering_tpu.serving.probe import Prober
+
+    emu_ms = 25.0          # 40 docs/s/replica -> 80/s fleet capacity
+    n_replicas = 2
+    per_level = 200
+    capacity = n_replicas * 1000.0 / emu_ms
+    offered = [0.5, 1.0, 1.5, 2.0, 3.0]   # x capacity
+
+    k, v = 2, 1 << 10
+    rng = np.random.default_rng(0)
+    model = LDAModel(
+        lam=rng.random((k, v)).astype(np.float32) + 0.1,
+        vocab=[f"h{i}" for i in range(v)],
+        alpha=np.full(k, 0.5, np.float32),
+        eta=0.1,
+    )
+    workdir = tempfile.mkdtemp(prefix="stc_bench_ovl_")
+    models_dir = os.path.join(workdir, "models")
+    save_model(model, os.path.join(models_dir, "LdaModel_EN_1000"))
+
+    fleet = os.path.join(workdir, "fleet")
+    log = open(os.path.join(workdir, "sup.log"), "w")
+    sup = subprocess.Popen(
+        [sys.executable, "-m", "spark_text_clustering_tpu.cli",
+         "supervise", "--role", "serve",
+         "--fleet-dir", fleet, "--workers", str(n_replicas),
+         "--front-port", "0",
+         "--models-dir", models_dir, "--no-lemmatize",
+         "--heartbeat-interval", "0.2", "--lease-timeout", "10",
+         "--grace-seconds", "5", "--sweep-interval", "0.1",
+         "--serve-max-batch", "4", "--serve-linger-ms", "1",
+         "--serve-emulate-doc-ms", str(emu_ms),
+         "--serve-max-queue", "16", "--max-seconds", "900"],
+        cwd=REPO_DIR, stdout=log, stderr=subprocess.STDOUT,
+    )
+
+    def _healthz(port):
+        c = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+        c.request("GET", "/healthz")
+        doc = json.loads(c.getresponse().read())
+        c.close()
+        return doc
+
+    levels = []
+    try:
+        front = os.path.join(fleet, "front.json")
+        deadline = time.time() + 600
+        port = None
+        while time.time() < deadline:
+            if sup.poll() is not None:
+                raise RuntimeError("overload fleet died at startup")
+            try:
+                with open(front) as f:
+                    port = json.load(f)["port"]
+                break
+            except (OSError, json.JSONDecodeError, KeyError):
+                time.sleep(0.2)
+        assert port, "front never announced"
+        while time.time() < deadline:
+            try:
+                if _healthz(port)["ready"] == n_replicas:
+                    break
+            except (OSError, http.client.HTTPException):
+                pass
+            time.sleep(0.3)
+
+        for mult in offered:
+            rate = capacity * mult
+            recs = []
+            rec_lock = threading.Lock()
+
+            class _Recording(Prober):
+                def probe_once(self):
+                    rec = Prober.probe_once(self)
+                    with rec_lock:
+                        recs.append(rec)
+                    return rec
+
+            prober = _Recording(
+                "127.0.0.1", port,
+                stream=f"bench-ovl-{mult}", timeout=20.0,
+                priority="batch",
+            )
+            t0 = time.time()
+            # flat open-loop level: ramp_to == rate
+            prober.run_ramp(per_level, rate, rate)
+            wall = max(1e-9, time.time() - t0)
+            oks = sorted(
+                r["seconds"] for r in recs if r["outcome"] == "ok"
+            )
+            n_ok = len(oks)
+            n_rej = sum(1 for r in recs if r["outcome"] == "rejected")
+            n_fail = len(recs) - n_ok - n_rej
+            unpriced = sum(
+                1 for r in recs
+                if r["outcome"] == "rejected"
+                and not (r["status"] == 429 and (r["retry_after"] or 0) >= 1)
+            )
+            lv = {
+                "offered_rps": round(rate, 1),
+                "offered_x_capacity": mult,
+                "sent": len(recs),
+                "ok": n_ok,
+                "rejected": n_rej,
+                "unpriced_rejections": unpriced,
+                "untyped_failures": n_fail,
+                "degraded": sum(1 for r in recs if r["degraded"]),
+                "goodput_rps": round(n_ok / wall, 1),
+                "ok_p50_ms": (
+                    round(1000 * oks[n_ok // 2], 2) if n_ok else None
+                ),
+                "ok_p99_ms": (
+                    round(1000 * oks[min(n_ok - 1, int(n_ok * 0.99))], 2)
+                    if n_ok else None
+                ),
+            }
+            levels.append(lv)
+            sys.stderr.write(
+                f"# overload[{mult}x]: offered {lv['offered_rps']}/s -> "
+                f"goodput {lv['goodput_rps']}/s, {n_rej} typed-429, "
+                f"{n_fail} untyped, p99 {lv['ok_p99_ms']} ms\n"
+            )
+            # let the bounded intake drain before the next level
+            time.sleep(1.0)
+
+        sup.send_signal(_signal.SIGTERM)
+        rc = sup.wait(timeout=120)
+    finally:
+        if sup.poll() is None:
+            sup.kill()
+        log.close()
+        shutil.rmtree(workdir, ignore_errors=True)
+
+    at_cap = next(
+        lv for lv in levels if lv["offered_x_capacity"] == 1.0
+    )
+    past = [lv for lv in levels if lv["offered_x_capacity"] > 1.0]
+    base = max(1e-9, at_cap["goodput_rps"])
+    goodput_floor = round(
+        min(lv["goodput_rps"] for lv in past) / base, 3
+    ) if past else None
+    return {
+        "engine": (
+            "open-loop Prober ramp against a real 2-replica emulated "
+            "`stc supervise --role serve` fleet behind the routing "
+            "front; admission + degrade live, autoscaling pinned off"
+        ),
+        "emulated_doc_ms": emu_ms,
+        "capacity_rps": capacity,
+        "requests_per_level": per_level,
+        "levels": levels,
+        "goodput_floor_vs_capacity": goodput_floor,
+        # degraded mode halves the per-document cost, so goodput past
+        # saturation may legitimately EXCEED the non-degraded capacity
+        "goodput_held": bool(
+            goodput_floor is not None and goodput_floor >= 0.8
+        ),
+        "zero_untyped_failures": bool(
+            sum(lv["untyped_failures"] for lv in levels) == 0
+        ),
+        "all_rejections_priced": bool(
+            sum(lv["unpriced_rejections"] for lv in levels) == 0
+        ),
+        "supervise_rc": rc,
+    }
+
+
 def _bench_scale():
     """Opt-in 1M-doc section (round-4 VERDICT Weak #3): the EM perf
     claim must also rest on a workload that exercises the chip, not the
@@ -1722,6 +1917,11 @@ def child_main() -> None:
         serve_fleet_rec = _bench_serve_fleet()
     except Exception as exc:
         sys.stderr.write(f"# serve_fleet bench skipped: {exc!r}\n")
+    overload_rec = None
+    try:
+        overload_rec = _bench_overload()
+    except Exception as exc:
+        sys.stderr.write(f"# overload bench skipped: {exc!r}\n")
     scale_rec = None
     try:
         scale_rec = _bench_scale()
@@ -1789,6 +1989,7 @@ def child_main() -> None:
                 "streaming": stream_rec,
                 "serve": serve_rec,
                 "serve_fleet": serve_fleet_rec,
+                "overload": overload_rec,
                 "cold_start": cold_start_rec,
                 "scale": scale_rec,
                 "slo_overhead": slo_rec,

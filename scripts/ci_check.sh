@@ -189,9 +189,21 @@
 #      must exit 1 and `stc metrics slo --fail-on-burn` over the
 #      collector-side probe stream must exit 1 — the whole analysis
 #      stack works unchanged over an aggregated dir
+#  21. sustained-overload drill: a 2-replica emulated fleet (pinned
+#      50 ms/doc service time, bounded intake) is driven past
+#      saturation through the front by an open-loop batch-class probe
+#      ramp while an interactive-class canary rides along.  Goodput
+#      must hold: zero untyped failures (every non-200 is a typed 429
+#      with a Retry-After schedule), the interactive canary completes
+#      18/18 with its burn-rate alert NOT firing (batch sheds first),
+#      >= 1 answer is served under degraded mode (X-STC-Degraded),
+#      and the predictive autoscaler's scale_out rides the
+#      ledger-gated actions file into a real supervisor resize to 3
+#      ready replicas; the canary's exact probe counters gate against
+#      the committed baseline
 #
 # Usage:
-#   scripts/ci_check.sh                 # run all twenty gates
+#   scripts/ci_check.sh                 # run all twenty-one gates
 #   scripts/ci_check.sh --rebaseline    # recapture ALL baselines
 #                                       # (metrics + lint waivers +
 #                                       # lint counters + scale record
@@ -1687,6 +1699,203 @@ print(f"transport observe drill: shipped {', '.join(shipped)} "
 EOF
 }
 
+run_overload_drill() {
+    # gate 21: sustained-overload drill (docs/SERVING.md "Overload &
+    # degradation").  A 2-replica EMULATED fleet (50 ms pinned
+    # per-document service time, max-batch 2, intake bound 8/replica)
+    # is driven past saturation through the front by an open-loop
+    # batch-class probe ramp (30 -> 240 req/s against ~40 docs/s of
+    # non-degraded fleet capacity, ~80/s once degraded mode halves the
+    # per-document cost) while 18 interactive-class probes ride along
+    # at 3/s.  The contract under load:
+    #   * zero untyped failures — every non-200 the batch ramp sees is
+    #     a typed 429 carrying a Retry-After schedule
+    #   * batch sheds FIRST: the interactive canary completes 18/18
+    #     with no rejection and its p99 burn-rate alert must NOT fire
+    #     (the predictive autoscaler acted BEFORE the SLO burned)
+    #   * >= 1 answer served under degraded mode (X-STC-Degraded)
+    #   * the autoscaler's scale_out rode the ledger-gated actions
+    #     file and the supervisor ACTUALLY grew the fleet to 3 ready
+    #     replicas
+    # The interactive stream's exact probe counters (18) gate against
+    # the committed baseline.
+    local workdir="$1"
+    rm -rf "$workdir/ovl_fleet" "$workdir/ovl_wtel"
+    python - "$workdir" <<'EOF'
+import http.client
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+workdir = sys.argv[1]
+models = os.path.join(workdir, "models")
+fleet = os.path.join(workdir, "ovl_fleet")
+actions = os.path.join(workdir, "ovl_actions.jsonl")
+log_path = os.path.join(workdir, "ovl_fleet.log")
+proc = subprocess.Popen(
+    [sys.executable, "-m", "spark_text_clustering_tpu.cli",
+     "supervise", "--role", "serve",
+     "--fleet-dir", fleet, "--workers", "2", "--front-port", "0",
+     "--min-workers", "2", "--max-workers", "3",
+     "--models-dir", models, "--no-lemmatize",
+     "--heartbeat-interval", "0.2", "--lease-timeout", "12",
+     "--grace-seconds", "6", "--sweep-interval", "0.1",
+     "--startup-grace", "240", "--swap-timeout", "120",
+     "--serve-max-batch", "2", "--serve-linger-ms", "2",
+     "--serve-emulate-doc-ms", "50", "--serve-max-queue", "8",
+     "--actions-file", actions,
+     "--autoscale", "--autoscale-high-rho", "0.8",
+     "--autoscale-confirm", "2", "--autoscale-cooldown", "5",
+     "--max-seconds", "600",
+     "--telemetry-file", os.path.join(workdir, "fleet_ovl.jsonl"),
+     "--worker-telemetry-dir", os.path.join(workdir, "ovl_wtel")],
+    env=dict(os.environ), stdout=open(log_path, "w"),
+    stderr=subprocess.STDOUT,
+)
+
+
+def fail(msg):
+    proc.send_signal(signal.SIGKILL)
+    sys.exit(f"overload drill: {msg}")
+
+
+def healthz(port):
+    c = http.client.HTTPConnection("127.0.0.1", port, timeout=15)
+    c.request("GET", "/healthz")
+    doc = json.loads(c.getresponse().read())
+    c.close()
+    return doc
+
+
+deadline = time.time() + 420
+port = None
+while time.time() < deadline and port is None:
+    if proc.poll() is not None:
+        sys.exit(f"supervisor died at startup (rc={proc.returncode})")
+    try:
+        with open(os.path.join(fleet, "front.json")) as f:
+            port = json.load(f)["port"]
+    except (OSError, json.JSONDecodeError, KeyError):
+        time.sleep(0.3)
+if port is None:
+    fail("front never announced")
+while time.time() < deadline:
+    try:
+        if healthz(port)["ready"] == 2:
+            break
+    except (OSError, http.client.HTTPException, ValueError):
+        pass
+    time.sleep(0.5)
+else:
+    fail("fleet never reached 2 ready replicas")
+
+# open-loop batch-class ramp: arrivals keep coming whether or not the
+# fleet answers — the coordinated-omission-free overload generator
+batch = subprocess.Popen(
+    [sys.executable, "-m", "spark_text_clustering_tpu.cli", "probe",
+     "--fleet-dir", fleet, "--count", "400", "--rate", "30",
+     "--ramp-to", "240", "--priority", "batch", "--timeout", "15",
+     "--stream", "ovl-batch", "--telemetry-file",
+     os.path.join(workdir, "probe_ovl_batch.jsonl")],
+    env=dict(os.environ),
+)
+time.sleep(1.0)                      # let the backlog actually build
+inter = subprocess.Popen(
+    [sys.executable, "-m", "spark_text_clustering_tpu.cli", "probe",
+     "--fleet-dir", fleet, "--count", "18", "--rate", "3",
+     "--priority", "interactive", "--timeout", "5",
+     "--stream", "ovl-int", "--telemetry-file",
+     os.path.join(workdir, "probe_ovl_interactive.jsonl")],
+    env=dict(os.environ),
+)
+if inter.wait(timeout=180) != 0:
+    fail("interactive probe run failed")
+if batch.wait(timeout=180) != 0:
+    fail("batch ramp run failed")
+
+# the autoscaler must have grown the fleet: 3 ready replicas
+while time.time() < deadline:
+    try:
+        if healthz(port)["ready"] == 3:
+            break
+    except (OSError, http.client.HTTPException, ValueError):
+        pass
+    time.sleep(0.5)
+else:
+    fail("autoscaler never grew the fleet to 3 ready replicas")
+
+proc.send_signal(signal.SIGTERM)
+if proc.wait(timeout=180) != 0:
+    fail("fleet drain did not exit 0")
+
+from spark_text_clustering_tpu.telemetry.metrics_cli import (
+    load_run, run_metrics,
+)
+
+_, iev = load_run(os.path.join(workdir, "probe_ovl_interactive.jsonl"))
+ireqs = [e for e in iev if e.get("event") == "probe_request"]
+assert len(ireqs) == 18, f"{len(ireqs)} interactive probes, want 18"
+assert all(e["outcome"] == "ok" for e in ireqs), [
+    e for e in ireqs if e["outcome"] != "ok"
+]
+
+_, bev = load_run(os.path.join(workdir, "probe_ovl_batch.jsonl"))
+breqs = [e for e in bev if e.get("event") == "probe_request"]
+assert len(breqs) == 400, f"{len(breqs)} batch probes, want 400"
+bad = [e for e in breqs if e["outcome"] not in ("ok", "rejected")]
+assert not bad, f"untyped failures under overload: {bad[:5]}"
+rej = [e for e in breqs if e["outcome"] == "rejected"]
+assert rej, "the ramp never drove the fleet into a typed refusal"
+unpriced = [
+    e for e in rej
+    if e.get("status") != 429 or not e.get("retry_after")
+    or e["retry_after"] < 1
+]
+assert not unpriced, f"429s without a Retry-After price: {unpriced[:5]}"
+degraded = [e for e in breqs + ireqs if e.get("degraded")]
+assert degraded, "no answer was ever served under degraded mode"
+
+# the scale_out rode the ledger-gated actions file, and the
+# supervisor acked + applied it as a resize
+with open(actions) as f:
+    acts = json.load(f)["actions"]
+outs = [a for a in acts if a.get("kind") == "scale_out"]
+assert outs, f"no scale_out action emitted: {acts}"
+assert all(a.get("alert") == "autoscale_rho" for a in outs), outs
+assert os.path.exists(actions + ".ack"), "supervisor never acked"
+_, fev = load_run(os.path.join(workdir, "fleet_ovl.jsonl"))
+fm = run_metrics(fev)
+assert int(fm.get("counter.fleet.resizes", 0)) >= 1, \
+    "supervisor never applied the autoscaler's resize"
+assert int(fm.get("counter.front.rejected_total", 0)) >= 1, \
+    "front never propagated a replica 429"
+assert any(
+    e.get("event") == "autoscale_decision" for e in fev
+), "no autoscale_decision event in the supervisor stream"
+print(
+    f"overload drill: 18/18 interactive OK, {len(rej)}/400 batch "
+    f"typed-429 (0 untyped), {len(degraded)} degraded answer(s), "
+    f"scale_out -> 3 replicas via the actions ledger"
+)
+EOF
+    [[ $? -ne 0 ]] && return 1
+    # predictive, not reactive: the interactive canary's latency/
+    # availability budget must NOT have burned — the autoscaler and
+    # the shedding tier held the interactive SLO while batch shed
+    python -m spark_text_clustering_tpu.cli monitor --once \
+        --stream "$workdir/probe_ovl_interactive.jsonl" \
+        --builtin budget_burn --slo-compression 400 --fail-on-alert \
+        --quiet --telemetry-file "$workdir/monitor_ovl.jsonl"
+    if [[ $? -ne 0 ]]; then
+        echo "overload drill: interactive burn-rate alert fired under overload"
+        return 1
+    fi
+    return 0
+}
+
 if [[ "${1:-}" == "--rebaseline" ]]; then
     # --scale --protocol: regenerate the waiver allowlist AND the
     # committed scale evidence record (scripts/records/
@@ -1816,12 +2025,12 @@ fail=0
 work=$(mktemp -d)
 trap 'rm -rf "$work"' EXIT
 
-echo "== [1/20] stc lint (AST rules + jaxpr audit) =="
+echo "== [1/21] stc lint (AST rules + jaxpr audit) =="
 python -m spark_text_clustering_tpu.cli lint \
     --telemetry-file "$work/lint.jsonl"
 if [[ $? -ne 0 ]]; then echo "FAIL: stc lint"; fail=1; fi
 
-echo "== [2/20] ruff (generic-Python tier) =="
+echo "== [2/21] ruff (generic-Python tier) =="
 if command -v ruff >/dev/null 2>&1; then
     ruff check spark_text_clustering_tpu
     if [[ $? -ne 0 ]]; then echo "FAIL: ruff"; fail=1; fi
@@ -1829,17 +2038,17 @@ else
     echo "ruff not installed — skipped (stc lint STC101/102/006 cover it)"
 fi
 
-echo "== [3/20] tier-1 tests =="
+echo "== [3/21] tier-1 tests =="
 timeout -k 10 870 python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors -p no:cacheprovider \
     -p no:xdist -p no:randomly
 if [[ $? -ne 0 ]]; then echo "FAIL: tier-1"; fail=1; fi
 
-echo "== [4/20] telemetry overhead budget =="
+echo "== [4/21] telemetry overhead budget =="
 python scripts/check_telemetry_overhead.py
 if [[ $? -ne 0 ]]; then echo "FAIL: telemetry overhead"; fail=1; fi
 
-echo "== [5/20] metrics regression gate =="
+echo "== [5/21] metrics regression gate =="
 if run_ci_train "$work"; then
     # lint., ledger., fleet., serve., and alert. families are captured
     # by their own gates (1/6, 8, 10, 11, and 12) — a batch train run
@@ -1856,7 +2065,7 @@ else
     fail=1
 fi
 
-echo "== [6/20] lint metrics gate (waiver count version-gated) =="
+echo "== [6/21] lint metrics gate (waiver count version-gated) =="
 if [[ -s "$work/lint.jsonl" ]]; then
     # lint.scale_* belong to the gate-15 --scale stream and
     # lint.protocol_* to the gate-19 --protocol stream, not stage 1's
@@ -1869,7 +2078,7 @@ else
     fail=1
 fi
 
-echo "== [7/20] cross-host skew gate (metrics merge) =="
+echo "== [7/21] cross-host skew gate (metrics merge) =="
 if make_skew_streams "$work"; then
     python -m spark_text_clustering_tpu.cli metrics merge \
         "$work/skew-p0.jsonl" "$work/skew-p1.jsonl" --fail-on-skew \
@@ -1890,7 +2099,7 @@ else
     fail=1
 fi
 
-echo "== [8/20] exactly-once ledger chaos drill (STC_FAULTS) =="
+echo "== [8/21] exactly-once ledger chaos drill (STC_FAULTS) =="
 if run_ledger_drill "$work"; then
     python -m spark_text_clustering_tpu.cli metrics check \
         "$work/ledger_drill.jsonl" --baseline "$BASELINE" \
@@ -1901,7 +2110,7 @@ else
     fail=1
 fi
 
-echo "== [9/20] recompile sentinel (metrics compile-check) =="
+echo "== [9/21] recompile sentinel (metrics compile-check) =="
 if [[ -s "$work/run.jsonl" ]] && run_ci_score "$work" \
     && run_ci_nmf "$work"; then
     python -m spark_text_clustering_tpu.cli metrics compile-check \
@@ -1928,7 +2137,7 @@ else
     fail=1
 fi
 
-echo "== [10/20] supervisor drill (lease expiry -> SIGKILL -> respawn) =="
+echo "== [10/21] supervisor drill (lease expiry -> SIGKILL -> respawn) =="
 if run_supervisor_drill "$work"; then
     # the ladder's counters are deterministic: 3 spawns (2 + 1
     # respawn), 1 lease expiry, 1 preemption (the drain SIGTERM the
@@ -1942,7 +2151,7 @@ else
     fail=1
 fi
 
-echo "== [11/20] serve drill (hot-swap + drain + zero-recompile) =="
+echo "== [11/21] serve drill (hot-swap + drain + zero-recompile) =="
 if [[ -d "$work/models" ]] && run_serve_drill "$work"; then
     # requests (32 = two exact 16-doc volleys) and swaps (1) are
     # machine-independent; batch counts depend on coalescing timing
@@ -1956,7 +2165,7 @@ else
     fail=1
 fi
 
-echo "== [12/20] monitor drill (alerts fire/resolve + resize-on-alert) =="
+echo "== [12/21] monitor drill (alerts fire/resolve + resize-on-alert) =="
 if run_monitor_once_drill "$work"; then
     # the --once storm run's alert counters are deterministic: exactly
     # one firing (retrace_storm), nothing pending/resolved
@@ -1977,7 +2186,7 @@ if ! run_monitor_resize_drill "$work"; then
     fail=1
 fi
 
-echo "== [13/20] executable-cache cold-start drill (compilecache) =="
+echo "== [13/21] executable-cache cold-start drill (compilecache) =="
 if [[ -d "$work/models" ]] && run_cold_start_drill "$work"; then
     # the warm B run's cache counters are deterministic: one hit per
     # score-path digest, zero misses/stores/invalidations
@@ -1990,7 +2199,7 @@ else
     fail=1
 fi
 
-echo "== [14/20] end-to-end lineage drill (causal tracing) =="
+echo "== [14/21] end-to-end lineage drill (causal tracing) =="
 if run_lineage_drill "$work"; then
     # the serve run's trace counters are deterministic: ONE sampled
     # request, four emitted spans, nothing dropped
@@ -2003,7 +2212,7 @@ else
     fail=1
 fi
 
-echo "== [15/20] scale audit (stc lint --scale, STC210-215) =="
+echo "== [15/21] scale audit (stc lint --scale, STC210-215) =="
 python -m spark_text_clustering_tpu.cli lint --scale \
     --telemetry-file "$work/lint_scale.jsonl" >/dev/null
 if [[ $? -ne 0 ]]; then
@@ -2075,7 +2284,7 @@ if [[ $? -ne 0 ]]; then
     fail=1
 fi
 
-echo "== [16/20] measured-scale observatory (probe + scale-check) =="
+echo "== [16/21] measured-scale observatory (probe + scale-check) =="
 # run the sharded entry families for REAL on the forced 2x4 host mesh
 # and reconcile the measured evidence against the gate-15 static
 # record: sharding match, tolerance, zero retraces, V=10M
@@ -2131,7 +2340,7 @@ if [[ $? -ne 1 ]]; then
     fail=1
 fi
 
-echo "== [17/20] serve-fleet chaos drill (rolling publish + SIGKILL) =="
+echo "== [17/21] serve-fleet chaos drill (rolling publish + SIGKILL) =="
 if [[ -d "$work/models" ]] && run_serve_fleet_drill "$work"; then
     # the front's routed-request counter (48 = three exact 16-doc
     # volleys) and the fleet respawn counter (1 — consistent with the
@@ -2147,7 +2356,7 @@ else
     fail=1
 fi
 
-echo "== [18/20] SLO/probe drill (burn-rate gate + queueing observatory) =="
+echo "== [18/21] SLO/probe drill (burn-rate gate + queueing observatory) =="
 slo_ok=1
 if [[ -d "$work/models" ]] && run_slo_probe_drill "$work" degraded; then
     # the planted slow replica (0.35s > the 0.32768s objective line)
@@ -2247,7 +2456,7 @@ if [[ $slo_ok -eq 1 ]]; then
 fi
 [[ $slo_ok -ne 1 ]] && fail=1
 
-echo "== [19/20] protocol audit (stc lint --protocol, STC300-305) =="
+echo "== [19/21] protocol audit (stc lint --protocol, STC300-305) =="
 python -m spark_text_clustering_tpu.cli lint --no-jaxpr --protocol \
     --telemetry-file "$work/lint_protocol.jsonl" >/dev/null
 if [[ $? -ne 0 ]]; then
@@ -2389,7 +2598,7 @@ if [[ $? -ne 0 ]]; then
     fail=1
 fi
 
-echo "== [20/20] telemetry transport drill (ship -> SIGKILL collector -> replay) =="
+echo "== [20/21] telemetry transport drill (ship -> SIGKILL collector -> replay) =="
 if run_transport_drill "$work"; then
     # the restarted collector's fold accounting is exact: 4 batches
     # (one replay + one live per worker), 12 events, 1 suppressed
@@ -2427,6 +2636,20 @@ if run_transport_observe_drill "$work"; then
     fi
 else
     echo "FAIL: transport observe drill"
+    fail=1
+fi
+
+echo "== [21/21] sustained-overload drill (admission + degrade + autoscale) =="
+if [[ -d "$work/models" ]] && run_overload_drill "$work"; then
+    # the interactive canary's counters are deterministic: 18 exact
+    # probes, zero failures, zero rejections (batch sheds first —
+    # interactive NEVER pays for the overload), zero pin violations
+    python -m spark_text_clustering_tpu.cli metrics check \
+        "$work/probe_ovl_interactive.jsonl" --baseline "$BASELINE" \
+        --include counter.probe.
+    if [[ $? -ne 0 ]]; then echo "FAIL: overload probe counters"; fail=1; fi
+else
+    echo "FAIL: sustained-overload drill"
     fail=1
 fi
 

@@ -558,6 +558,8 @@ def cmd_serve(args: argparse.Namespace) -> int:
                 int(args.worker_index) if lease is not None else None
             ),
             emulate_doc_seconds=emulate,
+            max_queue=args.max_queue,
+            batch_weight=args.batch_weight,
         )
     except CorruptArtifactError as exc:
         if lease is not None:
@@ -657,6 +659,8 @@ def cmd_front(args: argparse.Namespace) -> int:
         lease_timeout=args.lease_timeout,
         wait_for_replica_s=args.wait_for_replica,
         alerts_file=getattr(args, "alerts_file", None),
+        max_pending=args.max_pending,
+        retry_budget=args.retry_budget,
     )
     httpd = make_front_server(router, args.host, args.port)
     host, port = httpd.server_address[:2]
@@ -727,17 +731,28 @@ def cmd_probe(args: argparse.Namespace) -> int:
             kind="probe", host=host, port=port,
             fleet_dir=args.fleet_dir, stream=args.stream,
             count=args.count, rate=args.rate,
+            priority=args.priority, ramp_to=args.ramp_to,
         )
     prober = Prober(
         host, port,
         stream=args.stream,
         timeout=args.timeout,
         text=args.text or SENTINEL_TEXT,
+        priority=args.priority,
     )
-    rep = prober.run(count=args.count, rate=args.rate)
+    if args.ramp_to is not None:
+        # open-loop mode: an overload generator, not a canary — the
+        # send rate climbs regardless of how slowly the fleet answers
+        rep = prober.run_ramp(
+            count=args.count, rate=args.rate, ramp_to=args.ramp_to
+        )
+    else:
+        rep = prober.run(count=args.count, rate=args.rate)
     print(
         f"probe done: {rep['sent']} probe(s) against "
         f"http://{host}:{port}, {rep['failures']} failure(s), "
+        f"{rep['rejected']} rejected (typed 429), "
+        f"{rep['degraded']} degraded answer(s), "
         f"{rep['pin_violations']} pin violation(s)"
     )
     if own_telemetry:
@@ -1410,6 +1425,10 @@ def _supervise_serve(args: argparse.Namespace, own_telemetry: bool) -> int:
             argv += [
                 "--emulate-doc-ms", str(args.serve_emulate_doc_ms),
             ]
+        if args.serve_max_queue is not None:
+            argv += ["--max-queue", str(args.serve_max_queue)]
+        if args.serve_batch_weight is not None:
+            argv += ["--batch-weight", str(args.serve_batch_weight)]
         argv += args.worker_arg or []
         return argv
 
@@ -1476,8 +1495,11 @@ def _supervise_serve(args: argparse.Namespace, own_telemetry: bool) -> int:
         # THIS registry, i.e. on the front's /metrics, live
         import time as _time
 
-        from .telemetry.alerts import StreamSet
-        from .telemetry.queueing import QueueingEstimator
+        from .telemetry.alerts import ActionEmitter, StreamSet
+        from .telemetry.queueing import (
+            PredictiveAutoscaler,
+            QueueingEstimator,
+        )
 
         est = QueueingEstimator()
         qstreams = (
@@ -1486,6 +1508,22 @@ def _supervise_serve(args: argparse.Namespace, own_telemetry: bool) -> int:
             )])
             if args.worker_telemetry_dir else None
         )
+        scaler = None
+        scaler_emit = None
+        if args.autoscale and args.actions_file:
+            # the predictive half of ROADMAP item 3's control loop:
+            # decisions ride the SAME ledger-gated actions file the
+            # monitor's alert actions use — the supervisor applies
+            # them through _check_actions, acked and clamped
+            scaler = PredictiveAutoscaler(
+                min_replicas=args.min_workers,
+                max_replicas=args.max_workers,
+                high_rho=args.autoscale_high_rho,
+                low_rho=args.autoscale_low_rho,
+                confirm=args.autoscale_confirm,
+                cooldown_seconds=args.autoscale_cooldown,
+            )
+            scaler_emit = ActionEmitter(args.actions_file)
 
         def _queue_loop() -> None:
             reg = telemetry.get_registry()
@@ -1515,6 +1553,20 @@ def _supervise_serve(args: argparse.Namespace, own_telemetry: bool) -> int:
                         k: v for k, v in ev.items()
                         if k not in ("event", "ts")
                     })
+                if scaler is not None and ev is not None:
+                    decision = scaler.decide(ev, now)
+                    if decision is not None:
+                        scaler_emit.emit(
+                            decision["action"],
+                            alert="autoscale_rho",
+                            key="queueing.rho",
+                            value=decision["rho"],
+                            workers_delta=1,
+                        )
+                        try:
+                            scaler_emit.flush()
+                        except OSError:
+                            pass        # next decision re-flushes
                 queue_stop.wait(0.5)
 
         queue_thread = threading.Thread(
@@ -2042,6 +2094,16 @@ def build_parser() -> argparse.ArgumentParser:
     se.add_argument("--linger-ms", type=float, default=5.0,
                     help="max milliseconds a batch waits to fill after "
                          "its first document arrives")
+    se.add_argument("--max-queue", type=int, default=None,
+                    help="bounded admission: refuse intake beyond this "
+                         "many queued documents with a typed 429 + "
+                         "Retry-After (default 8x --max-batch; 0 "
+                         "disables the bound)")
+    se.add_argument("--batch-weight", type=float, default=0.25,
+                    help="fraction of every dispatch reserved for "
+                         "batch-class documents while any wait "
+                         "(anti-starvation floor under interactive "
+                         "pressure)")
     se.add_argument("--token-bucket", action="append", type=int,
                     default=[], metavar="T",
                     help="warmed pow2 token-bucket sizes (repeatable; "
@@ -2122,6 +2184,14 @@ def build_parser() -> argparse.ArgumentParser:
     fr.add_argument("--wait-for-replica", type=float, default=30.0,
                     help="seconds a request waits for ANY ready "
                          "replica before failing 503")
+    fr.add_argument("--max-pending", type=int, default=128,
+                    help="front-side shedding: 429 new requests once "
+                         "this many are in flight (batch-class sheds "
+                         "at half the watermark; 0 disables)")
+    fr.add_argument("--retry-budget", type=int, default=3,
+                    help="max retries per request on connection-level "
+                         "failures/503s, jittered backoff between "
+                         "them; a typed 429 never spends one")
     fr.add_argument("--max-seconds", type=float, default=None,
                     help="drain + exit after this many seconds "
                          "(drills); default: run until SIGTERM")
@@ -2155,6 +2225,19 @@ def build_parser() -> argparse.ArgumentParser:
                     help="number of probes to send")
     pb.add_argument("--rate", type=float, default=1.0,
                     help="probes per second (fixed wall-clock pacing)")
+    pb.add_argument("--ramp-to", type=float, default=None,
+                    help="open-loop overload mode: ramp the send rate "
+                         "linearly from --rate to this target over "
+                         "--count requests, firing each on its own "
+                         "thread at its scheduled time (arrivals keep "
+                         "coming even when the fleet slows — the "
+                         "overload-drill load generator)")
+    pb.add_argument("--priority", default=None,
+                    choices=("interactive", "batch"),
+                    help="send X-STC-Priority on every probe: feeds "
+                         "the per-class probe_* SLO objectives and "
+                         "lets a batch-class ramp shed first by "
+                         "design")
     pb.add_argument("--timeout", type=float, default=5.0,
                     help="per-probe HTTP timeout (a timeout is an "
                          "`error` outcome, not a crash)")
@@ -2403,6 +2486,36 @@ def build_parser() -> argparse.ArgumentParser:
                     help="--role serve: forward `serve "
                          "--emulate-doc-ms` to every replica (the "
                          "serve_fleet bench harness)")
+    sv.add_argument("--serve-max-queue", type=int, default=None,
+                    help="--role serve: forward `serve --max-queue` "
+                         "(bounded admission -> typed 429s) to every "
+                         "replica")
+    sv.add_argument("--serve-batch-weight", type=float, default=None,
+                    help="--role serve: forward `serve --batch-weight` "
+                         "(batch-class anti-starvation floor) to "
+                         "every replica")
+    sv.add_argument("--autoscale", action="store_true",
+                    help="--role serve: predictive autoscaling — feed "
+                         "the embedded queueing estimator's rho into "
+                         "scale_out/scale_in requests on "
+                         "--actions-file (requires --front-port and "
+                         "--actions-file), clamped to "
+                         "--min/--max-workers, ahead of the p99 "
+                         "burn-rate page")
+    sv.add_argument("--autoscale-high-rho", type=float, default=0.8,
+                    help="scale out after --autoscale-confirm "
+                         "consecutive estimates at or above this "
+                         "utilization")
+    sv.add_argument("--autoscale-low-rho", type=float, default=0.3,
+                    help="scale in after sustained utilization at or "
+                         "below this (dead band between low and high)")
+    sv.add_argument("--autoscale-confirm", type=int, default=2,
+                    help="consecutive estimates beyond a threshold "
+                         "before a decision (hysteresis)")
+    sv.add_argument("--autoscale-cooldown", type=float, default=30.0,
+                    help="seconds to hold after any decision (a fresh "
+                         "replica must warm before the signal is "
+                         "trusted again)")
     _add_compile_cache_arg(sv)
     sv.set_defaults(fn=cmd_supervise)
 

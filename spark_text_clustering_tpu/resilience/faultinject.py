@@ -88,8 +88,12 @@ SITES = frozenset({
     "worker.heartbeat",   # before a worker's lease heartbeat write
     "worker.kill",        # before the supervisor's SIGKILL escalation
     "serve.accept",       # before the scoring service accepts a request
+    "serve.admit",        # inside the coalescer's bounded admission
+                          # check (forces a typed 429, never a crash)
     "serve.batch",        # before a coalesced serve batch dispatches
     "serve.swap",         # before a verified model hot-swap installs
+    "front.shed",         # front-side pending-set admission (forces a
+                          # typed shed with Retry-After)
     "monitor.poll",       # top of each alert-engine evaluation cycle
     "monitor.action",     # before the monitor's actions-file write
     "compilecache.read",  # before an executable-cache entry is read

@@ -40,14 +40,25 @@ routing front with rolling hot-swap and per-stream generation pinning
 (docs/SERVING.md "Serve fleet").
 """
 
-from .coalescer import PendingDoc, RequestCoalescer, ServiceDraining
+from .coalescer import (
+    DEFAULT_PRIORITY,
+    PRIORITIES,
+    PendingDoc,
+    RequestCoalescer,
+    ServiceDraining,
+    ServiceOverloaded,
+)
 
 __all__ = [
+    "DEFAULT_PRIORITY",
+    "PRIORITIES",
     "PendingDoc",
     "RequestCoalescer",
     "ServiceDraining",
+    "ServiceOverloaded",
     "ScoringService",
     "ServeScorer",
+    "DegradeController",
     "make_http_server",
 ]
 
@@ -55,7 +66,10 @@ __all__ = [
 # (PEP 562) keeps ``serving.front`` — and therefore the supervisor and
 # `stc front` processes that import it — genuinely jax-free while
 # ``from .serving import ScoringService`` keeps working unchanged.
-_SERVER_EXPORTS = ("ScoringService", "ServeScorer", "make_http_server")
+_SERVER_EXPORTS = (
+    "ScoringService", "ServeScorer", "DegradeController",
+    "make_http_server",
+)
 
 
 def __getattr__(name):

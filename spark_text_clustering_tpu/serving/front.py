@@ -45,6 +45,7 @@ from __future__ import annotations
 import http.client
 import json
 import os
+import random
 import re
 import threading
 import time
@@ -54,6 +55,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional, Tuple
 
 from .. import telemetry
+from ..resilience import faultinject
 from ..resilience.retry import sleep as _sleep
 from ..resilience.supervisor import LEASE_DIRNAME, read_lease
 
@@ -64,18 +66,30 @@ __all__ = [
     "read_replicas",
     "FrontRouter",
     "NoReplicaAvailable",
+    "FrontOverloaded",
     "make_front_server",
     "REPLICA_HEADER",
     "GENERATION_HEADER",
     "STREAM_HEADER",
+    "PRIORITY_HEADER",
+    "DEGRADED_HEADER",
 ]
 
 # response attribution / affinity headers (the serve replica stamps
 # GENERATION_HEADER itself; the front adds REPLICA_HEADER and reads
-# STREAM_HEADER for pinning)
+# STREAM_HEADER for pinning).  PRIORITY_HEADER carries the request's
+# class (interactive | batch) front -> replica -> coalescer;
+# DEGRADED_HEADER comes back from a replica that answered under
+# degraded mode and is forwarded to the client verbatim.
 REPLICA_HEADER = "X-STC-Replica"
 GENERATION_HEADER = "X-STC-Generation"
 STREAM_HEADER = "X-STC-Stream"
+PRIORITY_HEADER = "X-STC-Priority"
+DEGRADED_HEADER = "X-STC-Degraded"
+
+# retry backoff jitter (decorrelates a thundering herd of front
+# handler threads re-trying into the same just-recovered replica)
+_jitter = random.Random()
 
 _STAMP_RE = re.compile(r"_(\d+)$")
 
@@ -183,6 +197,16 @@ class NoReplicaAvailable(RuntimeError):
     """No ready replica could take the request within the wait budget."""
 
 
+class FrontOverloaded(RuntimeError):
+    """The front's own pending set is full (or an armed ``front.shed``
+    fault forced the path): the request is shed at the edge with a
+    typed 429 before it can pile onto an already-saturated fleet."""
+
+    def __init__(self, message: str, *, retry_after: float = 1.0) -> None:
+        super().__init__(message)
+        self.retry_after = retry_after
+
+
 class FrontRouter:
     """Route /score requests across the lease-discovered replica set.
 
@@ -203,6 +227,8 @@ class FrontRouter:
         wait_for_replica_s: float = 30.0,
         request_timeout: float = 120.0,
         alerts_file: Optional[str] = None,
+        max_pending: int = 128,
+        retry_budget: int = 3,
     ) -> None:
         self.fleet_dir = fleet_dir
         self.host = host
@@ -213,6 +239,14 @@ class FrontRouter:
         self.retry_wait_s = float(retry_wait_s)
         self.wait_for_replica_s = float(wait_for_replica_s)
         self.request_timeout = float(request_timeout)
+        # front-side shedding: bound our own pending set so the front
+        # can never hold more in-flight work than the fleet could ever
+        # drain (batch-class requests shed at HALF the watermark —
+        # batch sheds first, here too).  0 disables the bound.
+        self.max_pending = int(max_pending)
+        # per-request retry budget (connection failures / 503s); a
+        # typed 429 NEVER spends a retry — it is propagated as-is
+        self.retry_budget = int(retry_budget)
         self._lock = threading.Lock()
         self._replicas: Dict[int, ReplicaView] = {}
         self._last_scan = 0.0
@@ -221,6 +255,10 @@ class FrontRouter:
         self._suspect: Dict[int, float] = {}
         self._pool: Dict[int, List[http.client.HTTPConnection]] = {}
         self._rr = 0
+        self._inflight = 0
+        # last Retry-After a replica priced (seconds): what a shed at
+        # the FRONT quotes, since the front has no estimator of its own
+        self._last_retry_after = 1.0
 
     # -- discovery -------------------------------------------------------
     def refresh(self, force: bool = False) -> None:
@@ -376,6 +414,7 @@ class FrontRouter:
         out_headers = {
             k: v for k, v in resp.getheaders()
             if k.lower() in ("x-stc-trace", "x-stc-generation",
+                             "x-stc-degraded", "retry-after",
                              "content-type")
         }
         self._pool_put(r, conn)
@@ -405,24 +444,93 @@ class FrontRouter:
             replica=replica,
         )
 
+    def _note_retry_after(self, out_headers: Dict[str, str]) -> float:
+        """Remember the replica-priced Retry-After (what a front-side
+        shed will quote next) and return it."""
+        try:
+            ra = float(out_headers.get("Retry-After", ""))
+        except ValueError:
+            ra = 1.0
+        with self._lock:
+            self._last_retry_after = max(1.0, ra)
+        return max(1.0, ra)
+
+    def _backoff(self, retries: int) -> None:
+        """Jittered exponential backoff between retries: decorrelates
+        handler threads re-trying into the same recovering replica
+        instead of re-forming the thundering herd that killed it."""
+        base = self.retry_wait_s * (2 ** max(0, retries - 1))
+        _sleep(min(1.0, base) * (0.5 + _jitter.random()))
+
     def route(
         self,
         body: bytes,
         *,
         stream: Optional[str] = None,
         trace_header: Optional[str] = None,
+        priority: Optional[str] = None,
     ) -> Tuple[int, bytes, Dict[str, str], int]:
         """Route one /score body; returns ``(status, body, headers,
         replica_index)``.  Retries connection-level failures and
-        503-draining answers on other replicas until the wait budget
-        runs out; scoring is idempotent per document so a retry can
-        never double-apply anything."""
+        503-draining answers on other replicas — at most
+        ``retry_budget`` retries per request, jittered backoff between
+        them, still fenced by the wait deadline.  A replica's typed 429
+        is propagated immediately with its Retry-After intact: a
+        saturated fleet must not be retry-stormed.  Raises
+        ``FrontOverloaded`` when the front's own pending set is full."""
+        t0 = time.perf_counter()
+        with self._lock:
+            self._inflight += 1
+            inflight = self._inflight
+        try:
+            return self._route_admitted(
+                body, stream=stream, trace_header=trace_header,
+                priority=priority, t0=t0, inflight=inflight,
+            )
+        finally:
+            with self._lock:
+                self._inflight -= 1
+
+    def _shed_check(
+        self, inflight: int, priority: Optional[str], t0: float
+    ) -> None:
+        forced = False
+        try:
+            faultinject.check("front.shed")
+        except OSError:
+            forced = True               # armed chaos: force the path
+        limit = self.max_pending
+        if limit and priority == "batch":
+            limit = max(1, limit // 2)  # batch sheds first
+        if forced or (limit and inflight > limit):
+            with self._lock:
+                ra = self._last_retry_after
+            telemetry.count("front.shed_total")
+            self._account("shed", t0)
+            raise FrontOverloaded(
+                f"front pending set full ({inflight} in flight, "
+                f"limit {limit})",
+                retry_after=ra,
+            )
+
+    def _route_admitted(
+        self,
+        body: bytes,
+        *,
+        stream: Optional[str],
+        trace_header: Optional[str],
+        priority: Optional[str],
+        t0: float,
+        inflight: int,
+    ) -> Tuple[int, bytes, Dict[str, str], int]:
+        self._shed_check(inflight, priority, t0)
         deadline = time.monotonic() + self.wait_for_replica_s
         headers = {"Content-Type": "application/json"}
         if trace_header:
             headers["X-STC-Trace"] = trace_header
-        t0 = time.perf_counter()
-        attempts = 0
+        if priority:
+            headers[PRIORITY_HEADER] = priority
+        retries = 0
         while True:
             try:
                 r = self.pick(stream)
@@ -434,7 +542,6 @@ class FrontRouter:
                 self.refresh(force=True)
                 _sleep(self.retry_wait_s)
                 continue
-            attempts += 1
             try:
                 status, payload, out_headers = self._forward_once(
                     r, body, headers
@@ -442,8 +549,18 @@ class FrontRouter:
             except (http.client.HTTPException, OSError):
                 self._release(r.index)
                 self._mark_suspect(r.index)
+                retries += 1
                 telemetry.count("front.retries")
                 telemetry.count(f"front.replica.{r.index}.retries")
+                if retries > self.retry_budget:
+                    telemetry.count("front.retry_budget_exhausted")
+                    self._account(
+                        "retry_budget_exhausted", t0, replica=r.index
+                    )
+                    raise NoReplicaAvailable(
+                        f"replica {r.index} failed and the "
+                        f"{self.retry_budget}-retry budget is spent"
+                    )
                 if time.monotonic() >= deadline:
                     telemetry.count("front.no_replica")
                     self._account(
@@ -451,22 +568,37 @@ class FrontRouter:
                     )
                     raise NoReplicaAvailable(
                         f"replica {r.index} failed and the retry "
-                        f"budget ran out"
+                        f"deadline ran out"
                     )
+                self._backoff(retries)
                 continue
             self._release(r.index)
+            if status == 429:
+                # the replica refused TYPED: propagate the refusal and
+                # its Retry-After schedule verbatim — spending retries
+                # here would storm the rest of the saturated fleet
+                self._note_retry_after(out_headers)
+                telemetry.count("front.rejected_total")
+                telemetry.count(f"front.replica.{r.index}.rejected")
+                self._account(
+                    "rejected", t0, status=status, replica=r.index,
+                )
+                return status, payload, out_headers, r.index
             if status == 503:
                 # the replica is draining (or refused): take it out of
                 # rotation until its lease says otherwise and retry
                 self._mark_suspect(r.index)
+                retries += 1
                 telemetry.count("front.retries")
                 telemetry.count(f"front.replica.{r.index}.retries")
-                if time.monotonic() >= deadline:
+                if retries > self.retry_budget or \
+                        time.monotonic() >= deadline:
                     self._account(
                         "error_status", t0,
                         status=status, replica=r.index,
                     )
                     return status, payload, out_headers, r.index
+                self._backoff(retries)
                 continue
             served = out_headers.get(GENERATION_HEADER)
             if stream and served is not None:
@@ -494,6 +626,10 @@ class FrontRouter:
     def health(self) -> dict:
         self.refresh()
         reg = telemetry.get_registry()
+        # per-replica utilisation from the queueing estimator (fed by
+        # the monitor's event stream): lets /healthz answer "which
+        # replica is saturating" without a metrics scrape
+        rho = reg.snapshot().get("gauges", {})
         with self._lock:
             replicas = [
                 {
@@ -507,10 +643,12 @@ class FrontRouter:
                     "lease_age_s": round(
                         max(0.0, time.time() - r.lease_ts), 3
                     ),
+                    "rho": rho.get(f"queueing.replica.{r.index}.rho"),
                 }
                 for _, r in sorted(self._replicas.items())
             ]
             pins = len(self._pins)
+            inflight = self._inflight
         ready = [r for r in replicas if r["state"] == "ready"]
         firing: List[Dict] = []
         if self.alerts_file:
@@ -531,6 +669,10 @@ class FrontRouter:
             "requests": reg.counter("front.requests").value,
             "retries": reg.counter("front.retries").value,
             "pinned_streams": pins,
+            "inflight": inflight,
+            "max_pending": self.max_pending,
+            "shed": reg.counter("front.shed_total").value,
+            "rejected": reg.counter("front.rejected_total").value,
         }
         if self.alerts_file:
             out["alerts"] = {
@@ -612,12 +754,29 @@ class _FrontHandler(BaseHTTPRequestHandler):
         length = int(self.headers.get("Content-Length", "0"))
         body = self.rfile.read(length)
         stream = self.headers.get(STREAM_HEADER)
+        priority = self.headers.get(PRIORITY_HEADER)
+        if priority:
+            priority = priority.strip().lower()
         try:
             status, payload, headers, replica = router.route(
                 body,
                 stream=stream,
                 trace_header=self.headers.get("X-STC-Trace"),
+                priority=priority,
             )
+        except FrontOverloaded as exc:
+            ra = max(1, int(exc.retry_after))
+            self._send(
+                429,
+                json.dumps({
+                    "error": str(exc),
+                    "status": "shed",
+                    "retry_after": ra,
+                }).encode("utf-8"),
+                "application/json",
+                extra={"Retry-After": str(ra)},
+            )
+            return
         except NoReplicaAvailable as exc:
             self._send_json(
                 503, {"error": str(exc), "status": "no_replica"}
@@ -636,7 +795,16 @@ def make_front_server(
 ) -> ThreadingHTTPServer:
     """Bind the front; ``port=0`` picks a free one.  The caller owns
     ``serve_forever`` (usually on a thread) and ``shutdown``."""
-    httpd = ThreadingHTTPServer((host, port), _FrontHandler)
+    # the stdlib listen backlog (5) overflows under a burst long before
+    # the shedding tier can answer with a typed 429 — clients would see
+    # raw connection resets, the exact untyped failure admission
+    # control exists to prevent; overload must land on /score, not on
+    # the SYN queue
+    _FrontServer = type(
+        "_FrontServer", (ThreadingHTTPServer,),
+        {"request_queue_size": 128},
+    )
+    httpd = _FrontServer((host, port), _FrontHandler)
     httpd.router = router
     httpd.daemon_threads = True
     return httpd

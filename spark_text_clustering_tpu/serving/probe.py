@@ -29,12 +29,19 @@ from __future__ import annotations
 import http.client
 import json
 import os
+import threading
 import time
-from typing import Dict, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 from .. import telemetry
 from ..resilience.retry import sleep as _sleep
-from .front import GENERATION_HEADER, REPLICA_HEADER, STREAM_HEADER
+from .front import (
+    DEGRADED_HEADER,
+    GENERATION_HEADER,
+    PRIORITY_HEADER,
+    REPLICA_HEADER,
+    STREAM_HEADER,
+)
 
 __all__ = [
     "SENTINEL_TEXT",
@@ -91,44 +98,64 @@ class Prober:
         stream: str = DEFAULT_STREAM,
         timeout: float = 5.0,
         text: str = SENTINEL_TEXT,
+        priority: Optional[str] = None,
     ) -> None:
         self.host = host
         self.port = int(port)
         self.stream = stream
         self.timeout = float(timeout)
+        self.priority = priority
         self.body = json.dumps(
             {"text": text, "names": ["probe"]}
         ).encode("utf-8")
         self._pin: Optional[int] = None
+        self._lock = threading.Lock()
         self.sent = 0
         self.failures = 0
+        self.rejected = 0
+        self.degraded = 0
         self.pin_violations = 0
 
     def probe_once(self) -> Dict:
         """One outside-in request; returns the ``probe_request`` record
         it also emitted.  Never raises: a dead front is an ``error``
-        outcome, which is exactly the measurement."""
+        outcome, which is exactly the measurement.  A typed 429 (shed
+        or admission refusal) is its own ``rejected`` outcome — under
+        deliberate overload a priced refusal is the system working, and
+        the SLO objectives must be able to tell it from a failure."""
         t0 = time.perf_counter()
         status: Optional[int] = None
         replica: Optional[int] = None
         generation: Optional[int] = None
+        retry_after: Optional[float] = None
+        degraded = False
         outcome = "ok"
+        headers = {
+            "Content-Type": "application/json",
+            STREAM_HEADER: self.stream,
+        }
+        if self.priority:
+            headers[PRIORITY_HEADER] = self.priority
         conn = http.client.HTTPConnection(
             self.host, self.port, timeout=self.timeout
         )
         try:
             conn.request(
-                "POST", "/score", body=self.body,
-                headers={
-                    "Content-Type": "application/json",
-                    STREAM_HEADER: self.stream,
-                },
+                "POST", "/score", body=self.body, headers=headers
             )
             resp = conn.getresponse()
             resp.read()
             status = resp.status
-            if status != 200:
+            if status == 429:
+                outcome = "rejected"
+                ra = resp.getheader("Retry-After")
+                try:
+                    retry_after = float(ra) if ra else None
+                except ValueError:
+                    retry_after = None
+            elif status != 200:
                 outcome = "error_status"
+            degraded = resp.getheader(DEGRADED_HEADER) is not None
             r = resp.getheader(REPLICA_HEADER)
             g = resp.getheader(GENERATION_HEADER)
             replica = int(r) if r is not None and r.isdigit() else None
@@ -146,21 +173,30 @@ class Prober:
         dt = time.perf_counter() - t0
 
         violation = False
-        if generation is not None:
-            if self._pin is not None and generation < self._pin:
-                # the stream observed an OLDER model generation than it
-                # was already answered with — the exact interleaving the
-                # front's pinning exists to forbid, seen from outside
-                violation = True
-                self.pin_violations += 1
-                telemetry.count("probe.pin_violations")
-            else:
-                self._pin = generation
-
-        self.sent += 1
+        with self._lock:
+            # ramp mode runs probe_once on many threads: the pin and
+            # the tallies are shared, so fold them under the lock
+            if generation is not None:
+                if self._pin is not None and generation < self._pin:
+                    # the stream observed an OLDER model generation than
+                    # it was already answered with — the interleaving
+                    # the front's pinning exists to forbid, from outside
+                    violation = True
+                    self.pin_violations += 1
+                    telemetry.count("probe.pin_violations")
+                else:
+                    self._pin = generation
+            self.sent += 1
+            if outcome == "rejected":
+                self.rejected += 1
+            elif outcome != "ok":
+                self.failures += 1
+            if degraded:
+                self.degraded += 1
         telemetry.count("probe.requests")
-        if outcome != "ok":
-            self.failures += 1
+        if outcome == "rejected":
+            telemetry.count("probe.rejected")
+        elif outcome != "ok":
             telemetry.count("probe.failures")
         telemetry.observe("probe.request_seconds", dt)
         rec = {
@@ -170,9 +206,22 @@ class Prober:
             "replica": replica,
             "generation": generation,
             "pin_violation": violation,
+            "priority": self.priority,
+            "retry_after": retry_after,
+            "degraded": degraded,
         }
         telemetry.event("probe_request", **rec)
         return rec
+
+    def _summary(self) -> Dict:
+        with self._lock:
+            return {
+                "sent": self.sent,
+                "failures": self.failures,
+                "rejected": self.rejected,
+                "degraded": self.degraded,
+                "pin_violations": self.pin_violations,
+            }
 
     def run(self, count: int, rate: float) -> Dict:
         """``count`` probes at ``rate``/s (fixed pacing off the wall
@@ -186,8 +235,32 @@ class Prober:
             delay = t_next - time.monotonic()
             if delay > 0:
                 _sleep(delay)
-        return {
-            "sent": self.sent,
-            "failures": self.failures,
-            "pin_violations": self.pin_violations,
-        }
+        return self._summary()
+
+    def run_ramp(
+        self, count: int, rate: float, ramp_to: float
+    ) -> Dict:
+        """Open-loop load ramp: ``count`` requests whose send rate
+        climbs linearly from ``rate``/s to ``ramp_to``/s, each fired on
+        its own thread AT its scheduled time whether or not earlier
+        requests have answered.  The closed-loop ``run()`` can never
+        drive a fleet past saturation (a slow fleet slows the prober —
+        the classic coordinated-omission trap); an overload drill needs
+        exactly the arrivals-keep-coming behavior of real clients."""
+        n = max(1, int(count))
+        threads: List[threading.Thread] = []
+        t0 = time.monotonic()
+        offset = 0.0
+        for i in range(n):
+            frac = i / max(1, n - 1)
+            cur = max(1e-6, rate + (ramp_to - rate) * frac)
+            delay = (t0 + offset) - time.monotonic()
+            if delay > 0:
+                _sleep(delay)
+            th = threading.Thread(target=self.probe_once, daemon=True)
+            th.start()
+            threads.append(th)
+            offset += 1.0 / cur
+        for th in threads:
+            th.join(self.timeout + 1.0)
+        return self._summary()

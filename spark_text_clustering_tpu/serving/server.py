@@ -30,12 +30,13 @@ byte-level pin is only asserted for LDA.
 from __future__ import annotations
 
 import json
+import math
 import os
 import threading
 import time
 import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import List, Optional, Sequence
+from typing import Callable, List, Optional, Sequence
 
 import numpy as np
 
@@ -44,9 +45,22 @@ from ..models.persistence import resolve_latest_model
 from ..resilience import CorruptArtifactError, Quarantine, faultinject
 from ..resilience.retry import sleep as _sleep
 from ..telemetry import tracing
-from .coalescer import PendingDoc, RequestCoalescer, ServiceDraining
+from ..telemetry.queueing import QueueingEstimator
+from .coalescer import (
+    DEFAULT_PRIORITY,
+    PRIORITIES,
+    PendingDoc,
+    RequestCoalescer,
+    ServiceDraining,
+    ServiceOverloaded,
+)
 
-__all__ = ["ServeScorer", "ScoringService", "make_http_server"]
+__all__ = [
+    "ServeScorer",
+    "ScoringService",
+    "DegradeController",
+    "make_http_server",
+]
 
 # default warmup grid: pow2 token buckets a book-sized request lands in
 DEFAULT_TOKEN_BUCKETS = (256, 1024, 4096)
@@ -175,7 +189,9 @@ class ServeScorer:
                 return t
         return want          # oversize: exact pow2, counted as a retrace
 
-    def score_rows(self, rows: List[tuple]) -> np.ndarray:
+    def score_rows(
+        self, rows: List[tuple], *, degraded: bool = False
+    ) -> np.ndarray:
         """Distributions [n, k] for up to ``max_batch`` vectorized rows.
 
         LDA path: the ``_topic_distribution_packed`` packing recipe
@@ -184,7 +200,15 @@ class ServeScorer:
         with per-document frozen convergence — so the bytes match the
         batch CLI's ``--per-doc-convergence`` output no matter how
         traffic coalesced, and every in-bucket dispatch reuses one
-        compiled executable."""
+        compiled executable.
+
+        ``degraded=True`` is the overload tier (docs/SERVING.md
+        "Overload & degradation"): documents are truncated to fit the
+        SMALLEST warmed token bucket, so a degraded dispatch reuses an
+        executable warmup already compiled — cheaper answers, zero new
+        compiles, and the zero-recompile serving contract holds.  The
+        emulated path halves its pinned service time instead (the same
+        capacity-for-quality trade, bench-shaped)."""
         n = len(rows)
         if n > self.max_batch:
             raise ValueError(f"{n} rows > max_batch {self.max_batch}")
@@ -194,7 +218,10 @@ class ServeScorer:
             # accelerator-shaped service time, deterministic output:
             # block (like a device dispatch would) for the pinned
             # per-document seconds, answer uniform-ish distributions
-            _sleep(self.emulate_doc_seconds * n)
+            per_doc = self.emulate_doc_seconds
+            if degraded:
+                per_doc *= 0.5
+            _sleep(per_doc * n)
             out = np.full((n, self.k), 1.0 / self.k, np.float32)
             out[:, 0] += 1e-3           # argmax pinned to topic 0
             return out
@@ -204,6 +231,15 @@ class ServeScorer:
             )
         import jax.numpy as jnp
 
+        if degraded:
+            budget = self.token_buckets[0]
+            total = sum(len(i) for i, _ in rows)
+            if total > budget:
+                # head-truncate each document to its share of the
+                # smallest bucket: total tokens <= budget, so _bucket
+                # resolves to an already-warmed executable
+                allow = max(1, budget // n)
+                rows = [(ids[:allow], wts[:allow]) for ids, wts in rows]
         t_pad = self._bucket(sum(len(i) for i, _ in rows))
         flat_i = np.zeros(t_pad, np.int32)
         flat_c = np.zeros(t_pad, np.float32)
@@ -275,6 +311,84 @@ class ServeScorer:
         return report
 
 
+class DegradeController:
+    """Hysteresis gate for degraded-mode answers.
+
+    ``update(pressure)`` is called once per dispatched batch with the
+    current pressure signal (max of queue fullness and the live ρ
+    estimate, both dimensionless around 1.0 = saturated).  The mode
+    flips to degraded only after pressure has held at or above
+    ``enter_pressure`` for ``enter_seconds`` of consecutive updates, and
+    restores only after it has held at or below ``exit_pressure`` for
+    ``exit_seconds`` — the gap between the thresholds plus the dwell
+    times is the hysteresis that keeps a noisy boundary load from
+    flapping quality.  ``clock`` is injectable so tests drive the dwell
+    on a fake clock.
+
+    Single-writer by construction: only the coalescer's batch worker
+    calls ``update``; readers (health, response attribution) see a
+    monotonic bool.
+    """
+
+    def __init__(
+        self,
+        *,
+        enter_pressure: float = 0.9,
+        exit_pressure: float = 0.6,
+        enter_seconds: float = 1.0,
+        exit_seconds: float = 3.0,
+        clock: Callable[[], float] = time.monotonic,
+    ) -> None:
+        if exit_pressure >= enter_pressure:
+            raise ValueError(
+                f"exit_pressure {exit_pressure} must be below "
+                f"enter_pressure {enter_pressure} (the hysteresis band)"
+            )
+        self.enter_pressure = float(enter_pressure)
+        self.exit_pressure = float(exit_pressure)
+        self.enter_seconds = float(enter_seconds)
+        self.exit_seconds = float(exit_seconds)
+        self._clock = clock
+        self._degraded = False
+        self._since: Optional[float] = None   # condition onset, or None
+
+    @property
+    def degraded(self) -> bool:
+        return self._degraded
+
+    def update(self, pressure: float) -> bool:
+        now = self._clock()
+        if not self._degraded:
+            if pressure >= self.enter_pressure:
+                if self._since is None:
+                    self._since = now
+                elif now - self._since >= self.enter_seconds:
+                    self._degraded = True
+                    self._since = None
+                    telemetry.count("degrade.entered")
+                    telemetry.event(
+                        "degrade_mode", state="degraded",
+                        pressure=round(pressure, 4),
+                    )
+            else:
+                self._since = None
+        else:
+            if pressure <= self.exit_pressure:
+                if self._since is None:
+                    self._since = now
+                elif now - self._since >= self.exit_seconds:
+                    self._degraded = False
+                    self._since = None
+                    telemetry.count("degrade.exited")
+                    telemetry.event(
+                        "degrade_mode", state="restored",
+                        pressure=round(pressure, 4),
+                    )
+            else:
+                self._since = None
+        return self._degraded
+
+
 class ScoringService:
     """Accept -> coalesce -> dispatch -> respond, with hot-swap + drain."""
 
@@ -297,6 +411,9 @@ class ScoringService:
         watch_model: bool = True,
         replica_index: Optional[int] = None,
         emulate_doc_seconds: Optional[float] = None,
+        max_queue: Optional[int] = None,
+        batch_weight: float = 0.25,
+        degrade: Optional[DegradeController] = None,
     ) -> None:
         self.models_dir = models_dir
         self.lang = lang
@@ -337,8 +454,24 @@ class ScoringService:
                 if k != "signatures"
             },
         )
+        # admission control (docs/SERVING.md "Overload & degradation"):
+        # None picks the default backlog bound (8 full batches); 0 keeps
+        # the pre-PR-20 unbounded intake for embedded/offline use
+        if max_queue is None:
+            max_queue = 8 * max_batch
+        self.max_queue = max_queue if max_queue > 0 else None
+        self._degrade = degrade if degrade is not None \
+            else DegradeController()
+        # in-process queueing triple (c=1: this replica) — arrivals
+        # noted per accepted request, service attributed per dispatch;
+        # the Erlang-C predicted wait prices every 429's Retry-After
+        self._queue_est = QueueingEstimator(
+            window_seconds=10.0, replica_count=1
+        )
+        self._est_lock = threading.Lock()
         self.coalescer = RequestCoalescer(
             self._dispatch, max_batch=max_batch, linger_s=linger_s,
+            max_queue=self.max_queue, batch_weight=batch_weight,
         )
         self._watcher = None
         if model is None and watch_model:
@@ -375,6 +508,8 @@ class ScoringService:
             "model": self._scorer.attribution,
             "uptime_s": round(time.time() - self.started_at, 3),
             "queue_depth": self.coalescer.queue_depth(),
+            "max_queue": self.max_queue,
+            "degraded_mode": self._degrade.degraded,
             "requests": reg.counter("serve.requests").value,
             "batches": reg.counter("serve.batches").value,
             "swaps": reg.counter("serve.swaps").value,
@@ -391,16 +526,34 @@ class ScoringService:
         return out
 
     # -- request path ----------------------------------------------------
+    def retry_after_seconds(self) -> float:
+        """Price of coming back: the live Erlang-C predicted wait (p99,
+        falling back to mean), ceil'd into [1, 60] whole seconds — a
+        refused client is told WHEN the backlog should have drained,
+        not just to go away.  A saturated replica has no steady state;
+        the estimator caps the prediction at its window, which lands
+        here as the window in seconds."""
+        with self._est_lock:
+            est = self._queue_est.estimate(time.time()) or {}
+        wait = est.get("predicted_wait_p99_seconds") \
+            or est.get("predicted_wait_seconds") or 0.0
+        if not math.isfinite(wait):
+            wait = self._queue_est.window_seconds
+        return float(min(max(1.0, math.ceil(wait)), 60.0))
+
     def submit_texts(
         self,
         texts: Sequence[str],
         names: Optional[Sequence[str]] = None,
         trace: Optional[tracing.TraceContext] = None,
+        priority: str = DEFAULT_PRIORITY,
     ) -> List[dict]:
         """Score ``texts``; returns one result dict per document, in
-        order.  Raises ``ServiceDraining`` after the preemption notice.
-        Called from HTTP handler threads (and directly by tests/bench);
-        blocks until every document's batch completed.
+        order.  Raises ``ServiceDraining`` after the preemption notice
+        and ``ServiceOverloaded`` (with ``retry_after`` priced) when the
+        bounded intake refuses the request or evicts every document in
+        it.  Called from HTTP handler threads (and directly by
+        tests/bench); blocks until every document's batch completed.
 
         ``trace``: the request's causal context (the HTTP front parses
         ``X-STC-Trace`` into one; None mints a head-sampled root).  A
@@ -416,6 +569,20 @@ class ScoringService:
                 "scoring service is draining (preemption notice "
                 "received) — retry against another replica"
             )
+        if priority not in PRIORITIES:
+            priority = DEFAULT_PRIORITY
+        # every arrival feeds λ — refused requests still arrived, and
+        # their pressure is exactly what prices the next Retry-After
+        with self._est_lock:
+            self._queue_est.note_arrivals(len(texts), time.time())
+        try:
+            # whole-request admission: reserve every slot up front so a
+            # multi-doc request is admitted or refused as ONE unit
+            self.coalescer.reserve(len(texts), priority)
+        except ServiceOverloaded as exc:
+            telemetry.count("serve.rejected", len(texts))
+            exc.retry_after = self.retry_after_seconds()
+            raise
         ctx = trace if trace is not None else tracing.mint()
         if ctx.sampled:
             telemetry.count("trace.sampled")
@@ -435,7 +602,9 @@ class ScoringService:
                 )
             except Exception as exc:
                 # one malformed document gets an error response; its
-                # batchmates (and the daemon) are untouched
+                # batchmates (and the daemon) are untouched — and its
+                # reserved intake slot goes back
+                self.coalescer.release(1)
                 telemetry.count("serve.quarantined")
                 telemetry.event(
                     "serve_quarantined", docs=1, stage="vectorize",
@@ -447,12 +616,17 @@ class ScoringService:
                 continue
             telemetry.count("serve.requests")
             pending.append(
-                self.coalescer.submit(PendingDoc(name=name, row=row))
+                self.coalescer.submit(
+                    PendingDoc(name=name, row=row, priority=priority)
+                )
             )
         vec_end = time.perf_counter()
+        evicted = 0
+        live = 0
         for i, doc in enumerate(pending):
             if doc is None:
                 continue
+            live += 1
             if not doc.done.wait(self.request_timeout):
                 results[i] = {
                     "name": doc.name,
@@ -461,6 +635,10 @@ class ScoringService:
                 continue
             if doc.error is not None:
                 results[i] = {"name": doc.name, "error": doc.error}
+                if doc.error_kind == "ServiceOverloaded":
+                    # evicted mid-queue by interactive load
+                    results[i]["rejected"] = True
+                    evicted += 1
             else:
                 dist = doc.distribution
                 results[i] = {
@@ -469,8 +647,22 @@ class ScoringService:
                     "distribution": [float(x) for x in dist],
                     "model": doc.served_by,
                 }
+                if doc.degraded:
+                    results[i]["degraded"] = True
+            dt = time.perf_counter() - t0
+            telemetry.observe("serve.request_seconds", dt)
             telemetry.observe(
-                "serve.request_seconds", time.perf_counter() - t0
+                f"serve.class.{priority}.request_seconds", dt
+            )
+        if live and evicted == live:
+            # the whole request was shed from the queue: surface it as
+            # one typed refusal (HTTP 429), not a 200 full of errors
+            telemetry.count("serve.rejected", evicted)
+            raise ServiceOverloaded(
+                f"all {evicted} document(s) evicted under interactive "
+                f"pressure (batch sheds first)",
+                priority=priority, evicted=True,
+                retry_after=self.retry_after_seconds(),
             )
         if traced:
             self._emit_request_spans(
@@ -566,10 +758,35 @@ class ScoringService:
         # every response in it — is attributable to exactly this model,
         # however the watcher swings ``self._scorer`` mid-flight
         scorer = self._scorer
-        dist = scorer.score_rows([d.row for d in batch])
+        # pressure = max(queue fullness, live ρ); ρ counts REFUSED
+        # arrivals too, so a replica busy saying no stays degraded —
+        # exactly the regime where cheaper answers buy back capacity
+        pressure = 0.0
+        if self.max_queue:
+            pressure = self.coalescer.queue_depth() / self.max_queue
+        with self._est_lock:
+            est = self._queue_est.estimate(time.time()) or {}
+        rho = est.get("rho")
+        if rho is not None and math.isfinite(rho):
+            pressure = max(pressure, float(rho))
+        degraded = self._degrade.update(pressure)
+        t0 = time.perf_counter()
+        dist = scorer.score_rows(
+            [d.row for d in batch], degraded=degraded
+        )
+        dt = time.perf_counter() - t0
+        with self._est_lock:
+            self._queue_est.observe_event(time.time(), {
+                "event": "serve_batch",
+                "docs": len(batch),
+                "seconds": dt,
+            })
+        if degraded:
+            telemetry.count("degrade.responses", len(batch))
         for d, row in zip(batch, dist):
             d.distribution = np.asarray(row)
             d.served_by = scorer.attribution
+            d.degraded = degraded
             d.done.set()
 
     # -- hot swap --------------------------------------------------------
@@ -650,6 +867,10 @@ class ScoringService:
             "swaps": reg.counter("serve.swaps").value,
             "quarantined": reg.counter("serve.quarantined").value,
             "rejected": reg.counter("serve.rejected").value,
+            "evicted": reg.counter("admission.evicted").value,
+            "degraded_responses": reg.counter(
+                "degrade.responses"
+            ).value,
             "retraces_total": int(retraces),
             "retraces_after_warmup": int(
                 retraces - self.warmup_report["retraces_at_warmup"]
@@ -669,7 +890,9 @@ class _ServeHandler(BaseHTTPRequestHandler):
     def log_message(self, fmt, *args):  # noqa: A003
         pass
 
-    def _send(self, code: int, doc: dict, trace=None) -> None:
+    def _send(
+        self, code: int, doc: dict, trace=None, headers=None
+    ) -> None:
         from .front import GENERATION_HEADER, REPLICA_HEADER
 
         service: ScoringService = self.server.service
@@ -677,6 +900,10 @@ class _ServeHandler(BaseHTTPRequestHandler):
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            # typed-refusal extras: Retry-After on a 429, X-STC-Degraded
+            # on a quality-shed answer
+            self.send_header(k, v)
         if trace is not None:
             # the served byte's end of the causal chain: clients (and
             # `stc lineage`) resume the walk from this header
@@ -750,6 +977,8 @@ class _ServeHandler(BaseHTTPRequestHandler):
             self._send(404, {"error": f"no route {self.path}"})
 
     def do_POST(self):  # noqa: N802
+        from .front import DEGRADED_HEADER, PRIORITY_HEADER
+
         service: ScoringService = self.server.service
         if self.path != "/score":
             self._send(404, {"error": f"no route {self.path}"})
@@ -759,6 +988,11 @@ class _ServeHandler(BaseHTTPRequestHandler):
         # CHILD span of it); no header mints a head-sampled root
         inbound = tracing.parse(self.headers.get(tracing.HEADER))
         ctx = inbound.child() if inbound is not None else tracing.mint()
+        # priority class: unknown values fold to the default so the
+        # header never grows unbounded per-class state
+        priority = (
+            self.headers.get(PRIORITY_HEADER) or DEFAULT_PRIORITY
+        ).strip().lower()
         try:
             length = int(self.headers.get("Content-Length", "0"))
             payload = json.loads(self.rfile.read(length) or b"{}")
@@ -774,13 +1008,38 @@ class _ServeHandler(BaseHTTPRequestHandler):
             self._send(400, {"error": f"bad request: {exc}"}, trace=ctx)
             return
         try:
-            results = service.submit_texts(texts, names, trace=ctx)
+            results = service.submit_texts(
+                texts, names, trace=ctx, priority=priority
+            )
         except ServiceDraining as exc:
             self._send(
                 503, {"error": str(exc), "status": "draining"},
                 trace=ctx,
             )
             return
+        except ServiceOverloaded as exc:
+            # the typed refusal: 429 + a Retry-After priced from the
+            # live Erlang-C predicted wait — refusal with a schedule
+            ra = exc.retry_after
+            if ra is None:
+                ra = service.retry_after_seconds()
+            self._send(
+                429,
+                {
+                    "error": str(exc),
+                    "status": "overloaded",
+                    "priority": exc.priority,
+                    "retry_after": ra,
+                },
+                trace=ctx,
+                headers={"Retry-After": str(int(math.ceil(ra)))},
+            )
+            return
+        extra = None
+        if any(r.get("degraded") for r in results):
+            # quality-shed attribution: clients (and the prober) can
+            # tell a cheap answer from a full one
+            extra = {DEGRADED_HEADER: "1"}
         self._send(
             200,
             {
@@ -789,6 +1048,7 @@ class _ServeHandler(BaseHTTPRequestHandler):
                 "trace": ctx.to_fields(),
             },
             trace=ctx,
+            headers=extra,
         )
 
 
@@ -798,7 +1058,14 @@ def make_http_server(
     """Bind the JSON front; ``port=0`` picks a free port (tests/bench).
     The caller owns ``serve_forever`` (usually on a thread) and
     ``shutdown`` after the drain."""
-    httpd = ThreadingHTTPServer((host, port), _ServeHandler)
+    # deep listen backlog for the same reason as the front's: a burst
+    # must reach the admission gate and be refused with a priced 429,
+    # not die as a connection reset in the kernel's SYN queue
+    _ServeServer = type(
+        "_ServeServer", (ThreadingHTTPServer,),
+        {"request_queue_size": 128},
+    )
+    httpd = _ServeServer((host, port), _ServeHandler)
     httpd.service = service
     httpd.daemon_threads = True
     return httpd

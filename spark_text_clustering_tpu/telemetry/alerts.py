@@ -464,6 +464,36 @@ BUILTIN_RULES: Dict[str, Dict] = {
         "description": "documents are failing vectorize/score faster "
                        "than a stray poison doc explains",
     },
+    # overload control: typed refusals are WORKING as designed, but a
+    # sustained reject rate means the fleet is undersized for its
+    # offered load — page a human (or let the autoscaler catch up)
+    "reject_rate": {
+        "kind": "threshold",
+        "signal": {"event": "front_request", "agg": "rate",
+                   "where": {"outcome": "rejected"},
+                   "window_seconds": 60.0},
+        "op": ">", "value": 1.0, "for_seconds": 5.0,
+        "resolve_seconds": 15.0,
+        "action": {"kind": "scale_out"},
+        "description": "the front is propagating replica 429s faster "
+                       "than one per second, sustained — admission "
+                       "control is holding the line but the fleet is "
+                       "undersized for the offered load",
+    },
+    # overload control: the fleet has been answering on the cheaper
+    # degraded tier for most of the window — capacity bought back by
+    # quality, which must not become the steady state silently
+    "degraded_fraction": {
+        "kind": "threshold",
+        "signal": {"event": "serve_batch", "field": "degraded",
+                   "agg": "mean", "window_seconds": 60.0},
+        "op": ">", "value": 0.5, "for_seconds": 5.0,
+        "resolve_seconds": 15.0,
+        "description": "most serve batches are dispatching in "
+                       "degraded mode (X-STC-Degraded answers) — "
+                       "sustained pressure is being paid for with "
+                       "answer quality",
+    },
     # epoch ledger: rollbacks burning against commits
     "ledger_rollback_rate": {
         "kind": "threshold",

@@ -694,6 +694,19 @@ def serve_fleet_health(
         "no_replica": int(metrics.get("counter.front.no_replica", 0)),
         "repins": int(metrics.get("counter.front.repins", 0)),
     }
+    # overload control at the edge (docs/SERVING.md "Overload &
+    # degradation"): typed sheds/rejections and the spent retry budget
+    shed = int(metrics.get("counter.front.shed_total", 0))
+    rejected = int(metrics.get("counter.front.rejected_total", 0))
+    budget_x = int(
+        metrics.get("counter.front.retry_budget_exhausted", 0)
+    )
+    if shed or rejected or budget_x:
+        out["overload"] = {
+            "shed": shed,
+            "rejected": rejected,
+            "retry_budget_exhausted": budget_x,
+        }
     lat = {}
     for q in ("p50", "p99", "mean", "count"):
         v = metrics.get(f"hist.front.request_seconds.{q}")
@@ -798,6 +811,38 @@ def serving_health(
     fill = metrics.get("hist.serve.batch_fill.mean")
     if fill is not None:
         out["batch_fill_mean"] = round(fill, 4)
+    # bounded admission + degraded mode (docs/SERVING.md "Overload &
+    # degradation"): the typed-429 ledger and the quality-for-capacity
+    # trade, rendered only for runs that exercised them
+    adm_re = re.compile(r"^counter\.admission\.(accepted|rejected)\.")
+    admission: Dict[str, int] = {}
+    for k in sorted(metrics):
+        m = adm_re.match(k)
+        if m:
+            admission[k[len("counter.admission."):]] = int(metrics[k])
+    evicted = int(metrics.get("counter.admission.evicted", 0))
+    if admission or evicted:
+        out["admission"] = dict(admission, evicted=evicted)
+    degraded = int(metrics.get("counter.degrade.responses", 0))
+    if degraded or metrics.get("counter.degrade.entered"):
+        out["degraded"] = {
+            "responses": degraded,
+            "entered": int(metrics.get("counter.degrade.entered", 0)),
+            "exited": int(metrics.get("counter.degrade.exited", 0)),
+        }
+    classes: Dict[str, Dict[str, float]] = {}
+    for cls in ("interactive", "batch"):
+        row = {}
+        for q in ("p50", "p99", "count"):
+            v = metrics.get(
+                f"hist.serve.class.{cls}.request_seconds.{q}"
+            )
+            if v is not None:
+                row[q] = v
+        if row:
+            classes[cls] = row
+    if classes:
+        out["classes"] = classes
     warm = next(
         (e for e in events if e.get("event") == "serve_warmup"), None
     )
@@ -1385,6 +1430,29 @@ def _print_serving_health(sh: Dict, file=None) -> None:
         f"refused while draining: {sh['rejected_while_draining']}",
         file=file,
     )
+    adm = sh.get("admission")
+    if adm:
+        parts = [
+            f"{k.replace('.', ' ')} {v}" for k, v in sorted(adm.items())
+        ]
+        print(f"  admission: {'  '.join(parts)}", file=file)
+    deg = sh.get("degraded")
+    if deg:
+        print(
+            f"  degraded mode: {deg['responses']} response(s)  "
+            f"entered {deg['entered']}x  exited {deg['exited']}x",
+            file=file,
+        )
+    for cls, row in sorted(sh.get("classes", {}).items()):
+        lat_c = (
+            f"  p50 {row['p50'] * 1000:.1f}ms  "
+            f"p99 {row['p99'] * 1000:.1f}ms"
+            if "p50" in row and "p99" in row else ""
+        )
+        print(
+            f"  class {cls}: {int(row.get('count', 0))} doc(s)"
+            f"{lat_c}", file=file,
+        )
     for s in sh.get("swap_history", ()):
         print(
             f"  swap: {s['from']} -> {s['to']} (epoch {s['epoch']})",
@@ -1423,6 +1491,14 @@ def _print_serve_fleet_health(sfh: Dict, file=None) -> None:
         f"{lat_s}",
         file=file,
     )
+    ov = sfh.get("overload")
+    if ov:
+        print(
+            f"  overload: shed {ov['shed']}  replica-429s propagated "
+            f"{ov['rejected']}  retry budget exhausted "
+            f"{ov['retry_budget_exhausted']}",
+            file=file,
+        )
     for r in sfh.get("replicas", ()):
         p99 = (
             f"  p99 {r['p99_seconds'] * 1000:.1f}ms"

@@ -105,6 +105,18 @@ METRICS: Dict[str, str] = {
         "replica response relayed, retry budget exhausted, or refused "
         "with no ready replica (includes routing, transport, and any "
         "retries — the latency-SLO denominator)",
+    "front.shed_total":
+        "requests shed at the front edge (pending set full or an "
+        "armed front.shed fault): typed 429 quoting the last "
+        "replica-priced Retry-After, never queued onto the fleet",
+    "front.rejected_total":
+        "replica 429s propagated to the client with Retry-After "
+        "intact — a typed refusal is an ANSWER, so no retry is spent "
+        "storming the rest of the saturated fleet",
+    "front.retry_budget_exhausted":
+        "requests failed after spending their whole per-request retry "
+        "budget on connection-level failures (its own typed outcome: "
+        "distinguishes a flapping fleet from an empty one)",
     # -- SLO engine & queueing observatory (docs/OBSERVABILITY.md
     #    "SLOs & error budgets") -----------------------------------------
     "probe.requests":
@@ -120,6 +132,10 @@ METRICS: Dict[str, str] = {
     "probe.request_seconds":
         "per-canary-request latency: connect -> response read "
         "(outside-in, fresh connection each probe)",
+    "probe.rejected":
+        "probe requests answered with a typed 429 (shed or admission "
+        "refusal) — counted apart from probe.failures because a typed "
+        "refusal under overload is the system WORKING",
     "queueing.updates":
         "queueing estimates computed (each one re-publishes the "
         "lambda/service/rho/wait gauges from the current window)",
@@ -365,6 +381,23 @@ PREFIXES: Dict[str, str] = {
         "telemetry.queueing: measured per-replica busy fraction "
         "(queueing.replica.<i>.rho — spread across replicas exposes "
         "routing skew the fleet-wide rho hides)",
+    "admission.":
+        "serving.coalescer bounded intake: per-priority accepted/"
+        "rejected counters plus admission.evicted (batch docs shed to "
+        "make room for interactive arrivals) — the typed-429 ledger",
+    "degrade.":
+        "serving.server degraded mode: degrade.entered/.exited "
+        "hysteresis transitions and degrade.responses (documents "
+        "answered on the cheaper tier, attributed via X-STC-Degraded)",
+    "serve.class.":
+        "serving.server per-priority-class latency histograms "
+        "(serve.class.<interactive|batch>.request_seconds — the "
+        "per-class SLO evidence that batch sheds first)",
+    "autoscale.":
+        "telemetry.queueing PredictiveAutoscaler: autoscale.scale_out/"
+        ".scale_in decisions emitted from the lambda*S vs c*capacity "
+        "signal (ahead of the p99 burn-rate page), plus the "
+        "autoscale.target gauge",
 }
 
 

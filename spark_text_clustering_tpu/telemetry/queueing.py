@@ -48,6 +48,7 @@ __all__ = [
     "erlang_c",
     "predicted_waits",
     "QueueingEstimator",
+    "PredictiveAutoscaler",
 ]
 
 # replica index out of a StreamSet label ("worker-w002-s0.jsonl")
@@ -254,3 +255,112 @@ class QueueingEstimator:
                     "queueing.wait_divergence", divergence
                 )
         return ev
+
+
+class PredictiveAutoscaler:
+    """Turn queueing estimates into replica-count decisions BEFORE the
+    p99 burn-rate page fires (ROADMAP item 3's control half).
+
+    The p99 alert is lagging by construction: by the time the tail
+    breaches, the queue that caused it is already full.  ρ = λ·S/c is
+    leading — it crosses ``high_rho`` while waits are still bounded
+    (the Erlang-C knee), which is exactly when adding a replica still
+    prevents the breach instead of mopping it up.
+
+    Deliberately boring control law, because flapping is worse than
+    lag:
+
+      * **hysteresis** — a decision needs ``confirm`` *consecutive*
+        estimates beyond the threshold (one window-sized spike is not
+        load), and ``high_rho``/``low_rho`` leave a dead band between
+        them;
+      * **cooldown** — after any decision the controller holds for
+        ``cooldown_seconds`` (a fresh replica needs a model load + a
+        warmup before it absorbs anything; deciding again off the
+        pre-spawn signal double-scales);
+      * **clamps** — the target never leaves
+        ``[min_replicas, max_replicas]``.
+
+    ``decide()`` is pure policy: it returns the decision (or None) and
+    publishes ``autoscale.*`` accounting; the serve-fleet supervisor
+    owns actuation through the same ledger-gated actions-file path the
+    monitor's alert actions ride.
+    """
+
+    def __init__(
+        self,
+        *,
+        min_replicas: int = 1,
+        max_replicas: int = 4,
+        high_rho: float = 0.8,
+        low_rho: float = 0.3,
+        confirm: int = 2,
+        cooldown_seconds: float = 30.0,
+    ) -> None:
+        if not 0.0 < low_rho < high_rho:
+            raise ValueError(
+                f"need 0 < low_rho < high_rho, got "
+                f"low={low_rho} high={high_rho}"
+            )
+        if min_replicas < 1 or max_replicas < min_replicas:
+            raise ValueError(
+                f"need 1 <= min_replicas <= max_replicas, got "
+                f"min={min_replicas} max={max_replicas}"
+            )
+        self.min_replicas = int(min_replicas)
+        self.max_replicas = int(max_replicas)
+        self.high_rho = float(high_rho)
+        self.low_rho = float(low_rho)
+        self.confirm = max(1, int(confirm))
+        self.cooldown_seconds = float(cooldown_seconds)
+        self._streak = 0                 # +n hot estimates / -n cold
+        self._last_decision_ts: Optional[float] = None
+
+    def decide(
+        self, estimate: Optional[Dict], now: float,
+        *, current: Optional[int] = None,
+    ) -> Optional[Dict]:
+        """Fold one ``queueing_estimate`` (as returned by
+        ``QueueingEstimator.estimate``); returns a decision dict
+        ``{"action", "from", "to", "rho", "streak"}`` or None.
+        ``current`` overrides the estimate's replica count with the
+        supervisor's actual spawn target (the estimate counts streams
+        it has SEEN, which lags a replica that is still loading)."""
+        if not estimate:
+            return None
+        rho = estimate.get("rho")
+        if not isinstance(rho, (int, float)) or isinstance(rho, bool):
+            return None                  # no service signal yet
+        c = current if current is not None else int(
+            estimate.get("replicas", self.min_replicas)
+        )
+        if rho >= self.high_rho:
+            self._streak = max(1, self._streak + 1)
+        elif rho <= self.low_rho:
+            self._streak = min(-1, self._streak - 1)
+        else:
+            self._streak = 0             # dead band: no opinion
+        if self._last_decision_ts is not None and \
+                now - self._last_decision_ts < self.cooldown_seconds:
+            return None
+        action: Optional[str] = None
+        target = c
+        if self._streak >= self.confirm and c < self.max_replicas:
+            action, target = "scale_out", c + 1
+        elif self._streak <= -self.confirm and c > self.min_replicas:
+            action, target = "scale_in", c - 1
+        if action is None:
+            return None
+        self._last_decision_ts = now
+        self._streak = 0
+        telemetry.count(f"autoscale.{action}")
+        telemetry.gauge("autoscale.target", target)
+        decision = {
+            "action": action,
+            "from": c,
+            "to": target,
+            "rho": round(float(rho), 6),
+            "streak": self.confirm,
+        }
+        telemetry.event("autoscale_decision", **decision)
+        return decision

@@ -241,6 +241,48 @@ BUILTIN_OBJECTIVES: Dict[str, Dict] = {
         "description": "outside-in: sentinel canary requests answered "
                        "inside the latency line",
     },
+    # per-priority-class promises (the overload drill's evidence):
+    # interactive holds the strict line while batch sheds first, so its
+    # objectives pre-filter on the priority the probe stamped
+    "probe_interactive_availability": {
+        "kind": "availability", "event": "probe_request",
+        "target": 0.99, "good_where": {"outcome": "ok"},
+        "where": {"priority": "interactive"},
+        "source": "probe",
+        "description": "outside-in, interactive class only: the "
+                       "strict promise that must HOLD while the fleet "
+                       "sheds batch under overload",
+    },
+    "probe_interactive_latency": {
+        "kind": "latency", "event": "probe_request",
+        "target": 0.99, "field": "seconds",
+        "threshold_seconds": DEFAULT_LATENCY_THRESHOLD,
+        "where": {"priority": "interactive"},
+        "source": "probe",
+        "description": "outside-in, interactive class only: p99 "
+                       "inside the latency line even past fleet "
+                       "saturation (admission control's job)",
+    },
+    "probe_batch_availability": {
+        "kind": "availability", "event": "probe_request",
+        "target": 0.5, "good_where": {"outcome": "ok"},
+        "where": {"priority": "batch"},
+        "source": "probe",
+        "description": "outside-in, batch class: deliberately loose — "
+                       "batch sheds FIRST under pressure (typed 429s "
+                       "spend this budget by design), it just must "
+                       "not starve outright",
+    },
+    "front_goodput": {
+        "kind": "availability", "event": "front_request",
+        "target": 0.9, "good_where": {"outcome": "ok"},
+        "source": "serve",
+        "description": "goodput: front requests that produced a real "
+                       "answer — typed sheds/rejections spend this "
+                       "budget, so a flat good fraction past "
+                       "saturation is the overload-control win "
+                       "condition (vs availability's stricter target)",
+    },
 }
 
 

@@ -1,0 +1,723 @@
+"""Overload behavior of the serving tier (docs/SERVING.md "Overload &
+degradation"): bounded priority admission at the coalescer, typed 429s
+with Erlang-C-priced Retry-After at the replica, batch-sheds-first
+eviction and anti-starvation weighting, degraded-mode hysteresis on a
+fake clock, front-side shedding / 429 propagation / retry budgets, the
+predictive autoscaler's streak + cooldown state machine, and the open-
+loop probe ramp that drives the CI overload drill.
+
+The races under test are the ones admission control exists to make
+boring: concurrent submits against a full queue, submit-vs-drain,
+interactive arrivals evicting queued batch work mid-flight.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from spark_text_clustering_tpu import telemetry
+from spark_text_clustering_tpu.resilience import faultinject
+from spark_text_clustering_tpu.serving.coalescer import (
+    DEFAULT_PRIORITY,
+    PRIORITIES,
+    PendingDoc,
+    RequestCoalescer,
+    ServiceDraining,
+    ServiceOverloaded,
+)
+from spark_text_clustering_tpu.serving.front import (
+    DEGRADED_HEADER,
+    PRIORITY_HEADER,
+    FrontOverloaded,
+    FrontRouter,
+    NoReplicaAvailable,
+    ReplicaView,
+)
+from spark_text_clustering_tpu.serving.probe import Prober
+from spark_text_clustering_tpu.telemetry import dispatch as dispatch_attr
+from spark_text_clustering_tpu.telemetry.queueing import (
+    PredictiveAutoscaler,
+)
+
+K = 3
+
+
+@pytest.fixture(autouse=True)
+def _telemetry_reset():
+    telemetry.shutdown()
+    telemetry.get_registry().reset()
+    dispatch_attr.reset()
+    faultinject.reset()
+    yield
+    telemetry.shutdown()
+    telemetry.get_registry().reset()
+    dispatch_attr.reset()
+    faultinject.reset()
+
+
+def _doc(i, priority=DEFAULT_PRIORITY):
+    return PendingDoc(
+        name=f"d{i}",
+        row=(np.zeros(1, np.int32), np.ones(1, np.float32)),
+        priority=priority,
+    )
+
+
+def _answer(batch):
+    for d in batch:
+        d.distribution = np.zeros(K, np.float32)
+        d.done.set()
+
+
+class _GatedDispatch:
+    """Dispatch that parks the batch worker until released — the queue
+    fills deterministically while the gate is shut."""
+
+    def __init__(self):
+        self.gate = threading.Event()
+        self.batches = []
+        self._lock = threading.Lock()
+
+    def __call__(self, batch):
+        self.gate.wait(10.0)
+        with self._lock:
+            self.batches.append([(d.name, d.priority) for d in batch])
+        _answer(batch)
+
+
+# ---------------------------------------------------------------------------
+# coalescer: bounded priority intake
+# ---------------------------------------------------------------------------
+class TestAdmission:
+    def test_queue_full_typed_refusal_under_concurrency(self):
+        """N concurrent submits against a bound of Q: exactly the docs
+        that fit are accepted, every other submit raises the TYPED
+        refusal (priority attached, never a bare exception), and the
+        accounting adds up."""
+        telemetry.configure(None)
+        gated = _GatedDispatch()
+        co = RequestCoalescer(
+            gated, max_batch=2, linger_s=0.001, max_queue=4
+        )
+        # park the worker on a primer doc so submits only queue
+        primer = co.submit(_doc(999))
+        deadline = time.monotonic() + 5.0
+        while co.queue_depth() > 0 and time.monotonic() < deadline:
+            time.sleep(0.005)
+
+        n = 16
+        refused, accepted, errors = [], [], []
+        start = threading.Barrier(n)
+
+        def submit(i):
+            start.wait(5.0)
+            try:
+                accepted.append(co.submit(_doc(i)))
+            except ServiceOverloaded as exc:
+                refused.append(exc)
+            except Exception as exc:  # noqa: BLE001 - the test's point
+                errors.append(exc)
+
+        threads = [
+            threading.Thread(target=submit, args=(i,)) for i in range(n)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(10.0)
+
+        assert not errors, f"untyped failures under overload: {errors}"
+        assert len(accepted) == 4          # the bound, exactly
+        assert len(refused) == n - 4
+        for exc in refused:
+            assert exc.priority in PRIORITIES
+            assert "intake full" in str(exc)
+        gated.gate.set()
+        for d in accepted + [primer]:
+            assert d.done.wait(10.0)
+        co.drain()
+        reg = telemetry.get_registry()
+        assert reg.counter(
+            "admission.rejected.interactive"
+        ).value == n - 4
+
+    def test_batch_sheds_first_eviction(self):
+        """Interactive arrivals against a full queue evict queued BATCH
+        docs (newest first) instead of being refused; the victims get a
+        typed, evicted-flagged ServiceOverloaded."""
+        telemetry.configure(None)
+        gated = _GatedDispatch()
+        co = RequestCoalescer(
+            gated, max_batch=2, linger_s=0.001, max_queue=3
+        )
+        primer = co.submit(_doc(999))
+        deadline = time.monotonic() + 5.0
+        while co.queue_depth() > 0 and time.monotonic() < deadline:
+            time.sleep(0.005)
+
+        victims = [co.submit(_doc(i, "batch")) for i in range(3)]
+        winner = co.submit(_doc(100))      # interactive: evicts a batch
+        assert winner.error_kind is None
+
+        evicted = [v for v in victims if v.done.is_set()]
+        assert len(evicted) == 1
+        assert evicted[0].error_kind == "ServiceOverloaded"
+        assert "batch sheds first" in str(evicted[0].error)
+        assert evicted[0].priority == "batch"
+
+        gated.gate.set()
+        survivors = [v for v in victims if v is not evicted[0]]
+        for d in survivors + [winner, primer]:
+            assert d.done.wait(10.0)
+        co.drain()
+        reg = telemetry.get_registry()
+        assert reg.counter("admission.evicted").value == 1
+
+    def test_batch_never_starved_beyond_weight(self):
+        """With interactive backlog far exceeding capacity, every popped
+        batch still reserves ceil(max_batch * batch_weight) slots for
+        waiting batch docs — priority is a weight, not a starvation."""
+        telemetry.configure(None)
+        gated = _GatedDispatch()
+        co = RequestCoalescer(
+            gated, max_batch=8, linger_s=0.001, max_queue=None,
+            batch_weight=0.25,
+        )
+        primer = co.submit(_doc(999))
+        deadline = time.monotonic() + 5.0
+        while co.queue_depth() > 0 and time.monotonic() < deadline:
+            time.sleep(0.005)
+
+        inter = [co.submit(_doc(i)) for i in range(32)]
+        batch = [co.submit(_doc(100 + i, "batch")) for i in range(4)]
+        gated.gate.set()
+        for d in inter + batch + [primer]:
+            assert d.done.wait(10.0)
+        co.drain()
+        # with share = ceil(8 * 0.25) = 2, the 4 batch docs ride the
+        # first two full batches popped after the primer — 2 per batch,
+        # alongside interactive docs, while 32 interactive still wait
+        mixed = [
+            b for b in gated.batches
+            if any(p == "batch" for _, p in b)
+        ]
+        assert len(mixed) == 2, f"batch share violated: {gated.batches}"
+        for popped in mixed:
+            assert sum(1 for _, p in popped if p == "batch") == 2
+            assert sum(
+                1 for _, p in popped if p != "batch"
+            ) == 6  # batch rode along, it did not monopolize
+
+    def test_concurrent_submit_vs_drain(self):
+        """Submits racing a drain: every document either completes or
+        gets a typed refusal (draining/overloaded) — no hangs, no
+        untyped errors, no document left unanswered."""
+        telemetry.configure(None)
+
+        def slow(batch):
+            time.sleep(0.002)
+            _answer(batch)
+
+        co = RequestCoalescer(slow, max_batch=4, linger_s=0.001)
+        outcomes, errors = [], []
+        stop = threading.Event()
+
+        def submitter(base):
+            i = 0
+            while not stop.is_set() and i < 200:
+                try:
+                    d = co.submit(_doc(base + i))
+                    outcomes.append(d)
+                except (ServiceDraining, ServiceOverloaded):
+                    outcomes.append(None)
+                except Exception as exc:  # noqa: BLE001
+                    errors.append(exc)
+                i += 1
+
+        threads = [
+            threading.Thread(target=submitter, args=(1000 * t,))
+            for t in range(4)
+        ]
+        for t in threads:
+            t.start()
+        time.sleep(0.05)
+        co.drain(timeout=30.0)
+        stop.set()
+        for t in threads:
+            t.join(10.0)
+
+        assert not errors, f"untyped failures during drain: {errors}"
+        accepted = [d for d in outcomes if d is not None]
+        assert accepted, "drain raced away every single submit"
+        for d in accepted:
+            # an ACCEPTED doc is owed an answer even across the drain
+            assert d.done.wait(10.0)
+            assert d.distribution is not None or d.error is not None
+
+    def test_reserve_release_roundtrip(self):
+        """Whole-request reservation: reserve() holds slots that
+        release() gives back; an oversized reservation is refused as
+        one typed unit (the all-or-nothing multi-doc request)."""
+        telemetry.configure(None)
+        gated = _GatedDispatch()
+        co = RequestCoalescer(
+            gated, max_batch=2, linger_s=0.001, max_queue=4
+        )
+        co.reserve(3, DEFAULT_PRIORITY)
+        with pytest.raises(ServiceOverloaded):
+            co.reserve(2, DEFAULT_PRIORITY)
+        co.release(3)
+        co.reserve(4, DEFAULT_PRIORITY)   # freed slots are back
+        co.release(4)
+        gated.gate.set()
+        co.drain()
+
+
+# ---------------------------------------------------------------------------
+# degraded-mode hysteresis (fake clock)
+# ---------------------------------------------------------------------------
+class TestDegradeController:
+    def _controller(self, clock):
+        from spark_text_clustering_tpu.serving.server import (
+            DegradeController,
+        )
+
+        return DegradeController(
+            enter_pressure=0.9, exit_pressure=0.6,
+            enter_seconds=1.0, exit_seconds=3.0, clock=clock,
+        )
+
+    def test_enter_exit_hysteresis_on_fake_clock(self):
+        telemetry.configure(None)
+        now = [0.0]
+        ctl = self._controller(lambda: now[0])
+
+        assert ctl.update(0.95) is False   # onset recorded, dwell unmet
+        now[0] = 0.5
+        assert ctl.update(0.95) is False   # 0.5s < enter_seconds
+        now[0] = 1.1
+        assert ctl.update(0.95) is True    # dwell satisfied: degraded
+        # pressure in the dead band (exit < p < enter) holds the mode
+        now[0] = 2.0
+        assert ctl.update(0.75) is True
+        # below exit, but not yet for exit_seconds
+        now[0] = 3.0
+        assert ctl.update(0.5) is True
+        now[0] = 5.0
+        assert ctl.update(0.5) is True     # 2s < exit_seconds
+        now[0] = 6.1
+        assert ctl.update(0.5) is False    # restored
+        reg = telemetry.get_registry()
+        assert reg.counter("degrade.entered").value == 1
+        assert reg.counter("degrade.exited").value == 1
+
+    def test_blip_below_enter_resets_onset(self):
+        telemetry.configure(None)
+        now = [0.0]
+        ctl = self._controller(lambda: now[0])
+        ctl.update(0.95)
+        now[0] = 0.9
+        ctl.update(0.5)                    # blip: onset cleared
+        now[0] = 1.5
+        assert ctl.update(0.95) is False   # dwell restarts from here
+        now[0] = 2.0
+        assert ctl.update(0.95) is False
+        now[0] = 2.6
+        assert ctl.update(0.95) is True
+
+    def test_band_validation(self):
+        from spark_text_clustering_tpu.serving.server import (
+            DegradeController,
+        )
+
+        with pytest.raises(ValueError):
+            DegradeController(enter_pressure=0.5, exit_pressure=0.5)
+
+
+# ---------------------------------------------------------------------------
+# front-side shedding, 429 propagation, retry budget
+# ---------------------------------------------------------------------------
+class TestFrontOverload:
+    def _router(self, tmp_path, **kw):
+        kw.setdefault("max_pending", 2)
+        kw.setdefault("retry_wait_s", 0.001)
+        kw.setdefault("wait_for_replica_s", 0.5)
+        return FrontRouter(str(tmp_path), **kw)
+
+    def _fake_replica(self):
+        return ReplicaView(
+            index=0, pid=1, spawn_id=1, port=1, state="ready",
+            model_path=None, stamp=None, lease_ts=time.time(),
+        )
+
+    def test_shed_over_watermark_and_batch_sheds_first(self, tmp_path):
+        telemetry.configure(None)
+        router = self._router(tmp_path, max_pending=4)
+        t0 = time.perf_counter()
+        router._shed_check(4, None, t0)           # at the bound: admitted
+        with pytest.raises(FrontOverloaded) as exc:
+            router._shed_check(5, None, t0)
+        assert exc.value.retry_after >= 1.0
+        # batch sheds at HALF the watermark
+        router._shed_check(2, "batch", t0)
+        with pytest.raises(FrontOverloaded):
+            router._shed_check(3, "batch", t0)
+        reg = telemetry.get_registry()
+        assert reg.counter("front.shed_total").value == 2
+        assert reg.counter(
+            "front.request_outcomes.shed"
+        ).value == 2
+
+    def test_armed_front_shed_fault_forces_path(self, tmp_path):
+        telemetry.configure(None)
+        faultinject.configure("front.shed:fail@1")
+        router = self._router(tmp_path)
+        with pytest.raises(FrontOverloaded):
+            router._shed_check(0, None, time.perf_counter())
+
+    def test_replica_429_propagates_without_retry(self, tmp_path):
+        """A replica's typed 429 comes back VERBATIM on the first
+        attempt — never retried onto another replica, Retry-After
+        remembered for the front's own sheds to quote."""
+        telemetry.configure(None)
+        router = self._router(tmp_path)
+        attempts = []
+
+        def fake_forward(r, body, headers):
+            attempts.append(r.index)
+            return 429, b'{"status": "overloaded"}', {
+                "Retry-After": "7", "Content-Type": "application/json",
+            }
+
+        router.pick = lambda stream=None: self._fake_replica()
+        router._forward_once = fake_forward
+        status, payload, headers, idx = router.route(b"{}")
+        assert status == 429
+        assert len(attempts) == 1
+        assert headers.get("Retry-After") == "7"
+        with router._lock:
+            assert router._last_retry_after == 7.0
+        # a front shed now quotes the replica-priced wait
+        with pytest.raises(FrontOverloaded) as exc:
+            router._shed_check(99, None, time.perf_counter())
+        assert exc.value.retry_after == 7.0
+        reg = telemetry.get_registry()
+        assert reg.counter("front.rejected_total").value == 1
+        assert reg.counter(
+            "front.request_outcomes.rejected"
+        ).value == 1
+
+    def test_retry_budget_exhaustion_is_typed(self, tmp_path):
+        """Connection failures burn the per-request retry budget and
+        surface as a TYPED NoReplicaAvailable plus its own counter —
+        not an infinite retry storm against a dying fleet."""
+        telemetry.configure(None)
+        router = self._router(tmp_path, retry_budget=2)
+        attempts = []
+
+        def fake_forward(r, body, headers):
+            attempts.append(1)
+            raise OSError("connection refused")
+
+        router.pick = lambda stream=None: self._fake_replica()
+        router._forward_once = fake_forward
+        with pytest.raises(NoReplicaAvailable):
+            router.route(b"{}")
+        assert len(attempts) == 3          # initial + 2 retries
+        reg = telemetry.get_registry()
+        assert reg.counter(
+            "front.retry_budget_exhausted"
+        ).value == 1
+
+    def test_note_retry_after_parses_and_clamps(self, tmp_path):
+        router = self._router(tmp_path)
+        assert router._note_retry_after({"Retry-After": "9.5"}) == 9.5
+        assert router._note_retry_after({"Retry-After": "junk"}) == 1.0
+        assert router._note_retry_after({}) == 1.0
+        assert router._note_retry_after({"Retry-After": "0.2"}) == 1.0
+
+
+# ---------------------------------------------------------------------------
+# predictive autoscaler
+# ---------------------------------------------------------------------------
+class TestPredictiveAutoscaler:
+    def _scaler(self, **kw):
+        kw.setdefault("min_replicas", 1)
+        kw.setdefault("max_replicas", 4)
+        kw.setdefault("high_rho", 0.8)
+        kw.setdefault("low_rho", 0.3)
+        kw.setdefault("confirm", 2)
+        kw.setdefault("cooldown_seconds", 30.0)
+        return PredictiveAutoscaler(**kw)
+
+    def test_scale_out_after_confirm_streak(self):
+        telemetry.configure(None)
+        sc = self._scaler()
+        est = {"rho": 0.95, "replicas": 2}
+        assert sc.decide(est, 0.0) is None        # streak 1 of 2
+        d = sc.decide(est, 1.0)
+        assert d == {
+            "action": "scale_out", "from": 2, "to": 3,
+            "rho": 0.95, "streak": 2,
+        }
+        reg = telemetry.get_registry()
+        assert reg.counter("autoscale.scale_out").value == 1
+
+    def test_dead_band_resets_streak(self):
+        sc = self._scaler()
+        assert sc.decide({"rho": 0.95, "replicas": 1}, 0.0) is None
+        assert sc.decide({"rho": 0.5, "replicas": 1}, 1.0) is None
+        # the earlier hot tick no longer counts
+        assert sc.decide({"rho": 0.95, "replicas": 1}, 2.0) is None
+        assert sc.decide({"rho": 0.95, "replicas": 1}, 3.0) is not None
+
+    def test_cooldown_gates_consecutive_decisions(self):
+        sc = self._scaler(confirm=1, cooldown_seconds=10.0)
+        est = {"rho": 0.95, "replicas": 1}
+        assert sc.decide(est, 0.0) is not None
+        assert sc.decide(est, 5.0) is None        # inside cooldown
+        assert sc.decide(est, 11.0) is not None
+
+    def test_scale_in_and_clamps(self):
+        sc = self._scaler(confirm=1, cooldown_seconds=0.0)
+        cold = {"rho": 0.1, "replicas": 3}
+        d = sc.decide(cold, 0.0)
+        assert d["action"] == "scale_in" and d["to"] == 2
+        # at the floor: no decision however cold
+        assert sc.decide({"rho": 0.1, "replicas": 1}, 1.0) is None
+        # at the ceiling: no decision however hot
+        assert sc.decide({"rho": 0.99, "replicas": 4}, 2.0) is None
+
+    def test_current_override_and_missing_estimate(self):
+        sc = self._scaler(confirm=1, cooldown_seconds=0.0)
+        assert sc.decide(None, 0.0) is None
+        assert sc.decide({}, 0.0) is None
+        d = sc.decide({"rho": 0.95, "replicas": 1}, 1.0, current=3)
+        assert d["from"] == 3 and d["to"] == 4
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            PredictiveAutoscaler(high_rho=0.3, low_rho=0.3)
+        with pytest.raises(ValueError):
+            PredictiveAutoscaler(min_replicas=3, max_replicas=2)
+
+
+# ---------------------------------------------------------------------------
+# prober: typed 429 outcome + open-loop ramp
+# ---------------------------------------------------------------------------
+class _StubOverloadedHandler:
+    """Factory for a BaseHTTPRequestHandler that always answers /score
+    with a priced 429 (plus a degraded marker) — the prober must read
+    it as 'rejected', not 'failure'."""
+
+    @staticmethod
+    def make():
+        from http.server import BaseHTTPRequestHandler
+
+        class H(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):  # noqa: A003
+                pass
+
+            def do_POST(self):  # noqa: N802
+                length = int(self.headers.get("Content-Length", "0"))
+                self.rfile.read(length)
+                body = json.dumps(
+                    {"error": "intake full", "status": "overloaded"}
+                ).encode()
+                self.send_response(429)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.send_header("Retry-After", "3")
+                self.send_header(DEGRADED_HEADER, "1")
+                self.end_headers()
+                self.wfile.write(body)
+
+        return H
+
+
+class TestProberOverload:
+    @pytest.fixture()
+    def overloaded_front(self):
+        from http.server import ThreadingHTTPServer
+
+        httpd = ThreadingHTTPServer(
+            ("127.0.0.1", 0), _StubOverloadedHandler.make()
+        )
+        httpd.daemon_threads = True
+        t = threading.Thread(target=httpd.serve_forever, daemon=True)
+        t.start()
+        yield httpd.server_address
+        httpd.shutdown()
+
+    def test_429_is_rejected_outcome_not_failure(self, overloaded_front):
+        telemetry.configure(None)
+        host, port = overloaded_front
+        p = Prober(host, port, priority="batch", timeout=5.0)
+        rec = p.probe_once()
+        assert rec["outcome"] == "rejected"
+        assert rec["status"] == 429
+        assert rec["retry_after"] == 3.0
+        assert rec["priority"] == "batch"
+        assert rec["degraded"] is True
+        reg = telemetry.get_registry()
+        assert reg.counter("probe.rejected").value == 1
+        assert reg.counter("probe.failures").value == 0
+
+    def test_run_ramp_is_open_loop_and_tallies(self, overloaded_front):
+        telemetry.configure(None)
+        host, port = overloaded_front
+        p = Prober(host, port, timeout=5.0)
+        summary = p.run_ramp(10, rate=100.0, ramp_to=400.0)
+        assert summary["sent"] == 10
+        assert summary["rejected"] == 10
+        assert summary["failures"] == 0
+        assert summary["degraded"] == 10
+
+
+# ---------------------------------------------------------------------------
+# HTTP-level: typed 429 + Retry-After + degraded header end to end
+# ---------------------------------------------------------------------------
+def _post(port, body, headers=None, timeout=30):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/score",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})},
+    )
+    return urllib.request.urlopen(req, timeout=timeout)
+
+
+class TestServeOverloadHttp:
+    """End-to-end against a real (jax-loaded) replica."""
+
+    def _service(self, models_dir, **kw):
+        from spark_text_clustering_tpu.serving import ScoringService
+
+        kw.setdefault("lemmatize", False)
+        kw.setdefault("max_batch", 8)
+        kw.setdefault("linger_s", 0.002)
+        kw.setdefault("token_buckets", (64, 256))
+        kw.setdefault("model_poll_interval", 0.05)
+        kw.setdefault("watch_model", False)
+        return ScoringService(models_dir, "EN", **kw)
+
+    @pytest.fixture()
+    def models_dir(self, tmp_path):
+        import os
+
+        from spark_text_clustering_tpu.models.base import LDAModel
+        from spark_text_clustering_tpu.models.persistence import (
+            save_model,
+        )
+        from spark_text_clustering_tpu.pipeline import TextPreprocessor
+
+        cands = [
+            f"x{a}{b}"
+            for a in "bcdfgklmnprtvz" for b in "bcdfgklmnprtvz"
+        ]
+        pre = TextPreprocessor(
+            stop_words=frozenset(), lemmatize=False
+        )
+        toks = pre.transform({"texts": [" ".join(cands)]})["tokens"][0]
+        vocab = [c for c in cands if c in set(toks)][:64]
+        rng = np.random.default_rng(0)
+        mdl = LDAModel(
+            lam=rng.random((K, len(vocab))).astype(np.float32) + 0.1,
+            vocab=vocab,
+            alpha=np.full(K, 0.5, np.float32),
+            eta=0.1,
+        )
+        d = str(tmp_path / "models")
+        save_model(mdl, os.path.join(d, "LdaModel_EN_1000"))
+        self._vocab = vocab
+        return d
+
+    def _texts(self, n, seed=7):
+        rng = np.random.default_rng(seed)
+        return [
+            " ".join(
+                rng.choice(self._vocab, size=int(rng.integers(5, 30)))
+            )
+            for _ in range(n)
+        ]
+
+    def test_admission_refusal_is_priced_429(self, models_dir, tmp_path):
+        telemetry.configure(str(tmp_path / "serve.jsonl"))
+        svc = self._service(models_dir)
+        from spark_text_clustering_tpu.serving import make_http_server
+
+        httpd = make_http_server(svc, port=0)
+        port = httpd.server_address[1]
+        t = threading.Thread(target=httpd.serve_forever, daemon=True)
+        t.start()
+        try:
+            faultinject.configure("serve.admit:fail@1")
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                _post(
+                    port,
+                    {"texts": self._texts(1)},
+                    headers={PRIORITY_HEADER: "batch"},
+                )
+            err = exc.value
+            assert err.code == 429
+            ra = err.headers.get("Retry-After")
+            assert ra is not None and int(ra) >= 1
+            doc = json.loads(err.read())
+            assert doc["status"] == "overloaded"
+            assert doc["priority"] == "batch"
+            assert doc["retry_after"] >= 1
+            # the fault consumed: the fleet recovers on the next request
+            with _post(port, {"texts": self._texts(2)}) as resp:
+                assert resp.status == 200
+            reg = telemetry.get_registry()
+            assert reg.counter("serve.rejected").value == 1
+        finally:
+            svc.begin_drain()
+            httpd.shutdown()
+
+    def test_degraded_mode_marks_responses(self, models_dir, tmp_path):
+        """With a hair-trigger controller, sustained dispatches flip
+        degraded mode; responses carry X-STC-Degraded and the per-doc
+        degraded flag until pressure clears."""
+        from spark_text_clustering_tpu.serving import (
+            DegradeController,
+            make_http_server,
+        )
+
+        telemetry.configure(str(tmp_path / "serve.jsonl"))
+        svc = self._service(
+            models_dir,
+            degrade=DegradeController(
+                enter_pressure=-1.0, exit_pressure=-2.0,
+                enter_seconds=0.0, exit_seconds=3600.0,
+            ),
+        )
+        httpd = make_http_server(svc, port=0)
+        port = httpd.server_address[1]
+        t = threading.Thread(target=httpd.serve_forever, daemon=True)
+        t.start()
+        try:
+            saw_degraded = False
+            for i in range(4):
+                with _post(port, {"texts": self._texts(1, seed=i)}) as r:
+                    doc = json.loads(r.read())
+                    if r.headers.get(DEGRADED_HEADER):
+                        saw_degraded = True
+                        assert any(
+                            res.get("degraded")
+                            for res in doc["results"]
+                        )
+            assert saw_degraded
+            assert svc.health()["degraded_mode"] is True
+            reg = telemetry.get_registry()
+            assert reg.counter("degrade.entered").value == 1
+            assert reg.counter("degrade.responses").value >= 1
+        finally:
+            svc.begin_drain()
+            httpd.shutdown()

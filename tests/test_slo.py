@@ -915,7 +915,10 @@ class TestProber:
             rep = p.run(count=3, rate=1000.0)
         finally:
             stub.close()
-        assert rep == {"sent": 3, "failures": 0, "pin_violations": 0}
+        assert rep == {
+            "sent": 3, "failures": 0, "rejected": 0, "degraded": 0,
+            "pin_violations": 0,
+        }
 
     def test_read_front_announce(self, tmp_path):
         from spark_text_clustering_tpu.serving.front import (
